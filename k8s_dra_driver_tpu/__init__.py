@@ -1,0 +1,22 @@
+"""TPU-native Kubernetes Dynamic Resource Allocation (DRA) driver.
+
+A from-scratch re-design of the capabilities of NVIDIA/k8s-dra-driver for TPU
+hardware (reference layer map: SURVEY.md §1).  The package splits the same way
+the reference does — a config API carried opaquely inside ResourceClaims, a
+node-local kubelet plugin, and a cluster-scoped controller — but the internals
+are TPU-idiomatic: chip enumeration through a C++ ``libtpuinfo`` shim over
+``/dev/accel*`` (instead of NVML cgo), MIG-profile partitioning becomes ICI
+subslice-shape geometry, and IMEX-channel pools become multi-host slice
+membership with JAX/libtpu environment injection.
+"""
+
+from k8s_dra_driver_tpu.version import __version__
+
+DRIVER_NAME = "tpu.google.com"
+"""DNS-style driver name used in DeviceClasses, ResourceSlices and CDI kinds.
+
+Mirrors the role of ``gpu.nvidia.com`` in the reference
+(cmd/nvidia-dra-plugin/main.go:36-42).
+"""
+
+__all__ = ["DRIVER_NAME", "__version__"]

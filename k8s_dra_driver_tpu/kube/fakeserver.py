@@ -1,0 +1,178 @@
+"""In-memory Kubernetes API server with watch support.
+
+The reference's controller and plugin talk to a real API server through
+client-go (pkg/flags/kubeclient.go:70-106; informer at
+cmd/nvidia-dra-controller/imex.go:222-295).  This module provides the same
+behavioral surface in-process: CRUD with uid/resourceVersion management,
+optimistic-concurrency conflicts, label-selected lists, and informer-style
+watches (replay of existing objects followed by live ADDED/MODIFIED/DELETED
+events).  It is the test/bench backbone the reference never built (SURVEY.md
+§4.5) and also backs the closed-loop e2e harness.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid as uuidlib
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from k8s_dra_driver_tpu.kube import objects
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class NotFound(APIError):
+    def __init__(self, message: str):
+        super().__init__(404, message)
+
+
+class Conflict(APIError):
+    def __init__(self, message: str):
+        super().__init__(409, message)
+
+
+class AlreadyExists(APIError):
+    def __init__(self, message: str):
+        super().__init__(409, message)
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Any
+
+
+class Watch:
+    def __init__(self, server: "InMemoryAPIServer", kind: str, callback: Callable[[WatchEvent], None]):
+        self._server = server
+        self.kind = kind
+        self.callback = callback
+        self.stopped = False
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._server._remove_watch(self)
+
+
+def _key(obj: Any) -> tuple[str, str, str]:
+    return (type(obj).KIND, obj.metadata.namespace, obj.metadata.name)
+
+
+class InMemoryAPIServer:
+    """Thread-safe in-memory object store with the client surface we need."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str, str], Any] = {}
+        self._rv = 0
+        self._watches: list[Watch] = []
+
+    # -- client surface ----------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            meta = obj.metadata
+            if not meta.name and meta.generate_name:
+                meta.name = meta.generate_name + uuidlib.uuid4().hex[:5]
+            key = _key(obj)
+            if key in self._objects:
+                raise AlreadyExists(f"{key[0]} {key[2]!r} already exists")
+            if not meta.uid:
+                meta.uid = str(uuidlib.uuid4())
+            self._rv += 1
+            meta.resource_version = str(self._rv)
+            stored = objects.deepcopy(obj)
+            self._objects[key] = stored
+            event = WatchEvent("ADDED", objects.deepcopy(stored))
+        self._notify(event)
+        return objects.deepcopy(stored)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return objects.deepcopy(obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+        field_selector: Optional[Callable[[Any], bool]] = None,
+    ) -> list[Any]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and any(
+                    obj.metadata.labels.get(lk) != lv for lk, lv in label_selector.items()
+                ):
+                    continue
+                if field_selector and not field_selector(obj):
+                    continue
+                out.append(objects.deepcopy(obj))
+            return out
+
+    def update(self, obj: Any) -> Any:
+        with self._lock:
+            key = _key(obj)
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFound(f"{key[0]} {key[1]}/{key[2]} not found")
+            if (
+                obj.metadata.resource_version
+                and obj.metadata.resource_version != current.metadata.resource_version
+            ):
+                raise Conflict(
+                    f"{key[0]} {key[2]!r}: resourceVersion {obj.metadata.resource_version} "
+                    f"!= {current.metadata.resource_version}"
+                )
+            self._rv += 1
+            obj.metadata.uid = current.metadata.uid
+            obj.metadata.resource_version = str(self._rv)
+            stored = objects.deepcopy(obj)
+            self._objects[key] = stored
+            event = WatchEvent("MODIFIED", objects.deepcopy(stored))
+        self._notify(event)
+        return objects.deepcopy(stored)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._lock:
+            obj = self._objects.pop((kind, namespace, name), None)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            event = WatchEvent("DELETED", objects.deepcopy(obj))
+        self._notify(event)
+
+    def watch(self, kind: str, callback: Callable[[WatchEvent], None]) -> Watch:
+        """Informer-style: replays existing objects as ADDED, then streams."""
+        with self._lock:
+            existing = [objects.deepcopy(o) for (k, _, _), o in self._objects.items() if k == kind]
+            w = Watch(self, kind, callback)
+            self._watches.append(w)
+        for obj in existing:
+            callback(WatchEvent("ADDED", obj))
+        return w
+
+    # -- internals ---------------------------------------------------------
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _notify(self, event: WatchEvent) -> None:
+        kind = type(event.object).KIND
+        with self._lock:
+            targets = [w for w in self._watches if w.kind == kind and not w.stopped]
+        for w in targets:
+            w.callback(WatchEvent(event.type, objects.deepcopy(event.object)))

@@ -1,0 +1,367 @@
+"""Typed models for the Kubernetes objects this driver touches.
+
+Covers the DRA ``resource.k8s.io/v1beta1`` structured-parameter surface
+(ResourceSlice / DeviceClass / ResourceClaim, as consumed by the reference at
+cmd/nvidia-dra-plugin/device_state.go:193-259 and published at
+cmd/nvidia-dra-controller/imex.go:371-416) plus the core objects the driver
+reads/writes (Node, Pod, Deployment — the last for the per-host topology
+daemon, the analog of the MPS control daemon Deployment render at
+cmd/nvidia-dra-plugin/sharing.go:185-287).
+
+Pod/Deployment specs are deliberately loose (raw dicts) — the driver templates
+them and never introspects deeply.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from k8s_dra_driver_tpu.kube import serde
+
+# ---------------------------------------------------------------------------
+# metav1
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generate_name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    creation_timestamp: str = ""
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In | Exists
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: list[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: list[NodeSelectorTerm] = field(default_factory=list)
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        """True if any term matches (terms are ORed, expressions ANDed)."""
+        for term in self.node_selector_terms:
+            if all(_req_matches(req, labels) for req in term.match_expressions):
+                return True
+        return False
+
+
+def _req_matches(req: NodeSelectorRequirement, labels: dict[str, str]) -> bool:
+    if req.operator == "Exists":
+        return req.key in labels
+    if req.operator == "In":
+        return labels.get(req.key) in req.values
+    raise ValueError(f"unsupported node selector operator {req.operator!r}")
+
+
+# ---------------------------------------------------------------------------
+# resource.k8s.io/v1beta1 — ResourceSlice
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceAttribute:
+    """One-of attribute value (string/int/bool/version)."""
+
+    string: Optional[str] = None
+    int_value: Optional[int] = None
+    bool_value: Optional[bool] = None
+    version: Optional[str] = None
+
+    @property
+    def value(self) -> Any:
+        for v in (self.string, self.int_value, self.bool_value, self.version):
+            if v is not None:
+                return v
+        return None
+
+    @staticmethod
+    def of(value: Any) -> "DeviceAttribute":
+        if isinstance(value, bool):
+            return DeviceAttribute(bool_value=value)
+        if isinstance(value, int):
+            return DeviceAttribute(int_value=value)
+        return DeviceAttribute(string=str(value))
+
+
+# Wire names for DeviceAttribute are `string`, `int`, `bool`, `version`.
+serde._SPECIAL_CAMEL.update({"int_value": "int", "bool_value": "bool"})
+
+
+@dataclass
+class BasicDevice:
+    attributes: dict[str, DeviceAttribute] = field(default_factory=dict)
+    capacity: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Device:
+    name: str = ""
+    basic: BasicDevice = field(default_factory=BasicDevice)
+
+
+@dataclass
+class ResourcePool:
+    name: str = ""
+    generation: int = 0
+    resource_slice_count: int = 1
+
+
+@dataclass
+class ResourceSliceSpec:
+    driver: str = ""
+    pool: ResourcePool = field(default_factory=ResourcePool)
+    node_name: str = ""
+    node_selector: Optional[NodeSelector] = None
+    all_nodes: Optional[bool] = None
+    devices: list[Device] = field(default_factory=list)
+
+
+@dataclass
+class ResourceSlice:
+    KIND = "ResourceSlice"
+    API_VERSION = "resource.k8s.io/v1beta1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceSliceSpec = field(default_factory=ResourceSliceSpec)
+
+
+# ---------------------------------------------------------------------------
+# resource.k8s.io/v1beta1 — DeviceClass
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CELDeviceSelector:
+    expression: str = ""
+
+
+@dataclass
+class DeviceSelector:
+    cel: Optional[CELDeviceSelector] = None
+
+
+@dataclass
+class OpaqueDeviceConfiguration:
+    driver: str = ""
+    parameters: Any = None  # runtime.RawExtension — arbitrary JSON
+
+
+@dataclass
+class DeviceClassConfiguration:
+    opaque: Optional[OpaqueDeviceConfiguration] = None
+
+
+@dataclass
+class DeviceClassSpec:
+    selectors: list[DeviceSelector] = field(default_factory=list)
+    config: list[DeviceClassConfiguration] = field(default_factory=list)
+
+
+@dataclass
+class DeviceClass:
+    KIND = "DeviceClass"
+    API_VERSION = "resource.k8s.io/v1beta1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeviceClassSpec = field(default_factory=DeviceClassSpec)
+
+
+# ---------------------------------------------------------------------------
+# resource.k8s.io/v1beta1 — ResourceClaim
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceRequest:
+    name: str = ""
+    device_class_name: str = ""
+    selectors: list[DeviceSelector] = field(default_factory=list)
+    allocation_mode: str = "ExactCount"  # ExactCount | All
+    count: int = 1
+    admin_access: Optional[bool] = None
+
+
+@dataclass
+class DeviceConstraint:
+    requests: list[str] = field(default_factory=list)
+    match_attribute: str = ""
+
+
+@dataclass
+class DeviceClaimConfiguration:
+    requests: list[str] = field(default_factory=list)
+    opaque: Optional[OpaqueDeviceConfiguration] = None
+
+
+@dataclass
+class DeviceClaim:
+    requests: list[DeviceRequest] = field(default_factory=list)
+    constraints: list[DeviceConstraint] = field(default_factory=list)
+    config: list[DeviceClaimConfiguration] = field(default_factory=list)
+
+
+@dataclass
+class ResourceClaimSpec:
+    devices: DeviceClaim = field(default_factory=DeviceClaim)
+
+
+@dataclass
+class DeviceRequestAllocationResult:
+    request: str = ""
+    driver: str = ""
+    pool: str = ""
+    device: str = ""
+    admin_access: Optional[bool] = None
+
+
+@dataclass
+class DeviceAllocationConfiguration:
+    source: str = ""  # FromClass | FromClaim
+    requests: list[str] = field(default_factory=list)
+    opaque: Optional[OpaqueDeviceConfiguration] = None
+
+
+@dataclass
+class DeviceAllocationResult:
+    results: list[DeviceRequestAllocationResult] = field(default_factory=list)
+    config: list[DeviceAllocationConfiguration] = field(default_factory=list)
+
+
+@dataclass
+class AllocationResult:
+    devices: DeviceAllocationResult = field(default_factory=DeviceAllocationResult)
+    node_selector: Optional[NodeSelector] = None
+
+
+@dataclass
+class ResourceClaimConsumerReference:
+    resource: str = "pods"
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class ResourceClaimStatus:
+    allocation: Optional[AllocationResult] = None
+    reserved_for: list[ResourceClaimConsumerReference] = field(default_factory=list)
+
+
+@dataclass
+class ResourceClaim:
+    KIND = "ResourceClaim"
+    API_VERSION = "resource.k8s.io/v1beta1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+    status: ResourceClaimStatus = field(default_factory=ResourceClaimStatus)
+
+
+@dataclass
+class ResourceClaimTemplate:
+    KIND = "ResourceClaimTemplate"
+    API_VERSION = "resource.k8s.io/v1beta1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Any = None  # {metadata, spec: ResourceClaimSpec-shaped dict}
+
+
+# ---------------------------------------------------------------------------
+# core/v1 + apps/v1 (loosely typed)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Node:
+    KIND = "Node"
+    API_VERSION = "v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Any = None
+    status: Any = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    message: str = ""
+
+
+@dataclass
+class Pod:
+    KIND = "Pod"
+    API_VERSION = "v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Any = field(default_factory=dict)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class Deployment:
+    KIND = "Deployment"
+    API_VERSION = "apps/v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Any = field(default_factory=dict)
+    status: Any = None
+
+
+KINDS = {
+    cls.KIND: cls
+    for cls in (
+        ResourceSlice,
+        DeviceClass,
+        ResourceClaim,
+        ResourceClaimTemplate,
+        Node,
+        Pod,
+        Deployment,
+    )
+}
+
+
+def to_json(obj: Any) -> dict:
+    data = serde.to_json(obj)
+    kind = getattr(type(obj), "KIND", None)
+    if kind:
+        data = {"apiVersion": type(obj).API_VERSION, "kind": kind, **data}
+    return data
+
+
+def from_json(data: dict) -> Any:
+    kind = data.get("kind")
+    if kind not in KINDS:
+        raise ValueError(f"unknown kind {kind!r}")
+    body = {k: v for k, v in data.items() if k not in ("apiVersion", "kind")}
+    return serde.from_json(KINDS[kind], body)
+
+
+def deepcopy(obj: Any) -> Any:
+    """Semantic equivalent of the generated zz_generated.deepcopy.go."""
+    return copy.deepcopy(obj)

@@ -1,0 +1,131 @@
+"""Declarative ResourceSlice reconciler.
+
+Behavioral re-provision of the vendored
+``k8s.io/dynamic-resource-allocation/resourceslice`` controller
+(resourceslicecontroller.go:102-227, SURVEY.md §2.5): the owner declares
+``DriverResources{pools{slices{devices}}}`` and the controller makes the API
+server match — creating, updating (with pool-generation bumps) and deleting
+ResourceSlice objects it owns.  Used by both the kubelet plugin (one node-local
+pool, driver.go:71-83) and the cluster controller (per-slice-domain pools,
+imex.go:112-158).
+
+Reconciliation is synchronous on :meth:`update` — simpler than the upstream
+queue-based version and sufficient because our callers already debounce.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_dra_driver_tpu.kube import objects
+from k8s_dra_driver_tpu.kube.objects import (
+    Device,
+    NodeSelector,
+    ObjectMeta,
+    ResourcePool,
+    ResourceSlice,
+    ResourceSliceSpec,
+)
+
+
+@dataclass
+class Slice:
+    devices: list[Device] = field(default_factory=list)
+
+
+@dataclass
+class Pool:
+    slices: list[Slice] = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Optional[NodeSelector] = None
+    all_nodes: Optional[bool] = None
+    generation: int = 0
+
+
+@dataclass
+class DriverResources:
+    pools: dict[str, Pool] = field(default_factory=dict)
+
+
+class ResourceSliceController:
+    def __init__(self, server, driver_name: str, owner_name: str):
+        """owner_name disambiguates publishers (node name or controller id)."""
+        self._server = server
+        self._driver = driver_name
+        self._owner = owner_name
+        self._lock = threading.Lock()
+        self._resources = DriverResources()
+
+    def update(self, resources: DriverResources) -> None:
+        with self._lock:
+            self._resources = resources
+            self._sync()
+
+    def stop(self, delete_owned: bool = True) -> None:
+        """On shutdown the IMEX manager deletes owned slices (imex.go:298-316)."""
+        if delete_owned:
+            with self._lock:
+                self._resources = DriverResources()
+                self._sync()
+
+    # -- internals ---------------------------------------------------------
+
+    def _slice_name(self, pool_name: str, index: int) -> str:
+        return f"{self._driver}-{self._owner}-{pool_name}-{index}".replace("/", "-")
+
+    def _owned(self) -> list[ResourceSlice]:
+        return [
+            s
+            for s in self._server.list(ResourceSlice.KIND)
+            if s.spec.driver == self._driver
+            and s.metadata.labels.get("dra.tpu.google.com/owner") == self._owner
+        ]
+
+    def _sync(self) -> None:
+        desired: dict[str, ResourceSlice] = {}
+        for pool_name, pool in self._resources.pools.items():
+            for i, sl in enumerate(pool.slices):
+                name = self._slice_name(pool_name, i)
+                desired[name] = ResourceSlice(
+                    metadata=ObjectMeta(
+                        name=name,
+                        labels={"dra.tpu.google.com/owner": self._owner},
+                    ),
+                    spec=ResourceSliceSpec(
+                        driver=self._driver,
+                        pool=ResourcePool(
+                            name=pool_name,
+                            generation=pool.generation,
+                            resource_slice_count=len(pool.slices),
+                        ),
+                        node_name=pool.node_name,
+                        node_selector=pool.node_selector,
+                        all_nodes=pool.all_nodes,
+                        devices=sl.devices,
+                    ),
+                )
+
+        existing = {s.metadata.name: s for s in self._owned()}
+
+        for name, current in existing.items():
+            if name not in desired:
+                self._server.delete(ResourceSlice.KIND, name)
+
+        for name, want in desired.items():
+            current = existing.get(name)
+            if current is None:
+                self._server.create(want)
+                continue
+            # Generation is managed here, not by the caller: adopt the stored
+            # value before diffing so an unchanged pool is a no-op.
+            want.spec.pool.generation = current.spec.pool.generation
+            if objects.to_json(current.spec) != objects.to_json(want.spec):
+                # Content changed: bump pool generation so the scheduler can
+                # prefer the freshest slice of a pool (upstream behavior).
+                want.spec.pool.generation = max(
+                    want.spec.pool.generation, current.spec.pool.generation + 1
+                )
+                current.spec = want.spec
+                self._server.update(current)

@@ -1,0 +1,7 @@
+"""Version stamping (reference: internal/info/version.go:21-43)."""
+
+__version__ = "0.1.0"
+
+
+def version_string() -> str:
+    return f"tpu-dra-driver {__version__}"

@@ -1,0 +1,30 @@
+"""Test bootstrap.
+
+Sharding tests run on a virtual 8-device CPU mesh: the XLA flag must be set
+before the first jax import.  On hosts where a TPU plugin still wins the
+default-backend race, tests explicitly ask for ``jax.devices("cpu")``.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPUINFO_FAKE_TOPOLOGY", "v5e-16")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def api_server():
+    from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+
+    return InMemoryAPIServer()
+
+
+def cpu_devices(n: int):
+    """Return n CPU devices regardless of which backend won the default race."""
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= n, f"need {n} cpu devices, have {len(devs)}"
+    return devs[:n]

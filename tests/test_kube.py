@@ -1,0 +1,204 @@
+"""Tests for the kube object model, fake API server and slice reconciler."""
+
+import pytest
+
+from k8s_dra_driver_tpu.kube import objects
+from k8s_dra_driver_tpu.kube.fakeserver import Conflict, InMemoryAPIServer, NotFound
+from k8s_dra_driver_tpu.kube.objects import (
+    BasicDevice,
+    Device,
+    DeviceAttribute,
+    Node,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    ResourceClaim,
+    ResourceSlice,
+)
+from k8s_dra_driver_tpu.kube.quantity import InvalidQuantity, format_bytes, parse
+from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+    Slice,
+)
+
+
+def make_device(name: str, **attrs) -> Device:
+    return Device(
+        name=name,
+        basic=BasicDevice(attributes={k: DeviceAttribute.of(v) for k, v in attrs.items()}),
+    )
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("16Gi", 16 * 1024**3),
+            ("1500M", 1_500_000_000),
+            ("7", 7),
+            ("0.5Ki", 512),
+        ],
+    )
+    def test_parse(self, s, expected):
+        assert parse(s) == expected
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(InvalidQuantity):
+            parse("12xyz")
+        with pytest.raises(InvalidQuantity):
+            parse("")
+
+    def test_format_roundtrip(self):
+        assert format_bytes(16 * 1024**3) == "16Gi"
+        assert parse(format_bytes(123456789)) == 123456789
+
+
+class TestSerde:
+    def test_resource_slice_roundtrip(self):
+        rs = ResourceSlice(
+            metadata=ObjectMeta(name="s1", labels={"a": "b"}),
+        )
+        rs.spec.driver = "tpu.google.com"
+        rs.spec.node_name = "host0"
+        rs.spec.devices = [make_device("tpu-0", type="tpu", index=3, healthy=True)]
+        data = objects.to_json(rs)
+        assert data["kind"] == "ResourceSlice"
+        assert data["apiVersion"] == "resource.k8s.io/v1beta1"
+        dev = data["spec"]["devices"][0]["basic"]["attributes"]
+        assert dev["type"] == {"string": "tpu"}
+        assert dev["index"] == {"int": 3}
+        assert dev["healthy"] == {"bool": True}
+        back = objects.from_json(data)
+        assert back.spec.devices[0].basic.attributes["index"].value == 3
+        assert back.spec.devices[0].basic.attributes["healthy"].value is True
+        assert objects.to_json(back) == data
+
+    def test_unknown_fields_ignored(self):
+        data = objects.to_json(ResourceClaim(metadata=ObjectMeta(name="c")))
+        data["spec"]["future"] = {"x": 1}
+        back = objects.from_json(data)
+        assert back.metadata.name == "c"
+
+
+class TestNodeSelector:
+    def test_terms_or_expressions_and(self):
+        sel = NodeSelector(
+            node_selector_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(key="domain", values=["d1"]),
+                        NodeSelectorRequirement(key="zone", operator="Exists"),
+                    ]
+                ),
+                NodeSelectorTerm(
+                    match_expressions=[NodeSelectorRequirement(key="domain", values=["d2"])]
+                ),
+            ]
+        )
+        assert sel.matches({"domain": "d1", "zone": "z"})
+        assert not sel.matches({"domain": "d1"})  # second expr fails, term ANDed
+        assert sel.matches({"domain": "d2"})  # second term ORed
+        assert not sel.matches({"domain": "d3"})
+
+
+class TestFakeServer:
+    def test_crud_and_uid_rv(self):
+        s = InMemoryAPIServer()
+        n = s.create(Node(metadata=ObjectMeta(name="host0")))
+        assert n.metadata.uid and n.metadata.resource_version == "1"
+        got = s.get("Node", "host0")
+        assert got.metadata.uid == n.metadata.uid
+        got.metadata.labels["k"] = "v"
+        updated = s.update(got)
+        assert updated.metadata.resource_version != n.metadata.resource_version
+        s.delete("Node", "host0")
+        with pytest.raises(NotFound):
+            s.get("Node", "host0")
+
+    def test_conflict_on_stale_rv(self):
+        s = InMemoryAPIServer()
+        s.create(Node(metadata=ObjectMeta(name="host0")))
+        a = s.get("Node", "host0")
+        b = s.get("Node", "host0")
+        s.update(a)
+        with pytest.raises(Conflict):
+            s.update(b)
+
+    def test_watch_replays_then_streams(self):
+        s = InMemoryAPIServer()
+        s.create(Node(metadata=ObjectMeta(name="host0")))
+        events = []
+        w = s.watch("Node", lambda e: events.append((e.type, e.object.metadata.name)))
+        s.create(Node(metadata=ObjectMeta(name="host1")))
+        s.delete("Node", "host0")
+        assert events == [("ADDED", "host0"), ("ADDED", "host1"), ("DELETED", "host0")]
+        w.stop()
+        s.create(Node(metadata=ObjectMeta(name="host2")))
+        assert len(events) == 3
+
+    def test_label_selected_list(self):
+        s = InMemoryAPIServer()
+        s.create(Node(metadata=ObjectMeta(name="a", labels={"d": "1"})))
+        s.create(Node(metadata=ObjectMeta(name="b", labels={"d": "2"})))
+        assert [n.metadata.name for n in s.list("Node", label_selector={"d": "2"})] == ["b"]
+
+
+class TestResourceSliceController:
+    def test_create_update_delete_cycle(self):
+        s = InMemoryAPIServer()
+        c = ResourceSliceController(s, "tpu.google.com", "host0")
+        c.update(
+            DriverResources(
+                pools={"host0": Pool(slices=[Slice(devices=[make_device("tpu-0")])], node_name="host0")}
+            )
+        )
+        slices = s.list(ResourceSlice.KIND)
+        assert len(slices) == 1
+        assert slices[0].spec.pool.name == "host0"
+        assert slices[0].spec.devices[0].name == "tpu-0"
+
+        # Content change bumps generation in-place.
+        c.update(
+            DriverResources(
+                pools={
+                    "host0": Pool(
+                        slices=[Slice(devices=[make_device("tpu-0"), make_device("tpu-1")])],
+                        node_name="host0",
+                    )
+                }
+            )
+        )
+        slices = s.list(ResourceSlice.KIND)
+        assert len(slices) == 1
+        assert len(slices[0].spec.devices) == 2
+        assert slices[0].spec.pool.generation == 1
+
+        # No-op update does not churn resourceVersion.
+        rv = slices[0].metadata.resource_version
+        c.update(
+            DriverResources(
+                pools={
+                    "host0": Pool(
+                        slices=[Slice(devices=[make_device("tpu-0"), make_device("tpu-1")])],
+                        node_name="host0",
+                    )
+                }
+            )
+        )
+        assert s.list(ResourceSlice.KIND)[0].metadata.resource_version == rv
+
+        c.stop()
+        assert s.list(ResourceSlice.KIND) == []
+
+    def test_does_not_touch_foreign_slices(self):
+        s = InMemoryAPIServer()
+        foreign = ResourceSlice(metadata=ObjectMeta(name="other"))
+        foreign.spec.driver = "gpu.nvidia.com"
+        s.create(foreign)
+        c = ResourceSliceController(s, "tpu.google.com", "host0")
+        c.update(DriverResources(pools={}))
+        c.stop()
+        assert [x.metadata.name for x in s.list(ResourceSlice.KIND)] == ["other"]
