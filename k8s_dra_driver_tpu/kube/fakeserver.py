@@ -88,9 +88,8 @@ class InMemoryAPIServer:
             meta.resource_version = str(self._rv)
             stored = objects.deepcopy(obj)
             self._objects[key] = stored
-            event = WatchEvent("ADDED", objects.deepcopy(stored))
-        self._notify(event)
-        return objects.deepcopy(stored)
+            self._notify(WatchEvent("ADDED", objects.deepcopy(stored)))
+            return objects.deepcopy(stored)
 
     def get(self, kind: str, name: str, namespace: str = "") -> Any:
         with self._lock:
@@ -141,27 +140,29 @@ class InMemoryAPIServer:
             obj.metadata.resource_version = str(self._rv)
             stored = objects.deepcopy(obj)
             self._objects[key] = stored
-            event = WatchEvent("MODIFIED", objects.deepcopy(stored))
-        self._notify(event)
-        return objects.deepcopy(stored)
+            self._notify(WatchEvent("MODIFIED", objects.deepcopy(stored)))
+            return objects.deepcopy(stored)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         with self._lock:
             obj = self._objects.pop((kind, namespace, name), None)
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            event = WatchEvent("DELETED", objects.deepcopy(obj))
-        self._notify(event)
+            self._notify(WatchEvent("DELETED", objects.deepcopy(obj)))
 
     def watch(self, kind: str, callback: Callable[[WatchEvent], None]) -> Watch:
-        """Informer-style: replays existing objects as ADDED, then streams."""
+        """Informer-style: replays existing objects as ADDED, then streams.
+
+        Replay happens under the server lock so a concurrent mutation cannot
+        interleave its event before the replay of older state.
+        """
         with self._lock:
             existing = [objects.deepcopy(o) for (k, _, _), o in self._objects.items() if k == kind]
             w = Watch(self, kind, callback)
             self._watches.append(w)
-        for obj in existing:
-            callback(WatchEvent("ADDED", obj))
-        return w
+            for obj in existing:
+                callback(WatchEvent("ADDED", obj))
+            return w
 
     # -- internals ---------------------------------------------------------
 
@@ -171,8 +172,9 @@ class InMemoryAPIServer:
                 self._watches.remove(w)
 
     def _notify(self, event: WatchEvent) -> None:
+        # Called with the lock held (it is reentrant): delivery order is the
+        # mutation order, and watch() replay cannot race behind a live event.
         kind = type(event.object).KIND
-        with self._lock:
-            targets = [w for w in self._watches if w.kind == kind and not w.stopped]
+        targets = [w for w in self._watches if w.kind == kind and not w.stopped]
         for w in targets:
             w.callback(WatchEvent(event.type, objects.deepcopy(event.object)))

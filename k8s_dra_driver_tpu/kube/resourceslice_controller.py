@@ -84,11 +84,24 @@ class ResourceSliceController:
         ]
 
     def _sync(self) -> None:
-        desired: dict[str, ResourceSlice] = {}
+        existing = {s.metadata.name: s for s in self._owned()}
+        desired_names: set[str] = set()
+
         for pool_name, pool in self._resources.pools.items():
-            for i, sl in enumerate(pool.slices):
+            # Generation is pool-scoped (DRA treats slices below the pool's
+            # max observed generation as stale): compute the desired specs at
+            # the pool's current generation, and if ANY slice of the pool
+            # changed, bump and rewrite the WHOLE pool at generation+1.
+            pool_existing = [
+                s for s in existing.values() if s.spec.pool.name == pool_name
+            ]
+            current_gen = max(
+                (s.spec.pool.generation for s in pool_existing), default=pool.generation
+            )
+
+            def build(i: int, sl: Slice, generation: int) -> ResourceSlice:
                 name = self._slice_name(pool_name, i)
-                desired[name] = ResourceSlice(
+                return ResourceSlice(
                     metadata=ObjectMeta(
                         name=name,
                         labels={"dra.tpu.google.com/owner": self._owner},
@@ -97,7 +110,7 @@ class ResourceSliceController:
                         driver=self._driver,
                         pool=ResourcePool(
                             name=pool_name,
-                            generation=pool.generation,
+                            generation=generation,
                             resource_slice_count=len(pool.slices),
                         ),
                         node_name=pool.node_name,
@@ -107,25 +120,25 @@ class ResourceSliceController:
                     ),
                 )
 
-        existing = {s.metadata.name: s for s in self._owned()}
-
-        for name, current in existing.items():
-            if name not in desired:
-                self._server.delete(ResourceSlice.KIND, name)
-
-        for name, want in desired.items():
-            current = existing.get(name)
-            if current is None:
-                self._server.create(want)
+            want_now = [build(i, sl, current_gen) for i, sl in enumerate(pool.slices)]
+            desired_names.update(w.metadata.name for w in want_now)
+            changed = len(pool_existing) != len(want_now) or any(
+                w.metadata.name not in existing
+                or objects.to_json(existing[w.metadata.name].spec) != objects.to_json(w.spec)
+                for w in want_now
+            )
+            if not changed:
                 continue
-            # Generation is managed here, not by the caller: adopt the stored
-            # value before diffing so an unchanged pool is a no-op.
-            want.spec.pool.generation = current.spec.pool.generation
-            if objects.to_json(current.spec) != objects.to_json(want.spec):
-                # Content changed: bump pool generation so the scheduler can
-                # prefer the freshest slice of a pool (upstream behavior).
-                want.spec.pool.generation = max(
-                    want.spec.pool.generation, current.spec.pool.generation + 1
-                )
-                current.spec = want.spec
-                self._server.update(current)
+            new_gen = current_gen + 1 if pool_existing else current_gen
+            for i, sl in enumerate(pool.slices):
+                want = build(i, sl, new_gen)
+                current = existing.get(want.metadata.name)
+                if current is None:
+                    self._server.create(want)
+                else:
+                    current.spec = want.spec
+                    self._server.update(current)
+
+        for name in existing:
+            if name not in desired_names:
+                self._server.delete(ResourceSlice.KIND, name)

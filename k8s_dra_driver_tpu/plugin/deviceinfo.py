@@ -1,0 +1,234 @@
+"""Device info structs and conversion to ``resourceapi.Device``.
+
+Mirror of cmd/nvidia-dra-plugin/deviceinfo.go:30-223: typed per-kind info with
+canonical names and a ``GetDevice``-style conversion that attaches the
+attributes the DeviceClass/request CEL selectors match on, plus capacity
+markers (the chip-overlap encoding, geometry.py).
+
+Attribute names are published under the driver's domain, e.g.
+``type``, ``uuid``, ``index``, ``productName``, ``tpuTopology``, ``coordX``…
+— the TPU-native analog of productName/brand/architecture/
+cudaComputeCapability (deviceinfo.go:98-223).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu.kube.objects import BasicDevice, Device, DeviceAttribute
+from k8s_dra_driver_tpu.kube.quantity import format_bytes
+from k8s_dra_driver_tpu.plugin.geometry import Subslice, chip_marker
+from k8s_dra_driver_tpu.tpuinfo.binding import ChipInfo, TopologyInfo
+
+DEVICE_TYPE_CHIP = "tpu"
+DEVICE_TYPE_SUBSLICE = "subslice"
+DEVICE_TYPE_MEMBERSHIP = "membership"
+
+_PRODUCT_NAMES = {
+    "v4": "tpu-v4",
+    "v5e": "tpu-v5e",
+    "v5p": "tpu-v5p",
+    "v6e": "tpu-v6e",
+}
+
+
+def chip_device_name(index: int) -> str:
+    """Canonical chip device name (``gpu-%d`` analog, deviceinfo.go:74-78)."""
+    return f"tpu-{index}"
+
+
+@dataclass
+class TpuChipInfo:
+    chip: ChipInfo
+    topology: TopologyInfo
+    # Position of this chip in topology.chips (the host-block row-major order
+    # geometry.Subslice.chip_indices refers to).  Distinct from chip.index,
+    # which is the /dev/accelN number and may be gapped/non-zero-based on real
+    # hosts — overlap markers must use the positional index.
+    local_pos: int = 0
+
+    @property
+    def name(self) -> str:
+        return chip_device_name(self.chip.index)
+
+    @property
+    def uuid(self) -> str:
+        return self.chip.uuid
+
+    def common_attributes(self) -> dict[str, DeviceAttribute]:
+        t = self.topology
+        return {
+            "productName": DeviceAttribute.of(_PRODUCT_NAMES.get(t.generation, t.generation)),
+            "generation": DeviceAttribute.of(t.generation),
+            "tpuTopology": DeviceAttribute.of(t.topology),
+            "hostId": DeviceAttribute.of(t.host_id),
+            "hostCount": DeviceAttribute.of(t.host_count),
+            "driverVersion": DeviceAttribute(version=_semverish(t.driver_version)),
+            "libtpuVersion": DeviceAttribute.of(t.libtpu_version),
+        }
+
+    def get_device(self) -> Device:
+        c = self.chip
+        attrs = {
+            "type": DeviceAttribute.of(DEVICE_TYPE_CHIP),
+            "uuid": DeviceAttribute.of(c.uuid),
+            "index": DeviceAttribute.of(c.index),
+            "coordX": DeviceAttribute.of(c.coords[0]),
+            "coordY": DeviceAttribute.of(c.coords[1]),
+            "coordZ": DeviceAttribute.of(c.coords[2]),
+            "cores": DeviceAttribute.of(c.cores),
+            "pcieAddress": DeviceAttribute.of(c.pci_address),
+            **self.common_attributes(),
+        }
+        capacity = {
+            "hbm": format_bytes(c.hbm_bytes),
+            # Overlap marker shared with every subslice covering this chip.
+            chip_marker(self.local_pos): "1",
+        }
+        return Device(name=self.name, basic=BasicDevice(attributes=attrs, capacity=capacity))
+
+
+@dataclass
+class TpuSubsliceInfo:
+    subslice: Subslice
+    topology: TopologyInfo
+
+    @property
+    def name(self) -> str:
+        return self.subslice.name(self.topology.ndims)
+
+    @property
+    def uuid(self) -> str:
+        # A subslice is identified by its member chips.
+        return "+".join(self.chip_uuids())
+
+    def chip_uuids(self) -> list[str]:
+        # chip_indices are positions into topology.chips (geometry.py).
+        return [self.topology.chips[i].uuid for i in self.subslice.chip_indices]
+
+    def get_device(self) -> Device:
+        s = self.subslice
+        t = self.topology
+        chips = [t.chips[i] for i in s.chip_indices]
+        attrs = {
+            "type": DeviceAttribute.of(DEVICE_TYPE_SUBSLICE),
+            "uuid": DeviceAttribute.of(self.uuid),
+            "shape": DeviceAttribute.of(s.shape_name(t.ndims)),
+            "chipCount": DeviceAttribute.of(s.chip_count),
+            "originX": DeviceAttribute.of(s.origin[0]),
+            "originY": DeviceAttribute.of(s.origin[1]),
+            "originZ": DeviceAttribute.of(s.origin[2]),
+            **TpuChipInfo(chips[0], t).common_attributes(),
+        }
+        capacity = {"hbm": format_bytes(sum(c.hbm_bytes for c in chips))}
+        for i in s.chip_indices:
+            capacity[chip_marker(i)] = "1"
+        return Device(name=self.name, basic=BasicDevice(attributes=attrs, capacity=capacity))
+
+
+@dataclass
+class SliceMembershipInfo:
+    """One multi-host slice-membership seat (IMEX-channel analog).
+
+    Published by the cluster controller per slice domain
+    (cmd/nvidia-dra-controller/imex.go:371-416's channel pool), claimed by
+    pods that need a worker id + coordinator wiring on that slice.
+    """
+
+    domain: str
+    worker_id: int
+    host_count: int = 0
+    coordinator_address: str = ""
+
+    @property
+    def name(self) -> str:
+        return f"membership-{self.worker_id}"
+
+    @property
+    def uuid(self) -> str:
+        return f"{self.domain}/worker-{self.worker_id}"
+
+    def get_device(self) -> Device:
+        attrs = {
+            "type": DeviceAttribute.of(DEVICE_TYPE_MEMBERSHIP),
+            "uuid": DeviceAttribute.of(self.uuid),
+            "sliceDomain": DeviceAttribute.of(self.domain),
+            "workerId": DeviceAttribute.of(self.worker_id),
+            "hostCount": DeviceAttribute.of(self.host_count),
+            "coordinatorAddress": DeviceAttribute.of(self.coordinator_address),
+        }
+        return Device(name=self.name, basic=BasicDevice(attributes=attrs))
+
+
+def _semverish(version: str) -> str:
+    """Coerce free-form driver versions into the semver the `version`
+    attribute type requires (deviceinfo.go stamps driverVersion similarly)."""
+    digits = [p for p in version.replace("-", ".").split(".") if p.isdigit()]
+    while len(digits) < 3:
+        digits.append("0")
+    return ".".join(digits[:3])
+
+
+@dataclass
+class AllocatableDevice:
+    """Tagged union over publishable device kinds
+    (cmd/nvidia-dra-plugin/allocatable.go:25-108)."""
+
+    chip: TpuChipInfo | None = None
+    subslice: TpuSubsliceInfo | None = None
+    membership: SliceMembershipInfo | None = None
+
+    @property
+    def kind(self) -> str:
+        if self.chip is not None:
+            return DEVICE_TYPE_CHIP
+        if self.subslice is not None:
+            return DEVICE_TYPE_SUBSLICE
+        if self.membership is not None:
+            return DEVICE_TYPE_MEMBERSHIP
+        raise ValueError("empty AllocatableDevice")
+
+    @property
+    def impl(self):
+        return self.chip or self.subslice or self.membership
+
+    @property
+    def name(self) -> str:
+        return self.impl.name
+
+    def uuids(self) -> list[str]:
+        if self.subslice is not None:
+            return self.subslice.chip_uuids()
+        return [self.impl.uuid]
+
+    def get_device(self) -> Device:
+        return self.impl.get_device()
+
+
+@dataclass
+class AllocatableDevices:
+    """Name-indexed collection of everything this node publishes."""
+
+    devices: dict[str, AllocatableDevice] = field(default_factory=dict)
+
+    @staticmethod
+    def from_topology(topology: TopologyInfo) -> "AllocatableDevices":
+        from k8s_dra_driver_tpu.plugin.geometry import enumerate_subslices
+
+        out: dict[str, AllocatableDevice] = {}
+        for pos, chip in enumerate(topology.chips):
+            info = TpuChipInfo(chip, topology, local_pos=pos)
+            out[info.name] = AllocatableDevice(chip=info)
+        for sub in enumerate_subslices(topology):
+            info = TpuSubsliceInfo(sub, topology)
+            out[info.name] = AllocatableDevice(subslice=info)
+        return AllocatableDevices(out)
+
+    def __iter__(self):
+        return iter(self.devices.values())
+
+    def __len__(self):
+        return len(self.devices)
+
+    def get_devices(self) -> list[Device]:
+        return [d.get_device() for d in self]

@@ -1,0 +1,152 @@
+"""ctypes binding for the C++ ``libtpuinfo`` shim.
+
+Counterpart of the reference's deviceLib wrapper around NVML
+(cmd/nvidia-dra-plugin/nvlib.go:40-72): load the native library, enumerate,
+expose typed results.  The shared object is built on demand with g++ so tests
+and air-gapped hosts need no pre-built artifact.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_CPP_DIR = Path(__file__).parent / "cpp"
+_LOCK = threading.Lock()
+
+
+class TpuInfoError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    index: int
+    device_path: str
+    uuid: str
+    coords: tuple[int, int, int]
+    hbm_bytes: int
+    cores: int
+    pci_address: str
+
+
+@dataclass(frozen=True)
+class TopologyInfo:
+    mode: str
+    generation: str
+    topology: str
+    ndims: int
+    dims: tuple[int, int, int]
+    wrap: tuple[bool, bool, bool]
+    host_bounds: tuple[int, int, int]
+    chips_per_host: int
+    host_count: int
+    host_id: int
+    worker_hostnames: tuple[str, ...]
+    driver_version: str
+    libtpu_version: str
+    chips: tuple[ChipInfo, ...] = field(default_factory=tuple)
+
+    @property
+    def total_chips(self) -> int:
+        return self.dims[0] * self.dims[1] * self.dims[2]
+
+
+def _build(sanitize: bool = False) -> Path:
+    target = "libtpuinfo_asan.so" if sanitize else "libtpuinfo.so"
+    so = _CPP_DIR / target
+    src = _CPP_DIR / "tpuinfo.cc"
+    if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+        return so
+    result = subprocess.run(
+        ["make", "-C", str(_CPP_DIR), target],
+        capture_output=True,
+        text=True,
+    )
+    if result.returncode != 0:
+        raise TpuInfoError(f"building {target} failed:\n{result.stderr}")
+    return so
+
+
+_lib: ctypes.CDLL | None = None
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _LOCK:
+        if _lib is None:
+            lib = ctypes.CDLL(str(_build()))
+            lib.tpuinfo_enumerate.argtypes = [ctypes.POINTER(ctypes.c_char_p)]
+            lib.tpuinfo_enumerate.restype = ctypes.c_int
+            lib.tpuinfo_free.argtypes = [ctypes.c_char_p]
+            lib.tpuinfo_version.restype = ctypes.c_char_p
+            _lib = lib
+    return _lib
+
+
+def library_version() -> str:
+    return load().tpuinfo_version().decode()
+
+
+def enumerate_topology(env: dict[str, str] | None = None) -> TopologyInfo:
+    """Enumerate the local host's chips and slice topology.
+
+    ``env`` overrides (TPUINFO_FAKE_TOPOLOGY etc.) are applied to the process
+    environment for the duration of the native call — the shim reads getenv.
+    """
+    lib = load()
+    out = ctypes.c_char_p()
+    with _LOCK:
+        saved: dict[str, str | None] = {}
+        if env:
+            for k, v in env.items():
+                saved[k] = os.environ.get(k)
+                os.environ[k] = v
+        try:
+            rc = lib.tpuinfo_enumerate(ctypes.byref(out))
+            raw = ctypes.string_at(out).decode()
+        finally:
+            # ctypes copied the bytes; release the native buffer.
+            lib.tpuinfo_free(out)
+            if env:
+                for k, old in saved.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+    if rc != 0:
+        raise TpuInfoError(raw)
+    data = json.loads(raw)
+    chips = tuple(
+        ChipInfo(
+            index=c["index"],
+            device_path=c["device_path"],
+            uuid=c["uuid"],
+            coords=tuple(c["coords"]),
+            hbm_bytes=c["hbm_bytes"],
+            cores=c["cores"],
+            pci_address=c["pci_address"],
+        )
+        for c in data["chips"]
+    )
+    return TopologyInfo(
+        mode=data["mode"],
+        generation=data["generation"],
+        topology=data["topology"],
+        ndims=data["ndims"],
+        dims=tuple(data["dims"]),
+        wrap=tuple(data["wrap"]),
+        host_bounds=tuple(data["host_bounds"]),
+        chips_per_host=data["chips_per_host"],
+        host_count=data["host_count"],
+        host_id=data["host_id"],
+        worker_hostnames=tuple(data["worker_hostnames"]),
+        driver_version=data["driver_version"],
+        libtpu_version=data["libtpu_version"],
+        chips=chips,
+    )

@@ -1,0 +1,409 @@
+// libtpuinfo implementation.  See tpuinfo.h for the ABI contract.
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <array>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+
+struct Chip {
+  int index = 0;
+  std::string device_path;
+  std::string uuid;
+  std::array<int, 3> coords{0, 0, 0};
+  int64_t hbm_bytes = 0;
+  int cores = 1;
+  std::string pci_address;
+};
+
+struct Topology {
+  std::string mode;        // "fake" | "real"
+  std::string generation;  // v5e, v4, v5p, v6e
+  std::string topology;    // "4x4" or "2x2x2"
+  std::array<int, 3> dims{1, 1, 1};
+  int ndims = 2;
+  std::array<bool, 3> wrap{false, false, false};
+  std::array<int, 3> host_bounds{1, 1, 1};  // chips per host along each dim
+  int chips_per_host = 0;
+  int host_count = 1;
+  int host_id = 0;
+  std::vector<std::string> worker_hostnames;
+  std::vector<Chip> chips;  // local host's chips only
+  std::string driver_version = "accel-1.0";
+  std::string libtpu_version = "unknown";
+};
+
+struct GenSpec {
+  int ndims;
+  int64_t hbm_bytes;
+  int cores;
+  // Chips per host along each dim when the slice spans multiple hosts.
+  std::array<int, 3> host_bounds;
+};
+
+const std::map<std::string, GenSpec>& gen_specs() {
+  static const std::map<std::string, GenSpec> specs = {
+      // v5e/v6e: 2D ICI mesh, 16 GiB HBM, 1 TensorCore per chip, 2x2 hosts.
+      {"v5e", {2, 16LL << 30, 1, {2, 2, 1}}},
+      {"v6e", {2, 32LL << 30, 1, {2, 2, 1}}},
+      // v4/v5p: 3D torus, 32/95 GiB HBM, 2 TensorCores per chip, 2x2x1 hosts.
+      {"v4", {3, 32LL << 30, 2, {2, 2, 1}}},
+      {"v5p", {3, 95LL << 30, 2, {2, 2, 1}}},
+  };
+  return specs;
+}
+
+// Smallest standard topology for `chips` chips of a generation.  2D shapes
+// follow the v5e product matrix (1x1, 2x2, 2x4, 4x4, 4x8, 8x8, 8x16, 16x16);
+// 3D shapes follow v4/v5p cubes-then-doubling.
+bool shape_for(const std::string& gen, int chips, std::array<int, 3>* dims) {
+  const auto& spec = gen_specs().at(gen);
+  if (spec.ndims == 2) {
+    static const std::array<std::array<int, 2>, 8> shapes = {{
+        {1, 1}, {2, 2}, {2, 4}, {4, 4}, {4, 8}, {8, 8}, {8, 16}, {16, 16},
+    }};
+    for (const auto& s : shapes) {
+      if (s[0] * s[1] == chips) {
+        *dims = {s[0], s[1], 1};
+        return true;
+      }
+    }
+    return false;
+  }
+  static const std::array<std::array<int, 3>, 8> shapes = {{
+      {1, 1, 1}, {2, 2, 1}, {2, 2, 2}, {2, 2, 4},
+      {2, 4, 4}, {4, 4, 4}, {4, 4, 8}, {4, 8, 8},
+  }};
+  for (const auto& s : shapes) {
+    if (s[0] * s[1] * s[2] == chips) {
+      *dims = s;
+      return true;
+    }
+  }
+  return false;
+}
+
+std::string getenv_str(const char* name) {
+  const char* v = std::getenv(name);
+  return v ? std::string(v) : std::string();
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+// FNV-1a over identifying fields; gives stable, unique-enough device UUIDs.
+std::string make_uuid(const std::string& gen, int host_id, int index) {
+  std::string key = gen + ":" + std::to_string(host_id) + ":" + std::to_string(index);
+  uint64_t h = 1469598103934665603ULL;
+  for (char c : key) {
+    h ^= static_cast<uint8_t>(c);
+    h *= 1099511628211ULL;
+  }
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "tpu-%s-%d-%d-%08llx", gen.c_str(), host_id, index,
+                static_cast<unsigned long long>(h & 0xffffffffULL));
+  return buf;
+}
+
+// Parse "v5e-16" -> (gen, chips).  Also accepts explicit topology "v4-2x2x2".
+bool parse_fake_spec(const std::string& spec, std::string* gen, int* chips,
+                     std::array<int, 3>* dims, bool* have_dims) {
+  auto dash = spec.find('-');
+  if (dash == std::string::npos) return false;
+  *gen = spec.substr(0, dash);
+  if (!gen_specs().count(*gen)) return false;
+  std::string rest = spec.substr(dash + 1);
+  if (rest.find('x') != std::string::npos) {
+    std::array<int, 3> d{1, 1, 1};
+    int i = 0;
+    std::stringstream ss(rest);
+    std::string part;
+    while (std::getline(ss, part, 'x')) {
+      if (i >= 3 || part.empty()) return false;
+      d[i++] = std::atoi(part.c_str());
+    }
+    *dims = d;
+    *have_dims = true;
+    *chips = d[0] * d[1] * d[2];
+    return *chips > 0;
+  }
+  *chips = std::atoi(rest.c_str());
+  *have_dims = false;
+  return *chips > 0;
+}
+
+void finish_topology(Topology* t) {
+  const auto& spec = gen_specs().at(t->generation);
+  t->ndims = spec.ndims;
+  int total = t->dims[0] * t->dims[1] * t->dims[2];
+
+  // Single-host slices keep every chip local; multi-host slices partition the
+  // mesh into host_bounds blocks (v5e: 2x2 chips/host; v4: 2x2x1).
+  if (total <= (t->generation == "v5e" || t->generation == "v6e" ? 8 : 4)) {
+    t->host_bounds = t->dims;
+    t->chips_per_host = total;
+    t->host_count = 1;
+  } else {
+    t->host_bounds = spec.host_bounds;
+    t->chips_per_host = spec.host_bounds[0] * spec.host_bounds[1] * spec.host_bounds[2];
+    t->host_count = total / t->chips_per_host;
+  }
+
+  // ICI wrap-around exists on 3D-torus generations when a dimension spans the
+  // full pod axis; approximation: wrap any 3D dim >= 4 (documented heuristic).
+  for (int i = 0; i < 3; i++) {
+    t->wrap[i] = (spec.ndims == 3 && t->dims[i] >= 4);
+  }
+
+  std::ostringstream topo;
+  for (int i = 0; i < t->ndims; i++) {
+    if (i) topo << "x";
+    topo << t->dims[i];
+  }
+  t->topology = topo.str();
+}
+
+// Host blocks are laid out row-major over the mesh-of-hosts; chips within a
+// host are row-major within the block.  Local chip coords are global.
+void add_local_chips(Topology* t, const std::string& dev_prefix) {
+  std::array<int, 3> hosts_per_dim;
+  for (int i = 0; i < 3; i++) hosts_per_dim[i] = t->dims[i] / t->host_bounds[i];
+  int hid = t->host_id;
+  std::array<int, 3> host_coord;
+  host_coord[2] = hid / (hosts_per_dim[0] * hosts_per_dim[1]);
+  int rem = hid % (hosts_per_dim[0] * hosts_per_dim[1]);
+  host_coord[1] = rem / hosts_per_dim[0];
+  host_coord[0] = rem % hosts_per_dim[0];
+
+  const auto& spec = gen_specs().at(t->generation);
+  int index = 0;
+  for (int z = 0; z < t->host_bounds[2]; z++) {
+    for (int y = 0; y < t->host_bounds[1]; y++) {
+      for (int x = 0; x < t->host_bounds[0]; x++) {
+        Chip c;
+        c.index = index;
+        c.device_path = dev_prefix + std::to_string(index);
+        c.coords = {host_coord[0] * t->host_bounds[0] + x,
+                    host_coord[1] * t->host_bounds[1] + y,
+                    host_coord[2] * t->host_bounds[2] + z};
+        c.hbm_bytes = spec.hbm_bytes;
+        c.cores = spec.cores;
+        c.uuid = make_uuid(t->generation, hid, index);
+        char pci[32];
+        std::snprintf(pci, sizeof(pci), "0000:00:%02x.0", 4 + index);
+        c.pci_address = pci;
+        t->chips.push_back(c);
+        index++;
+      }
+    }
+  }
+}
+
+int enumerate_fake(Topology* t, std::string* err) {
+  std::string spec = getenv_str("TPUINFO_FAKE_TOPOLOGY");
+  std::string gen;
+  int chips = 0;
+  std::array<int, 3> dims{1, 1, 1};
+  bool have_dims = false;
+  if (!parse_fake_spec(spec, &gen, &chips, &dims, &have_dims)) {
+    *err = "invalid TPUINFO_FAKE_TOPOLOGY: " + spec;
+    return 1;
+  }
+  t->mode = "fake";
+  t->generation = gen;
+  if (!have_dims && !shape_for(gen, chips, &dims)) {
+    *err = "no standard " + gen + " topology with " + std::to_string(chips) + " chips";
+    return 1;
+  }
+  t->dims = dims;
+  finish_topology(t);
+
+  std::string hid = getenv_str("TPUINFO_FAKE_HOST_ID");
+  t->host_id = hid.empty() ? 0 : std::atoi(hid.c_str());
+  if (t->host_id < 0 || t->host_id >= t->host_count) {
+    *err = "TPUINFO_FAKE_HOST_ID out of range";
+    return 1;
+  }
+  for (int i = 0; i < t->host_count; i++) {
+    t->worker_hostnames.push_back("tpu-host-" + std::to_string(i));
+  }
+  t->libtpu_version = "fake-" + std::string(kVersion);
+  add_local_chips(t, "/dev/accel");
+  return 0;
+}
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return "";
+  std::string s;
+  std::getline(f, s);
+  return s;
+}
+
+int enumerate_real(Topology* t, std::string* err) {
+  // Scan /dev for accelN device nodes.
+  std::vector<int> indices;
+  if (DIR* d = opendir("/dev")) {
+    while (dirent* e = readdir(d)) {
+      std::string name = e->d_name;
+      if (name.rfind("accel", 0) == 0 && name.size() > 5) {
+        bool digits = true;
+        for (size_t i = 5; i < name.size(); i++) {
+          if (!isdigit(name[i])) digits = false;
+        }
+        if (digits) indices.push_back(std::atoi(name.c_str() + 5));
+      }
+    }
+    closedir(d);
+  }
+  if (indices.empty()) {
+    *err = "no /dev/accel* device nodes found";
+    return 1;
+  }
+  std::sort(indices.begin(), indices.end());
+
+  t->mode = "real";
+  // Accelerator type from the runtime env (GKE TPU nodepools export these) —
+  // e.g. TPU_ACCELERATOR_TYPE=v5litepod-16, TPU_TOPOLOGY=4x4.
+  std::string acc = getenv_str("TPU_ACCELERATOR_TYPE");
+  if (acc.rfind("v5lite", 0) == 0) t->generation = "v5e";
+  else if (acc.rfind("v5p", 0) == 0) t->generation = "v5p";
+  else if (acc.rfind("v6e", 0) == 0) t->generation = "v6e";
+  else if (acc.rfind("v4", 0) == 0) t->generation = "v4";
+  else t->generation = "v5e";  // conservative default for unknown parts
+
+  std::string topo_env = getenv_str("TPU_TOPOLOGY");
+  std::array<int, 3> dims{1, 1, 1};
+  bool have_dims = false;
+  if (!topo_env.empty()) {
+    std::string gen_ignored;
+    int chips_ignored;
+    have_dims = parse_fake_spec(t->generation + "-" + topo_env, &gen_ignored,
+                                &chips_ignored, &dims, &have_dims) && have_dims;
+  }
+  if (!have_dims && !shape_for(t->generation, static_cast<int>(indices.size()), &dims)) {
+    dims = {static_cast<int>(indices.size()), 1, 1};  // linear fallback
+  }
+  t->dims = dims;
+  finish_topology(t);
+
+  std::string wid = getenv_str("TPU_WORKER_ID");
+  t->host_id = wid.empty() ? 0 : std::atoi(wid.c_str());
+  if (t->host_id < 0 || t->host_id >= t->host_count) {
+    *err = "TPU_WORKER_ID " + wid + " out of range for " +
+           std::to_string(t->host_count) + " host(s)";
+    return 1;
+  }
+  std::string hostnames = getenv_str("TPU_WORKER_HOSTNAMES");
+  if (!hostnames.empty()) {
+    std::stringstream ss(hostnames);
+    std::string h;
+    while (std::getline(ss, h, ',')) t->worker_hostnames.push_back(h);
+  }
+  add_local_chips(t, "/dev/accel");
+  // Overwrite synthetic per-chip facts with sysfs truth where available.
+  for (size_t i = 0; i < t->chips.size() && i < indices.size(); i++) {
+    Chip& c = t->chips[i];
+    c.index = indices[i];
+    c.device_path = "/dev/accel" + std::to_string(indices[i]);
+    std::string sys = "/sys/class/accel/accel" + std::to_string(indices[i]) + "/device/";
+    std::string pci = read_file(sys + "uevent");
+    auto pos = pci.find("PCI_SLOT_NAME=");
+    if (pos != std::string::npos) {
+      c.pci_address = pci.substr(pos + 14, 12);
+    }
+  }
+  t->driver_version = read_file("/sys/module/tpu/version");
+  if (t->driver_version.empty()) t->driver_version = "accel-unknown";
+  return 0;
+}
+
+std::string to_json(const Topology& t) {
+  std::ostringstream o;
+  o << "{";
+  o << "\"mode\":\"" << t.mode << "\",";
+  o << "\"generation\":\"" << t.generation << "\",";
+  o << "\"topology\":\"" << t.topology << "\",";
+  o << "\"ndims\":" << t.ndims << ",";
+  o << "\"dims\":[" << t.dims[0] << "," << t.dims[1] << "," << t.dims[2] << "],";
+  o << "\"wrap\":[" << (t.wrap[0] ? "true" : "false") << ","
+    << (t.wrap[1] ? "true" : "false") << "," << (t.wrap[2] ? "true" : "false") << "],";
+  o << "\"host_bounds\":[" << t.host_bounds[0] << "," << t.host_bounds[1] << ","
+    << t.host_bounds[2] << "],";
+  o << "\"chips_per_host\":" << t.chips_per_host << ",";
+  o << "\"host_count\":" << t.host_count << ",";
+  o << "\"host_id\":" << t.host_id << ",";
+  o << "\"worker_hostnames\":[";
+  for (size_t i = 0; i < t.worker_hostnames.size(); i++) {
+    if (i) o << ",";
+    o << "\"" << json_escape(t.worker_hostnames[i]) << "\"";
+  }
+  o << "],";
+  o << "\"driver_version\":\"" << json_escape(t.driver_version) << "\",";
+  o << "\"libtpu_version\":\"" << json_escape(t.libtpu_version) << "\",";
+  o << "\"chips\":[";
+  for (size_t i = 0; i < t.chips.size(); i++) {
+    const Chip& c = t.chips[i];
+    if (i) o << ",";
+    o << "{\"index\":" << c.index << ",\"device_path\":\"" << json_escape(c.device_path)
+      << "\",\"uuid\":\"" << c.uuid << "\",\"coords\":[" << c.coords[0] << ","
+      << c.coords[1] << "," << c.coords[2] << "],\"hbm_bytes\":" << c.hbm_bytes
+      << ",\"cores\":" << c.cores << ",\"pci_address\":\"" << json_escape(c.pci_address)
+      << "\"}";
+  }
+  o << "]}";
+  return o.str();
+}
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_enumerate(char** json_out) {
+  Topology t;
+  std::string err;
+  int rc;
+  if (!getenv_str("TPUINFO_FAKE_TOPOLOGY").empty()) {
+    rc = enumerate_fake(&t, &err);
+  } else {
+    rc = enumerate_real(&t, &err);
+  }
+  *json_out = dup_string(rc == 0 ? to_json(t) : err);
+  return rc;
+}
+
+void tpuinfo_free(char* p) { std::free(p); }
+
+const char* tpuinfo_version(void) { return kVersion; }
+
+}  // extern "C"

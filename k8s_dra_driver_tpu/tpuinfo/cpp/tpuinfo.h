@@ -1,0 +1,39 @@
+/* libtpuinfo — C ABI for TPU chip/topology enumeration.
+ *
+ * TPU-native replacement for the NVML boundary the reference reaches through
+ * cgo (cmd/nvidia-dra-plugin/nvlib.go:48-72 loads libnvidia-ml.so.1; SURVEY.md
+ * §2.9 mandates a first-party C++ shim here).  Two modes:
+ *
+ *   real — enumerate /dev/accel* + /sys/class/accel, fold in the TPU runtime
+ *          environment (TPU_ACCELERATOR_TYPE / TPU_TOPOLOGY / TPU_WORKER_ID /
+ *          TPU_WORKER_HOSTNAMES as published on GKE TPU nodepools).
+ *   fake — synthetic topology selected by TPUINFO_FAKE_TOPOLOGY (e.g.
+ *          "v5e-16", "v4-16"), local host by TPUINFO_FAKE_HOST_ID.  This is
+ *          the hardware-free test backbone (SURVEY.md §4.5).
+ *
+ * The result crosses the ABI as a single JSON document: the enumeration logic
+ * lives in C++; Python only parses.
+ */
+
+#ifndef TPUINFO_H_
+#define TPUINFO_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Enumerate the local host's TPU chips and slice topology.
+ * On success returns 0 and sets *json_out to a malloc'd JSON string the
+ * caller must release with tpuinfo_free().  On failure returns nonzero and
+ * sets *json_out to a malloc'd error message (also to be freed). */
+int tpuinfo_enumerate(char** json_out);
+
+void tpuinfo_free(char* p);
+
+const char* tpuinfo_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUINFO_H_ */

@@ -1,0 +1,185 @@
+"""Config API tests.
+
+Mirrors (behaviorally, not textually) the reference's only unit test file —
+the table-driven MPS limit-normalization test (sharing_test.go:28-160) — and
+extends coverage to the strict decoder and validation, per SURVEY.md §4's
+"do better" mandate.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.api import (
+    API_VERSION,
+    Decoder,
+    DecodeError,
+    ErrInvalidDeviceSelector,
+    ErrInvalidLimit,
+    HbmLimits,
+    SharingStrategy,
+    SpatialPartitionConfig,
+    TimeSliceInterval,
+    TpuConfig,
+    TpuSharing,
+    default_tpu_config,
+)
+from k8s_dra_driver_tpu.api.tpuconfig import SliceMembershipConfig, SubsliceConfig
+
+UUIDS = ["tpu-aaaa", "tpu-bbbb", "tpu-cccc"]
+
+
+class TestHbmLimitNormalize:
+    @pytest.mark.parametrize(
+        "limits,expected",
+        [
+            ({}, {}),
+            ({"*": "4Gi"}, {u: "4096Mi" for u in UUIDS}),
+            ({"0": "1Gi"}, {"tpu-aaaa": "1024Mi"}),
+            ({"2": "2048Mi"}, {"tpu-cccc": "2048Mi"}),
+            ({"tpu-bbbb": "512Mi"}, {"tpu-bbbb": "512Mi"}),
+            # explicit key beats wildcard regardless of iteration order
+            (
+                {"*": "1Gi", "tpu-aaaa": "2Gi"},
+                {"tpu-aaaa": "2048Mi", "tpu-bbbb": "1024Mi", "tpu-cccc": "1024Mi"},
+            ),
+            (
+                {"tpu-aaaa": "2Gi", "*": "1Gi"},
+                {"tpu-aaaa": "2048Mi", "tpu-bbbb": "1024Mi", "tpu-cccc": "1024Mi"},
+            ),
+            # decimal suffixes convert to binary-MiB strings (floored)
+            ({"1": "1500M"}, {"tpu-bbbb": "1430Mi"}),
+            ({"0": "1Mi"}, {"tpu-aaaa": "1Mi"}),
+        ],
+    )
+    def test_normalize(self, limits, expected):
+        assert HbmLimits(limits).normalize(UUIDS) == expected
+
+    @pytest.mark.parametrize(
+        "limits,err",
+        [
+            ({"3": "1Gi"}, ErrInvalidDeviceSelector),  # index out of range
+            ({"tpu-zzzz": "1Gi"}, ErrInvalidDeviceSelector),  # unknown uuid
+            ({"-1": "1Gi"}, ErrInvalidDeviceSelector),
+            ({"0": "512Ki"}, ErrInvalidLimit),  # below 1Mi floor
+            ({"0": "banana"}, ErrInvalidLimit),
+            ({"0": ""}, ErrInvalidLimit),
+        ],
+    )
+    def test_errors(self, limits, err):
+        with pytest.raises(err):
+            HbmLimits(limits).normalize(UUIDS)
+
+
+class TestSharingValidation:
+    def test_default_config_is_exclusive(self):
+        cfg = default_tpu_config()
+        assert cfg.sharing.strategy == SharingStrategy.EXCLUSIVE
+        cfg.validate()
+
+    def test_timeslicing_normalize_fills_interval(self):
+        s = TpuSharing(strategy=SharingStrategy.TIME_SLICING)
+        s.normalize()
+        assert s.time_slicing_config.interval == TimeSliceInterval.DEFAULT
+        assert s.get_time_slicing_config().interval.level() == 0
+        s.validate()
+
+    def test_mutually_exclusive_configs(self):
+        s = TpuSharing(
+            strategy=SharingStrategy.EXCLUSIVE,
+            spatial_partition_config=SpatialPartitionConfig(),
+        )
+        with pytest.raises(ValueError, match="spatialPartitionConfig"):
+            s.validate()
+
+    def test_get_config_respects_strategy(self):
+        s = TpuSharing(strategy=SharingStrategy.TIME_SLICING)
+        s.normalize()
+        assert s.get_spatial_partition_config() is None
+
+    def test_core_fraction_range(self):
+        c = SpatialPartitionConfig(default_core_fraction=0)
+        with pytest.raises(ValueError, match="defaultCoreFraction"):
+            c.validate()
+        c = SpatialPartitionConfig(default_core_fraction=101)
+        with pytest.raises(ValueError):
+            c.validate()
+
+    def test_spatial_normalize_propagates_default_limit(self):
+        c = SpatialPartitionConfig(default_hbm_limit="2Gi")
+        c.normalize()
+        assert c.normalized_limits(UUIDS) == {u: "2048Mi" for u in UUIDS}
+
+    def test_subslice_rejects_spatial_partition(self):
+        cfg = SubsliceConfig(sharing=TpuSharing(strategy=SharingStrategy.SPATIAL_PARTITION))
+        cfg.normalize()
+        with pytest.raises(ValueError, match="already a spatial partition"):
+            cfg.validate()
+
+    def test_slice_membership_defaults_and_validation(self):
+        cfg = SliceMembershipConfig()
+        cfg.normalize()
+        assert cfg.coordinator_port == 8476
+        cfg.validate()
+        cfg = SliceMembershipConfig(extra_env={"lower": "x"})
+        cfg.normalize()
+        with pytest.raises(ValueError, match="UPPER_SNAKE"):
+            cfg.validate()
+
+
+class TestDecoder:
+    def decode(self, body):
+        return Decoder().decode(body)
+
+    def test_decode_full_tpu_config(self):
+        cfg = self.decode(
+            {
+                "apiVersion": API_VERSION,
+                "kind": "TpuConfig",
+                "sharing": {
+                    "strategy": "SpatialPartition",
+                    "spatialPartitionConfig": {
+                        "defaultCoreFraction": 50,
+                        "perDeviceHbmLimit": {"0": "4Gi"},
+                    },
+                },
+            }
+        )
+        assert isinstance(cfg, TpuConfig)
+        cfg.normalize()
+        cfg.validate()
+        sp = cfg.sharing.get_spatial_partition_config()
+        assert sp.default_core_fraction == 50
+        assert sp.normalized_limits(UUIDS) == {"tpu-aaaa": "4096Mi"}
+
+    def test_rejects_wrong_api_version(self):
+        with pytest.raises(DecodeError, match="apiVersion"):
+            self.decode({"apiVersion": "nvidia.com/v1", "kind": "TpuConfig"})
+
+    def test_rejects_unknown_kind(self):
+        with pytest.raises(DecodeError, match="unknown kind"):
+            self.decode({"apiVersion": API_VERSION, "kind": "GpuConfig"})
+
+    def test_strict_unknown_field(self):
+        with pytest.raises(DecodeError, match="unknown field 'sharingg'"):
+            self.decode({"apiVersion": API_VERSION, "kind": "TpuConfig", "sharingg": {}})
+
+    def test_strict_nested_unknown_field(self):
+        with pytest.raises(DecodeError, match="TpuConfig.sharing: unknown field"):
+            self.decode(
+                {"apiVersion": API_VERSION, "kind": "TpuConfig", "sharing": {"strat": "x"}}
+            )
+
+    def test_strict_bad_enum(self):
+        with pytest.raises(DecodeError, match="strategy"):
+            self.decode(
+                {"apiVersion": API_VERSION, "kind": "TpuConfig", "sharing": {"strategy": "MPS"}}
+            )
+
+    def test_strict_type_mismatch(self):
+        with pytest.raises(DecodeError, match="expected int"):
+            self.decode(
+                {
+                    "apiVersion": API_VERSION,
+                    "kind": "SliceMembershipConfig",
+                    "coordinatorPort": "8476",
+                }
+            )

@@ -1,0 +1,153 @@
+"""Geometry + device-info tests, including the overlap property test
+SURVEY.md §7 calls out as "easy to get subtly wrong"."""
+
+import itertools
+
+from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices, TpuSubsliceInfo
+from k8s_dra_driver_tpu.plugin.geometry import chip_marker, enumerate_subslices, host_origin
+from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+
+def fake(spec: str, host_id: int = 0):
+    return enumerate_topology(
+        env={"TPUINFO_FAKE_TOPOLOGY": spec, "TPUINFO_FAKE_HOST_ID": str(host_id)}
+    )
+
+
+class TestEnumerateSubslices:
+    def test_v5e_multihost_block_shapes(self):
+        # 2x2 host block: 1x2 (x2 placements), 2x1 (x2), 2x2 (x1)
+        subs = enumerate_subslices(fake("v5e-16"))
+        by_shape = {}
+        for s in subs:
+            by_shape.setdefault(s.shape, []).append(s)
+        assert set(by_shape) == {(1, 2, 1), (2, 1, 1), (2, 2, 1)}
+        assert len(by_shape[(1, 2, 1)]) == 2
+        assert len(by_shape[(2, 1, 1)]) == 2
+        assert len(by_shape[(2, 2, 1)]) == 1
+
+    def test_v5e8_single_host_shapes(self):
+        subs = enumerate_subslices(fake("v5e-8"))  # 2x4 block
+        shapes = {s.shape for s in subs}
+        assert (2, 4, 1) in shapes  # whole host
+        assert (2, 2, 1) in shapes
+        assert (1, 2, 1) in shapes
+        whole = [s for s in subs if s.shape == (2, 4, 1)]
+        assert len(whole) == 1 and whole[0].chip_count == 8
+
+    def test_v4_3d_block_shapes(self):
+        subs = enumerate_subslices(fake("v4-16"))  # 2x2x1 host block
+        shapes = {s.shape for s in subs}
+        assert shapes == {(1, 2, 1), (2, 1, 1), (2, 2, 1)}
+
+    def test_placements_are_aligned_and_tile(self):
+        # Same-shape placements partition the block exactly.
+        t = fake("v5e-8")
+        subs = enumerate_subslices(t)
+        for shape in {s.shape for s in subs}:
+            covered = list(
+                itertools.chain.from_iterable(
+                    s.chip_indices for s in subs if s.shape == shape
+                )
+            )
+            assert sorted(covered) == list(range(8)), shape
+            assert len(set(covered)) == len(covered), shape
+
+    def test_global_origins_offset_by_host(self, ):
+        t = fake("v5e-16", host_id=3)
+        assert host_origin(t) == (2, 2, 0)
+        whole = [s for s in enumerate_subslices(t) if s.shape == (2, 2, 1)][0]
+        assert whole.origin == (2, 2, 0)
+        assert whole.name(t.ndims) == "tpu-slice-2x2-2-2"
+
+
+class TestOverlapMarkers:
+    def test_shared_chip_implies_shared_marker(self):
+        """THE property: any two devices sharing a chip share a capacity
+        marker, so counter-aware allocation can never double-book a chip."""
+        t = fake("v5e-8")
+        devices = AllocatableDevices.from_topology(t)
+        caps = {name: set(d.get_device().basic.capacity) for name, d in devices.devices.items()}
+        chips = {
+            name: set(
+                d.subslice.subslice.chip_indices if d.subslice else [d.chip.chip.index]
+            )
+            for name, d in devices.devices.items()
+        }
+        for a, b in itertools.combinations(devices.devices, 2):
+            share_chip = bool(chips[a] & chips[b])
+            share_marker = bool(
+                {c for c in caps[a] if c.startswith("chip")}
+                & {c for c in caps[b] if c.startswith("chip")}
+            )
+            assert share_chip == share_marker, (a, b)
+
+    def test_marker_names_match_local_indices(self):
+        t = fake("v5e-16")
+        dev = AllocatableDevices.from_topology(t).devices["tpu-slice-2x2-0-0"]
+        cap = dev.get_device().basic.capacity
+        assert {chip_marker(i) for i in range(4)} <= set(cap)
+
+
+class TestDeviceConversion:
+    def test_chip_device_attributes(self):
+        t = fake("v5e-16", host_id=1)
+        devices = AllocatableDevices.from_topology(t)
+        d = devices.devices["tpu-0"].get_device()
+        a = d.basic.attributes
+        assert a["type"].value == "tpu"
+        assert a["productName"].value == "tpu-v5e"
+        assert a["tpuTopology"].value == "4x4"
+        assert (a["coordX"].value, a["coordY"].value) == (2, 0)  # host 1 block
+        assert d.basic.capacity["hbm"] == "16Gi"
+        assert a["driverVersion"].version is not None
+
+    def test_subslice_device(self):
+        t = fake("v5e-16")
+        devices = AllocatableDevices.from_topology(t)
+        sub = devices.devices["tpu-slice-2x2-0-0"]
+        d = sub.get_device()
+        assert d.basic.attributes["type"].value == "subslice"
+        assert d.basic.attributes["chipCount"].value == 4
+        assert d.basic.capacity["hbm"] == "64Gi"
+        assert len(sub.uuids()) == 4
+
+    def test_total_device_count(self):
+        # 4 chips + (2x 1x2 + 2x 2x1 + 1x 2x2) = 9 devices per v5e host block
+        assert len(AllocatableDevices.from_topology(fake("v5e-16"))) == 9
+
+    def test_gapped_device_node_numbering(self):
+        # Real hosts may expose /dev/accel1..accel4 (gap at 0).  Overlap
+        # markers must use positional indices so chip and subslice devices
+        # still agree.
+        import dataclasses
+
+        t = fake("v5e-4")
+        gapped = dataclasses.replace(
+            t,
+            chips=tuple(
+                dataclasses.replace(
+                    c, index=c.index + 1, device_path=f"/dev/accel{c.index + 1}"
+                )
+                for c in t.chips
+            ),
+        )
+        devices = AllocatableDevices.from_topology(gapped)
+        assert set(devices.devices) >= {"tpu-1", "tpu-2", "tpu-3", "tpu-4"}
+        chip_caps = {
+            name: {c for c in d.get_device().basic.capacity if c.startswith("chip")}
+            for name, d in devices.devices.items()
+        }
+        # The whole-block subslice covers markers chip0..chip3 — exactly the
+        # union of the per-chip markers.
+        whole = [n for n in devices.devices if n.startswith("tpu-slice-2x2")][0]
+        per_chip = set().union(*(chip_caps[f"tpu-{i}"] for i in range(1, 5)))
+        assert chip_caps[whole] == per_chip == {f"chip{i}" for i in range(4)}
+        # And uuids resolve without KeyError.
+        assert len(devices.devices[whole].uuids()) == 4
+
+    def test_subslice_uuid_is_membership_derived(self):
+        t = fake("v5e-16")
+        sub = [s for s in enumerate_subslices(t) if s.shape == (2, 2, 1)][0]
+        info = TpuSubsliceInfo(sub, t)
+        assert info.uuid.count("+") == 3

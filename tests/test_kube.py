@@ -193,6 +193,26 @@ class TestResourceSliceController:
         c.stop()
         assert s.list(ResourceSlice.KIND) == []
 
+    def test_pool_generation_is_pool_scoped(self):
+        # Changing one slice of a 2-slice pool must rewrite BOTH at the new
+        # generation — stale-generation siblings are invisible to the DRA
+        # scheduler.
+        s = InMemoryAPIServer()
+        c = ResourceSliceController(s, "tpu.google.com", "ctrl")
+        two = {
+            "p": Pool(
+                slices=[Slice(devices=[make_device("a")]), Slice(devices=[make_device("b")])]
+            )
+        }
+        c.update(DriverResources(pools=two))
+        gens = {x.spec.pool.generation for x in s.list(ResourceSlice.KIND)}
+        assert gens == {0}
+        two["p"].slices[0].devices = [make_device("a2")]
+        c.update(DriverResources(pools=two))
+        slices = s.list(ResourceSlice.KIND)
+        assert {x.spec.pool.generation for x in slices} == {1}
+        assert len(slices) == 2
+
     def test_does_not_touch_foreign_slices(self):
         s = InMemoryAPIServer()
         foreign = ResourceSlice(metadata=ObjectMeta(name="other"))

@@ -1,0 +1,103 @@
+"""Tests for the C++ libtpuinfo shim through its Python binding."""
+
+import json
+
+import pytest
+
+from k8s_dra_driver_tpu.tpuinfo import binding
+from k8s_dra_driver_tpu.tpuinfo.binding import TpuInfoError, enumerate_topology
+
+
+def fake(spec: str, host_id: int = 0):
+    return enumerate_topology(
+        env={"TPUINFO_FAKE_TOPOLOGY": spec, "TPUINFO_FAKE_HOST_ID": str(host_id)}
+    )
+
+
+class TestFakeTopologies:
+    def test_v5e_16_is_2d_multihost(self):
+        t = fake("v5e-16")
+        assert (t.generation, t.topology, t.ndims) == ("v5e", "4x4", 2)
+        assert t.dims == (4, 4, 1)
+        assert t.host_bounds == (2, 2, 1)
+        assert t.chips_per_host == 4 and t.host_count == 4
+        assert len(t.chips) == 4
+        assert t.wrap == (False, False, False)  # v5e is a mesh, no torus links
+        assert [c.device_path for c in t.chips] == [f"/dev/accel{i}" for i in range(4)]
+
+    def test_v5e_8_single_host(self):
+        t = fake("v5e-8")
+        assert t.topology == "2x4"
+        assert t.host_count == 1 and t.chips_per_host == 8
+        assert len(t.chips) == 8
+
+    def test_v4_16_is_3d(self):
+        t = fake("v4-16")
+        assert (t.topology, t.ndims) == ("2x2x4", 3)
+        assert t.host_count == 4
+        assert t.wrap == (False, False, True)  # dim 4 wraps on 3D torus gens
+        assert all(c.cores == 2 for c in t.chips)
+        assert all(c.hbm_bytes == 32 << 30 for c in t.chips)
+
+    def test_explicit_topology_spec(self):
+        t = fake("v4-2x2x2")
+        assert t.topology == "2x2x2" and t.total_chips == 8
+
+    def test_host_coords_partition_the_mesh(self):
+        # Collect every host's chips; together they must tile the 4x4 mesh
+        # exactly once.
+        seen = set()
+        for host in range(4):
+            t = fake("v5e-16", host_id=host)
+            for c in t.chips:
+                assert c.coords not in seen, "chip coordinate double-assigned"
+                seen.add(c.coords)
+        assert seen == {(x, y, 0) for x in range(4) for y in range(4)}
+
+    def test_uuids_are_stable_and_unique(self):
+        a = fake("v5e-16", host_id=1)
+        b = fake("v5e-16", host_id=1)
+        assert [c.uuid for c in a.chips] == [c.uuid for c in b.chips]
+        uuids = set()
+        for host in range(4):
+            uuids.update(c.uuid for c in fake("v5e-16", host_id=host).chips)
+        assert len(uuids) == 16
+
+    def test_worker_hostnames(self):
+        t = fake("v5e-32")
+        assert t.host_count == 8
+        assert len(t.worker_hostnames) == 8
+        assert t.worker_hostnames[3] == "tpu-host-3"
+
+    @pytest.mark.parametrize("spec", ["v5e-3", "v7x-8", "banana", "v5e-", "v4-0x2x2"])
+    def test_invalid_specs_error(self, spec):
+        with pytest.raises(TpuInfoError):
+            fake(spec)
+
+    def test_host_id_out_of_range(self):
+        with pytest.raises(TpuInfoError, match="out of range"):
+            fake("v5e-16", host_id=4)
+
+
+class TestBinding:
+    def test_version(self):
+        assert binding.library_version() == "0.1.0"
+
+    def test_json_is_parseable_raw(self):
+        # The ABI contract: a single JSON doc crosses the boundary.
+        import ctypes
+
+        lib = binding.load()
+        out = ctypes.c_char_p()
+        import os
+
+        os.environ["TPUINFO_FAKE_TOPOLOGY"] = "v5e-4"
+        try:
+            rc = lib.tpuinfo_enumerate(ctypes.byref(out))
+            data = json.loads(ctypes.string_at(out).decode())
+            lib.tpuinfo_free(out)
+        finally:
+            os.environ.pop("TPUINFO_FAKE_TOPOLOGY", None)
+        assert rc == 0
+        assert data["mode"] == "fake"
+        assert {c["index"] for c in data["chips"]} == {0, 1, 2, 3}
