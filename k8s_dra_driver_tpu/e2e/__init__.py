@@ -1,0 +1,9 @@
+"""Closed-loop end-to-end harness: fake cluster + scheduler + plugin.
+
+The reference's e2e story requires a kind cluster with real GPUs
+(SURVEY.md §4.3).  This package is the hardware-free equivalent: an in-process
+cluster (fake API server + structured allocator standing in for
+kube-scheduler) wired to the real plugin stack (tpuinfo fake mode → geometry →
+CDI → checkpoint), so the full claim-to-running path is testable and
+benchmarkable anywhere.
+"""

@@ -1,0 +1,67 @@
+"""Runnable closed-loop demo: the quickstart flows without a cluster.
+
+``python -m k8s_dra_driver_tpu.e2e.demo`` walks the reference's quickstart
+scenarios (SURVEY.md §2.7: gpu-test1/2/3 shapes, subslice claim, sharing
+config) against a fake v5e-16 host and prints what each pod would see.
+"""
+
+from __future__ import annotations
+
+import json
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import (
+    SUBSLICE_CLASS,
+    TPU_CLASS,
+    make_cluster,
+    simple_claim,
+)
+
+
+def main() -> None:
+    cluster = make_cluster(hosts=1, topology="v5e-16")
+    node = "tpu-host-0"
+    server = cluster.server
+
+    print(f"== inventory published by {node} ==")
+    for s in server.list("ResourceSlice"):
+        for d in s.spec.devices:
+            attrs = {k: a.value for k, a in d.basic.attributes.items()}
+            print(f"  {d.name:22s} type={attrs['type']:9s} caps={sorted(d.basic.capacity)}")
+
+    print("\n== tpu-test1: two pods, one distinct chip each (template fan-out) ==")
+    for pod in ("pod-0", "pod-1"):
+        claim = server.create(simple_claim(f"test1-{pod}"))
+        devices = cluster.schedule_and_prepare(claim, node)
+        print(f"  {pod}: {[d['device_name'] for d in devices]}")
+
+    print("\n== tpu-test2-style: one claim with a 2x2 subslice ==")
+    claim = server.create(
+        simple_claim(
+            "test2-subslice",
+            device_class=SUBSLICE_CLASS,
+            selectors=[f"device.attributes['{DRIVER_NAME}'].shape == '2x2'"],
+        )
+    )
+    try:
+        cluster.schedule_and_prepare(claim, node)
+        raise SystemExit("BUG: overlapping subslice allocation must have failed")
+    except Exception as exc:
+        print(f"  correctly rejected while chips are held: {exc}")
+
+    print("\n== teardown test1 claims, then the subslice fits ==")
+    for pod in ("pod-0", "pod-1"):
+        c = server.get("ResourceClaim", f"test1-{pod}", "default")
+        cluster.unprepare_and_deallocate(c, node)
+    claim = server.get("ResourceClaim", "test2-subslice", "default")
+    devices = cluster.schedule_and_prepare(claim, node)
+    print(f"  prepared: {json.dumps(devices[0], indent=4)}")
+
+    state = cluster.nodes[node].state
+    spec_path = state.cdi.claim_spec_path(claim.metadata.uid)
+    print(f"\n== CDI spec on disk: {spec_path.name} ==")
+    print(spec_path.read_text())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""In-process cluster harness used by tests, the demo and bench.py."""
+
+from __future__ import annotations
+
+import tempfile
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import (
+    CELDeviceSelector,
+    Deployment,
+    DeviceClaim,
+    DeviceClass,
+    DeviceClassSpec,
+    DeviceRequest,
+    DeviceSelector,
+    Node,
+    ObjectMeta,
+    ResourceClaim,
+    ResourceClaimSpec,
+)
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
+from k8s_dra_driver_tpu.scheduler.allocator import Allocator
+
+TPU_CLASS = "tpu.google.com"
+SUBSLICE_CLASS = "subslice.tpu.google.com"
+MEMBERSHIP_CLASS = "membership.tpu.google.com"
+
+_CLASS_SELECTORS = {
+    TPU_CLASS: "tpu",
+    SUBSLICE_CLASS: "subslice",
+    MEMBERSHIP_CLASS: "membership",
+}
+
+
+def cel_selector(expr: str) -> DeviceSelector:
+    return DeviceSelector(cel=CELDeviceSelector(expression=expr))
+
+
+def install_device_classes(server: InMemoryAPIServer) -> None:
+    """The three DeviceClasses the helm chart ships (templates/deviceclass-*,
+    SURVEY.md §2.6), selecting on driver + type attribute."""
+    for name, devtype in _CLASS_SELECTORS.items():
+        server.create(
+            DeviceClass(
+                metadata=ObjectMeta(name=name),
+                spec=DeviceClassSpec(
+                    selectors=[
+                        cel_selector(
+                            f"device.driver == '{DRIVER_NAME}' && "
+                            f"device.attributes['{DRIVER_NAME}'].type == '{devtype}'"
+                        )
+                    ]
+                ),
+            )
+        )
+
+
+@dataclass
+class FakeNode:
+    name: str
+    state: DeviceState
+
+
+@dataclass
+class Cluster:
+    """A fake cluster with N TPU hosts running the real plugin stack."""
+
+    server: InMemoryAPIServer
+    nodes: dict[str, FakeNode] = field(default_factory=dict)
+    allocator: Allocator = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.allocator is None:
+            self.allocator = Allocator(self.server)
+
+    def node_labels(self, name: str) -> dict[str, str]:
+        node = self.server.get(Node.KIND, name)
+        return dict(node.metadata.labels)
+
+    def schedule_and_prepare(self, claim: ResourceClaim, node_name: str) -> list[dict]:
+        """The §3.2 hot path: allocate (scheduler) then Prepare (kubelet)."""
+        allocated = self.allocator.allocate(
+            claim, node_name=node_name, node_labels=self.node_labels(node_name)
+        )
+        return self.nodes[node_name].state.prepare(allocated)
+
+    def unprepare_and_deallocate(self, claim: ResourceClaim, node_name: str) -> None:
+        self.nodes[node_name].state.unprepare(claim.metadata.uid)
+        self.allocator.deallocate(self.server.get(
+            ResourceClaim.KIND, claim.metadata.name, claim.metadata.namespace
+        ))
+
+
+def make_cluster(
+    hosts: int = 1,
+    topology: str = "v5e-16",
+    work_dir: str | None = None,
+    slice_domain: str = "",
+    daemon_controller: bool = True,
+) -> Cluster:
+    """Build a cluster of ``hosts`` TPU hosts sharing one fake slice topology.
+
+    Each host gets a Node object (labeled with the slice domain for the
+    multi-host controller), a DeviceState whose plugin publishes its
+    inventory, and its own cdi/checkpoint dirs under ``work_dir``.
+    """
+    from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+
+    server = InMemoryAPIServer()
+    install_device_classes(server)
+    if daemon_controller:
+        _install_daemon_controller(server)
+    work_dir = work_dir or tempfile.mkdtemp(prefix="tpu-dra-e2e-")
+    cluster = Cluster(server=server)
+    for host_id in range(hosts):
+        name = f"tpu-host-{host_id}"
+        labels = {"kubernetes.io/hostname": name}
+        if slice_domain:
+            labels["tpu.google.com/slice-domain"] = slice_domain
+            labels["tpu.google.com/slice-host-id"] = str(host_id)
+        server.create(Node(metadata=ObjectMeta(name=name, labels=labels)))
+        driver = Driver(
+            server,
+            DriverConfig(
+                node_name=name,
+                cdi_root=f"{work_dir}/{name}/cdi",
+                checkpoint_path=f"{work_dir}/{name}/checkpoint.json",
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": topology,
+                    "TPUINFO_FAKE_HOST_ID": str(host_id),
+                },
+                daemon_backoff_initial=0.001,
+            ),
+        )
+        cluster.nodes[name] = FakeNode(name=name, state=driver.state)
+    return cluster
+
+
+def _install_daemon_controller(server: InMemoryAPIServer) -> None:
+    def on_event(event):
+        dep = event.object
+        if event.type == "ADDED" and not (dep.status or {}).get("readyReplicas"):
+            dep.status = {"readyReplicas": 1}
+            server.update(dep)
+
+    server.watch(Deployment.KIND, on_event)
+
+
+def simple_claim(
+    name: str,
+    namespace: str = "default",
+    device_class: str = TPU_CLASS,
+    count: int = 1,
+    selectors: list[str] = (),
+) -> ResourceClaim:
+    return ResourceClaim(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=ResourceClaimSpec(
+            devices=DeviceClaim(
+                requests=[
+                    DeviceRequest(
+                        name="req",
+                        device_class_name=device_class,
+                        count=count,
+                        selectors=[cel_selector(e) for e in selectors],
+                    )
+                ]
+            )
+        ),
+    )

@@ -64,8 +64,11 @@ class NodeSelector:
     node_selector_terms: list[NodeSelectorTerm] = field(default_factory=list)
 
     def matches(self, labels: dict[str, str]) -> bool:
-        """True if any term matches (terms are ORed, expressions ANDed)."""
+        """True if any term matches (terms are ORed, expressions ANDed).
+        Per core/v1 semantics a null/empty term matches NO objects."""
         for term in self.node_selector_terms:
+            if not term.match_expressions:
+                continue
             if all(_req_matches(req, labels) for req in term.match_expressions):
                 return True
         return False
@@ -88,6 +91,8 @@ def _req_matches(req: NodeSelectorRequirement, labels: dict[str, str]) -> bool:
 class DeviceAttribute:
     """One-of attribute value (string/int/bool/version)."""
 
+    SERDE_NAMES = {"int_value": "int", "bool_value": "bool"}
+
     string: Optional[str] = None
     int_value: Optional[int] = None
     bool_value: Optional[bool] = None
@@ -107,10 +112,6 @@ class DeviceAttribute:
         if isinstance(value, int):
             return DeviceAttribute(int_value=value)
         return DeviceAttribute(string=str(value))
-
-
-# Wire names for DeviceAttribute are `string`, `int`, `bool`, `version`.
-serde._SPECIAL_CAMEL.update({"int_value": "int", "bool_value": "bool"})
 
 
 @dataclass

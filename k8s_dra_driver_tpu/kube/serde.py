@@ -15,15 +15,12 @@ from typing import Any, TypeVar, get_args, get_origin, get_type_hints
 
 T = TypeVar("T")
 
-# Field-name spellings that simple snake->camel conversion gets wrong.
-_SPECIAL_CAMEL = {
-    "api_version": "apiVersion",
-}
 
-
-def snake_to_camel(name: str) -> str:
-    if name in _SPECIAL_CAMEL:
-        return _SPECIAL_CAMEL[name]
+def snake_to_camel(name: str, overrides: dict[str, str] | None = None) -> str:
+    """Default field-name mapping; a dataclass can pin exceptions by defining
+    a ``SERDE_NAMES = {field_name: wire_name}`` class attribute."""
+    if overrides and name in overrides:
+        return overrides[name]
     head, *rest = name.split("_")
     return head + "".join(part[:1].upper() + part[1:] for part in rest)
 
@@ -36,12 +33,13 @@ def _is_empty(value: Any) -> bool:
 def to_json(obj: Any) -> Any:
     """Convert a dataclass tree to JSON-compatible data, dropping empties."""
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        overrides = getattr(type(obj), "SERDE_NAMES", None)
         out = {}
         for f in dataclasses.fields(obj):
             value = getattr(obj, f.name)
             if _is_empty(value):
                 continue
-            out[snake_to_camel(f.name)] = to_json(value)
+            out[snake_to_camel(f.name, overrides)] = to_json(value)
         return out
     if isinstance(obj, enum.Enum):
         return obj.value
@@ -82,7 +80,8 @@ def _from_json(tp: Any, data: Any) -> Any:
         return tp(data)
     if dataclasses.is_dataclass(tp):
         hints = get_type_hints(tp)
-        camel_to_field = {snake_to_camel(f.name): f for f in dataclasses.fields(tp)}
+        overrides = getattr(tp, "SERDE_NAMES", None)
+        camel_to_field = {snake_to_camel(f.name, overrides): f for f in dataclasses.fields(tp)}
         kwargs = {}
         for key, value in data.items():
             f = camel_to_field.get(key)

@@ -1,0 +1,61 @@
+"""Checksummed checkpoint of prepared-claim state.
+
+Mirror of cmd/nvidia-dra-plugin/checkpoint.go (kubelet checkpointmanager
+format: versioned schema + checksum, single ``checkpoint.json`` under the
+plugin dir — main.go:39-41, device_state.go:94-155).  Restoring across plugin
+restarts is what makes Prepare idempotent under kubelet retries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Any
+
+SCHEMA_VERSION = "v1"
+
+
+class CorruptCheckpoint(RuntimeError):
+    pass
+
+
+def _checksum(payload: str) -> str:
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+class CheckpointFile:
+    """``prepared_claims``: claim-uid → JSON-serializable prepared state."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+
+    def read(self) -> dict[str, Any]:
+        if not self.path.exists():
+            return {}
+        doc = json.loads(self.path.read_text())
+        if doc.get("version") != SCHEMA_VERSION:
+            raise CorruptCheckpoint(f"unknown checkpoint version {doc.get('version')!r}")
+        payload = json.dumps(doc.get("preparedClaims", {}), sort_keys=True)
+        if _checksum(payload) != doc.get("checksum"):
+            raise CorruptCheckpoint(f"checksum mismatch in {self.path}")
+        return doc["preparedClaims"]
+
+    def write(self, prepared_claims: dict[str, Any]) -> None:
+        payload = json.dumps(prepared_claims, sort_keys=True)
+        doc = {
+            "version": SCHEMA_VERSION,
+            "checksum": _checksum(payload),
+            "preparedClaims": prepared_claims,
+        }
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.path.parent, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except BaseException:
+            os.unlink(tmp)
+            raise

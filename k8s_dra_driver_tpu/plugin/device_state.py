@@ -1,0 +1,324 @@
+"""Node-side device state machine: Prepare / Unprepare with checkpointing.
+
+Mirror of cmd/nvidia-dra-plugin/device_state.go (558 LoC):
+
+* enumerate → base CDI spec → checkpoint restore on construction (:57-126)
+* ``prepare`` idempotent via checkpoint (:128-159)
+* opaque-config extraction with class < claim precedence (:446-510) and
+  reverse-precedence request matching (:225-259)
+* ``apply_sharing_config`` dispatch (:380-428)
+* ``unprepare`` teardown (:161-190, 350-365)
+
+One deliberate improvement over the reference (SURVEY.md §7 "hard parts" #2):
+Prepare is structured as **compensable steps** — every side effect pushes an
+undo closure, and a mid-way failure unwinds them instead of leaking daemons,
+spec files or mounts (the reference leaks, e.g. sharing.go:260-287).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.api import (
+    Decoder,
+    SliceMembershipConfig,
+    SubsliceConfig,
+    TpuConfig,
+    default_subslice_config,
+    default_tpu_config,
+)
+from k8s_dra_driver_tpu.api.sharing import SharingStrategy
+from k8s_dra_driver_tpu.kube.objects import ResourceClaim
+from k8s_dra_driver_tpu.plugin.cdi import CDIHandler, ContainerEdits
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile
+from k8s_dra_driver_tpu.plugin.deviceinfo import (
+    DEVICE_TYPE_CHIP,
+    DEVICE_TYPE_MEMBERSHIP,
+    DEVICE_TYPE_SUBSLICE,
+    AllocatableDevice,
+    AllocatableDevices,
+)
+from k8s_dra_driver_tpu.plugin.prepared import (
+    DeviceConfigState,
+    PreparedClaim,
+    PreparedDevice,
+    PreparedDeviceGroup,
+)
+from k8s_dra_driver_tpu.plugin.sharing import (
+    SharingError,
+    SpatialPartitionManager,
+    TimeSlicingManager,
+    TopologyDaemon,
+)
+from k8s_dra_driver_tpu.tpuinfo.binding import TopologyInfo, enumerate_topology
+
+
+class PrepareError(RuntimeError):
+    pass
+
+
+@dataclass
+class DeviceStateConfig:
+    node_name: str = ""
+    namespace: str = "tpu-dra-driver"
+    cdi_root: str = "/var/run/cdi"
+    checkpoint_path: str = "/var/lib/kubelet/plugins/tpu.google.com/checkpoint.json"
+    driver_root: str = ""
+    libtpu_path: str = "/lib/libtpu.so"
+    topology_env: dict[str, str] = field(default_factory=dict)
+    # Readiness backoff overrides for tests.
+    daemon_backoff_initial: float = 1.0
+    daemon_backoff_steps: int = 4
+
+
+class DeviceState:
+    def __init__(self, server, config: DeviceStateConfig):
+        self._lock = threading.Lock()
+        self.config = config
+        self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
+        self.allocatable = AllocatableDevices.from_topology(self.topology)
+        self.cdi = CDIHandler(
+            cdi_root=config.cdi_root,
+            driver_root=config.driver_root,
+            libtpu_path=config.libtpu_path,
+        )
+        self.cdi.create_base_spec(self.allocatable)
+        self.ts_manager = TimeSlicingManager()
+        self.sp_manager = SpatialPartitionManager(
+            server,
+            namespace=config.namespace,
+            node_name=config.node_name,
+            backoff_initial=config.daemon_backoff_initial,
+            backoff_steps=config.daemon_backoff_steps,
+        )
+        self._decoder = Decoder()
+        self._checkpoint = CheckpointFile(config.checkpoint_path)
+        raw = self._checkpoint.read()
+        self.prepared: dict[str, PreparedClaim] = {
+            uid: PreparedClaim.from_json(doc) for uid, doc in raw.items()
+        }
+
+    # ------------------------------------------------------------------
+    # Prepare
+    # ------------------------------------------------------------------
+
+    def prepare(self, claim: ResourceClaim) -> list[dict]:
+        with self._lock:
+            uid = claim.metadata.uid
+            if uid in self.prepared:  # idempotent (device_state.go:140-142)
+                return self.prepared[uid].flatten()
+            if claim.status.allocation is None:
+                raise PrepareError(f"claim {claim.metadata.name!r} has no allocation")
+
+            undo: list[Callable[[], None]] = []
+            try:
+                prepared = self._prepare_devices(claim, undo)
+                self.cdi.create_claim_spec_file(
+                    uid,
+                    [
+                        (
+                            [d.name for d in g.devices],
+                            ContainerEdits(env=g.config_state.env),
+                        )
+                        for g in prepared.groups
+                    ],
+                )
+                undo.append(lambda: self.cdi.delete_claim_spec_file(uid))
+                self.prepared[uid] = prepared
+                self._write_checkpoint()
+            except BaseException:
+                for fn in reversed(undo):
+                    try:
+                        fn()
+                    except Exception:
+                        pass  # best-effort unwind; original error wins
+                raise
+            return prepared.flatten()
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._lock:
+            prepared = self.prepared.get(claim_uid)
+            if prepared is None:
+                return  # idempotent
+            for group in prepared.groups:
+                if group.config_state.daemon_name:
+                    self.sp_manager.stop(
+                        TopologyDaemon(
+                            name=group.config_state.daemon_name,
+                            namespace=group.config_state.daemon_namespace,
+                        )
+                    )
+            self.cdi.delete_claim_spec_file(claim_uid)
+            del self.prepared[claim_uid]
+            self._write_checkpoint()
+
+    def prepared_claim_uids(self) -> list[str]:
+        with self._lock:
+            return list(self.prepared)
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _write_checkpoint(self) -> None:
+        self._checkpoint.write({uid: p.to_json() for uid, p in self.prepared.items()})
+
+    def _prepare_devices(self, claim: ResourceClaim, undo) -> PreparedClaim:
+        alloc = claim.status.allocation
+
+        # 1. Decode opaque configs, class configs first (lowest precedence
+        #    among explicit ones), then claim configs (device_state.go:446-510).
+        configs: list[tuple[Optional[set], object]] = []  # (requests|None=all, config)
+        for c in sorted(
+            alloc.devices.config, key=lambda c: 0 if c.source == "FromClass" else 1
+        ):
+            if c.opaque is None or c.opaque.driver != DRIVER_NAME:
+                continue
+            decoded = self._decoder.decode(c.opaque.parameters)
+            configs.append((set(c.requests) if c.requests else None, decoded))
+
+        # 2. Resolve per allocation result by reverse-precedence scan
+        #    (device_state.go:225-259); fall back to per-type defaults
+        #    (:210-221).
+        groups: dict[int, tuple[object, list[tuple[str, AllocatableDevice]]]] = {}
+        defaults: dict[str, object] = {}
+        for result in alloc.devices.results:
+            if result.driver != DRIVER_NAME:
+                continue
+            device = self.allocatable.devices.get(result.device)
+            if device is None:
+                raise PrepareError(f"allocated device {result.device!r} is not on this node")
+            chosen = None
+            for requests, cfg in reversed(configs):
+                if requests is None or result.request in requests:
+                    chosen = cfg
+                    break
+            if chosen is None:
+                kind = device.kind
+                if kind not in defaults:
+                    defaults[kind] = self._default_config(kind)
+                chosen = defaults[kind]
+            self._check_config_applies(chosen, device)
+            key = id(chosen)
+            groups.setdefault(key, (chosen, []))[1].append((result.request, device))
+
+        # 3. Normalize+validate each chosen config once, then realize it
+        #    (device_state.go:279-287, 367-428).
+        prepared = PreparedClaim(
+            uid=claim.metadata.uid,
+            namespace=claim.metadata.namespace,
+            name=claim.metadata.name,
+        )
+        for cfg, members in groups.values():
+            cfg.normalize()
+            cfg.validate()
+            devices = [d for _, d in members]
+            edits, state = self._apply_config(claim, cfg, devices, undo)
+            group = PreparedDeviceGroup(config_state=state)
+            for request, device in members:
+                group.devices.append(self._prepared_device(claim, request, device))
+            group.config_state.env = {**self._wiring_env(devices), **edits.env}
+            prepared.groups.append(group)
+        return prepared
+
+    def _default_config(self, kind: str):
+        if kind == DEVICE_TYPE_CHIP:
+            return default_tpu_config()
+        if kind == DEVICE_TYPE_SUBSLICE:
+            return default_subslice_config()
+        cfg = SliceMembershipConfig()
+        cfg.normalize()
+        return cfg
+
+    def _check_config_applies(self, cfg, device: AllocatableDevice) -> None:
+        """Config kind ↔ device kind compatibility (the reference's typed
+        dispatch in applyConfig, device_state.go:367-378)."""
+        ok = (
+            (isinstance(cfg, TpuConfig) and device.kind == DEVICE_TYPE_CHIP)
+            or (isinstance(cfg, SubsliceConfig) and device.kind == DEVICE_TYPE_SUBSLICE)
+            or (
+                isinstance(cfg, SliceMembershipConfig)
+                and device.kind == DEVICE_TYPE_MEMBERSHIP
+            )
+        )
+        if not ok:
+            raise PrepareError(
+                f"config {type(cfg).__name__} cannot apply to {device.kind} "
+                f"device {device.name!r}"
+            )
+
+    def _apply_config(
+        self, claim, cfg, devices: list[AllocatableDevice], undo
+    ) -> tuple[ContainerEdits, DeviceConfigState]:
+        if isinstance(cfg, SliceMembershipConfig):
+            env = {"JAX_COORDINATOR_PORT": str(cfg.coordinator_port), **cfg.extra_env}
+            if cfg.megascale:
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = "auto"
+            return ContainerEdits(env=env), DeviceConfigState(strategy="Membership", env={})
+
+        sharing = cfg.sharing
+        strategy = sharing.strategy
+        if strategy == SharingStrategy.EXCLUSIVE:
+            return ContainerEdits(), DeviceConfigState(strategy="Exclusive")
+        if strategy == SharingStrategy.TIME_SLICING:
+            edits = self.ts_manager.apply(devices, sharing.get_time_slicing_config())
+            return edits, DeviceConfigState(strategy="TimeSlicing")
+        if strategy == SharingStrategy.SPATIAL_PARTITION:
+            edits, daemon = self.sp_manager.start(
+                claim.metadata.uid, devices, sharing.get_spatial_partition_config()
+            )
+            undo.append(lambda: self.sp_manager.stop(daemon))
+            return edits, DeviceConfigState(
+                strategy="SpatialPartition",
+                daemon_name=daemon.name,
+                daemon_namespace=daemon.namespace,
+            )
+        raise SharingError(f"unhandled strategy {strategy!r}")
+
+    def _wiring_env(self, devices: list[AllocatableDevice]) -> dict[str, str]:
+        """libtpu/JAX wiring for the claimed devices: which chips are visible
+        and, for subslices, the process-local mesh bounds (the TPU
+        counterpart of CUDA_VISIBLE_DEVICES injection via CDI)."""
+        env: dict[str, str] = {}
+        chip_indices: list[int] = []
+        for d in devices:
+            if d.chip is not None:
+                chip_indices.append(d.chip.chip.index)
+            elif d.subslice is not None:
+                topo = d.subslice.topology
+                chip_indices.extend(
+                    topo.chips[i].index for i in d.subslice.subslice.chip_indices
+                )
+        if chip_indices:
+            env["TPU_VISIBLE_DEVICES"] = ",".join(str(i) for i in sorted(chip_indices))
+        subslices = [d for d in devices if d.subslice is not None]
+        if len(subslices) == 1:
+            shape = subslices[0].subslice.subslice.shape
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(str(s) for s in shape)
+            env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        return env
+
+    def _prepared_device(self, claim, request: str, device: AllocatableDevice) -> PreparedDevice:
+        paths: list[str] = []
+        if device.chip is not None:
+            paths = [device.chip.chip.device_path]
+        elif device.subslice is not None:
+            topo = device.subslice.topology
+            paths = [topo.chips[i].device_path for i in device.subslice.subslice.chip_indices]
+        return PreparedDevice(
+            kind=device.kind,
+            name=device.name,
+            pool=self.config.node_name,
+            request=request,
+            uuids=device.uuids(),
+            device_paths=paths,
+            cdi_device_ids=[
+                self.cdi.qualified_name(device.name),
+                self.cdi.qualified_name(
+                    self.cdi.claim_device_name(claim.metadata.uid, device.name)
+                ),
+            ],
+        )

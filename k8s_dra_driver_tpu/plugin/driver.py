@@ -1,0 +1,125 @@
+"""Kubelet-plugin driver: inventory publishing + claim prepare/unprepare.
+
+Mirror of cmd/nvidia-dra-plugin/driver.go (168 LoC): construct DeviceState,
+publish every allocatable device as one node-local ResourceSlice pool
+(driver.go:71-83), serialize Prepare/Unprepare per claim with per-claim error
+fan-out (driver.go:96-154).  The gRPC transport lives in grpc_service.py;
+this class is the transport-independent core so the in-process harness and
+the unix-socket server share one implementation.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.kube.fakeserver import NotFound
+from k8s_dra_driver_tpu.kube.objects import ResourceClaim
+from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+    Slice,
+)
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
+
+# ResourceSlice device limit per object (upstream k8s constant): split pools
+# into slices of at most this many devices.
+DEVICES_PER_SLICE = 128
+
+
+@dataclass
+class DriverConfig(DeviceStateConfig):
+    publish: bool = True
+
+
+@dataclass
+class ClaimRef:
+    uid: str
+    name: str
+    namespace: str
+
+
+@dataclass
+class ClaimResult:
+    """Per-claim result of a batched NodePrepare/NodeUnprepare call."""
+
+    devices: list[dict] = field(default_factory=list)
+    error: str = ""
+
+
+class Driver:
+    def __init__(self, server, config: DriverConfig):
+        self._server = server
+        self.config = config
+        self._lock = threading.Lock()
+        self.state = DeviceState(server, config)
+        self._slice_controller = ResourceSliceController(
+            server, DRIVER_NAME, config.node_name
+        )
+        if config.publish:
+            self.publish_resources()
+
+    # -- inventory (driver.go:71-83) ---------------------------------------
+
+    def publish_resources(self) -> None:
+        devices = self.state.allocatable.get_devices()
+        slices = [
+            Slice(devices=devices[i : i + DEVICES_PER_SLICE])
+            for i in range(0, len(devices), DEVICES_PER_SLICE)
+        ] or [Slice()]
+        self._slice_controller.update(
+            DriverResources(
+                pools={
+                    self.config.node_name: Pool(
+                        slices=slices, node_name=self.config.node_name
+                    )
+                }
+            )
+        )
+
+    def shutdown(self, delete_slices: bool = False) -> None:
+        """The node plugin normally leaves its slices published across
+        restarts; tests can force cleanup."""
+        self._slice_controller.stop(delete_owned=delete_slices)
+
+    # -- claim fan-out (driver.go:96-154) ----------------------------------
+
+    def node_prepare_resources(self, claims: list[ClaimRef]) -> dict[str, ClaimResult]:
+        out: dict[str, ClaimResult] = {}
+        with self._lock:
+            for ref in claims:
+                try:
+                    out[ref.uid] = ClaimResult(devices=self._prepare_one(ref))
+                except Exception as exc:  # per-claim, not process-fatal
+                    out[ref.uid] = ClaimResult(
+                        error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
+                    )
+        return out
+
+    def node_unprepare_resources(self, claims: list[ClaimRef]) -> dict[str, ClaimResult]:
+        out: dict[str, ClaimResult] = {}
+        with self._lock:
+            for ref in claims:
+                try:
+                    self.state.unprepare(ref.uid)
+                    out[ref.uid] = ClaimResult()
+                except Exception as exc:
+                    out[ref.uid] = ClaimResult(
+                        error=f"error unpreparing claim {ref.namespace}/{ref.name}: {exc}"
+                    )
+        return out
+
+    def _prepare_one(self, ref: ClaimRef) -> list[dict]:
+        # Re-fetch the claim from the API server — the kubelet request only
+        # carries the reference (driver.go:122-125).
+        try:
+            claim = self._server.get(ResourceClaim.KIND, ref.name, ref.namespace)
+        except NotFound as exc:
+            raise RuntimeError(f"failed to fetch ResourceClaim {ref.name!r}: {exc}") from exc
+        if claim.metadata.uid != ref.uid:
+            raise RuntimeError(
+                f"claim {ref.name!r} uid mismatch: have {claim.metadata.uid}, want {ref.uid}"
+            )
+        return self.state.prepare(claim)

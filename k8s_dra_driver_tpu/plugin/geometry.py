@@ -83,9 +83,9 @@ def enumerate_subslices(topology: TopologyInfo, include_single_chip: bool = Fals
 
     out = []
     for shape in shapes:
-        for oz in range(0, hb[2], shape[2]):
-            for oy in range(0, hb[1], shape[1]):
-                for ox in range(0, hb[0], shape[0]):
+        for oz in range(0, hb[2] - shape[2] + 1, shape[2]):
+            for oy in range(0, hb[1] - shape[1] + 1, shape[1]):
+                for ox in range(0, hb[0] - shape[0] + 1, shape[0]):
                     chips = tuple(
                         _local_index(x, y, z, hb)
                         for z in range(oz, oz + shape[2])

@@ -1,0 +1,70 @@
+"""Checkpointed prepared-claim model.
+
+Mirror of cmd/nvidia-dra-plugin/prepared.go (205 LoC): JSON-serializable
+groups of prepared devices, each group carrying the config state that was
+applied to it, flattening to the kubelet-facing device list
+(pool/device/CDI-ids triples).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu.kube import serde
+
+
+@dataclass
+class PreparedDevice:
+    kind: str = ""  # tpu | subslice | membership
+    name: str = ""
+    pool: str = ""
+    request: str = ""
+    uuids: list[str] = field(default_factory=list)
+    device_paths: list[str] = field(default_factory=list)
+    cdi_device_ids: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DeviceConfigState:
+    """What was applied at Prepare time — enough to undo it at Unprepare
+    (device_state.go's DeviceConfigState + sharing.go daemon bookkeeping)."""
+
+    strategy: str = "Exclusive"
+    env: dict[str, str] = field(default_factory=dict)
+    daemon_name: str = ""  # SpatialPartition topology-daemon Deployment name
+    daemon_namespace: str = ""
+
+
+@dataclass
+class PreparedDeviceGroup:
+    devices: list[PreparedDevice] = field(default_factory=list)
+    config_state: DeviceConfigState = field(default_factory=DeviceConfigState)
+
+
+@dataclass
+class PreparedClaim:
+    uid: str = ""
+    namespace: str = ""
+    name: str = ""
+    groups: list[PreparedDeviceGroup] = field(default_factory=list)
+
+    def flatten(self) -> list[dict]:
+        """The gRPC NodePrepareResources per-claim response payload
+        (device_state.go:316-321)."""
+        return [
+            {
+                "pool_name": d.pool,
+                "device_name": d.name,
+                "request_names": [d.request] if d.request else [],
+                "cdi_device_ids": d.cdi_device_ids,
+            }
+            for g in self.groups
+            for d in g.devices
+        ]
+
+    def to_json(self) -> dict:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(data: dict) -> "PreparedClaim":
+        return serde.from_json(PreparedClaim, data)

@@ -1,0 +1,192 @@
+"""Sharing managers — realize a claim's sharing config on the node.
+
+Mirror of cmd/nvidia-dra-plugin/sharing.go (442 LoC), re-imagined for TPU:
+
+* ``TimeSlicingManager`` — the reference shells out to nvidia-smi to set a
+  preemptive compute-policy timeslice (nvlib.go:521-539).  libtpu has no
+  preemptive timeslicing (SURVEY.md §2.10), so the TPU realization is
+  cooperative: the claim's containers get queue-quantum env consumed by the
+  per-host topology daemon, and exclusivity is dropped so several containers
+  can open the chip.
+* ``SpatialPartitionManager`` — the MPS analog.  Spawns a per-claim topology
+  daemon Deployment (template render + API create + readiness poll with the
+  same 1s→10s×4 exponential backoff, sharing.go:185-344) and computes the
+  ``TPU_PROCESS_BOUNDS``-family env that subdivides the claimed chips among
+  consumer containers, plus normalized per-chip HBM limits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import string
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+import yaml
+
+from k8s_dra_driver_tpu.api.sharing import SpatialPartitionConfig, TimeSlicingConfig
+from k8s_dra_driver_tpu.kube import objects
+from k8s_dra_driver_tpu.kube.fakeserver import NotFound
+from k8s_dra_driver_tpu.plugin.cdi import ContainerEdits
+from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevice
+
+_TEMPLATE_PATH = Path(__file__).parent.parent.parent / "templates" / "topology-daemon.tmpl.yaml"
+
+# Cooperative scheduler quantum per named interval, milliseconds.
+_QUANTUM_MS = {0: 5, 1: 1, 2: 5, 3: 20}
+
+
+class SharingError(RuntimeError):
+    pass
+
+
+def _require_chips(devices: list[AllocatableDevice], strategy: str) -> None:
+    """Sharing strategies apply to whole chips only — the reference likewise
+    rejects MIG devices for time-slicing (sharing.go:103-107); subslices are
+    already spatial partitions."""
+    bad = [d.name for d in devices if d.chip is None]
+    if bad:
+        raise SharingError(f"{strategy} sharing requires whole-chip devices, got {bad}")
+
+
+class TimeSlicingManager:
+    def apply(
+        self, devices: list[AllocatableDevice], config: TimeSlicingConfig
+    ) -> ContainerEdits:
+        _require_chips(devices, "TimeSlicing")
+        interval = config.interval
+        level = interval.level() if interval is not None else 0
+        return ContainerEdits(
+            env={
+                "TPU_SHARING_STRATEGY": "time-slicing",
+                "TPU_QUEUE_QUANTUM_MS": str(_QUANTUM_MS[level]),
+            }
+        )
+
+
+@dataclass
+class TopologyDaemon:
+    """Handle to one running per-claim daemon (MpsControlDaemon analog)."""
+
+    name: str
+    namespace: str
+
+
+class SpatialPartitionManager:
+    def __init__(
+        self,
+        server,
+        namespace: str = "tpu-dra-driver",
+        node_name: str = "",
+        daemon_image: str = "tpu-dra-driver:latest",
+        socket_dir: str = "/run/tpu-topology",
+        backoff_initial: float = 1.0,
+        backoff_cap: float = 10.0,
+        backoff_steps: int = 4,
+    ):
+        self._server = server
+        self.namespace = namespace
+        self.node_name = node_name
+        self.daemon_image = daemon_image
+        self.socket_dir = socket_dir
+        self._backoff = (backoff_initial, backoff_cap, backoff_steps)
+
+    # -- daemon naming (sharing.go:151-155) --------------------------------
+
+    def daemon_name(self, claim_uid: str, uuids: list[str]) -> str:
+        digest = hashlib.sha256(",".join(sorted(uuids)).encode()).hexdigest()[:5]
+        return f"tpu-topology-daemon-{claim_uid[:13]}-{digest}"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(
+        self,
+        claim_uid: str,
+        devices: list[AllocatableDevice],
+        config: SpatialPartitionConfig,
+    ) -> tuple[ContainerEdits, TopologyDaemon]:
+        _require_chips(devices, "SpatialPartition")
+        uuids = [u for d in devices for u in d.uuids()]
+        limits = config.normalized_limits(uuids)
+
+        name = self.daemon_name(claim_uid, uuids)
+        rendered = string.Template(_TEMPLATE_PATH.read_text()).substitute(
+            DAEMON_NAME=name,
+            NAMESPACE=self.namespace,
+            CLAIM_UID=claim_uid,
+            NODE_NAME=self.node_name,
+            DAEMON_IMAGE=self.daemon_image,
+            SOCKET_DIR=self.socket_dir,
+            PARTITION_SPEC=self._partition_spec(devices, config),
+            HBM_LIMITS=",".join(f"{k}={v}" for k, v in sorted(limits.items())),
+        )
+        deployment = objects.from_json(yaml.safe_load(rendered))
+        created = False
+        try:
+            self._server.get(objects.Deployment.KIND, name, self.namespace)
+        except NotFound:
+            self._server.create(deployment)
+            created = True
+        try:
+            self.assert_ready(name)
+        except BaseException:
+            # Compensate our own side effect — the reference leaks the
+            # daemon/tmpfs when readiness fails mid-Start (sharing.go:260-287).
+            if created:
+                self.stop(TopologyDaemon(name=name, namespace=self.namespace))
+            raise
+
+        edits = ContainerEdits(
+            env={
+                "TPU_SHARING_STRATEGY": "spatial-partition",
+                "TPU_PROCESS_BOUNDS": self._partition_spec(devices, config),
+                "TPU_TOPOLOGY_DAEMON_SOCKET": f"{self.socket_dir}/{claim_uid}.sock",
+                "TPU_CORE_FRACTION": str(config.default_core_fraction or 100),
+                **(
+                    {"TPU_HBM_LIMITS": ",".join(f"{k}={v}" for k, v in sorted(limits.items()))}
+                    if limits
+                    else {}
+                ),
+            },
+            mounts=[(self.socket_dir, self.socket_dir)],
+        )
+        return edits, TopologyDaemon(name=name, namespace=self.namespace)
+
+    def assert_ready(self, name: str) -> None:
+        """Poll the daemon Deployment's availability with exponential backoff
+        (sharing.go:289-344)."""
+        delay, cap, steps = self._backoff
+        for _ in range(steps):
+            try:
+                dep = self._server.get(objects.Deployment.KIND, name, self.namespace)
+            except NotFound:
+                dep = None
+            if dep is not None and _deployment_ready(dep):
+                return
+            time.sleep(delay)
+            delay = min(delay * 2, cap)
+        raise SharingError(f"topology daemon {name!r} did not become ready")
+
+    def stop(self, daemon: TopologyDaemon) -> None:
+        """Teardown (sharing.go:368-403).  Idempotent: a daemon already gone
+        is success, matching the reference's tolerance of repeat Unprepare."""
+        try:
+            self._server.delete(objects.Deployment.KIND, daemon.name, daemon.namespace)
+        except NotFound:
+            pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _partition_spec(
+        self, devices: list[AllocatableDevice], config: SpatialPartitionConfig
+    ) -> str:
+        """1D split of the claimed chips among consumers: 'N,1,1' bounds."""
+        return f"{len(devices)},1,1"
+
+
+def _deployment_ready(dep) -> bool:
+    status = dep.status or {}
+    if isinstance(status, dict):
+        return (status.get("readyReplicas") or 0) >= 1
+    return False

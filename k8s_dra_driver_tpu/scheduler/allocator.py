@@ -1,0 +1,328 @@
+"""Structured-parameter allocator — the kube-scheduler's DRA half.
+
+Allocation happens OUTSIDE the reference repo (SURVEY.md §3.5): the upstream
+scheduler reads published ResourceSlices, evaluates DeviceClass + per-request
+CEL selectors, honors ``matchAttribute`` constraints and capacity non-overlap,
+and writes ``claim.Status.Allocation``.  This module re-implements those
+semantics so the repo is a *closed loop* — unit/integration tests, the demo
+harness and the bench can schedule claims with no cluster.  It also documents
+exactly what geometry encoding the driver relies on:
+
+* device filtering: ``device.driver`` must match the DeviceClass driver
+  implied by its selectors; every CEL selector must evaluate true (an
+  erroring expression is a non-match, CEL-in-k8s semantics);
+* per-pool only the highest observed generation is visible;
+* a device may be allocated to at most one claim;
+* **counter non-overlap**: within one pool, two allocated devices may never
+  both carry the same capacity-marker name (``chip%d`` — geometry.py).  This
+  is the scheduler-side contract that makes overlapping ICI subslices
+  mutually exclusive, the TPU analog of MIG ``memorySlice%d`` capacities;
+* ``matchAttribute`` constraints across requests (gpu-test4.yaml:43-45's
+  ``parentUUID`` pattern → our ``hostId``/``sliceDomain``);
+* allocation is all-or-nothing per claim, via backtracking search.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import (
+    AllocationResult,
+    Device,
+    DeviceAllocationConfiguration,
+    DeviceAllocationResult,
+    DeviceClass,
+    DeviceRequestAllocationResult,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ResourceClaim,
+    ResourceSlice,
+)
+from k8s_dra_driver_tpu.scheduler import cel
+
+
+class AllocationError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class _Candidate:
+    driver: str
+    pool: str
+    device: Device
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.driver, self.pool, self.device.name)
+
+    def markers(self) -> frozenset[tuple[str, str]]:
+        """(pool, capacity-name) pairs consumed by this device."""
+        return frozenset((self.pool, name) for name in self.device.basic.capacity)
+
+
+def _device_env(c: _Candidate) -> dict:
+    """CEL environment for one device, mirroring k8s DRA's `device` variable:
+    attributes/capacity are maps keyed by qualified name then attribute."""
+    attrs = cel.AttrBag()
+    caps = cel.AttrBag()
+    for name, attr in c.device.basic.attributes.items():
+        attrs[name] = attr.value
+    for name, qty in c.device.basic.capacity.items():
+        caps[name] = qty
+    return {
+        "device": cel.AttrBag(
+            driver=c.driver,
+            attributes=cel.AttrBag({c.driver: attrs}),
+            capacity=cel.AttrBag({c.driver: caps}),
+        )
+    }
+
+
+def _matches_selectors(c: _Candidate, selectors) -> bool:
+    env = _device_env(c)
+    for sel in selectors or []:
+        if sel.cel is None:
+            continue
+        try:
+            if not cel.evaluate(sel.cel.expression, env) is True:
+                return False
+        except cel.CELError:
+            return False  # erroring selector == non-match
+    return True
+
+
+def _qualified_attr(c: _Candidate, qualified_name: str):
+    """Resolve a matchAttribute name like 'tpu.google.com/hostId'."""
+    if "/" in qualified_name:
+        domain, name = qualified_name.rsplit("/", 1)
+        if domain != c.driver:
+            return None
+    else:
+        name = qualified_name
+    attr = c.device.basic.attributes.get(name)
+    return None if attr is None else attr.value
+
+
+class Allocator:
+    """Allocates pending ResourceClaims against published ResourceSlices."""
+
+    def __init__(self, server: InMemoryAPIServer):
+        self._server = server
+
+    # -- public ------------------------------------------------------------
+
+    def allocate(
+        self,
+        claim: ResourceClaim,
+        node_name: str = "",
+        node_labels: Optional[dict[str, str]] = None,
+    ) -> ResourceClaim:
+        """Allocate ``claim`` for a pod placed on ``node_name``.
+
+        Writes ``status.allocation`` back through the API server and returns
+        the updated claim.  Raises AllocationError when the claim cannot be
+        satisfied on this node.
+        """
+        if claim.status.allocation is not None:
+            return claim  # already allocated (idempotent)
+        node_labels = dict(node_labels or {})
+        node_labels.setdefault("kubernetes.io/hostname", node_name)
+
+        candidates = self._visible_devices(node_name, node_labels)
+        in_use, used_markers = self._consumed()
+
+        free = [c for c in candidates if c.key not in in_use]
+
+        requests = claim.spec.devices.requests
+        if not requests:
+            raise AllocationError("claim has no device requests")
+
+        classes = {dc.metadata.name: dc for dc in self._server.list(DeviceClass.KIND)}
+
+        per_request: list[tuple[str, int, list[_Candidate]]] = []
+        for req in requests:
+            dc = classes.get(req.device_class_name)
+            if dc is None:
+                raise AllocationError(f"unknown DeviceClass {req.device_class_name!r}")
+            matching = [
+                c
+                for c in free
+                if _matches_selectors(c, dc.spec.selectors)
+                and _matches_selectors(c, req.selectors)
+            ]
+            if req.allocation_mode == "All":
+                count = len(matching)
+                if count == 0:
+                    raise AllocationError(f"request {req.name!r}: no devices match")
+            else:
+                count = req.count or 1
+            per_request.append((req.name, count, matching))
+
+        constraints = [
+            (set(con.requests or [r.name for r in requests]), con.match_attribute)
+            for con in claim.spec.devices.constraints
+            if con.match_attribute
+        ]
+
+        chosen = self._search(per_request, constraints, used_markers)
+        if chosen is None:
+            raise AllocationError(
+                f"claim {claim.metadata.name!r}: cannot satisfy "
+                f"{[(name, count) for name, count, _ in per_request]} on node {node_name!r}"
+            )
+
+        results = [
+            DeviceRequestAllocationResult(
+                request=req_name, driver=c.driver, pool=c.pool, device=c.device.name
+            )
+            for req_name, c in chosen
+        ]
+        config = self._gather_config(claim, requests, classes)
+        claim.status.allocation = AllocationResult(
+            devices=DeviceAllocationResult(results=results, config=config),
+            node_selector=NodeSelector(
+                node_selector_terms=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key="kubernetes.io/hostname", values=[node_name]
+                            )
+                        ]
+                    )
+                ]
+            )
+            if node_name
+            else None,
+        )
+        return self._server.update(claim)
+
+    def deallocate(self, claim: ResourceClaim) -> ResourceClaim:
+        claim.status.allocation = None
+        return self._server.update(claim)
+
+    # -- internals ---------------------------------------------------------
+
+    def _visible_devices(self, node_name: str, node_labels: dict[str, str]) -> list[_Candidate]:
+        slices = self._server.list(ResourceSlice.KIND)
+        # Per (driver, pool) keep only the highest generation.
+        max_gen: dict[tuple[str, str], int] = {}
+        for s in slices:
+            key = (s.spec.driver, s.spec.pool.name)
+            max_gen[key] = max(max_gen.get(key, -1), s.spec.pool.generation)
+        out = []
+        for s in slices:
+            if s.spec.pool.generation != max_gen[(s.spec.driver, s.spec.pool.name)]:
+                continue
+            if s.spec.node_name and s.spec.node_name != node_name:
+                continue
+            if s.spec.node_selector is not None and not s.spec.node_selector.matches(node_labels):
+                continue
+            for d in s.spec.devices:
+                out.append(_Candidate(driver=s.spec.driver, pool=s.spec.pool.name, device=d))
+        return out
+
+    def _consumed(self) -> tuple[set, set]:
+        """Devices and (pool, marker) pairs held by existing allocations."""
+        in_use: set = set()
+        used_markers: set = set()
+        device_index = {
+            (s.spec.driver, s.spec.pool.name, d.name): d
+            for s in self._server.list(ResourceSlice.KIND)
+            for d in s.spec.devices
+        }
+        for other in self._server.list(ResourceClaim.KIND):
+            if other.status.allocation is None:
+                continue
+            for r in other.status.allocation.devices.results:
+                in_use.add((r.driver, r.pool, r.device))
+                dev = device_index.get((r.driver, r.pool, r.device))
+                if dev is not None:
+                    for cap in dev.basic.capacity:
+                        used_markers.add((r.pool, cap))
+        return in_use, used_markers
+
+    def _search(self, per_request, constraints, used_markers):
+        """Backtracking all-or-nothing assignment honoring markers +
+        matchAttribute constraints."""
+        flat: list[tuple[str, list[_Candidate]]] = []
+        for name, count, matching in per_request:
+            if len(matching) < count:
+                return None
+            for _ in range(count):
+                flat.append((name, matching))
+
+        chosen: list[tuple[str, _Candidate]] = []
+        taken: set = set()
+        markers: set = set(used_markers)
+        attr_value: dict[str, object] = {}
+
+        def constraint_ok(req_name: str, c: _Candidate) -> bool:
+            for req_set, attr in constraints:
+                if req_name not in req_set:
+                    continue
+                value = _qualified_attr(c, attr)
+                if value is None:
+                    return False
+                if attr in attr_value and attr_value[attr] != value:
+                    return False
+            return True
+
+        def assign(i: int) -> bool:
+            if i == len(flat):
+                return True
+            req_name, matching = flat[i]
+            for c in matching:
+                if c.key in taken:
+                    continue
+                # hbm is a real quantity, not an exclusion marker; only the
+                # synthetic markers participate in overlap exclusion.
+                dev_markers = {
+                    (c.pool, cap) for cap in c.device.basic.capacity if cap.startswith("chip")
+                }
+                if dev_markers & markers:
+                    continue
+                if not constraint_ok(req_name, c):
+                    continue
+                saved_attrs = dict(attr_value)
+                for req_set, attr in constraints:
+                    if req_name in req_set and attr not in attr_value:
+                        attr_value[attr] = _qualified_attr(c, attr)
+                taken.add(c.key)
+                markers.update(dev_markers)
+                chosen.append((req_name, c))
+                if assign(i + 1):
+                    return True
+                chosen.pop()
+                markers.difference_update(dev_markers - set(used_markers))
+                taken.discard(c.key)
+                attr_value.clear()
+                attr_value.update(saved_attrs)
+            return False
+
+        return chosen if assign(0) else None
+
+    def _gather_config(self, claim, requests, classes) -> list[DeviceAllocationConfiguration]:
+        """Copy class + claim opaque configs into the allocation result with
+        their source recorded — the plugin's precedence resolution depends on
+        it (device_state.go:225-259: class < claim)."""
+        out = []
+        for req in requests:
+            dc = classes.get(req.device_class_name)
+            for cc in dc.spec.config or []:
+                if cc.opaque is not None:
+                    out.append(
+                        DeviceAllocationConfiguration(
+                            source="FromClass", requests=[req.name], opaque=cc.opaque
+                        )
+                    )
+        for cc in claim.spec.devices.config or []:
+            if cc.opaque is not None:
+                out.append(
+                    DeviceAllocationConfiguration(
+                        source="FromClaim", requests=list(cc.requests), opaque=cc.opaque
+                    )
+                )
+        return out

@@ -156,18 +156,29 @@ bool parse_fake_spec(const std::string& spec, std::string* gen, int* chips,
   return *chips > 0;
 }
 
-void finish_topology(Topology* t) {
+int finish_topology(Topology* t, bool force_single_host, std::string* err) {
   const auto& spec = gen_specs().at(t->generation);
   t->ndims = spec.ndims;
   int total = t->dims[0] * t->dims[1] * t->dims[2];
 
   // Single-host slices keep every chip local; multi-host slices partition the
   // mesh into host_bounds blocks (v5e: 2x2 chips/host; v4: 2x2x1).
-  if (total <= (t->generation == "v5e" || t->generation == "v6e" ? 8 : 4)) {
+  int single_host_max = (t->generation == "v5e" || t->generation == "v6e") ? 8 : 4;
+  if (force_single_host || total <= single_host_max) {
     t->host_bounds = t->dims;
     t->chips_per_host = total;
     t->host_count = 1;
   } else {
+    // A multi-host mesh must tile exactly into host blocks, or host
+    // coordinate math is undefined (division by zero / truncation).
+    for (int i = 0; i < 3; i++) {
+      if (t->dims[i] % spec.host_bounds[i] != 0) {
+        *err = "topology " + std::to_string(t->dims[0]) + "x" +
+               std::to_string(t->dims[1]) + "x" + std::to_string(t->dims[2]) +
+               " does not tile into " + t->generation + " host blocks";
+        return 1;
+      }
+    }
     t->host_bounds = spec.host_bounds;
     t->chips_per_host = spec.host_bounds[0] * spec.host_bounds[1] * spec.host_bounds[2];
     t->host_count = total / t->chips_per_host;
@@ -185,6 +196,7 @@ void finish_topology(Topology* t) {
     topo << t->dims[i];
   }
   t->topology = topo.str();
+  return 0;
 }
 
 // Host blocks are laid out row-major over the mesh-of-hosts; chips within a
@@ -240,7 +252,7 @@ int enumerate_fake(Topology* t, std::string* err) {
     return 1;
   }
   t->dims = dims;
-  finish_topology(t);
+  if (finish_topology(t, /*force_single_host=*/false, err)) return 1;
 
   std::string hid = getenv_str("TPUINFO_FAKE_HOST_ID");
   t->host_id = hid.empty() ? 0 : std::atoi(hid.c_str());
@@ -259,9 +271,14 @@ int enumerate_fake(Topology* t, std::string* err) {
 std::string read_file(const std::string& path) {
   std::ifstream f(path);
   if (!f) return "";
-  std::string s;
-  std::getline(f, s);
-  return s;
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+std::string first_line(const std::string& s) {
+  auto nl = s.find('\n');
+  return nl == std::string::npos ? s : s.substr(0, nl);
 }
 
 int enumerate_real(Topology* t, std::string* err) {
@@ -305,11 +322,24 @@ int enumerate_real(Topology* t, std::string* err) {
     have_dims = parse_fake_spec(t->generation + "-" + topo_env, &gen_ignored,
                                 &chips_ignored, &dims, &have_dims) && have_dims;
   }
+  bool linear_fallback = false;
   if (!have_dims && !shape_for(t->generation, static_cast<int>(indices.size()), &dims)) {
     dims = {static_cast<int>(indices.size()), 1, 1};  // linear fallback
+    linear_fallback = true;
   }
   t->dims = dims;
-  finish_topology(t);
+  // The linear fallback describes only what this host exposes — treat it as a
+  // single-host mesh rather than guessing multi-host block math.
+  if (finish_topology(t, /*force_single_host=*/linear_fallback, err)) return 1;
+
+  // The discovered device nodes must agree with the topology's
+  // chips-per-host: publishing phantom chips (dead device node) or silently
+  // dropping real ones corrupts scheduling either way.
+  if (static_cast<int>(indices.size()) != t->chips_per_host) {
+    *err = "found " + std::to_string(indices.size()) + " /dev/accel* nodes but topology " +
+           t->topology + " implies " + std::to_string(t->chips_per_host) + " chips per host";
+    return 1;
+  }
 
   std::string wid = getenv_str("TPU_WORKER_ID");
   t->host_id = wid.empty() ? 0 : std::atoi(wid.c_str());
@@ -334,10 +364,12 @@ int enumerate_real(Topology* t, std::string* err) {
     std::string pci = read_file(sys + "uevent");
     auto pos = pci.find("PCI_SLOT_NAME=");
     if (pos != std::string::npos) {
-      c.pci_address = pci.substr(pos + 14, 12);
+      auto end = pci.find('\n', pos);
+      c.pci_address = pci.substr(pos + 14, end == std::string::npos ? std::string::npos
+                                                                    : end - (pos + 14));
     }
   }
-  t->driver_version = read_file("/sys/module/tpu/version");
+  t->driver_version = first_line(read_file("/sys/module/tpu/version"));
   if (t->driver_version.empty()) t->driver_version = "accel-unknown";
   return 0;
 }

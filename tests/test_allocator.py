@@ -1,0 +1,389 @@
+"""Structured-parameter allocator tests.
+
+Covers the scheduler semantics the driver's published geometry relies on
+(SURVEY.md §3.5), including the central property: overlapping subslices are
+never co-allocated (the memorySlice%d analog)."""
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import (
+    CELDeviceSelector,
+    DeviceClaim,
+    DeviceClass,
+    DeviceClassSpec,
+    DeviceConstraint,
+    DeviceRequest,
+    DeviceSelector,
+    ObjectMeta,
+    ResourceClaim,
+    ResourceClaimSpec,
+)
+from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+    Slice,
+)
+from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+from k8s_dra_driver_tpu.scheduler.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+TPU_CLASS = "tpu.google.com"
+SUBSLICE_CLASS = "subslice.tpu.google.com"
+
+
+def sel(expr: str) -> DeviceSelector:
+    return DeviceSelector(cel=CELDeviceSelector(expression=expr))
+
+
+def install_classes(server):
+    server.create(
+        DeviceClass(
+            metadata=ObjectMeta(name=TPU_CLASS),
+            spec=DeviceClassSpec(
+                selectors=[
+                    sel(
+                        f"device.driver == '{DRIVER_NAME}' && "
+                        f"device.attributes['{DRIVER_NAME}'].type == 'tpu'"
+                    )
+                ]
+            ),
+        )
+    )
+    server.create(
+        DeviceClass(
+            metadata=ObjectMeta(name=SUBSLICE_CLASS),
+            spec=DeviceClassSpec(
+                selectors=[
+                    sel(
+                        f"device.driver == '{DRIVER_NAME}' && "
+                        f"device.attributes['{DRIVER_NAME}'].type == 'subslice'"
+                    )
+                ]
+            ),
+        )
+    )
+
+
+def publish_host(server, spec="v5e-16", host_id=0, node="host0", pool=None):
+    """Publish one TPU host's inventory.  ``pool`` lets tests co-locate
+    several host-blocks' pools on one k8s node (device names collide across
+    pools otherwise)."""
+    pool = pool or node
+    topo = enumerate_topology(
+        env={"TPUINFO_FAKE_TOPOLOGY": spec, "TPUINFO_FAKE_HOST_ID": str(host_id)}
+    )
+    devices = AllocatableDevices.from_topology(topo).get_devices()
+    ctrl = ResourceSliceController(server, DRIVER_NAME, pool)
+    ctrl.update(
+        DriverResources(pools={pool: Pool(slices=[Slice(devices=devices)], node_name=node)})
+    )
+    return topo
+
+
+def make_claim(server, name, requests, constraints=None):
+    claim = ResourceClaim(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=ResourceClaimSpec(
+            devices=DeviceClaim(requests=requests, constraints=constraints or [])
+        ),
+    )
+    return server.create(claim)
+
+
+@pytest.fixture
+def cluster(api_server):
+    install_classes(api_server)
+    publish_host(api_server)
+    return api_server
+
+
+class TestBasicAllocation:
+    def test_single_chip(self, cluster):
+        claim = make_claim(
+            cluster, "c1", [DeviceRequest(name="tpu", device_class_name=TPU_CLASS)]
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        results = updated.status.allocation.devices.results
+        assert len(results) == 1
+        assert results[0].device.startswith("tpu-")
+        assert updated.status.allocation.node_selector.matches(
+            {"kubernetes.io/hostname": "host0"}
+        )
+
+    def test_exact_count(self, cluster):
+        claim = make_claim(
+            cluster,
+            "c2",
+            [DeviceRequest(name="tpus", device_class_name=TPU_CLASS, count=4)],
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        assert len(updated.status.allocation.devices.results) == 4
+
+    def test_insufficient_devices(self, cluster):
+        claim = make_claim(
+            cluster,
+            "c3",
+            [DeviceRequest(name="tpus", device_class_name=TPU_CLASS, count=5)],
+        )
+        with pytest.raises(AllocationError):
+            Allocator(cluster).allocate(claim, node_name="host0")
+
+    def test_wrong_node_sees_nothing(self, cluster):
+        claim = make_claim(
+            cluster, "c4", [DeviceRequest(name="tpu", device_class_name=TPU_CLASS)]
+        )
+        with pytest.raises(AllocationError):
+            Allocator(cluster).allocate(claim, node_name="other-host")
+
+    def test_allocation_mode_all(self, cluster):
+        claim = make_claim(
+            cluster,
+            "c5",
+            [DeviceRequest(name="all", device_class_name=TPU_CLASS, allocation_mode="All")],
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        assert len(updated.status.allocation.devices.results) == 4
+
+    def test_idempotent(self, cluster):
+        claim = make_claim(
+            cluster, "c6", [DeviceRequest(name="tpu", device_class_name=TPU_CLASS)]
+        )
+        a = Allocator(cluster)
+        first = a.allocate(claim, node_name="host0")
+        again = a.allocate(first, node_name="host0")
+        assert again.status.allocation.devices.results == first.status.allocation.devices.results
+
+
+class TestSelectors:
+    def test_request_level_cel(self, cluster):
+        claim = make_claim(
+            cluster,
+            "c1",
+            [
+                DeviceRequest(
+                    name="tpu",
+                    device_class_name=TPU_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].index in [2, 3]")],
+                )
+            ],
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        assert updated.status.allocation.devices.results[0].device in ("tpu-2", "tpu-3")
+
+    def test_shape_selector(self, cluster):
+        claim = make_claim(
+            cluster,
+            "c2",
+            [
+                DeviceRequest(
+                    name="slice",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")],
+                )
+            ],
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        assert updated.status.allocation.devices.results[0].device == "tpu-slice-2x2-0-0"
+
+    def test_erroring_selector_is_nonmatch(self, cluster):
+        claim = make_claim(
+            cluster,
+            "c3",
+            [
+                DeviceRequest(
+                    name="tpu",
+                    device_class_name=TPU_CLASS,
+                    selectors=[sel("device.attributes['missing.domain'].x == 1")],
+                )
+            ],
+        )
+        with pytest.raises(AllocationError):
+            Allocator(cluster).allocate(claim, node_name="host0")
+
+
+class TestOverlapExclusion:
+    def test_subslice_excludes_chip(self, cluster):
+        a = Allocator(cluster)
+        slice_claim = make_claim(
+            cluster,
+            "slice",
+            [
+                DeviceRequest(
+                    name="s",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")],
+                )
+            ],
+        )
+        a.allocate(cluster.get("ResourceClaim", "slice", "default"), node_name="host0")
+        # The 2x2 subslice covers all 4 chips: any chip claim must now fail.
+        chip_claim = make_claim(
+            cluster, "chip", [DeviceRequest(name="t", device_class_name=TPU_CLASS)]
+        )
+        with pytest.raises(AllocationError):
+            a.allocate(chip_claim, node_name="host0")
+
+    def test_chip_excludes_covering_subslice_only(self, cluster):
+        a = Allocator(cluster)
+        chip0 = make_claim(
+            cluster,
+            "chip0",
+            [
+                DeviceRequest(
+                    name="t",
+                    device_class_name=TPU_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].index == 0")],
+                )
+            ],
+        )
+        a.allocate(chip0, node_name="host0")
+        # 1x2 at origin (1,0) covers chips 1,3 (column x=1) — still free.
+        ok = make_claim(
+            cluster,
+            "free-slice",
+            [
+                DeviceRequest(
+                    name="s",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[
+                        sel(
+                            f"device.attributes['{DRIVER_NAME}'].shape == '1x2' && "
+                            f"device.attributes['{DRIVER_NAME}'].originX == 1"
+                        )
+                    ],
+                )
+            ],
+        )
+        updated = a.allocate(ok, node_name="host0")
+        assert updated.status.allocation is not None
+        # But the covering 2x2 must fail.
+        bad = make_claim(
+            cluster,
+            "covering",
+            [
+                DeviceRequest(
+                    name="s",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")],
+                )
+            ],
+        )
+        with pytest.raises(AllocationError):
+            a.allocate(bad, node_name="host0")
+
+    def test_disjoint_subslices_coexist(self, cluster):
+        a = Allocator(cluster)
+        for origin in (0, 1):
+            claim = make_claim(
+                cluster,
+                f"s{origin}",
+                [
+                    DeviceRequest(
+                        name="s",
+                        device_class_name=SUBSLICE_CLASS,
+                        selectors=[
+                            sel(
+                                f"device.attributes['{DRIVER_NAME}'].shape == '1x2' && "
+                                f"device.attributes['{DRIVER_NAME}'].originX == {origin}"
+                            )
+                        ],
+                    )
+                ],
+            )
+            assert a.allocate(claim, node_name="host0").status.allocation
+
+
+class TestConstraints:
+    def test_match_attribute_same_host(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, host_id=0, node="host0", pool="block0")
+        publish_host(api_server, host_id=1, node="host0", pool="block1")
+        claim = make_claim(
+            api_server,
+            "pair",
+            [
+                DeviceRequest(name="a", device_class_name=TPU_CLASS, count=2),
+                DeviceRequest(name="b", device_class_name=TPU_CLASS, count=2),
+            ],
+            constraints=[
+                DeviceConstraint(requests=[], match_attribute=f"{DRIVER_NAME}/hostId")
+            ],
+        )
+        updated = Allocator(api_server).allocate(claim, node_name="host0")
+        slices = api_server.list("ResourceSlice")
+        host_ids = set()
+        for r in updated.status.allocation.devices.results:
+            for s in slices:
+                if s.spec.pool.name == r.pool:
+                    for d in s.spec.devices:
+                        if d.name == r.device:
+                            host_ids.add(d.basic.attributes["hostId"].value)
+        assert len(host_ids) == 1
+
+    def test_match_attribute_unsatisfiable(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, host_id=0, node="host0", pool="block0")
+        publish_host(api_server, host_id=1, node="host0", pool="block1")
+        # 5 chips same hostId is impossible (4 per host block)
+        claim = make_claim(
+            api_server,
+            "five",
+            [DeviceRequest(name="a", device_class_name=TPU_CLASS, count=5)],
+            constraints=[
+                DeviceConstraint(requests=["a"], match_attribute=f"{DRIVER_NAME}/hostId")
+            ],
+        )
+        with pytest.raises(AllocationError):
+            Allocator(api_server).allocate(claim, node_name="host0")
+
+
+class TestBacktracking:
+    def test_all_or_nothing_forces_disjoint_choice(self, cluster):
+        # Request both a 2x1 and a 2x2... impossible (2x2 is the whole block
+        # minus nothing; 2x1 overlaps it) → whole claim fails, nothing leaks.
+        claim = make_claim(
+            cluster,
+            "both",
+            [
+                DeviceRequest(
+                    name="a",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")],
+                ),
+                DeviceRequest(
+                    name="b",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x1'")],
+                ),
+            ],
+        )
+        with pytest.raises(AllocationError):
+            Allocator(cluster).allocate(claim, node_name="host0")
+        fresh = cluster.get("ResourceClaim", "both", "default")
+        assert fresh.status.allocation is None
+
+    def test_two_disjoint_slices_found_by_search(self, cluster):
+        # Two 1x2 requests: the only non-overlapping assignment is the two
+        # distinct columns; the search must find it.
+        claim = make_claim(
+            cluster,
+            "cols",
+            [
+                DeviceRequest(
+                    name="a",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '1x2'")],
+                ),
+                DeviceRequest(
+                    name="b",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '1x2'")],
+                ),
+            ],
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        devices = {r.device for r in updated.status.allocation.devices.results}
+        assert devices == {"tpu-slice-1x2-0-0", "tpu-slice-1x2-1-0"}

@@ -1,0 +1,80 @@
+"""CEL-subset evaluator tests."""
+
+import pytest
+
+from k8s_dra_driver_tpu.scheduler.cel import AttrBag, CELError, evaluate
+
+
+ENV = {
+    "device": AttrBag(
+        driver="tpu.google.com",
+        attributes=AttrBag(
+            {
+                "tpu.google.com": AttrBag(
+                    type="tpu",
+                    index=3,
+                    productName="tpu-v5e",
+                    healthy=True,
+                    shape="2x2",
+                )
+            }
+        ),
+        capacity=AttrBag({"tpu.google.com": AttrBag(hbm="16Gi")}),
+    )
+}
+
+
+@pytest.mark.parametrize(
+    "expr,expected",
+    [
+        ("device.driver == 'tpu.google.com'", True),
+        ('device.driver == "gpu.nvidia.com"', False),
+        ("device.attributes['tpu.google.com'].type == 'tpu'", True),
+        (
+            "device.driver == 'tpu.google.com' && device.attributes['tpu.google.com'].type == 'tpu'",
+            True,
+        ),
+        ("device.attributes['tpu.google.com'].index in [0, 1, 3]", True),
+        ("device.attributes['tpu.google.com'].index in [0, 1]", False),
+        ("device.attributes['tpu.google.com'].productName.matches('v5e|v6e')", True),
+        ("device.attributes['tpu.google.com'].productName.startsWith('tpu-')", True),
+        ("device.attributes['tpu.google.com'].productName.endsWith('v4')", False),
+        ("device.attributes['tpu.google.com'].productName.contains('5e')", True),
+        ("size(device.attributes['tpu.google.com'].shape) == 3", True),
+        ("device.attributes['tpu.google.com'].index >= 2", True),
+        ("device.attributes['tpu.google.com'].index + 1 == 4", True),
+        ("!device.attributes['tpu.google.com'].healthy", False),
+        ("device.attributes['tpu.google.com'].healthy ? 1 : 2", 1),
+        ("1 < 2 || 3 < 2", True),
+        ("10 % 3", 1),
+        ("-(2 * 3) + 7", 1),
+        ("[1, 2][1]", 2),
+    ],
+)
+def test_eval(expr, expected):
+    assert evaluate(expr, ENV) == expected
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "unknownVar == 1",
+        "device.attributes['other.domain'].type == 'x'",  # missing key
+        "device.attributes['tpu.google.com'].nope == 1",
+        "device.driver ==",  # syntax
+        "device.driver == 'a' &&",  # syntax
+        "1 +",  # syntax
+        "device.attributes['tpu.google.com'].index.matches('x')",  # non-string recv
+        "'a'.matches('[')",  # bad regex
+        "1 && true",  # non-bool operand
+    ],
+)
+def test_errors(expr):
+    with pytest.raises(CELError):
+        evaluate(expr, ENV)
+
+
+def test_short_circuit_does_not_mask_type_sanity():
+    # && short-circuits like CEL: the erroring RHS is never evaluated.
+    assert evaluate("false && unknownVar == 1", ENV) is False
+    assert evaluate("true || unknownVar == 1", ENV) is True

@@ -53,6 +53,16 @@ class TestEnumerateSubslices:
             assert sorted(covered) == list(range(8)), shape
             assert len(set(covered)) == len(covered), shape
 
+    def test_non_power_of_two_block_placements_fit(self):
+        # 6x1 host block: extent-4 shapes only fit at origin 0; no placement
+        # may reference chips outside the block.
+        t = fake("v5e-6x1")
+        subs = enumerate_subslices(t)
+        for s in subs:
+            assert all(0 <= i < 6 for i in s.chip_indices), s
+        assert [s.origin for s in subs if s.shape == (4, 1, 1)] == [(0, 0, 0)]
+        AllocatableDevices.from_topology(t).get_devices()  # no IndexError
+
     def test_global_origins_offset_by_host(self, ):
         t = fake("v5e-16", host_id=3)
         assert host_origin(t) == (2, 2, 0)

@@ -1,0 +1,331 @@
+"""DeviceState Prepare/Unprepare tests: CDI specs, checkpointing, sharing
+managers, config precedence, and compensable rollback."""
+
+import json
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.api import API_VERSION
+from k8s_dra_driver_tpu.kube.objects import (
+    Deployment,
+    DeviceClaimConfiguration,
+    DeviceRequest,
+    OpaqueDeviceConfiguration,
+)
+from k8s_dra_driver_tpu.plugin.device_state import (
+    DeviceState,
+    DeviceStateConfig,
+    PrepareError,
+)
+from k8s_dra_driver_tpu.plugin.sharing import SharingError
+from tests.test_allocator import (
+    SUBSLICE_CLASS,
+    TPU_CLASS,
+    install_classes,
+    make_claim,
+    publish_host,
+    sel,
+)
+
+
+def daemon_controller(server):
+    """Simulates the kubelet/deployment controller: marks topology-daemon
+    Deployments ready as soon as they appear."""
+
+    def on_event(event):
+        dep = event.object
+        if event.type in ("ADDED",) and not (dep.status or {}).get("readyReplicas"):
+            dep.status = {"readyReplicas": 1}
+            server.update(dep)
+
+    return server.watch(Deployment.KIND, on_event)
+
+
+@pytest.fixture
+def cluster(api_server):
+    install_classes(api_server)
+    publish_host(api_server)
+    return api_server
+
+
+@pytest.fixture
+def state(cluster, tmp_path):
+    return DeviceState(
+        cluster,
+        DeviceStateConfig(
+            node_name="host0",
+            cdi_root=str(tmp_path / "cdi"),
+            checkpoint_path=str(tmp_path / "checkpoint.json"),
+            topology_env={"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"},
+            daemon_backoff_initial=0.001,
+            daemon_backoff_steps=2,
+        ),
+    )
+
+
+def allocate(cluster, name, requests, config=None):
+    from k8s_dra_driver_tpu.scheduler.allocator import Allocator
+
+    claim = make_claim(cluster, name, requests)
+    if config:
+        claim.spec.devices.config = config
+        claim = cluster.update(claim)
+    return Allocator(cluster).allocate(claim, node_name="host0")
+
+
+def opaque(parameters, requests=()):
+    return DeviceClaimConfiguration(
+        requests=list(requests),
+        opaque=OpaqueDeviceConfiguration(driver=DRIVER_NAME, parameters=parameters),
+    )
+
+
+class TestExclusivePrepare:
+    def test_single_chip(self, cluster, state, tmp_path):
+        claim = allocate(cluster, "c1", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        devices = state.prepare(claim)
+        assert len(devices) == 1
+        d = devices[0]
+        assert d["pool_name"] == "host0"
+        assert d["device_name"].startswith("tpu-")
+        assert len(d["cdi_device_ids"]) == 2
+        assert d["cdi_device_ids"][0].startswith("k8s.tpu.google.com/tpu=")
+        spec_path = tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-claim-{claim.metadata.uid}.json"
+        spec = json.loads(spec_path.read_text())
+        env = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+        assert env["TPU_VISIBLE_DEVICES"] in {"0", "1", "2", "3"}
+
+    def test_base_spec_has_all_devices(self, state, tmp_path):
+        base = json.loads((tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-base.json").read_text())
+        names = {d["name"] for d in base["devices"]}
+        assert {"tpu-0", "tpu-slice-2x2-0-0"} <= names
+        assert base["kind"] == "k8s.tpu.google.com/tpu"
+        # chips carry their device node
+        chip = [d for d in base["devices"] if d["name"] == "tpu-0"][0]
+        assert chip["containerEdits"]["deviceNodes"] == [{"path": "/dev/accel0"}]
+
+    def test_subslice_bounds_env(self, cluster, state, tmp_path):
+        claim = allocate(
+            cluster,
+            "c2",
+            [
+                DeviceRequest(
+                    name="s",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")],
+                )
+            ],
+        )
+        state.prepare(claim)
+        spec = json.loads(
+            (tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-claim-{claim.metadata.uid}.json").read_text()
+        )
+        env = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+        assert env["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+        assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+    def test_idempotent_and_checkpoint_restore(self, cluster, state, tmp_path):
+        claim = allocate(cluster, "c3", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        first = state.prepare(claim)
+        assert state.prepare(claim) == first
+
+        # a fresh DeviceState (plugin restart) restores from checkpoint
+        restarted = DeviceState(
+            cluster,
+            DeviceStateConfig(
+                node_name="host0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "checkpoint.json"),
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                    "TPUINFO_FAKE_HOST_ID": "0",
+                },
+            ),
+        )
+        assert restarted.prepare(claim) == first
+        assert restarted.prepared_claim_uids() == [claim.metadata.uid]
+
+    def test_unprepare_removes_state(self, cluster, state, tmp_path):
+        claim = allocate(cluster, "c4", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        state.prepare(claim)
+        state.unprepare(claim.metadata.uid)
+        assert state.prepared_claim_uids() == []
+        assert not (
+            tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-claim-{claim.metadata.uid}.json"
+        ).exists()
+        state.unprepare(claim.metadata.uid)  # idempotent
+
+    def test_prepare_unallocated_claim_fails(self, cluster, state):
+        claim = make_claim(cluster, "c5", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        with pytest.raises(PrepareError, match="no allocation"):
+            state.prepare(claim)
+
+
+class TestSharingConfigs:
+    def test_time_slicing_from_claim_config(self, cluster, state, tmp_path):
+        claim = allocate(
+            cluster,
+            "ts",
+            [DeviceRequest(name="t", device_class_name=TPU_CLASS)],
+            config=[
+                opaque(
+                    {
+                        "apiVersion": API_VERSION,
+                        "kind": "TpuConfig",
+                        "sharing": {
+                            "strategy": "TimeSlicing",
+                            "timeSlicingConfig": {"interval": "Long"},
+                        },
+                    }
+                )
+            ],
+        )
+        state.prepare(claim)
+        spec = json.loads(
+            (tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-claim-{claim.metadata.uid}.json").read_text()
+        )
+        env = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+        assert env["TPU_SHARING_STRATEGY"] == "time-slicing"
+        assert env["TPU_QUEUE_QUANTUM_MS"] == "20"
+
+    def test_spatial_partition_spawns_daemon(self, cluster, state):
+        watch = daemon_controller(cluster)
+        claim = allocate(
+            cluster,
+            "sp",
+            [DeviceRequest(name="t", device_class_name=TPU_CLASS, count=2)],
+            config=[
+                opaque(
+                    {
+                        "apiVersion": API_VERSION,
+                        "kind": "TpuConfig",
+                        "sharing": {
+                            "strategy": "SpatialPartition",
+                            "spatialPartitionConfig": {"defaultHbmLimit": "4Gi"},
+                        },
+                    }
+                )
+            ],
+        )
+        state.prepare(claim)
+        daemons = cluster.list(Deployment.KIND, namespace="tpu-dra-driver")
+        assert len(daemons) == 1
+        assert daemons[0].metadata.name.startswith("tpu-topology-daemon-")
+        # teardown deletes the daemon
+        state.unprepare(claim.metadata.uid)
+        assert cluster.list(Deployment.KIND, namespace="tpu-dra-driver") == []
+        watch.stop()
+
+    def test_spatial_partition_rollback_on_unready_daemon(self, cluster, state, tmp_path):
+        # No daemon controller -> readiness never arrives -> prepare fails and
+        # compensable undo removes the daemon Deployment; nothing checkpointed.
+        claim = allocate(
+            cluster,
+            "sp-fail",
+            [DeviceRequest(name="t", device_class_name=TPU_CLASS)],
+            config=[
+                opaque(
+                    {
+                        "apiVersion": API_VERSION,
+                        "kind": "TpuConfig",
+                        "sharing": {"strategy": "SpatialPartition"},
+                    }
+                )
+            ],
+        )
+        with pytest.raises(SharingError, match="did not become ready"):
+            state.prepare(claim)
+        assert cluster.list(Deployment.KIND, namespace="tpu-dra-driver") == []
+        assert state.prepared_claim_uids() == []
+        assert not (
+            tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-claim-{claim.metadata.uid}.json"
+        ).exists()
+
+    def test_class_config_overridden_by_claim_config(self, cluster, state):
+        # Simulate a class-level TimeSlicing default overridden by the
+        # claim's Exclusive config: reverse-precedence scan must pick the
+        # claim's (device_state.go:225-259).
+        claim = allocate(cluster, "prec", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        from k8s_dra_driver_tpu.kube.objects import DeviceAllocationConfiguration
+
+        claim.status.allocation.devices.config = [
+            DeviceAllocationConfiguration(
+                source="FromClass",
+                opaque=OpaqueDeviceConfiguration(
+                    driver=DRIVER_NAME,
+                    parameters={
+                        "apiVersion": API_VERSION,
+                        "kind": "TpuConfig",
+                        "sharing": {"strategy": "TimeSlicing"},
+                    },
+                ),
+            ),
+            DeviceAllocationConfiguration(
+                source="FromClaim",
+                requests=["t"],
+                opaque=OpaqueDeviceConfiguration(
+                    driver=DRIVER_NAME,
+                    parameters={
+                        "apiVersion": API_VERSION,
+                        "kind": "TpuConfig",
+                        "sharing": {"strategy": "Exclusive"},
+                    },
+                ),
+            ),
+        ]
+        claim = cluster.update(claim)
+        state.prepare(claim)
+        group = state.prepared[claim.metadata.uid].groups[0]
+        assert group.config_state.strategy == "Exclusive"
+
+    def test_config_kind_device_mismatch(self, cluster, state):
+        claim = allocate(
+            cluster,
+            "mismatch",
+            [
+                DeviceRequest(
+                    name="s",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")],
+                )
+            ],
+            config=[
+                opaque(
+                    {"apiVersion": API_VERSION, "kind": "TpuConfig"},
+                    requests=["s"],
+                )
+            ],
+        )
+        with pytest.raises(PrepareError, match="cannot apply"):
+            state.prepare(claim)
+
+    def test_foreign_driver_config_ignored(self, cluster, state):
+        claim = allocate(cluster, "foreign", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        from k8s_dra_driver_tpu.kube.objects import DeviceAllocationConfiguration
+
+        claim.status.allocation.devices.config = [
+            DeviceAllocationConfiguration(
+                source="FromClaim",
+                opaque=OpaqueDeviceConfiguration(
+                    driver="gpu.nvidia.com", parameters={"kind": "GpuConfig"}
+                ),
+            )
+        ]
+        claim = cluster.update(claim)
+        state.prepare(claim)  # must not try to decode the foreign config
+        assert state.prepared[claim.metadata.uid].groups[0].config_state.strategy == "Exclusive"
+
+
+class TestCheckpointIntegrity:
+    def test_corrupt_checkpoint_detected(self, tmp_path):
+        from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile, CorruptCheckpoint
+
+        cp = CheckpointFile(tmp_path / "checkpoint.json")
+        cp.write({"uid1": {"uid": "uid1"}})
+        assert cp.read() == {"uid1": {"uid": "uid1"}}
+        raw = (tmp_path / "checkpoint.json").read_text().replace("uid1", "uid2")
+        (tmp_path / "checkpoint.json").write_text(raw)
+        with pytest.raises(CorruptCheckpoint, match="checksum"):
+            cp.read()

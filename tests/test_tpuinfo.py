@@ -74,6 +74,17 @@ class TestFakeTopologies:
         with pytest.raises(TpuInfoError):
             fake(spec)
 
+    def test_non_tileable_multihost_topology_errors_cleanly(self):
+        # 12x1 exceeds the single-host limit but does not tile into 2x2 host
+        # blocks: must be a clean error, not a SIGFPE in host-coord math.
+        with pytest.raises(TpuInfoError, match="does not tile"):
+            fake("v5e-12x1")
+
+    def test_odd_single_host_topology_works(self):
+        t = fake("v5e-6x1")
+        assert t.host_count == 1 and t.chips_per_host == 6
+        assert len(t.chips) == 6
+
     def test_host_id_out_of_range(self):
         with pytest.raises(TpuInfoError, match="out of range"):
             fake("v5e-16", host_id=4)
