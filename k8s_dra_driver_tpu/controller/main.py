@@ -1,0 +1,68 @@
+"""Controller binary: ``python -m k8s_dra_driver_tpu.controller.main``.
+
+Mirror of cmd/nvidia-dra-controller/main.go (241 LoC): flags with env
+mirrors, optional HTTP diagnostics endpoint (pprof/metrics analog —
+observability.py), the slice manager started only when the membership device
+class is enabled."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
+from k8s_dra_driver_tpu.e2e.harness import install_device_classes
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.utils.logging import get_logger
+
+log = get_logger("tpu-dra-controller")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-dra-controller")
+    p.add_argument(
+        "--device-classes",
+        default=os.environ.get("DEVICE_CLASSES", "tpu,subslice,membership"),
+        help="comma-separated enabled classes; membership enables the slice manager",
+    )
+    p.add_argument(
+        "--retry-timeout-s",
+        type=float,
+        default=float(os.environ.get("RETRY_TIMEOUT_S", "60")),
+    )
+    p.add_argument("--fake-cluster", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.fake_cluster:
+        log.error("only --fake-cluster mode is wired in this build")
+        return 2
+    server = InMemoryAPIServer()
+    install_device_classes(server)
+
+    manager = None
+    if "membership" in args.device_classes.split(","):
+        manager = SliceManager(server, retry_timeout_s=args.retry_timeout_s)
+        manager.start()
+        log.info("slice manager watching node slice-domain labels")
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    # Retry loop for transiently-failed domains (imex.go:131-151).
+    while not stop.wait(timeout=1.0):
+        if manager is not None:
+            manager.retry_pending()
+    if manager is not None:
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,203 @@
+"""Cluster-scoped multi-host slice controller.
+
+Mirror of the reference's ImexManager (cmd/nvidia-dra-controller/imex.go,
+416 LoC, SURVEY.md §2.3), re-imagined for TPU multi-host slices: where the
+IMEX manager watches ``nvidia.com/gpu.imex-domain`` node labels and publishes
+per-domain pools of fungible channel devices, this manager watches TPU slice
+-domain labels (GKE provisions multi-host slices atomically and labels every
+node) and publishes per-domain pools of **membership seats** — one per worker
+host — each carrying the worker id, host count and coordinator address a JAX
+process needs to join the slice (jax.distributed / megascale wiring).
+
+Kept behaviors (imex.go citations):
+* first/last-node edge detection per domain via Node informer (:207-295)
+* offset-window assignment out of a global seat budget (:319-351)
+* NodeSelector-gated ResourceSlice pools via the declarative reconciler (:371-416)
+* transient-error retry after a timeout (:131-151)
+* deletion of all owned slices on shutdown (:298-316)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.kube.objects import (
+    Node,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+    Slice,
+)
+from k8s_dra_driver_tpu.plugin.deviceinfo import SliceMembershipInfo
+
+SLICE_DOMAIN_LABEL = "tpu.google.com/slice-domain"
+SLICE_HOST_ID_LABEL = "tpu.google.com/slice-host-id"
+
+# Global seat budget and per-slice cap (imex.go:43-46's 2048/128 analogs).
+DRIVER_MEMBERSHIP_LIMIT = 2048
+MEMBERSHIP_PER_SLICE_LIMIT = 128
+RETRY_TIMEOUT_S = 60.0
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+class TransientError(RuntimeError):
+    """Retryable condition (seat budget exhaustion), imex.go:49."""
+
+
+@dataclass
+class _Domain:
+    nodes: dict[str, int] = field(default_factory=dict)  # node name -> host id
+    offset: int = -1
+
+
+class SliceManager:
+    def __init__(
+        self,
+        server,
+        owner: str = "controller",
+        retry_timeout_s: float = RETRY_TIMEOUT_S,
+        clock=time.monotonic,
+    ):
+        self._server = server
+        self._lock = threading.Lock()
+        self._domains: dict[str, _Domain] = {}
+        self._offsets: dict[str, int] = {}  # domain -> window start
+        self._retry: dict[str, float] = {}  # domain -> earliest retry time
+        self._retry_timeout = retry_timeout_s
+        self._clock = clock
+        self._controller = ResourceSliceController(server, DRIVER_NAME, owner)
+        self._watch = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._watch = self._server.watch(Node.KIND, self._on_node_event)
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+        self._controller.stop(delete_owned=True)  # imex.go:298-316
+
+    def retry_pending(self) -> None:
+        """Re-attempt domains parked on transient errors whose timeout has
+        elapsed (imex.go:131-151's RetryTimeout loop, driven externally for
+        determinism)."""
+        with self._lock:
+            now = self._clock()
+            due = [d for d, t in self._retry.items() if t <= now]
+            for domain in due:
+                del self._retry[domain]
+            if due:
+                self._publish()
+
+    # -- node informer (imex.go:207-295) -----------------------------------
+
+    def _on_node_event(self, event) -> None:
+        node = event.object
+        domain = node.metadata.labels.get(SLICE_DOMAIN_LABEL)
+        with self._lock:
+            if event.type == "DELETED" or domain is None:
+                changed = self._forget_node(node.metadata.name)
+            else:
+                host_id = int(node.metadata.labels.get(SLICE_HOST_ID_LABEL, "0"))
+                changed = self._remember_node(domain, node.metadata.name, host_id)
+            if changed:
+                self._publish()
+
+    def _remember_node(self, domain: str, node_name: str, host_id: int) -> bool:
+        # A node can move between domains (slice re-provisioned): drop any
+        # old membership first.
+        changed = self._forget_node(node_name, except_domain=domain)
+        d = self._domains.setdefault(domain, _Domain())
+        if d.nodes.get(node_name) != host_id:
+            d.nodes[node_name] = host_id
+            changed = True
+        return changed
+
+    def _forget_node(self, node_name: str, except_domain: str | None = None) -> bool:
+        changed = False
+        for domain, d in list(self._domains.items()):
+            if domain == except_domain:
+                continue
+            if node_name in d.nodes:
+                del d.nodes[node_name]
+                changed = True
+                if not d.nodes:  # last node: domain gone (imex.go:233-277)
+                    del self._domains[domain]
+                    self._offsets.pop(domain, None)
+                    self._retry.pop(domain, None)
+        return changed
+
+    # -- seat-window assignment (imex.go:319-351) ---------------------------
+
+    def _assign_offset(self, domain: str) -> int:
+        if domain in self._offsets:
+            return self._offsets[domain]
+        used = set(self._offsets.values())
+        for offset in range(0, DRIVER_MEMBERSHIP_LIMIT, MEMBERSHIP_PER_SLICE_LIMIT):
+            if offset not in used:
+                self._offsets[domain] = offset
+                return offset
+        raise TransientError(
+            f"all {DRIVER_MEMBERSHIP_LIMIT // MEMBERSHIP_PER_SLICE_LIMIT} "
+            f"membership windows in use; cannot admit domain {domain!r}"
+        )
+
+    # -- pool publication (imex.go:371-416) ---------------------------------
+
+    def _publish(self) -> None:
+        pools: dict[str, Pool] = {}
+        for domain, d in sorted(self._domains.items()):
+            if domain in self._retry:
+                continue
+            try:
+                self._assign_offset(domain)
+            except TransientError:
+                self._retry[domain] = self._clock() + self._retry_timeout
+                continue
+            host_count = len(d.nodes)
+            coordinator = self._coordinator_address(d)
+            devices = [
+                SliceMembershipInfo(
+                    domain=domain,
+                    worker_id=worker_id,
+                    host_count=host_count,
+                    coordinator_address=coordinator,
+                ).get_device()
+                for worker_id in sorted(d.nodes.values())
+            ]
+            pools[f"slice-{domain}"] = Pool(
+                slices=[Slice(devices=devices)],
+                node_selector=NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key=SLICE_DOMAIN_LABEL, values=[domain]
+                                )
+                            ]
+                        )
+                    ]
+                ),
+            )
+        self._controller.update(DriverResources(pools=pools))
+
+    def _coordinator_address(self, d: _Domain) -> str:
+        """Worker 0's node is the jax.distributed coordinator."""
+        for node_name, host_id in sorted(d.nodes.items(), key=lambda kv: kv[1]):
+            return f"{node_name}:{DEFAULT_COORDINATOR_PORT}"
+        return ""
+
+    # -- introspection ------------------------------------------------------
+
+    def domains(self) -> dict[str, int]:
+        with self._lock:
+            return {domain: len(d.nodes) for domain, d in self._domains.items()}

@@ -15,11 +15,10 @@ Spec layout on disk (cdi_root, default /var/run/cdi):
 
 from __future__ import annotations
 
-import json
-import os
-import tempfile
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from k8s_dra_driver_tpu.utils.fileio import write_json_atomic
 
 from k8s_dra_driver_tpu import DRIVER_NAME
 from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
@@ -178,12 +177,4 @@ class CDIHandler:
         return ContainerEdits()
 
     def _write(self, path: Path, spec: dict) -> Path:
-        fd, tmp = tempfile.mkstemp(dir=self.cdi_root, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(spec, f, indent=2, sort_keys=True)
-            os.replace(tmp, path)
-        except BaseException:
-            os.unlink(tmp)
-            raise
-        return path
+        return write_json_atomic(path, spec)

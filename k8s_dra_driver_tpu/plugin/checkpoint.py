@@ -10,10 +10,10 @@ from __future__ import annotations
 
 import hashlib
 import json
-import os
-import tempfile
 from pathlib import Path
 from typing import Any
+
+from k8s_dra_driver_tpu.utils.fileio import write_json_atomic
 
 SCHEMA_VERSION = "v1"
 
@@ -50,12 +50,4 @@ class CheckpointFile:
             "checksum": _checksum(payload),
             "preparedClaims": prepared_claims,
         }
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=self.path.parent, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(doc, f, indent=1, sort_keys=True)
-            os.replace(tmp, self.path)
-        except BaseException:
-            os.unlink(tmp)
-            raise
+        write_json_atomic(self.path, doc, indent=1)

@@ -128,6 +128,10 @@ class DeviceState:
                 )
                 undo.append(lambda: self.cdi.delete_claim_spec_file(uid))
                 self.prepared[uid] = prepared
+                # The in-memory entry must unwind too: if the checkpoint write
+                # below fails, a kubelet retry would otherwise hit the
+                # idempotence fast-path and report stale success.
+                undo.append(lambda: self.prepared.pop(uid, None))
                 self._write_checkpoint()
             except BaseException:
                 for fn in reversed(undo):
@@ -153,7 +157,15 @@ class DeviceState:
                     )
             self.cdi.delete_claim_spec_file(claim_uid)
             del self.prepared[claim_uid]
-            self._write_checkpoint()
+            try:
+                self._write_checkpoint()
+            except BaseException:
+                # Keep the entry so a kubelet retry re-runs teardown (all
+                # steps are idempotent) and re-attempts the write; dropping
+                # it would leave a phantom claim in the on-disk checkpoint
+                # that resurrects on restart.
+                self.prepared[claim_uid] = prepared
+                raise
 
     def prepared_claim_uids(self) -> list[str]:
         with self._lock:

@@ -1,0 +1,116 @@
+"""Kubelet-plugin binary: ``python -m k8s_dra_driver_tpu.plugin.main``.
+
+Mirror of cmd/nvidia-dra-plugin/main.go (206 LoC): every flag has an env-var
+mirror, socket dirs default to the kubelet plugin paths, lifecycle is
+signal-driven.  Without a reachable cluster the binary runs against the
+in-process API server (``--fake-cluster``), which is also how the kind-less
+demo harness exercises it; a real client-go-equivalent transport is a
+deployment concern this repo stubs deliberately (zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import install_device_classes
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.plugin.grpc_service import PluginServer
+from k8s_dra_driver_tpu.utils.logging import get_logger
+
+log = get_logger("tpu-dra-plugin")
+
+
+def env_default(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-dra-plugin")
+    p.add_argument("--node-name", default=env_default("NODE_NAME", ""), help="K8s node name")
+    p.add_argument(
+        "--namespace", default=env_default("NAMESPACE", "tpu-dra-driver"),
+        help="namespace for topology-daemon Deployments",
+    )
+    p.add_argument("--cdi-root", default=env_default("CDI_ROOT", "/var/run/cdi"))
+    p.add_argument(
+        "--plugin-path",
+        default=env_default("PLUGIN_PATH", f"/var/lib/kubelet/plugins/{DRIVER_NAME}"),
+    )
+    p.add_argument(
+        "--registry-path",
+        default=env_default("REGISTRY_PATH", "/var/lib/kubelet/plugins_registry"),
+    )
+    p.add_argument("--driver-root", default=env_default("DRIVER_ROOT", ""))
+    p.add_argument("--libtpu-path", default=env_default("LIBTPU_PATH", "/lib/libtpu.so"))
+    p.add_argument(
+        "--fake-topology", default=env_default("TPUINFO_FAKE_TOPOLOGY", ""),
+        help="run against a synthetic topology (e.g. v5e-16) instead of /dev/accel*",
+    )
+    p.add_argument(
+        "--fake-host-id", default=env_default("TPUINFO_FAKE_HOST_ID", "0"))
+    p.add_argument(
+        "--fake-cluster", action="store_true",
+        help="serve against an in-process API server (demo/e2e mode)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.node_name:
+        log.error("--node-name (or NODE_NAME) is required")
+        return 2
+    if not args.fake_cluster:
+        log.error(
+            "only --fake-cluster mode is wired in this build; a real API-server "
+            "transport replaces the fake server behind the same client surface"
+        )
+        return 2
+
+    server = InMemoryAPIServer()
+    install_device_classes(server)
+    topology_env = {}
+    if args.fake_topology:
+        topology_env = {
+            "TPUINFO_FAKE_TOPOLOGY": args.fake_topology,
+            "TPUINFO_FAKE_HOST_ID": args.fake_host_id,
+        }
+    driver = Driver(
+        server,
+        DriverConfig(
+            node_name=args.node_name,
+            namespace=args.namespace,
+            cdi_root=args.cdi_root,
+            checkpoint_path=os.path.join(args.plugin_path, "checkpoint.json"),
+            driver_root=args.driver_root,
+            libtpu_path=args.libtpu_path,
+            topology_env=topology_env,
+        ),
+    )
+    plugin = PluginServer(driver, plugin_dir=args.plugin_path, registry_dir=args.registry_path)
+    plugin.start()
+    log.info(
+        "driver %s serving on %s (registration: %s); %d devices published",
+        DRIVER_NAME,
+        plugin.plugin_socket,
+        plugin.registry_socket,
+        len(driver.state.allocatable),
+    )
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    log.info("shutting down")
+    plugin.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,13 +157,15 @@ class SpatialPartitionManager:
         """Poll the daemon Deployment's availability with exponential backoff
         (sharing.go:289-344)."""
         delay, cap, steps = self._backoff
-        for _ in range(steps):
+        for step in range(steps + 1):
             try:
                 dep = self._server.get(objects.Deployment.KIND, name, self.namespace)
             except NotFound:
                 dep = None
             if dep is not None and _deployment_ready(dep):
                 return
+            if step == steps:
+                break  # final check failed: raise without a useless sleep
             time.sleep(delay)
             delay = min(delay * 2, cap)
         raise SharingError(f"topology daemon {name!r} did not become ready")

@@ -58,10 +58,6 @@ class _Candidate:
     def key(self) -> tuple[str, str, str]:
         return (self.driver, self.pool, self.device.name)
 
-    def markers(self) -> frozenset[tuple[str, str]]:
-        """(pool, capacity-name) pairs consumed by this device."""
-        return frozenset((self.pool, name) for name in self.device.basic.capacity)
-
 
 def _device_env(c: _Candidate) -> dict:
     """CEL environment for one device, mirroring k8s DRA's `device` variable:
@@ -257,16 +253,18 @@ class Allocator:
         chosen: list[tuple[str, _Candidate]] = []
         taken: set = set()
         markers: set = set(used_markers)
-        attr_value: dict[str, object] = {}
+        # Constraints are independent of one another even when they name the
+        # same attribute: agreement is tracked per constraint *instance*.
+        attr_value: dict[int, object] = {}
 
         def constraint_ok(req_name: str, c: _Candidate) -> bool:
-            for req_set, attr in constraints:
+            for ci, (req_set, attr) in enumerate(constraints):
                 if req_name not in req_set:
                     continue
                 value = _qualified_attr(c, attr)
                 if value is None:
                     return False
-                if attr in attr_value and attr_value[attr] != value:
+                if ci in attr_value and attr_value[ci] != value:
                     return False
             return True
 
@@ -287,16 +285,16 @@ class Allocator:
                 if not constraint_ok(req_name, c):
                     continue
                 saved_attrs = dict(attr_value)
-                for req_set, attr in constraints:
-                    if req_name in req_set and attr not in attr_value:
-                        attr_value[attr] = _qualified_attr(c, attr)
+                for ci, (req_set, attr) in enumerate(constraints):
+                    if req_name in req_set and ci not in attr_value:
+                        attr_value[ci] = _qualified_attr(c, attr)
                 taken.add(c.key)
                 markers.update(dev_markers)
                 chosen.append((req_name, c))
                 if assign(i + 1):
                     return True
                 chosen.pop()
-                markers.difference_update(dev_markers - set(used_markers))
+                markers.difference_update(dev_markers)
                 taken.discard(c.key)
                 attr_value.clear()
                 attr_value.update(saved_attrs)

@@ -1,0 +1,43 @@
+"""Structured logging setup (klog/logsapi analog, pkg/flags/logging.go).
+
+Supports text and JSON formats like the reference's ``--logging-format``
+bridge (logging.go:33-48); JSON output makes the driver's logs ingestible by
+the same pipelines the k8s components feed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+
+class JSONFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def get_logger(name: str, level: str | None = None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        if os.environ.get("LOG_FORMAT", "text") == "json":
+            handler.setFormatter(JSONFormatter())
+        else:
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname).1s %(name)s] %(message)s")
+            )
+        logger.addHandler(handler)
+        logger.propagate = False
+    logger.setLevel((level or os.environ.get("LOG_LEVEL", "INFO")).upper())
+    return logger

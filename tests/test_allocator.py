@@ -323,6 +323,28 @@ class TestConstraints:
                             host_ids.add(d.basic.attributes["hostId"].value)
         assert len(host_ids) == 1
 
+    def test_independent_constraints_same_attribute_not_coupled(self, api_server):
+        # Two constraints on the same attribute but disjoint request sets are
+        # independent: a may land on host block 0 and b on block 1.  Coupling
+        # them (one shared attr_value) would make 3+3 chips unsatisfiable.
+        install_classes(api_server)
+        publish_host(api_server, host_id=0, node="host0", pool="block0")
+        publish_host(api_server, host_id=1, node="host0", pool="block1")
+        claim = make_claim(
+            api_server,
+            "indep",
+            [
+                DeviceRequest(name="a", device_class_name=TPU_CLASS, count=3),
+                DeviceRequest(name="b", device_class_name=TPU_CLASS, count=3),
+            ],
+            constraints=[
+                DeviceConstraint(requests=["a"], match_attribute=f"{DRIVER_NAME}/hostId"),
+                DeviceConstraint(requests=["b"], match_attribute=f"{DRIVER_NAME}/hostId"),
+            ],
+        )
+        updated = Allocator(api_server).allocate(claim, node_name="host0")
+        assert len(updated.status.allocation.devices.results) == 6
+
     def test_match_attribute_unsatisfiable(self, api_server):
         install_classes(api_server)
         publish_host(api_server, host_id=0, node="host0", pool="block0")

@@ -1,0 +1,124 @@
+"""SliceManager (multi-host controller) tests — IMEX-manager behaviors
+mapped to TPU slice domains."""
+
+import itertools
+
+from k8s_dra_driver_tpu.controller.slice_manager import (
+    MEMBERSHIP_PER_SLICE_LIMIT,
+    SLICE_DOMAIN_LABEL,
+    SLICE_HOST_ID_LABEL,
+    SliceManager,
+)
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import Node, ObjectMeta, ResourceSlice
+
+
+def add_node(server, name, domain=None, host_id=0):
+    labels = {"kubernetes.io/hostname": name}
+    if domain:
+        labels[SLICE_DOMAIN_LABEL] = domain
+        labels[SLICE_HOST_ID_LABEL] = str(host_id)
+    return server.create(Node(metadata=ObjectMeta(name=name, labels=labels)))
+
+
+def membership_slices(server):
+    return [
+        s
+        for s in server.list(ResourceSlice.KIND)
+        if s.spec.pool.name.startswith("slice-")
+    ]
+
+
+class TestSliceManager:
+    def test_domain_appears_with_first_node(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        assert membership_slices(server) == []
+        add_node(server, "h0", domain="v5e-32-a", host_id=0)
+        slices = membership_slices(server)
+        assert len(slices) == 1
+        devices = slices[0].spec.devices
+        assert len(devices) == 1
+        assert devices[0].basic.attributes["workerId"].value == 0
+        assert devices[0].basic.attributes["coordinatorAddress"].value == "h0:8476"
+        # gated on the domain label
+        sel = slices[0].spec.node_selector
+        assert sel.matches({SLICE_DOMAIN_LABEL: "v5e-32-a"})
+        assert not sel.matches({SLICE_DOMAIN_LABEL: "other"})
+        mgr.stop()
+
+    def test_all_hosts_get_seats_and_coordinator_is_worker0(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        for hid in (2, 0, 1, 3):
+            add_node(server, f"h{hid}", domain="d", host_id=hid)
+        slices = membership_slices(server)
+        devices = slices[0].spec.devices
+        assert [d.basic.attributes["workerId"].value for d in devices] == [0, 1, 2, 3]
+        assert all(
+            d.basic.attributes["coordinatorAddress"].value == "h0:8476" for d in devices
+        )
+        assert all(d.basic.attributes["hostCount"].value == 4 for d in devices)
+        mgr.stop()
+
+    def test_domain_disappears_with_last_node(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        add_node(server, "h0", domain="d", host_id=0)
+        add_node(server, "h1", domain="d", host_id=1)
+        server.delete("Node", "h0")
+        assert len(membership_slices(server)[0].spec.devices) == 1
+        server.delete("Node", "h1")
+        assert membership_slices(server) == []
+        mgr.stop()
+
+    def test_informer_replay_on_late_start(self):
+        server = InMemoryAPIServer()
+        add_node(server, "h0", domain="d", host_id=0)  # exists before start
+        mgr = SliceManager(server)
+        mgr.start()
+        assert len(membership_slices(server)) == 1
+        mgr.stop()
+
+    def test_stop_cleans_owned_slices(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        add_node(server, "h0", domain="d", host_id=0)
+        mgr.stop()
+        assert membership_slices(server) == []
+
+    def test_node_relabel_moves_domain(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        node = add_node(server, "h0", domain="d1", host_id=0)
+        node.metadata.labels[SLICE_DOMAIN_LABEL] = "d2"
+        server.update(node)
+        slices = membership_slices(server)
+        assert len(slices) == 1
+        assert slices[0].spec.devices[0].basic.attributes["sliceDomain"].value == "d2"
+        mgr.stop()
+
+    def test_window_exhaustion_is_transient_and_retries(self):
+        server = InMemoryAPIServer()
+        fake_time = itertools.count(0, 120.0)  # 120s per clock() call
+        clock = lambda: next(fake_time)  # noqa: E731
+        mgr = SliceManager(server, retry_timeout_s=60.0, clock=clock)
+        mgr.start()
+        limit = 2048 // MEMBERSHIP_PER_SLICE_LIMIT  # 16 windows
+        for i in range(limit):
+            add_node(server, f"h{i}", domain=f"d{i}", host_id=0)
+        assert len(membership_slices(server)) == limit
+        # 17th domain: parked on transient error
+        add_node(server, "hx", domain="overflow", host_id=0)
+        assert len(membership_slices(server)) == limit
+        # free a window, then retry after the timeout elapses
+        server.delete("Node", "h3")
+        mgr.retry_pending()
+        names = {s.spec.pool.name for s in membership_slices(server)}
+        assert "slice-overflow" in names
+        mgr.stop()

@@ -1,0 +1,87 @@
+"""gRPC DRA service tests: the kubelet wire path over unix sockets."""
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import TPU_CLASS, make_cluster, simple_claim
+from k8s_dra_driver_tpu.plugin.driver import ClaimRef
+from k8s_dra_driver_tpu.plugin.grpc_service import (
+    DRAClient,
+    PluginServer,
+    RegistrationClient,
+)
+
+
+@pytest.fixture
+def served(tmp_path):
+    cluster = make_cluster(hosts=1, work_dir=str(tmp_path / "work"))
+    node = cluster.nodes["tpu-host-0"]
+    # Reach into the harness driver (it owns the DeviceState).
+    from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+
+    driver = Driver(
+        cluster.server,
+        DriverConfig(
+            node_name="tpu-host-0",
+            cdi_root=str(tmp_path / "cdi"),
+            checkpoint_path=str(tmp_path / "checkpoint.json"),
+            topology_env={"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"},
+            publish=False,  # harness node already published this pool
+        ),
+    )
+    server = PluginServer(
+        driver,
+        plugin_dir=str(tmp_path / "plugins" / DRIVER_NAME),
+        registry_dir=str(tmp_path / "plugins_registry"),
+    )
+    server.start()
+    yield cluster, server
+    server.stop()
+
+
+class TestGRPC:
+    def test_registration_handshake(self, served):
+        _, server = served
+        client = RegistrationClient(server.registry_socket)
+        info = client.handshake()
+        assert info.type == "DRAPlugin"
+        assert info.name == DRIVER_NAME
+        assert info.endpoint == server.plugin_socket
+        assert list(info.supported_versions) == ["v1beta1"]
+        assert server.registered.is_set()
+        client.close()
+
+    def test_prepare_unprepare_roundtrip(self, served):
+        cluster, server = served
+        claim = cluster.server.create(simple_claim("rpc-claim"))
+        allocated = cluster.allocator.allocate(claim, node_name="tpu-host-0")
+        ref = ClaimRef(
+            uid=allocated.metadata.uid, name="rpc-claim", namespace="default"
+        )
+
+        client = DRAClient(server.plugin_socket)
+        resp = client.node_prepare_resources([ref])
+        result = resp.claims[ref.uid]
+        assert result.error == ""
+        assert len(result.devices) == 1
+        assert result.devices[0].pool_name == "tpu-host-0"
+        assert result.devices[0].device_name.startswith("tpu-")
+        assert len(result.devices[0].cdi_device_ids) == 2
+
+        un = client.node_unprepare_resources([ref])
+        assert un.claims[ref.uid].error == ""
+        client.close()
+
+    def test_per_claim_error_fanout(self, served):
+        cluster, server = served
+        good = cluster.server.create(simple_claim("good"))
+        allocated = cluster.allocator.allocate(good, node_name="tpu-host-0")
+        refs = [
+            ClaimRef(uid=allocated.metadata.uid, name="good", namespace="default"),
+            ClaimRef(uid="nope", name="missing", namespace="default"),
+        ]
+        client = DRAClient(server.plugin_socket)
+        resp = client.node_prepare_resources(refs)
+        assert resp.claims[allocated.metadata.uid].error == ""
+        assert "missing" in resp.claims["nope"].error
+        client.close()

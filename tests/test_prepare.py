@@ -318,6 +318,42 @@ class TestSharingConfigs:
         assert state.prepared[claim.metadata.uid].groups[0].config_state.strategy == "Exclusive"
 
 
+class TestCheckpointFailureRecovery:
+    def test_prepare_checkpoint_write_failure_is_not_stale_success(
+        self, cluster, state, monkeypatch
+    ):
+        claim = allocate(cluster, "cpfail", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+
+        def boom(_):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(state._checkpoint, "write", boom)
+        with pytest.raises(OSError):
+            state.prepare(claim)
+        # the idempotence fast-path must NOT now report success
+        assert state.prepared_claim_uids() == []
+        monkeypatch.undo()
+        devices = state.prepare(claim)  # retry succeeds for real
+        assert devices and state.prepared_claim_uids() == [claim.metadata.uid]
+
+    def test_unprepare_checkpoint_write_failure_keeps_entry_for_retry(
+        self, cluster, state, monkeypatch
+    ):
+        claim = allocate(cluster, "upfail", [DeviceRequest(name="t", device_class_name=TPU_CLASS)])
+        state.prepare(claim)
+
+        def boom(_):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(state._checkpoint, "write", boom)
+        with pytest.raises(OSError):
+            state.unprepare(claim.metadata.uid)
+        assert state.prepared_claim_uids() == [claim.metadata.uid]
+        monkeypatch.undo()
+        state.unprepare(claim.metadata.uid)  # retry completes
+        assert state.prepared_claim_uids() == []
+
+
 class TestCheckpointIntegrity:
     def test_corrupt_checkpoint_detected(self, tmp_path):
         from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile, CorruptCheckpoint
