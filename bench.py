@@ -1,0 +1,137 @@
+"""Benchmark: ResourceClaim-to-Running p50 (the BASELINE.md headline metric).
+
+One cycle = create claim → structured allocation (scheduler semantics) →
+NodePrepareResources over the real gRPC unix-socket wire → CDI spec on disk.
+That is the §3.2 hot path end-to-end minus container start.  After the timed
+cycles, the claimed device is proven live by running a jitted burn-in
+training step on the default backend (the real TPU chip when present) — the
+bench fails if the data plane does not execute.
+
+Prints exactly one JSON line:
+  {"metric": "claim_to_running_p50_ms", "value": ..., "unit": "ms",
+   "vs_baseline": ...}
+
+vs_baseline: the reference publishes no numbers (SURVEY.md §6); BASELINE.md
+sets a 1000 ms claim-to-running budget (the reference's own MPS readiness
+backoff alone starts at 1s — sharing.go:290-296).  vs_baseline = budget/p50,
+so >1.0 means faster than budget; later rounds compare against BENCH_r1.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import tempfile
+import time
+
+BASELINE_BUDGET_MS = 1000.0
+CYCLES = 40
+
+
+def run_control_plane() -> list[float]:
+    from k8s_dra_driver_tpu import DRIVER_NAME
+    from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+    from k8s_dra_driver_tpu.plugin.driver import ClaimRef, Driver, DriverConfig
+    from k8s_dra_driver_tpu.plugin.grpc_service import DRAClient, PluginServer
+
+    work = tempfile.mkdtemp(prefix="tpu-dra-bench-")
+    cluster = make_cluster(hosts=1, topology="v5e-16", work_dir=work)
+    node = "tpu-host-0"
+    driver = Driver(
+        cluster.server,
+        DriverConfig(
+            node_name=node,
+            cdi_root=f"{work}/bench-cdi",
+            checkpoint_path=f"{work}/bench-checkpoint.json",
+            topology_env={"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"},
+            publish=False,
+        ),
+    )
+    server = PluginServer(
+        driver, plugin_dir=f"{work}/plugins/{DRIVER_NAME}", registry_dir=f"{work}/registry"
+    )
+    server.start()
+    client = DRAClient(server.plugin_socket)
+
+    samples = []
+    try:
+        for i in range(CYCLES):
+            name = f"bench-claim-{i}"
+            start = time.perf_counter()
+            claim = cluster.server.create(simple_claim(name))
+            allocated = cluster.allocator.allocate(
+                claim, node_name=node, node_labels=cluster.node_labels(node)
+            )
+            resp = client.node_prepare_resources(
+                [ClaimRef(uid=allocated.metadata.uid, name=name, namespace="default")]
+            )
+            result = resp.claims[allocated.metadata.uid]
+            if result.error:
+                raise RuntimeError(f"prepare failed: {result.error}")
+            samples.append((time.perf_counter() - start) * 1000)
+            # teardown outside the timed window
+            client.node_unprepare_resources(
+                [ClaimRef(uid=allocated.metadata.uid, name=name, namespace="default")]
+            )
+            cluster.allocator.deallocate(
+                cluster.server.get("ResourceClaim", name, "default")
+            )
+    finally:
+        client.close()
+        server.stop()
+    return samples
+
+
+def run_data_plane() -> dict:
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin
+    from k8s_dra_driver_tpu.ops.collectives import matmul_tflops
+
+    cfg = burnin.ModelConfig(
+        vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048, max_seq=512
+    )
+    fns = burnin.build_train_step(cfg)
+    params, opt_state = fns.init(jax.random.PRNGKey(0))
+    tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=cfg.max_seq)
+    params, opt_state, loss = fns.step(params, opt_state, tokens)  # compile
+    jax.block_until_ready(loss)
+    start = time.perf_counter()
+    steps = 5
+    for _ in range(steps):
+        params, opt_state, loss = fns.step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    step_ms = (time.perf_counter() - start) / steps * 1000
+    return {
+        "backend": jax.default_backend(),
+        "burnin_step_ms": round(step_ms, 2),
+        "burnin_loss": round(float(loss), 4),
+        "matmul_tflops": round(matmul_tflops(size=2048, iters=5), 2),
+    }
+
+
+def main() -> int:
+    samples = run_control_plane()
+    p50 = statistics.median(samples)
+    data = run_data_plane()
+    print(
+        f"# control-plane: {len(samples)} cycles, p50={p50:.2f}ms "
+        f"p90={statistics.quantiles(samples, n=10)[8]:.2f}ms; data-plane: {data}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "claim_to_running_p50_ms",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_BUDGET_MS / p50, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,9 @@ from k8s_dra_driver_tpu.kube.resourceslice_controller import (
     Slice,
 )
 from k8s_dra_driver_tpu.plugin.deviceinfo import SliceMembershipInfo
+from k8s_dra_driver_tpu.utils.logging import get_logger
+
+log = get_logger("tpu-dra-controller.slice-manager")
 
 SLICE_DOMAIN_LABEL = "tpu.google.com/slice-domain"
 SLICE_HOST_ID_LABEL = "tpu.google.com/slice-host-id"
@@ -50,6 +53,16 @@ DEFAULT_COORDINATOR_PORT = 8476
 
 class TransientError(RuntimeError):
     """Retryable condition (seat budget exhaustion), imex.go:49."""
+
+
+def _parse_host_id(raw: str | None) -> int | None:
+    if raw is None:
+        return None
+    try:
+        value = int(raw)
+    except ValueError:
+        return None
+    return value if value >= 0 else None
 
 
 @dataclass
@@ -103,11 +116,22 @@ class SliceManager:
     def _on_node_event(self, event) -> None:
         node = event.object
         domain = node.metadata.labels.get(SLICE_DOMAIN_LABEL)
+        host_id = _parse_host_id(node.metadata.labels.get(SLICE_HOST_ID_LABEL))
         with self._lock:
-            if event.type == "DELETED" or domain is None:
+            if event.type == "DELETED" or domain is None or host_id is None:
+                # Malformed/missing host-id: the node cannot take a seat —
+                # treat it as not part of any domain (and log) rather than
+                # defaulting to 0, which would mint duplicate worker-0 seats.
+                if domain is not None and host_id is None:
+                    log.warning(
+                        "node %s has domain %r but invalid %s label %r; ignoring",
+                        node.metadata.name,
+                        domain,
+                        SLICE_HOST_ID_LABEL,
+                        node.metadata.labels.get(SLICE_HOST_ID_LABEL),
+                    )
                 changed = self._forget_node(node.metadata.name)
             else:
-                host_id = int(node.metadata.labels.get(SLICE_HOST_ID_LABEL, "0"))
                 changed = self._remember_node(domain, node.metadata.name, host_id)
             if changed:
                 self._publish()
@@ -165,6 +189,14 @@ class SliceManager:
                 continue
             host_count = len(d.nodes)
             coordinator = self._coordinator_address(d)
+            worker_ids = sorted(set(d.nodes.values()))
+            if len(worker_ids) != len(d.nodes):
+                log.warning(
+                    "domain %s: duplicate slice-host-id labels across nodes %s; "
+                    "publishing one seat per distinct id",
+                    domain,
+                    sorted(d.nodes),
+                )
             devices = [
                 SliceMembershipInfo(
                     domain=domain,
@@ -172,7 +204,7 @@ class SliceManager:
                     host_count=host_count,
                     coordinator_address=coordinator,
                 ).get_device()
-                for worker_id in sorted(d.nodes.values())
+                for worker_id in worker_ids
             ]
             pools[f"slice-{domain}"] = Pool(
                 slices=[Slice(devices=devices)],

@@ -1,0 +1,220 @@
+"""Slice burn-in / validation transformer — the flagship workload.
+
+The reference validates claimed GPUs with CUDA demos (nbody —
+demo/specs/quickstart/gpu-test5.yaml:57-60); the TPU-native equivalent must
+actually exercise the claimed ICI mesh, so it is a small decoder-only
+transformer LM with real DP/TP/SP shardings:
+
+* **TP (``model`` axis)**: attention in-projection and MLP up-projection are
+  column-sharded, out-projections row-sharded (Megatron layout) — XLA inserts
+  the psum on the row-sharded matmuls over ICI;
+* **DP (``data`` axis)**: batch sharded; gradients all-reduce over ``data``;
+* **SP (``seq`` axis)**: activations sequence-sharded between blocks via
+  sharding constraints (ring-attention-style full context parallelism lands
+  in ops/ in a later round — the axis and layouts are already in place).
+
+TPU-first choices: everything bf16 (MXU-native), einsum-only matmuls (no
+scalar loops), static shapes, ``jax.checkpoint`` on blocks to trade FLOPs for
+HBM, loss in f32 for stability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 512
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# Flagship default: big enough that the MXU (not dispatch overhead) dominates
+# a single-chip step, small enough to init in seconds.
+FLAGSHIP = ModelConfig(
+    vocab_size=32768, d_model=1024, n_heads=16, n_layers=8, d_ff=4096, max_seq=1024
+)
+TINY = ModelConfig()
+
+
+def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
+    keys = iter(jax.random.split(key, 4 + 4 * cfg.n_layers))
+    scale = cfg.d_model**-0.5
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params = {
+        "embed": dense(next(keys), (cfg.vocab_size, cfg.d_model)),
+        "pos_embed": dense(next(keys), (cfg.max_seq, cfg.d_model)),
+        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
+        "blocks": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["blocks"].append(
+            {
+                "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
+                "qkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
+                "attn_out": dense(next(keys), (cfg.d_model, cfg.d_model)),
+                "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
+                "mlp_up": dense(next(keys), (cfg.d_model, cfg.d_ff)),
+                "mlp_down": dense(next(keys), (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return params
+
+
+def param_pspecs(cfg: ModelConfig) -> dict:
+    """Megatron TP layout over the ``model`` axis."""
+    block = {
+        "ln1": P(),
+        "qkv": P(None, "model"),       # column-parallel
+        "attn_out": P("model", None),  # row-parallel (psum after)
+        "ln2": P(),
+        "mlp_up": P(None, "model"),
+        "mlp_down": P("model", None),
+    }
+    return {
+        "embed": P("model", None),  # vocab-sharded embedding
+        "pos_embed": P(),
+        "ln_f": P(),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+    }
+
+
+def _rms_norm(x, gamma):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * gamma
+
+
+def _constrain(x, act_spec):
+    """Apply an activation sharding constraint; None = single-device."""
+    if act_spec is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, act_spec)
+
+
+def _block(x, p, cfg: ModelConfig, act_spec):
+    b, s, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    y = _rms_norm(x, p["ln1"])
+    qkv = jnp.einsum("bsd,de->bse", y, p["qkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, hd)
+    k = k.reshape(b, s, h, hd)
+    v = v.reshape(b, s, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, d)
+    x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
+    x = _constrain(x, act_spec)
+
+    y = _rms_norm(x, p["ln2"])
+    y = jnp.einsum("bsd,df->bsf", y, p["mlp_up"])
+    y = jax.nn.gelu(y)
+    x = x + jnp.einsum("bsf,fd->bsd", y, p["mlp_down"])
+    return _constrain(x, act_spec)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: ModelConfig, act_spec=None) -> jax.Array:
+    """tokens [B,S] int32 -> logits [B,S,V] (f32)."""
+    s = tokens.shape[1]
+    x = params["embed"][tokens] + params["pos_embed"][:s]
+    x = _constrain(x, act_spec)
+    block = functools.partial(_block, cfg=cfg, act_spec=act_spec)
+    for p in params["blocks"]:
+        x = jax.checkpoint(block)(x, p)  # remat: HBM for FLOPs
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: ModelConfig, act_spec=None) -> jax.Array:
+    logits = forward(params, tokens[:, :-1], cfg, act_spec)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_optimizer(lr: float = 3e-4):
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+
+
+@dataclass
+class TrainStepFns:
+    init: callable
+    step: callable
+
+
+def build_train_step(
+    cfg: ModelConfig, mesh: Mesh | None = None, lr: float = 3e-4
+) -> TrainStepFns:
+    """Returns jitted (init, step).  With a mesh, params/opt-state/activations
+    get DP/TP/SP shardings; without, everything runs single-device."""
+    opt = make_optimizer(lr)
+    if mesh is None:
+        act_spec = None
+
+        def init(key):
+            params = init_params(key, cfg)
+            return params, opt.init(params)
+
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, act_spec)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return TrainStepFns(init=jax.jit(init), step=jax.jit(step))
+
+    act_spec = P("data", "seq", None)
+    pspecs = param_pspecs(cfg)
+    param_shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    data_sharding = NamedSharding(mesh, P("data", None))
+
+    def init(key):
+        params = init_params(key, cfg)
+        return params, opt.init(params)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, NamedSharding(mesh, act_spec)
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jit_init = jax.jit(init, out_shardings=(param_shardings, None))
+    jit_step = jax.jit(
+        step,
+        in_shardings=(param_shardings, None, data_sharding),
+        out_shardings=(param_shardings, None, None),
+        donate_argnums=(0, 1),
+    )
+    return TrainStepFns(init=jit_init, step=jit_step)
+
+
+def sample_tokens(key: jax.Array, cfg: ModelConfig, batch: int, seq: int) -> jax.Array:
+    return jax.random.randint(key, (batch, seq), 0, cfg.vocab_size, dtype=jnp.int32)

@@ -1,0 +1,123 @@
+"""Collective micro-benchmarks over a claimed mesh.
+
+The BASELINE north-star data-plane metric is ``jax.lax.psum`` bandwidth on a
+claimed slice (BASELINE.md): these helpers measure algorithmic all-reduce /
+all-gather bandwidth the standard way (ring algbw: 2(n-1)/n × bytes / time)
+using ``jax.shard_map`` so the collective pattern is explicit and XLA lowers
+it onto ICI.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class BandwidthResult:
+    collective: str
+    axis: str
+    n_devices: int
+    payload_bytes: int
+    seconds_per_call: float
+    algbw_gbps: float  # algorithmic bandwidth, GB/s
+
+
+def _time_fn(fn, *args, warmup: int = 2, iters: int = 10) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters
+
+
+def psum_bandwidth(
+    mesh: Mesh, axis: str = "model", mib: int = 64, dtype=jnp.bfloat16, iters: int = 10
+) -> BandwidthResult:
+    """All-reduce ``mib`` MiB per device over ``axis``."""
+    n = mesh.shape[axis]
+    elems = mib * 1024 * 1024 // jnp.dtype(dtype).itemsize
+    spec = P(axis)
+    x = jax.device_put(
+        jnp.ones((n * elems,), dtype), NamedSharding(mesh, spec)
+    )
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=spec, out_specs=P())
+    def allreduce(shard):
+        # psum output is replicated across `axis`; out_specs=P() asserts it.
+        return jax.lax.psum(shard, axis)
+
+    secs = _time_fn(allreduce, x, iters=iters)
+    payload = elems * jnp.dtype(dtype).itemsize
+    algbw = (2 * (n - 1) / max(n, 1)) * payload / secs / 1e9 if n > 1 else payload / secs / 1e9
+    return BandwidthResult("psum", axis, n, payload, secs, algbw)
+
+
+def all_gather_bandwidth(
+    mesh: Mesh, axis: str = "model", mib: int = 64, dtype=jnp.bfloat16, iters: int = 10
+) -> BandwidthResult:
+    n = mesh.shape[axis]
+    elems = mib * 1024 * 1024 // jnp.dtype(dtype).itemsize
+    spec = P(axis)
+    x = jax.device_put(jnp.ones((n * elems,), dtype), NamedSharding(mesh, spec))
+
+    # check_vma off: all_gather output is replicated in value but JAX's
+    # varying-axes tracking still marks it as varying over `axis`.
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=spec, out_specs=P(), check_vma=False)
+    def gather(shard):
+        return jax.lax.all_gather(shard, axis, tiled=True)
+
+    secs = _time_fn(gather, x, iters=iters)
+    payload = elems * jnp.dtype(dtype).itemsize
+    algbw = ((n - 1) / max(n, 1)) * payload / secs / 1e9 if n > 1 else payload / secs / 1e9
+    return BandwidthResult("all_gather", axis, n, payload, secs, algbw)
+
+
+def matmul_tflops(
+    device=None, size: int = 4096, dtype=jnp.bfloat16, iters: int = 10
+) -> float:
+    """Single-device MXU utilization probe: TFLOP/s of a size³ matmul."""
+    if device is None:
+        device = jax.devices()[0]
+    key = jax.random.PRNGKey(0)
+    a = jax.device_put(jax.random.normal(key, (size, size), dtype), device)
+    b = jax.device_put(jax.random.normal(key, (size, size), dtype), device)
+    f = jax.jit(lambda x, y: x @ y)
+    secs = _time_fn(f, a, b, iters=iters)
+    return 2 * size**3 / secs / 1e12
+
+
+def ring_latency_us(mesh: Mesh, axis: str = "model", iters: int = 50) -> float:
+    """One-hop ppermute latency around the ring — the ICI hop probe."""
+    n = mesh.shape[axis]
+    if n < 2:
+        return 0.0
+    x = jax.device_put(
+        jnp.zeros((n, 8), jnp.float32), NamedSharding(mesh, P(axis, None))
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
+    def hop(shard):
+        return jax.lax.ppermute(shard, axis, perm)
+
+    secs = _time_fn(hop, x, iters=iters)
+    return secs * 1e6
+
+
+def summarize(results: list[BandwidthResult]) -> dict:
+    return {
+        r.collective: {"n": r.n_devices, "algbw_gbps": round(r.algbw_gbps, 3)}
+        for r in results
+    }

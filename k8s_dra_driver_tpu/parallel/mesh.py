@@ -1,0 +1,83 @@
+"""Device-mesh construction for claimed TPU slices.
+
+The driver's job ends at injecting ``TPU_*`` env + device nodes
+(SURVEY.md §2.11); this module is the consumer-side counterpart that turns a
+claimed slice into a ``jax.sharding.Mesh`` with the axis layout the burn-in
+model and the collective benchmarks use.  Axis convention (scaling-book
+style): ``data`` (batch), ``seq`` (sequence/context parallelism), ``model``
+(tensor parallelism).  Shardings are chosen so collectives ride ICI: the
+``model`` axis maps to the innermost (fastest-wrap) mesh dimension.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "seq", "model")
+
+
+@dataclass(frozen=True)
+class MeshShape:
+    data: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.data * self.seq * self.model
+
+
+def claimed_device_env() -> dict[str, str]:
+    """The env the driver injects at Prepare (plugin/device_state.py
+    _wiring_env): which chips are visible and the process-local bounds."""
+    return {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith("TPU_") or k.startswith("JAX_COORDINATOR")
+    }
+
+
+def auto_mesh_shape(n_devices: int, want_seq: bool = False) -> MeshShape:
+    """Factor a device count into (data, seq, model).
+
+    Heuristic: model parallelism gets the largest power-of-two factor up to 4
+    (v5e host block size — keeps TP collectives inside one host's ICI block),
+    sequence parallelism (if requested) up to 2, data parallelism the rest.
+    """
+    model = 1
+    for cand in (4, 2):
+        if n_devices % cand == 0:
+            model = cand
+            break
+    rest = n_devices // model
+    seq = 2 if (want_seq and rest % 2 == 0) else 1
+    data = rest // seq
+    return MeshShape(data=data, seq=seq, model=model)
+
+
+def build_mesh(devices, shape: MeshShape) -> Mesh:
+    if shape.total != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {shape.total} devices, got {len(devices)}")
+    arr = np.array(devices).reshape(shape.data, shape.seq, shape.model)
+    return Mesh(arr, AXES)
+
+
+def mesh_for(devices, want_seq: bool = False) -> Mesh:
+    return build_mesh(devices, auto_mesh_shape(len(devices), want_seq=want_seq))
+
+
+def validate_claimed_mesh(mesh: Mesh, env: dict[str, str]) -> None:
+    """Cross-check a mesh against the driver-injected bounds env."""
+    bounds = env.get("TPU_CHIPS_PER_PROCESS_BOUNDS")
+    if not bounds:
+        return
+    expected = math.prod(int(b) for b in bounds.split(","))
+    if mesh.size != expected:
+        raise ValueError(
+            f"mesh has {mesh.size} devices but claim bounds {bounds} imply {expected}"
+        )

@@ -12,7 +12,7 @@ protoc grpc plugin is needed.
 from __future__ import annotations
 
 import subprocess
-import sys
+
 import threading
 from concurrent import futures
 from importlib import import_module
@@ -30,6 +30,9 @@ SUPPORTED_VERSIONS = ["v1beta1"]
 
 
 def _generate() -> None:
+    """Regenerate stale stubs when protoc is available; otherwise fall back
+    to the committed stubs (git checkout does not preserve mtimes, so a
+    fresh clone may look 'stale' on a machine without protoc)."""
     _GEN_DIR.mkdir(exist_ok=True)
     init = _GEN_DIR / "__init__.py"
     if not init.exists():
@@ -39,16 +42,23 @@ def _generate() -> None:
         out = _GEN_DIR / (proto.replace(".proto", "_pb2.py"))
         if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
             continue
-        result = subprocess.run(
-            [
-                "protoc",
-                f"--proto_path={_PROTO_DIR}",
-                f"--python_out={_GEN_DIR}",
-                str(src),
-            ],
-            capture_output=True,
-            text=True,
-        )
+        try:
+            result = subprocess.run(
+                [
+                    "protoc",
+                    f"--proto_path={_PROTO_DIR}",
+                    f"--python_out={_GEN_DIR}",
+                    str(src),
+                ],
+                capture_output=True,
+                text=True,
+            )
+        except FileNotFoundError:
+            if out.exists():
+                continue  # no protoc, but committed stubs exist — use them
+            raise RuntimeError(
+                f"protoc is not installed and no generated stub exists for {proto}"
+            ) from None
         if result.returncode != 0:
             raise RuntimeError(f"protoc failed for {proto}:\n{result.stderr}")
 
@@ -60,9 +70,9 @@ def pb2(name: str):
     """Import a generated module (``dra`` or ``registration``)."""
     if name not in _modules:
         _generate()
-        if str(_GEN_DIR) not in sys.path:
-            sys.path.insert(0, str(_GEN_DIR))
-        _modules[name] = import_module(f"{name}_pb2")
+        _modules[name] = import_module(
+            f"k8s_dra_driver_tpu.plugin.proto.gen.{name}_pb2"
+        )
     return _modules[name]
 
 

@@ -103,6 +103,34 @@ class TestSliceManager:
         assert slices[0].spec.devices[0].basic.attributes["sliceDomain"].value == "d2"
         mgr.stop()
 
+    def test_malformed_host_id_label_is_ignored_not_fatal(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        add_node(server, "h0", domain="d", host_id=0)
+        # Node with garbage host-id: must not crash the watch, must not mint
+        # a duplicate worker-0 seat.
+        bad = Node(
+            metadata=ObjectMeta(
+                name="hbad",
+                labels={SLICE_DOMAIN_LABEL: "d", SLICE_HOST_ID_LABEL: "host-1"},
+            )
+        )
+        server.create(bad)
+        devices = membership_slices(server)[0].spec.devices
+        assert [d.basic.attributes["workerId"].value for d in devices] == [0]
+        mgr.stop()
+
+    def test_duplicate_host_ids_deduped(self):
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        add_node(server, "h0", domain="d", host_id=0)
+        add_node(server, "h0b", domain="d", host_id=0)  # mislabel: same seat
+        devices = membership_slices(server)[0].spec.devices
+        assert [d.name for d in devices] == ["membership-0"]  # no dup names
+        mgr.stop()
+
     def test_window_exhaustion_is_transient_and_retries(self):
         server = InMemoryAPIServer()
         fake_time = itertools.count(0, 120.0)  # 120s per clock() call

@@ -1,0 +1,133 @@
+"""JAX data-plane tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from k8s_dra_driver_tpu.models import burnin
+from k8s_dra_driver_tpu.ops import collectives
+from k8s_dra_driver_tpu.parallel.mesh import (
+    MeshShape,
+    auto_mesh_shape,
+    build_mesh,
+    mesh_for,
+    validate_claimed_mesh,
+)
+from tests.conftest import cpu_devices
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return mesh_for(cpu_devices(8))  # data=2, seq=1, model=4
+
+
+class TestMesh:
+    def test_auto_shape_factors(self):
+        assert auto_mesh_shape(8) == MeshShape(data=2, seq=1, model=4)
+        assert auto_mesh_shape(8, want_seq=True) == MeshShape(data=1, seq=2, model=4)
+        assert auto_mesh_shape(1) == MeshShape(1, 1, 1)
+        assert auto_mesh_shape(6) == MeshShape(data=3, seq=1, model=2)
+
+    def test_build_mesh_validates_count(self):
+        with pytest.raises(ValueError, match="needs 8 devices"):
+            build_mesh(cpu_devices(4), MeshShape(2, 1, 4))
+
+    def test_validate_claimed_mesh(self, mesh8):
+        validate_claimed_mesh(mesh8, {"TPU_CHIPS_PER_PROCESS_BOUNDS": "2,2,2"})
+        with pytest.raises(ValueError, match="imply 4"):
+            validate_claimed_mesh(mesh8, {"TPU_CHIPS_PER_PROCESS_BOUNDS": "2,2,1"})
+
+
+class TestBurninModel:
+    def test_forward_shapes_single_device(self):
+        cfg = burnin.TINY
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=16)
+        logits = jax.jit(lambda p, t: burnin.forward(p, t, cfg))(params, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_loss_decreases_single_device(self):
+        cfg = burnin.TINY
+        fns = burnin.build_train_step(cfg, lr=1e-2)
+        params, opt_state = fns.init(jax.random.PRNGKey(0))
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=32)
+        first = None
+        for _ in range(5):
+            params, opt_state, loss = fns.step(params, opt_state, tokens)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first  # memorizing one batch must reduce loss
+
+    def test_sharded_train_step(self, mesh8):
+        cfg = burnin.TINY
+        fns = burnin.build_train_step(cfg, mesh=mesh8)
+        with mesh8:
+            params, opt_state = fns.init(jax.random.PRNGKey(0))
+            # TP layout realized: qkv column-sharded over `model`
+            qkv = params["blocks"][0]["qkv"]
+            assert qkv.sharding.spec == P(None, "model")
+            tokens = jax.device_put(
+                burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=8, seq=32),
+                NamedSharding(mesh8, P("data", None)),
+            )
+            params, opt_state, loss = fns.step(params, opt_state, tokens)
+        assert jnp.isfinite(loss)
+
+    def test_sharded_matches_single_device_loss(self, mesh8):
+        cfg = burnin.TINY
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=8, seq=32)
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        ref = float(jax.jit(lambda p, t: burnin.loss_fn(p, t, cfg))(params, tokens))
+        with mesh8:
+            sharded_params = jax.device_put(
+                params,
+                jax.tree.map(
+                    lambda spec: NamedSharding(mesh8, spec),
+                    burnin.param_pspecs(cfg),
+                    is_leaf=lambda x: isinstance(x, P),
+                ),
+            )
+            sharded_tokens = jax.device_put(tokens, NamedSharding(mesh8, P("data", None)))
+            got = float(
+                jax.jit(
+                    lambda p, t: burnin.loss_fn(
+                        p, t, cfg, NamedSharding(mesh8, P("data", "seq", None))
+                    )
+                )(sharded_params, sharded_tokens)
+            )
+        assert abs(got - ref) < 0.05  # bf16 + reduction-order tolerance
+
+
+class TestCollectives:
+    def test_psum_bandwidth(self, mesh8):
+        r = collectives.psum_bandwidth(mesh8, axis="model", mib=1, iters=3)
+        assert r.n_devices == 4
+        assert r.algbw_gbps > 0
+
+    def test_all_gather_bandwidth(self, mesh8):
+        r = collectives.all_gather_bandwidth(mesh8, axis="model", mib=1, iters=3)
+        assert r.algbw_gbps > 0
+
+    def test_ring_latency(self, mesh8):
+        assert collectives.ring_latency_us(mesh8, axis="model", iters=5) > 0
+
+    def test_matmul_tflops(self):
+        assert collectives.matmul_tflops(cpu_devices(1)[0], size=256, iters=2) > 0
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip(self, capsys):
+        import __graft_entry__ as ge
+
+        ge.dryrun_multichip(8)
+        assert "dryrun_multichip: mesh" in capsys.readouterr().out
+
+    def test_entry_compiles_tiny_analog(self):
+        # entry() uses the flagship config (slow on CPU); validate the same
+        # path with the tiny config here, flagship is exercised by the driver.
+        import __graft_entry__ as ge
+
+        fn, (params, tokens) = ge.entry()
+        assert callable(fn) and tokens.ndim == 2
