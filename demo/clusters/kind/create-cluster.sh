@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Create a kind cluster wired for DRA + CDI, with the fake TPU topology so
+# the full driver stack runs with zero TPU hardware (the reference needs real
+# GPUs injected into the kind worker — demo/clusters/kind/scripts/
+# kind-cluster-config.yaml:56-63; our fake libtpuinfo backend removes that
+# requirement entirely).
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+FAKE_TOPOLOGY="${FAKE_TOPOLOGY:-v5e-16}"
+
+cat <<EOF | kind create cluster --name "${CLUSTER_NAME}" --config=-
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+featureGates:
+  DynamicResourceAllocation: true
+containerdConfigPatches:
+  - |-
+    [plugins."io.containerd.grpc.v1.cri"]
+      enable_cdi = true
+nodes:
+  - role: control-plane
+    kubeadmConfigPatches:
+      - |
+        kind: ClusterConfiguration
+        apiServer:
+          extraArgs:
+            runtime-config: "resource.k8s.io/v1beta1=true"
+  - role: worker
+    labels:
+      tpu.google.com/fake-topology: "${FAKE_TOPOLOGY}"
+EOF
+
+echo "cluster ${CLUSTER_NAME} ready; install the driver with:"
+echo "  helm install tpu-dra-driver deployments/helm/tpu-dra-driver --set fakeTopology=${FAKE_TOPOLOGY}"

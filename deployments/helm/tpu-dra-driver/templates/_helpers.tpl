@@ -1,0 +1,17 @@
+{{- define "tpu-dra-driver.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "tpu-dra-driver.namespace" -}}
+{{- default .Release.Namespace .Values.namespaceOverride -}}
+{{- end -}}
+
+{{- define "tpu-dra-driver.labels" -}}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+{{- end -}}
+
+{{- define "tpu-dra-driver.serviceAccountName" -}}
+{{ include "tpu-dra-driver.name" . }}-service-account
+{{- end -}}

@@ -31,7 +31,7 @@ from k8s_dra_driver_tpu.api import (
     default_tpu_config,
 )
 from k8s_dra_driver_tpu.api.sharing import SharingStrategy
-from k8s_dra_driver_tpu.kube.objects import ResourceClaim
+from k8s_dra_driver_tpu.kube.objects import ResourceClaim, ResourceSlice
 from k8s_dra_driver_tpu.plugin.cdi import CDIHandler, ContainerEdits
 from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile
 from k8s_dra_driver_tpu.plugin.deviceinfo import (
@@ -40,6 +40,7 @@ from k8s_dra_driver_tpu.plugin.deviceinfo import (
     DEVICE_TYPE_SUBSLICE,
     AllocatableDevice,
     AllocatableDevices,
+    SliceMembershipInfo,
 )
 from k8s_dra_driver_tpu.plugin.prepared import (
     DeviceConfigState,
@@ -77,6 +78,7 @@ class DeviceStateConfig:
 class DeviceState:
     def __init__(self, server, config: DeviceStateConfig):
         self._lock = threading.Lock()
+        self._server = server
         self.config = config
         self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
         self.allocatable = AllocatableDevices.from_topology(self.topology)
@@ -195,12 +197,19 @@ class DeviceState:
         # 2. Resolve per allocation result by reverse-precedence scan
         #    (device_state.go:225-259); fall back to per-type defaults
         #    (:210-221).
-        groups: dict[int, tuple[object, list[tuple[str, AllocatableDevice]]]] = {}
+        groups: dict[int, tuple[object, list[tuple[object, AllocatableDevice]]]] = {}
+        # members carry (DeviceRequestAllocationResult, AllocatableDevice)
         defaults: dict[str, object] = {}
         for result in alloc.devices.results:
             if result.driver != DRIVER_NAME:
                 continue
             device = self.allocatable.devices.get(result.device)
+            if device is None:
+                # Membership seats are published by the cluster controller,
+                # not this node's pool — resolve them from the API server
+                # (the reference's plugin likewise prepares IMEX channels the
+                # controller published, nvlib.go:182-200 + device_state.go:430-444).
+                device = self._resolve_remote_device(result)
             if device is None:
                 raise PrepareError(f"allocated device {result.device!r} is not on this node")
             chosen = None
@@ -215,7 +224,7 @@ class DeviceState:
                 chosen = defaults[kind]
             self._check_config_applies(chosen, device)
             key = id(chosen)
-            groups.setdefault(key, (chosen, []))[1].append((result.request, device))
+            groups.setdefault(key, (chosen, []))[1].append((result, device))
 
         # 3. Normalize+validate each chosen config once, then realize it
         #    (device_state.go:279-287, 367-428).
@@ -230,11 +239,43 @@ class DeviceState:
             devices = [d for _, d in members]
             edits, state = self._apply_config(claim, cfg, devices, undo)
             group = PreparedDeviceGroup(config_state=state)
-            for request, device in members:
-                group.devices.append(self._prepared_device(claim, request, device))
+            for result, device in members:
+                group.devices.append(
+                    self._prepared_device(claim, result.request, result.pool, device)
+                )
             group.config_state.env = {**self._wiring_env(devices), **edits.env}
             prepared.groups.append(group)
         return prepared
+
+    def _resolve_remote_device(self, result) -> Optional[AllocatableDevice]:
+        slices = [
+            s
+            for s in self._server.list(ResourceSlice.KIND)
+            if s.spec.driver == result.driver and s.spec.pool.name == result.pool
+        ]
+        if not slices:
+            return None
+        # Only the pool's highest generation is authoritative — same rule the
+        # allocator applies (scheduler/allocator.py), so a Prepare racing a
+        # pool rewrite never wires stale coordinator/host-count data.
+        max_gen = max(s.spec.pool.generation for s in slices)
+        for s in slices:
+            if s.spec.pool.generation != max_gen:
+                continue
+            for d in s.spec.devices:
+                if d.name != result.device:
+                    continue
+                attrs = d.basic.attributes
+                if attrs.get("type") and attrs["type"].value == DEVICE_TYPE_MEMBERSHIP:
+                    return AllocatableDevice(
+                        membership=SliceMembershipInfo(
+                            domain=attrs["sliceDomain"].value,
+                            worker_id=attrs["workerId"].value,
+                            host_count=attrs["hostCount"].value,
+                            coordinator_address=attrs["coordinatorAddress"].value,
+                        )
+                    )
+        return None
 
     def _default_config(self, kind: str):
         if kind == DEVICE_TYPE_CHIP:
@@ -311,26 +352,47 @@ class DeviceState:
             shape = subslices[0].subslice.subslice.shape
             env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(str(s) for s in shape)
             env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        memberships = [d for d in devices if d.membership is not None]
+        if len(memberships) > 1:
+            # Env is group-scoped; two seats in one group would silently
+            # last-wins the worker identity.
+            raise PrepareError(
+                "a claim may bind at most one slice-membership seat per "
+                f"config group, got {[d.name for d in memberships]}"
+            )
+        for d in memberships:
+            m = d.membership
+            env["TPU_WORKER_ID"] = str(m.worker_id)
+            env["TPU_HOST_COUNT"] = str(m.host_count)
+            if m.coordinator_address:
+                env["JAX_COORDINATOR_ADDRESS"] = m.coordinator_address
         return env
 
-    def _prepared_device(self, claim, request: str, device: AllocatableDevice) -> PreparedDevice:
+    def _prepared_device(
+        self, claim, request: str, pool: str, device: AllocatableDevice
+    ) -> PreparedDevice:
         paths: list[str] = []
         if device.chip is not None:
             paths = [device.chip.chip.device_path]
         elif device.subslice is not None:
             topo = device.subslice.topology
             paths = [topo.chips[i].device_path for i in device.subslice.subslice.chip_indices]
+        # Membership seats exist only in the per-claim transient spec (the
+        # base spec covers local hardware); emitting a base-qualified id for
+        # them would hand kubelet a CDI name no spec defines.
+        cdi_ids = [
+            self.cdi.qualified_name(
+                self.cdi.claim_device_name(claim.metadata.uid, device.name)
+            )
+        ]
+        if device.membership is None:
+            cdi_ids.insert(0, self.cdi.qualified_name(device.name))
         return PreparedDevice(
             kind=device.kind,
             name=device.name,
-            pool=self.config.node_name,
+            pool=pool,
             request=request,
             uuids=device.uuids(),
             device_paths=paths,
-            cdi_device_ids=[
-                self.cdi.qualified_name(device.name),
-                self.cdi.qualified_name(
-                    self.cdi.claim_device_name(claim.metadata.uid, device.name)
-                ),
-            ],
+            cdi_device_ids=cdi_ids,
         )

@@ -1,0 +1,98 @@
+"""Integration tests: the quickstart demo specs run against the closed loop.
+
+The functional north star (BASELINE.md): the tpu-test{1,2,3} clones run JAX
+containers with every chip bound via DRA; tpu-test4/5/6 exercise subslice
+geometry, mixed sharing configs and CEL selection; slice-test1 runs the
+multi-host membership flow.  The reference can only verify these manually on
+a kind cluster with real GPUs (SURVEY.md §4.3) — here they are pytest."""
+
+from pathlib import Path
+
+import pytest
+
+from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
+from k8s_dra_driver_tpu.e2e.harness import make_cluster
+from k8s_dra_driver_tpu.e2e.spec_runner import SpecError, apply_spec
+
+SPECS = Path(__file__).parent.parent / "demo" / "specs" / "quickstart"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    return make_cluster(hosts=1, topology="v5e-16", work_dir=str(tmp_path))
+
+
+class TestQuickstart:
+    def test_tpu_test1_distinct_chips(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test1.yaml")
+        assert len(pods) == 2
+        chips = {p.devices[0]["device_name"] for p in pods}
+        assert len(chips) == 2  # distinct devices
+        for p in pods:
+            assert p.env["TPU_VISIBLE_DEVICES"] in {"0", "1", "2", "3"}
+
+    def test_tpu_test2_containers_share_one_claim(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test2.yaml")
+        assert len(pods) == 1
+        assert len(pods[0].devices) == 1  # one chip, both containers see it
+
+    def test_tpu_test3_pods_share_global_claim(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test3.yaml")
+        assert len(pods) == 2
+        assert pods[0].devices == pods[1].devices  # same underlying chip
+        assert pods[0].node == pods[1].node  # pinned by the shared allocation
+
+    def test_tpu_test4_subslices_same_host(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test4.yaml")
+        (pod,) = pods
+        names = {d["device_name"] for d in pod.devices}
+        assert names == {"tpu-slice-1x2-0-0", "tpu-slice-1x2-1-0"}
+
+    def test_tpu_test5_mixed_sharing_configs(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test5.yaml")
+        (pod,) = pods
+        assert len(pod.devices) == 2
+        # Both strategies visible in the merged env; the spatial partition
+        # spawned a topology daemon.
+        assert pod.env["TPU_QUEUE_QUANTUM_MS"] == "20"  # TimeSlicing Long
+        assert pod.env["TPU_CORE_FRACTION"] == "50"
+        daemons = cluster.server.list("Deployment", namespace="tpu-dra-driver")
+        assert len(daemons) == 1
+
+    def test_tpu_test6_cel_selection(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test6.yaml")
+        assert pods[0].devices[0]["device_name"] in {"tpu-0", "tpu-1"}
+
+    def test_whole_inventory_exhaustion_is_clean(self, cluster):
+        apply_spec(cluster, SPECS / "tpu-test6.yaml")  # one of chips 0/1
+        apply_spec(cluster, SPECS / "tpu-test3.yaml")  # one more
+        apply_spec(cluster, SPECS / "tpu-test1.yaml")  # remaining two
+        # Fifth chip does not exist: next spec must fail with a clear error.
+        with pytest.raises(SpecError, match="unschedulable"):
+            apply_spec(cluster, SPECS / "tpu-test2.yaml")
+
+
+class TestSliceTest1:
+    def test_multihost_membership_flow(self, tmp_path):
+        cluster = make_cluster(
+            hosts=4, topology="v5e-16", work_dir=str(tmp_path), slice_domain="v5e-16-demo"
+        )
+        manager = SliceManager(cluster.server)
+        manager.start()
+        pods = apply_spec(cluster, SPECS / "slice-test1.yaml")
+        assert len(pods) == 4
+        assert len({p.node for p in pods}) == 4  # anti-affinity honored
+        worker_envs = sorted(p.env.get("JAX_COORDINATOR_PORT") for p in pods)
+        assert worker_envs == ["8476"] * 4
+        # every pod got a 2x2 subslice (4 chips) + a membership seat
+        for p in pods:
+            kinds = sorted(d["device_name"] for d in p.devices)
+            assert any(k.startswith("tpu-slice-2x2") for k in kinds)
+            assert any(k.startswith("membership-") for k in kinds)
+        # distinct seats
+        seats = {
+            d["device_name"] for p in pods for d in p.devices
+            if d["device_name"].startswith("membership-")
+        }
+        assert len(seats) == 4
+        manager.stop()
