@@ -34,7 +34,14 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=float(os.environ.get("RETRY_TIMEOUT_S", "60")),
     )
-    p.add_argument("--fake-cluster", action="store_true")
+    p.add_argument(
+        "--fake-cluster", action="store_true",
+        default=os.environ.get("FAKE_CLUSTER", "") == "true",
+    )
+    p.add_argument(
+        "--http-port", type=int, default=int(os.environ.get("HTTP_PORT", "-1")),
+        help="diagnostics endpoint port (/metrics,/healthz); -1 disables, 0 = ephemeral",
+    )
     return p
 
 
@@ -52,6 +59,19 @@ def main(argv: list[str] | None = None) -> int:
         manager.start()
         log.info("slice manager watching node slice-domain labels")
 
+    diagnostics = None
+    if args.http_port >= 0:
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        diagnostics = DiagnosticsServer(
+            port=args.http_port,
+            state_provider=lambda: {
+                "domains": manager.domains() if manager else {},
+            },
+        )
+        diagnostics.start()
+        log.info("diagnostics on http://127.0.0.1:%d/metrics", diagnostics.port)
+
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
@@ -59,6 +79,8 @@ def main(argv: list[str] | None = None) -> int:
     while not stop.wait(timeout=1.0):
         if manager is not None:
             manager.retry_pending()
+    if diagnostics is not None:
+        diagnostics.stop()
     if manager is not None:
         manager.stop()
     return 0

@@ -11,6 +11,7 @@ the unix-socket server share one implementation.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 from k8s_dra_driver_tpu import DRIVER_NAME
@@ -23,6 +24,7 @@ from k8s_dra_driver_tpu.kube.resourceslice_controller import (
     Slice,
 )
 from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 
 # ResourceSlice device limit per object (upstream k8s constant): split pools
 # into slices of at most this many devices.
@@ -54,7 +56,21 @@ class Driver:
         self._server = server
         self.config = config
         self._lock = threading.Lock()
+        # The BASELINE claim-latency instrumentation the reference lacks
+        # (SURVEY.md §5 "no claim-latency histograms").
+        self._prepare_seconds = REGISTRY.histogram(
+            "dra_node_prepare_seconds", "NodePrepareResources per-claim latency"
+        )
+        self._unprepare_seconds = REGISTRY.histogram(
+            "dra_node_unprepare_seconds", "NodeUnprepareResources per-claim latency"
+        )
+        self._claim_errors = REGISTRY.counter(
+            "dra_claim_errors_total", "Per-claim prepare/unprepare failures"
+        )
         self.state = DeviceState(server, config)
+        REGISTRY.gauge(
+            "dra_allocatable_devices", "Devices this node publishes"
+        ).set(len(self.state.allocatable), node=config.node_name)
         self._slice_controller = ResourceSliceController(
             server, DRIVER_NAME, config.node_name
         )
@@ -90,9 +106,12 @@ class Driver:
         out: dict[str, ClaimResult] = {}
         with self._lock:
             for ref in claims:
+                start = time.perf_counter()
                 try:
                     out[ref.uid] = ClaimResult(devices=self._prepare_one(ref))
+                    self._prepare_seconds.observe(time.perf_counter() - start)
                 except Exception as exc:  # per-claim, not process-fatal
+                    self._claim_errors.inc(op="prepare")
                     out[ref.uid] = ClaimResult(
                         error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
                     )
@@ -102,14 +121,73 @@ class Driver:
         out: dict[str, ClaimResult] = {}
         with self._lock:
             for ref in claims:
+                start = time.perf_counter()
                 try:
                     self.state.unprepare(ref.uid)
+                    self._unprepare_seconds.observe(time.perf_counter() - start)
                     out[ref.uid] = ClaimResult()
                 except Exception as exc:
+                    self._claim_errors.inc(op="unprepare")
                     out[ref.uid] = ClaimResult(
                         error=f"error unpreparing claim {ref.namespace}/{ref.name}: {exc}"
                     )
         return out
+
+    # -- orphan cleanup (the reference left this as a TODO, driver.go:156-168)
+
+    def cleanup_orphans(self) -> dict[str, list[str]]:
+        """Reconcile node-local residue against the API server + checkpoint.
+
+        Three sweeps, in dependency order:
+        1. checkpointed claims whose ResourceClaim is gone (deleted while the
+           plugin was down) → full unprepare (stops daemons, removes specs);
+        2. CDI claim-spec files on disk with no checkpoint entry (crash
+           between spec write and checkpoint write) → delete;
+        3. topology-daemon Deployments whose claim uid is no longer prepared
+           (crash between daemon create and checkpoint write) → delete.
+        Returns what was cleaned, for logging/metrics.
+        """
+        from k8s_dra_driver_tpu.kube.objects import Deployment
+
+        cleaned: dict[str, list[str]] = {"claims": [], "cdi_specs": [], "daemons": []}
+        with self._lock:
+            for uid in self.state.prepared_claim_uids():
+                prepared = self.state.prepared[uid]
+                gone = False
+                try:
+                    claim = self._server.get(
+                        ResourceClaim.KIND, prepared.name, prepared.namespace
+                    )
+                    gone = claim.metadata.uid != uid
+                except NotFound:
+                    gone = True
+                if gone:
+                    self.state.unprepare(uid)
+                    cleaned["claims"].append(uid)
+
+            live = set(self.state.prepared_claim_uids())
+            for uid in self.state.cdi.list_claim_spec_uids():
+                if uid not in live:
+                    self.state.cdi.delete_claim_spec_file(uid)
+                    cleaned["cdi_specs"].append(uid)
+
+            # Scope to THIS node's daemons: other plugins' claims are not in
+            # our checkpoint and must never look like orphans to us.
+            for dep in self._server.list(
+                Deployment.KIND,
+                namespace=self.config.namespace,
+                label_selector={
+                    "app.kubernetes.io/name": "tpu-topology-daemon",
+                    "tpu.google.com/node": self.config.node_name,
+                },
+            ):
+                uid = dep.metadata.labels.get("resourceclaim.tpu.google.com/uid", "")
+                if uid and uid not in live:
+                    self._server.delete(
+                        Deployment.KIND, dep.metadata.name, dep.metadata.namespace
+                    )
+                    cleaned["daemons"].append(dep.metadata.name)
+        return cleaned
 
     def _prepare_one(self, ref: ClaimRef) -> list[dict]:
         # Re-fetch the claim from the API server — the kubelet request only

@@ -56,7 +56,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--fake-host-id", default=env_default("TPUINFO_FAKE_HOST_ID", "0"))
     p.add_argument(
         "--fake-cluster", action="store_true",
-        help="serve against an in-process API server (demo/e2e mode)",
+        default=env_default("FAKE_CLUSTER", "") == "true",
+        help="serve against an in-process API server (demo/e2e mode; env FAKE_CLUSTER=true)",
+    )
+    p.add_argument(
+        "--http-port", type=int, default=int(env_default("HTTP_PORT", "-1")),
+        help="diagnostics endpoint port (/metrics,/healthz); -1 disables, 0 = ephemeral",
+    )
+    p.add_argument(
+        "--cleanup-interval-s", type=float,
+        default=float(env_default("CLEANUP_INTERVAL_S", "60")),
+        help="orphan-cleanup sweep period",
     )
     return p
 
@@ -95,6 +105,20 @@ def main(argv: list[str] | None = None) -> int:
     )
     plugin = PluginServer(driver, plugin_dir=args.plugin_path, registry_dir=args.registry_path)
     plugin.start()
+    diagnostics = None
+    if args.http_port >= 0:
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        diagnostics = DiagnosticsServer(
+            port=args.http_port,
+            state_provider=lambda: {
+                "node": args.node_name,
+                "allocatable": sorted(driver.state.allocatable.devices),
+                "prepared_claims": driver.state.prepared_claim_uids(),
+            },
+        )
+        diagnostics.start()
+        log.info("diagnostics on http://127.0.0.1:%d/metrics", diagnostics.port)
     log.info(
         "driver %s serving on %s (registration: %s); %d devices published",
         DRIVER_NAME,
@@ -106,8 +130,20 @@ def main(argv: list[str] | None = None) -> int:
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
-    stop.wait()
+    # Periodic orphan-cleanup sweep (driver.go:156-168's missing loop).  A
+    # failing sweep must never take down the node's DRA driver — log and
+    # retry next period (transient API errors are expected).
+    while not stop.wait(timeout=args.cleanup_interval_s):
+        try:
+            cleaned = driver.cleanup_orphans()
+        except Exception:
+            log.exception("orphan cleanup sweep failed; will retry")
+            continue
+        if any(cleaned.values()):
+            log.info("orphan cleanup: %s", cleaned)
     log.info("shutting down")
+    if diagnostics is not None:
+        diagnostics.stop()
     plugin.stop()
     return 0
 

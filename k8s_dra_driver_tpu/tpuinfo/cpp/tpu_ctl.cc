@@ -1,0 +1,92 @@
+// tpu-ctl — minimal TPU admin/inspection CLI over libtpuinfo.
+//
+// The TPU-native analog of the nvidia-smi surface the reference leans on
+// (exec'd for listing and runtime settings, nvlib.go:521-558; demo pods
+// verify bindings with `nvidia-smi -L`).  A claimed container runs
+// `tpu-ctl list` to prove its device binding the same way.
+//
+// Commands:
+//   tpu-ctl list        one line per visible chip (nvidia-smi -L style)
+//   tpu-ctl topology    full enumeration JSON (libtpuinfo passthrough)
+//   tpu-ctl version     CLI + library version
+
+#include <cstdio>
+#include <cstring>
+
+#include "tpuinfo.h"
+
+namespace {
+
+// Tiny extractor for flat "key":value / "key":"value" pairs in the
+// enumeration JSON (values never contain escaped quotes; arrays handled by
+// the caller).  Avoids dragging a JSON library into the CLI.
+bool find_raw(const char* json, const char* key, char* out, size_t out_len) {
+  char pattern[64];
+  std::snprintf(pattern, sizeof(pattern), "\"%s\":", key);
+  const char* p = std::strstr(json, pattern);
+  if (!p) return false;
+  p += std::strlen(pattern);
+  const char* end;
+  if (*p == '"') {
+    p++;
+    end = std::strchr(p, '"');
+  } else {
+    end = p;
+    while (*end && *end != ',' && *end != '}' && *end != ']') end++;
+  }
+  if (!end || static_cast<size_t>(end - p) >= out_len) return false;
+  std::memcpy(out, p, end - p);
+  out[end - p] = '\0';
+  return true;
+}
+
+int cmd_list(const char* json) {
+  char gen[32] = "?", topo[32] = "?", host[16] = "?";
+  find_raw(json, "generation", gen, sizeof(gen));
+  find_raw(json, "topology", topo, sizeof(topo));
+  find_raw(json, "host_id", host, sizeof(host));
+  const char* chips = std::strstr(json, "\"chips\":[");
+  if (!chips) {
+    std::fprintf(stderr, "tpu-ctl: malformed enumeration payload\n");
+    return 1;
+  }
+  int n = 0;
+  for (const char* p = chips; (p = std::strstr(p, "{\"index\":")); n++) {
+    char uuid[64] = "?", path[64] = "?", idx[16] = "?";
+    find_raw(p, "index", idx, sizeof(idx));
+    find_raw(p, "device_path", path, sizeof(path));
+    find_raw(p, "uuid", uuid, sizeof(uuid));
+    std::printf("TPU %s: %s %s (UUID: %s)\n", idx, gen, path, uuid);
+    p += 9;
+  }
+  std::printf("topology %s, host %s, %d local chip(s)\n", topo, host, n);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* cmd = argc > 1 ? argv[1] : "list";
+  if (std::strcmp(cmd, "version") == 0) {
+    std::printf("tpu-ctl %s (libtpuinfo %s)\n", tpuinfo_version(), tpuinfo_version());
+    return 0;
+  }
+  char* json = nullptr;
+  int rc = tpuinfo_enumerate(&json);
+  if (rc != 0) {
+    std::fprintf(stderr, "tpu-ctl: %s\n", json ? json : "enumeration failed");
+    tpuinfo_free(json);
+    return 1;
+  }
+  if (std::strcmp(cmd, "topology") == 0) {
+    std::printf("%s\n", json);
+  } else if (std::strcmp(cmd, "list") == 0) {
+    cmd_list(json);
+  } else {
+    std::fprintf(stderr, "usage: tpu-ctl [list|topology|version]\n");
+    tpuinfo_free(json);
+    return 2;
+  }
+  tpuinfo_free(json);
+  return 0;
+}

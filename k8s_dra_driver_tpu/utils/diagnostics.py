@@ -1,0 +1,62 @@
+"""HTTP diagnostics endpoint: /metrics, /healthz, /debug/state.
+
+Mirror of the controller's SetupHTTPEndpoint (cmd/nvidia-dra-controller/
+main.go:194-241, promhttp + pprof), extended to both binaries — the
+reference's plugin has no diagnostics at all (SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, Registry
+
+
+class DiagnosticsServer:
+    def __init__(
+        self,
+        port: int = 0,
+        registry: Registry = REGISTRY,
+        state_provider: Optional[Callable[[], dict]] = None,
+        bind_host: str = "0.0.0.0",
+    ):
+        """``bind_host`` defaults to all interfaces so in-cluster scrapes and
+        kubelet probes (which hit the pod IP) can reach the endpoint."""
+        registry_ref = registry
+        state_ref = state_provider or (lambda: {})
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = registry_ref.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body = b"ok"
+                    ctype = "text/plain"
+                elif self.path == "/debug/state":
+                    body = json.dumps(state_ref(), indent=1, default=str).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,168 @@
+"""Minimal Prometheus-style metrics registry.
+
+The reference exposes client-go/workqueue collectors via promhttp on the
+controller only (cmd/nvidia-dra-controller/main.go:194-214) and has NO
+custom metrics — SURVEY.md §5 calls out that the BASELINE
+claim-to-running-p50 metric needs new instrumentation.  This module provides
+it for both binaries: counters, gauges and histograms with labels, rendered
+in the Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+def _label_key(labels: dict[str, str] | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _label_str(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+@dataclass
+class Counter:
+    """Mutation and render are lock-protected: /metrics scrapes run on
+    DiagnosticsServer threads concurrently with driver-thread updates."""
+
+    name: str
+    help: str
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            out.append(f"{self.name}{_label_str(key)} {v}")
+        return out
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            out.append(f"{self.name}{_label_str(key)} {v}")
+        return out
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str
+    buckets: tuple = _DEFAULT_BUCKETS
+    _counts: dict[tuple, list[int]] = field(default_factory=dict)
+    _sums: dict[tuple, float] = field(default_factory=dict)
+    _totals: dict[tuple, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._totals.get(_label_key(labels), 0)
+
+    def quantile(self, q: float, **labels) -> float:
+        """Approximate quantile from bucket counts (upper bound of the bucket
+        that crosses the rank) — the claim-latency p50/p90 readout."""
+        key = _label_key(labels)
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if total == 0:
+                return 0.0
+            rank = q * total
+            counts = list(self._counts[key])
+        for i, bound in enumerate(self.buckets):
+            if counts[i] >= rank:
+                return bound
+        return float("inf")
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            keys = sorted(self._totals)
+            counts = {k: list(self._counts[k]) for k in keys}
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for key in keys:
+            for i, bound in enumerate(self.buckets):
+                bucket_key = key + (("le", str(bound)),)
+                out.append(f"{self.name}_bucket{_label_str(bucket_key)} {counts[key][i]}")
+            inf_key = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_label_str(inf_key)} {totals[key]}")
+            out.append(f"{self.name}_sum{_label_str(key)} {sums[key]}")
+            out.append(f"{self.name}_count{_label_str(key)} {totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help, buckets))
+
+    def _get_or_create(self, name, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    def render(self) -> str:
+        with self._lock:
+            lines = []
+            for metric in self._metrics.values():
+                lines.extend(metric.render())
+            return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

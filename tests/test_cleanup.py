@@ -1,0 +1,180 @@
+"""Orphan-cleanup loop + tpu-ctl CLI tests."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.api import API_VERSION
+from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+from k8s_dra_driver_tpu.kube.objects import (
+    Deployment,
+    DeviceClaimConfiguration,
+    OpaqueDeviceConfiguration,
+)
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+
+CPP_DIR = Path(__file__).parent.parent / "k8s_dra_driver_tpu" / "tpuinfo" / "cpp"
+
+
+@pytest.fixture
+def rig(tmp_path):
+    cluster = make_cluster(hosts=1, work_dir=str(tmp_path / "work"))
+    driver = Driver(
+        cluster.server,
+        DriverConfig(
+            node_name="tpu-host-0",
+            cdi_root=str(tmp_path / "cdi"),
+            checkpoint_path=str(tmp_path / "checkpoint.json"),
+            topology_env={"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"},
+            publish=False,
+            daemon_backoff_initial=0.001,
+        ),
+    )
+    return cluster, driver
+
+
+def spatial_config():
+    return [
+        DeviceClaimConfiguration(
+            opaque=OpaqueDeviceConfiguration(
+                driver=DRIVER_NAME,
+                parameters={
+                    "apiVersion": API_VERSION,
+                    "kind": "TpuConfig",
+                    "sharing": {"strategy": "SpatialPartition"},
+                },
+            )
+        )
+    ]
+
+
+class TestOrphanCleanup:
+    def prepare_claim(self, cluster, driver, name, config=None):
+        claim = cluster.server.create(simple_claim(name))
+        if config:
+            claim.spec.devices.config = config
+            claim = cluster.server.update(claim)
+        allocated = cluster.allocator.allocate(claim, node_name="tpu-host-0")
+        driver.state.prepare(allocated)
+        return allocated
+
+    def test_deleted_claim_is_fully_unprepared(self, rig):
+        cluster, driver = rig
+        claim = self.prepare_claim(cluster, driver, "gone", config=spatial_config())
+        assert cluster.server.list(Deployment.KIND, namespace="tpu-dra-driver")
+        cluster.server.delete("ResourceClaim", "gone", "default")
+        cleaned = driver.cleanup_orphans()
+        assert cleaned["claims"] == [claim.metadata.uid]
+        assert driver.state.prepared_claim_uids() == []
+        assert cluster.server.list(Deployment.KIND, namespace="tpu-dra-driver") == []
+        assert not driver.state.cdi.claim_spec_path(claim.metadata.uid).exists()
+
+    def test_live_claim_untouched(self, rig):
+        cluster, driver = rig
+        claim = self.prepare_claim(cluster, driver, "live")
+        cleaned = driver.cleanup_orphans()
+        assert cleaned == {"claims": [], "cdi_specs": [], "daemons": []}
+        assert driver.state.prepared_claim_uids() == [claim.metadata.uid]
+
+    def test_stray_cdi_spec_removed(self, rig):
+        cluster, driver = rig
+        stray = driver.state.cdi.claim_spec_path("dead-uid")
+        stray.write_text("{}")
+        cleaned = driver.cleanup_orphans()
+        assert cleaned["cdi_specs"] == ["dead-uid"]
+        assert not stray.exists()
+
+    def test_stray_daemon_removed(self, rig):
+        cluster, driver = rig
+        # Simulate a crash between daemon create and checkpoint write: daemon
+        # exists, checkpoint has no claim.
+        from k8s_dra_driver_tpu.kube.objects import ObjectMeta
+
+        cluster.server.create(
+            Deployment(
+                metadata=ObjectMeta(
+                    name="tpu-topology-daemon-deadbeef",
+                    namespace="tpu-dra-driver",
+                    labels={
+                        "app.kubernetes.io/name": "tpu-topology-daemon",
+                        "resourceclaim.tpu.google.com/uid": "dead-uid",
+                        "tpu.google.com/node": "tpu-host-0",
+                    },
+                )
+            )
+        )
+        cleaned = driver.cleanup_orphans()
+        assert cleaned["daemons"] == ["tpu-topology-daemon-deadbeef"]
+
+    def test_other_nodes_daemons_untouched(self, rig):
+        # A daemon owned by another node's plugin must never look like an
+        # orphan to this node's sweep.
+        cluster, driver = rig
+        from k8s_dra_driver_tpu.kube.objects import ObjectMeta
+
+        cluster.server.create(
+            Deployment(
+                metadata=ObjectMeta(
+                    name="tpu-topology-daemon-othernode",
+                    namespace="tpu-dra-driver",
+                    labels={
+                        "app.kubernetes.io/name": "tpu-topology-daemon",
+                        "resourceclaim.tpu.google.com/uid": "foreign-uid",
+                        "tpu.google.com/node": "tpu-host-9",
+                    },
+                )
+            )
+        )
+        cleaned = driver.cleanup_orphans()
+        assert cleaned["daemons"] == []
+        assert cluster.server.get(
+            Deployment.KIND, "tpu-topology-daemon-othernode", "tpu-dra-driver"
+        )
+
+    def test_uid_reuse_is_detected(self, rig):
+        # Claim deleted and recreated with the same name but a new uid: the
+        # old prepared state must be cleaned.
+        cluster, driver = rig
+        old = self.prepare_claim(cluster, driver, "reused")
+        cluster.server.delete("ResourceClaim", "reused", "default")
+        cluster.server.create(simple_claim("reused"))
+        cleaned = driver.cleanup_orphans()
+        assert cleaned["claims"] == [old.metadata.uid]
+
+
+class TestTpuCtl:
+    @pytest.fixture(scope="class", autouse=True)
+    def build(self):
+        subprocess.run(["make", "-s", "-C", str(CPP_DIR), "tpu-ctl"], check=True)
+
+    def run_ctl(self, *args, topo="v5e-16", host="1"):
+        return subprocess.run(
+            [str(CPP_DIR / "tpu-ctl"), *args],
+            env={"TPUINFO_FAKE_TOPOLOGY": topo, "TPUINFO_FAKE_HOST_ID": host, "PATH": "/usr/bin"},
+            capture_output=True,
+            text=True,
+        )
+
+    def test_list(self):
+        r = self.run_ctl("list")
+        assert r.returncode == 0
+        assert r.stdout.count("TPU ") == 4
+        assert "topology 4x4, host 1, 4 local chip(s)" in r.stdout
+
+    def test_topology_json(self):
+        import json
+
+        r = self.run_ctl("topology")
+        doc = json.loads(r.stdout)
+        assert doc["generation"] == "v5e" and len(doc["chips"]) == 4
+
+    def test_error_path(self):
+        r = self.run_ctl("list", topo="nope")
+        assert r.returncode == 1
+        assert "invalid TPUINFO_FAKE_TOPOLOGY" in r.stderr
+
+    def test_bad_command(self):
+        r = self.run_ctl("frobnicate")
+        assert r.returncode == 2

@@ -1,0 +1,100 @@
+"""Metrics registry + diagnostics endpoint + driver instrumentation tests."""
+
+import urllib.request
+
+from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+from k8s_dra_driver_tpu.plugin.driver import ClaimRef, Driver, DriverConfig
+from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+from k8s_dra_driver_tpu.utils.metrics import Registry
+
+
+class TestRegistry:
+    def test_counter_and_labels(self):
+        r = Registry()
+        c = r.counter("errors_total", "errors")
+        c.inc(op="prepare")
+        c.inc(op="prepare")
+        c.inc(op="unprepare")
+        assert c.value(op="prepare") == 2
+        text = r.render()
+        assert 'errors_total{op="prepare"} 2.0' in text
+        assert "# TYPE errors_total counter" in text
+
+    def test_histogram_quantile_and_render(self):
+        r = Registry()
+        h = r.histogram("latency_seconds", "lat", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.005, 0.05, 0.5):
+            h.observe(v)
+        assert h.quantile(0.5) == 0.01  # 2 of 4 in first bucket
+        assert h.quantile(0.99) == 1.0
+        text = r.render()
+        assert 'latency_seconds_bucket{le="0.01"} 2' in text
+        assert 'latency_seconds_bucket{le="+Inf"} 4' in text
+        assert "latency_seconds_count 4" in text
+
+    def test_gauge(self):
+        r = Registry()
+        g = r.gauge("devices", "devices")
+        g.set(9, node="h0")
+        assert 'devices{node="h0"} 9' in r.render()
+
+    def test_same_name_returns_same_metric(self):
+        r = Registry()
+        assert r.counter("x") is r.counter("x")
+
+
+class TestDiagnosticsServer:
+    def test_endpoints(self):
+        r = Registry()
+        r.counter("hits_total", "").inc()
+        srv = DiagnosticsServer(port=0, registry=r, state_provider=lambda: {"ok": True})
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "hits_total 1.0" in metrics
+            assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+            state = urllib.request.urlopen(f"{base}/debug/state").read().decode()
+            assert '"ok": true' in state
+            try:
+                urllib.request.urlopen(f"{base}/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()
+
+
+class TestDriverInstrumentation:
+    def test_prepare_latency_recorded(self, tmp_path):
+        from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+        cluster = make_cluster(hosts=1, work_dir=str(tmp_path))
+        driver = Driver(
+            cluster.server,
+            DriverConfig(
+                node_name="tpu-host-0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "cp.json"),
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                    "TPUINFO_FAKE_HOST_ID": "0",
+                },
+                publish=False,
+            ),
+        )
+        h = REGISTRY.histogram("dra_node_prepare_seconds")
+        before = h.count()
+        claim = cluster.server.create(simple_claim("m1"))
+        allocated = cluster.allocator.allocate(claim, node_name="tpu-host-0")
+        driver.node_prepare_resources(
+            [ClaimRef(uid=allocated.metadata.uid, name="m1", namespace="default")]
+        )
+        assert h.count() == before + 1
+
+        errs = REGISTRY.counter("dra_claim_errors_total")
+        before_err = errs.value(op="prepare")
+        driver.node_prepare_resources(
+            [ClaimRef(uid="x", name="ghost", namespace="default")]
+        )
+        assert errs.value(op="prepare") == before_err + 1
