@@ -111,7 +111,13 @@ def _constrain(x, act_spec):
     return jax.lax.with_sharding_constraint(x, act_spec)
 
 
-def _block(x, p, cfg: ModelConfig, act_spec):
+def _full_attention(q, k, v):
+    from k8s_dra_driver_tpu.ops.ring_attention import reference_attention
+
+    return reference_attention(q, k, v, causal=True)
+
+
+def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
     b, s, d = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
@@ -121,11 +127,7 @@ def _block(x, p, cfg: ModelConfig, act_spec):
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, h, hd)
     v = v.reshape(b, s, h, hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-    weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, d)
+    attn = attn_fn(q, k, v).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
     x = _constrain(x, act_spec)
 
@@ -136,22 +138,28 @@ def _block(x, p, cfg: ModelConfig, act_spec):
     return _constrain(x, act_spec)
 
 
-def forward(params: dict, tokens: jax.Array, cfg: ModelConfig, act_spec=None) -> jax.Array:
+def forward(
+    params: dict, tokens: jax.Array, cfg: ModelConfig, act_spec=None, attn_fn=None
+) -> jax.Array:
     """tokens [B,S] int32 -> logits [B,S,V] (f32)."""
     s = tokens.shape[1]
     x = params["embed"][tokens] + params["pos_embed"][:s]
     x = _constrain(x, act_spec)
-    block = functools.partial(_block, cfg=cfg, act_spec=act_spec)
+    block = functools.partial(
+        _block, cfg=cfg, act_spec=act_spec, attn_fn=attn_fn or _full_attention
+    )
     for p in params["blocks"]:
         x = jax.checkpoint(block)(x, p)  # remat: HBM for FLOPs
     x = _rms_norm(x, params["ln_f"])
     return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
 
 
-def loss_fn(params, tokens, cfg: ModelConfig, act_spec=None) -> jax.Array:
-    logits = forward(params, tokens[:, :-1], cfg, act_spec)
+def loss_fn(params, tokens, cfg: ModelConfig, act_spec=None, attn_fn=None) -> jax.Array:
+    # Forward runs on the full sequence (keeps S divisible by the seq mesh
+    # axis); the shift happens in the loss.
+    logits = forward(params, tokens, cfg, act_spec, attn_fn)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
 
@@ -167,10 +175,22 @@ class TrainStepFns:
 
 
 def build_train_step(
-    cfg: ModelConfig, mesh: Mesh | None = None, lr: float = 3e-4
+    cfg: ModelConfig,
+    mesh: Mesh | None = None,
+    lr: float = 3e-4,
+    sequence_parallel: str = "auto",
 ) -> TrainStepFns:
     """Returns jitted (init, step).  With a mesh, params/opt-state/activations
-    get DP/TP/SP shardings; without, everything runs single-device."""
+    get DP/TP/SP shardings; without, everything runs single-device.
+
+    ``sequence_parallel``: 'auto' uses ring attention whenever the mesh's
+    ``seq`` axis is >1 (K/V blocks rotate over ICI, no full-sequence gather);
+    'ring' forces it; 'ulysses' uses all-to-all head/sequence resharding
+    (requires an unsharded head dim, i.e. model axis == 1); 'none' leaves
+    resharding to XLA."""
+    valid = ("auto", "ring", "ulysses", "none")
+    if sequence_parallel not in valid:
+        raise ValueError(f"sequence_parallel must be one of {valid}, got {sequence_parallel!r}")
     opt = make_optimizer(lr)
     if mesh is None:
         act_spec = None
@@ -187,6 +207,28 @@ def build_train_step(
         return TrainStepFns(init=jax.jit(init), step=jax.jit(step))
 
     act_spec = P("data", "seq", None)
+    scheme = sequence_parallel
+    if scheme == "auto":
+        scheme = "ring" if mesh.shape.get("seq", 1) > 1 else "none"
+    attn_fn = None
+    if scheme == "ring":
+        from k8s_dra_driver_tpu.ops.ring_attention import ring_attention
+
+        attn_fn = functools.partial(
+            ring_attention, mesh=mesh, axis_name="seq",
+            batch_axis="data", head_axis="model",
+        )
+    elif scheme == "ulysses":
+        from k8s_dra_driver_tpu.ops.ring_attention import ulysses_attention
+
+        if mesh.shape.get("model", 1) > 1:
+            raise ValueError(
+                "ulysses sequence parallelism needs the full head dim per "
+                "shard; use model axis 1 or sequence_parallel='ring'"
+            )
+        attn_fn = functools.partial(
+            ulysses_attention, mesh=mesh, axis_name="seq", batch_axis="data"
+        )
     pspecs = param_pspecs(cfg)
     param_shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
@@ -201,7 +243,7 @@ def build_train_step(
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, cfg, NamedSharding(mesh, act_spec)
+            params, tokens, cfg, NamedSharding(mesh, act_spec), attn_fn
         )
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss

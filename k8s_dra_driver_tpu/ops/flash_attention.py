@@ -1,0 +1,128 @@
+"""Fused causal flash attention as a Pallas TPU kernel.
+
+The burn-in model's hot op.  Classic flash-attention grid: one program per
+(batch·head, q-block, k-block) with the k dimension innermost; online-softmax
+state (m, l, acc) lives in VMEM scratch and persists across the sequential
+k iterations (TPU grids execute in order), so the full [S, S] score matrix
+never exists.  Matmuls run on the MXU in the input dtype with f32
+accumulation (``preferred_element_type``); masking and softmax run on the
+VPU.  Causal q/k blocks strictly above the diagonal are predicated off with
+``pl.when`` — they cost a grid step but no FLOPs.
+
+Forward-only for now (the training path keeps the jnp attention for autodiff;
+a custom VJP lands in a later round).  ``interpret=True`` runs the same
+kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, block_q: int, block_k: int, causal: bool, num_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: a k block whose first position exceeds the q block's last
+    # position contributes nothing.
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]  # [block_q, 1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        out_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(out_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """q/k/v: [B, S, H, D] -> [B, S, H, D].
+
+    S must be a multiple of the block sizes (pad upstream); D should be a
+    multiple of 128 for MXU efficiency but smaller D works.
+    """
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"sequence {s} not divisible by blocks ({block_q},{block_k})")
+    num_q = s // block_q
+    num_k = s // block_k
+    scale = 1.0 / math.sqrt(d)
+
+    # [B, S, H, D] -> [B*H, S, D]: heads become grid rows.
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale, block_q=block_q, block_k=block_k, causal=causal, num_k=num_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (value in lane 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+        ],
+        interpret=interpret,
+    )(to_bh(q), to_bh(k), to_bh(v))
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

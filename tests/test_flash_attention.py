@@ -1,0 +1,54 @@
+"""Pallas flash-attention numerics (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.ops.flash_attention import flash_attention
+from k8s_dra_driver_tpu.ops.ring_attention import reference_attention
+from tests.conftest import cpu_devices
+
+
+def make_qkv(b=1, s=128, h=2, d=64, dtype=jnp.float32, seed=3):
+    cpu = cpu_devices(1)[0]
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(
+        jax.device_put(jax.random.normal(key, (b, s, h, d), dtype), cpu)
+        for key in keys
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = make_qkv()
+        want = reference_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_multi_block_and_uneven_block_sizes(self):
+        q, k, v = make_qkv(b=2, s=256, h=1, d=32)
+        want = reference_attention(q, k, v)
+        got = flash_attention(q, k, v, block_q=64, block_k=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = make_qkv(dtype=jnp.bfloat16)
+        want = reference_attention(q, k, v)
+        got = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+        )
+
+    def test_rejects_indivisible_sequence(self):
+        q, k, v = make_qkv(s=96)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+
+    def test_single_block(self):
+        q, k, v = make_qkv(s=32)
+        want = reference_attention(q, k, v)
+        got = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
