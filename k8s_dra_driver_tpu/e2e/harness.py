@@ -33,6 +33,10 @@ _CLASS_SELECTORS = {
     MEMBERSHIP_CLASS: "membership",
 }
 
+# Hardware classes additionally require the device to be healthy; membership
+# seats are logical and carry no health attribute.
+_HEALTH_GATED = {TPU_CLASS, SUBSLICE_CLASS}
+
 
 def cel_selector(expr: str) -> DeviceSelector:
     return DeviceSelector(cel=CELDeviceSelector(expression=expr))
@@ -42,17 +46,16 @@ def install_device_classes(server: InMemoryAPIServer) -> None:
     """The three DeviceClasses the helm chart ships (templates/deviceclass-*,
     SURVEY.md §2.6), selecting on driver + type attribute."""
     for name, devtype in _CLASS_SELECTORS.items():
+        expr = (
+            f"device.driver == '{DRIVER_NAME}' && "
+            f"device.attributes['{DRIVER_NAME}'].type == '{devtype}'"
+        )
+        if name in _HEALTH_GATED:
+            expr += f" && device.attributes['{DRIVER_NAME}'].healthy == true"
         server.create(
             DeviceClass(
                 metadata=ObjectMeta(name=name),
-                spec=DeviceClassSpec(
-                    selectors=[
-                        cel_selector(
-                            f"device.driver == '{DRIVER_NAME}' && "
-                            f"device.attributes['{DRIVER_NAME}'].type == '{devtype}'"
-                        )
-                    ]
-                ),
+                spec=DeviceClassSpec(selectors=[cel_selector(expr)]),
             )
         )
 

@@ -191,6 +191,11 @@ def build_train_step(
     valid = ("auto", "ring", "ulysses", "none")
     if sequence_parallel not in valid:
         raise ValueError(f"sequence_parallel must be one of {valid}, got {sequence_parallel!r}")
+    if mesh is None and sequence_parallel in ("ring", "ulysses"):
+        raise ValueError(
+            f"sequence_parallel={sequence_parallel!r} requires a mesh; "
+            "single-device training has no seq axis"
+        )
     opt = make_optimizer(lr)
     if mesh is None:
         act_spec = None

@@ -173,6 +173,19 @@ class DeviceState:
         with self._lock:
             return list(self.prepared)
 
+    def refresh(self) -> bool:
+        """Re-enumerate the hardware; True when the inventory changed
+        (chip died/recovered, topology env changed).  On change the base CDI
+        spec is rewritten so future claims see current truth."""
+        with self._lock:
+            new_topology = enumerate_topology(env=self.config.topology_env or None)
+            if new_topology == self.topology:
+                return False
+            self.topology = new_topology
+            self.allocatable = AllocatableDevices.from_topology(new_topology)
+            self.cdi.create_base_spec(self.allocatable)
+            return True
+
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
@@ -212,6 +225,7 @@ class DeviceState:
                 device = self._resolve_remote_device(result)
             if device is None:
                 raise PrepareError(f"allocated device {result.device!r} is not on this node")
+            self._check_health(device)
             chosen = None
             for requests, cfg in reversed(configs):
                 if requests is None or result.request in requests:
@@ -276,6 +290,21 @@ class DeviceState:
                         )
                     )
         return None
+
+    def _check_health(self, device: AllocatableDevice) -> None:
+        """A claim allocated before a chip died must fail Prepare loudly, not
+        hand the pod a dead device node."""
+        chips = []
+        if device.chip is not None:
+            chips = [device.chip.chip]
+        elif device.subslice is not None:
+            topo = device.subslice.topology
+            chips = [topo.chips[i] for i in device.subslice.subslice.chip_indices]
+        dead = [c.device_path for c in chips if not c.healthy]
+        if dead:
+            raise PrepareError(
+                f"device {device.name!r} includes unhealthy chip(s): {dead}"
+            )
 
     def _default_config(self, kind: str):
         if kind == DEVICE_TYPE_CHIP:

@@ -73,6 +73,9 @@ class TpuChipInfo:
             "type": DeviceAttribute.of(DEVICE_TYPE_CHIP),
             "uuid": DeviceAttribute.of(c.uuid),
             "index": DeviceAttribute.of(c.index),
+            # Health surfaces as an attribute so DeviceClass CEL gates on it
+            # (the k8s-idiomatic mechanism: publish truth, select in class).
+            "healthy": DeviceAttribute.of(bool(c.healthy)),
             "coordX": DeviceAttribute.of(c.coords[0]),
             "coordY": DeviceAttribute.of(c.coords[1]),
             "coordZ": DeviceAttribute.of(c.coords[2]),
@@ -113,6 +116,7 @@ class TpuSubsliceInfo:
         attrs = {
             "type": DeviceAttribute.of(DEVICE_TYPE_SUBSLICE),
             "uuid": DeviceAttribute.of(self.uuid),
+            "healthy": DeviceAttribute.of(all(c.healthy for c in chips)),
             "shape": DeviceAttribute.of(s.shape_name(t.ndims)),
             "chipCount": DeviceAttribute.of(s.chip_count),
             "originX": DeviceAttribute.of(s.origin[0]),

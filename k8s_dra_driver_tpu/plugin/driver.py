@@ -68,6 +68,7 @@ class Driver:
             "dra_claim_errors_total", "Per-claim prepare/unprepare failures"
         )
         self.state = DeviceState(server, config)
+        self._needs_publish = False
         REGISTRY.gauge(
             "dra_allocatable_devices", "Devices this node publishes"
         ).set(len(self.state.allocatable), node=config.node_name)
@@ -132,6 +133,31 @@ class Driver:
                         error=f"error unpreparing claim {ref.namespace}/{ref.name}: {exc}"
                     )
         return out
+
+    # -- health monitoring (neither reference binary has this) ---------------
+
+    def refresh_inventory(self) -> bool:
+        """Periodic health sweep: re-enumerate, republish on change, export
+        the unhealthy-chip gauge.  Returns True when inventory changed.
+
+        Publish failures keep ``_needs_publish`` set so the NEXT sweep
+        retries even though refresh() already committed the new topology —
+        otherwise a transient API error would leave stale slices advertised
+        forever."""
+        changed = self.state.refresh()
+        unhealthy = sum(1 for c in self.state.topology.chips if not c.healthy)
+        REGISTRY.gauge(
+            "dra_unhealthy_chips", "Local chips currently failing enumeration/health"
+        ).set(unhealthy, node=self.config.node_name)
+        if changed:
+            REGISTRY.gauge("dra_allocatable_devices", "Devices this node publishes").set(
+                len(self.state.allocatable), node=self.config.node_name
+            )
+            self._needs_publish = True
+        if self._needs_publish and self.config.publish:
+            self.publish_resources()  # raising keeps the flag set for retry
+            self._needs_publish = False
+        return changed
 
     # -- orphan cleanup (the reference left this as a TODO, driver.go:156-168)
 

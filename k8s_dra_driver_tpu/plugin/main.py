@@ -134,13 +134,19 @@ def main(argv: list[str] | None = None) -> int:
     # failing sweep must never take down the node's DRA driver — log and
     # retry next period (transient API errors are expected).
     while not stop.wait(timeout=args.cleanup_interval_s):
+        # Health and cleanup fail independently: a wedged enumeration must
+        # not starve orphan cleanup, and vice versa.
+        try:
+            if driver.refresh_inventory():
+                log.warning("inventory changed; republished ResourceSlices")
+        except Exception:
+            log.exception("health sweep failed; will retry")
         try:
             cleaned = driver.cleanup_orphans()
+            if any(cleaned.values()):
+                log.info("orphan cleanup: %s", cleaned)
         except Exception:
             log.exception("orphan cleanup sweep failed; will retry")
-            continue
-        if any(cleaned.values()):
-            log.info("orphan cleanup: %s", cleaned)
     log.info("shutting down")
     if diagnostics is not None:
         diagnostics.stop()

@@ -33,6 +33,7 @@ class ChipInfo:
     hbm_bytes: int
     cores: int
     pci_address: str
+    healthy: bool = True
 
 
 @dataclass(frozen=True)
@@ -131,6 +132,7 @@ def enumerate_topology(env: dict[str, str] | None = None) -> TopologyInfo:
             hbm_bytes=c["hbm_bytes"],
             cores=c["cores"],
             pci_address=c["pci_address"],
+            healthy=c.get("healthy", True),
         )
         for c in data["chips"]
     )

@@ -27,6 +27,7 @@ struct Chip {
   std::array<int, 3> coords{0, 0, 0};
   int64_t hbm_bytes = 0;
   int cores = 1;
+  bool healthy = true;
   std::string pci_address;
 };
 
@@ -265,6 +266,19 @@ int enumerate_fake(Topology* t, std::string* err) {
   }
   t->libtpu_version = "fake-" + std::string(kVersion);
   add_local_chips(t, "/dev/accel");
+  // Fault injection: TPUINFO_FAKE_DEAD_CHIPS="1,3" marks local chip
+  // positions unhealthy (the hardware-free analog of a dead device node).
+  std::string dead = getenv_str("TPUINFO_FAKE_DEAD_CHIPS");
+  if (!dead.empty()) {
+    std::stringstream ss(dead);
+    std::string part;
+    while (std::getline(ss, part, ',')) {
+      int pos = std::atoi(part.c_str());
+      if (pos >= 0 && pos < static_cast<int>(t->chips.size())) {
+        t->chips[pos].healthy = false;
+      }
+    }
+  }
   return 0;
 }
 
@@ -368,6 +382,14 @@ int enumerate_real(Topology* t, std::string* err) {
       c.pci_address = pci.substr(pos + 14, end == std::string::npos ? std::string::npos
                                                                     : end - (pos + 14));
     }
+    // Real health source: the PCI `enable` flag.  A chip whose function is
+    // disabled (surprise-removed, firmware-fenced) reads "0" and is marked
+    // unhealthy rather than dropped, so the driver can publish the truth.
+    // Deeper health (libtpu runtime self-test) is a later-round source.
+    std::string enable = first_line(read_file(sys + "enable"));
+    if (!enable.empty() && enable == "0") {
+      c.healthy = false;
+    }
   }
   t->driver_version = first_line(read_file("/sys/module/tpu/version"));
   if (t->driver_version.empty()) t->driver_version = "accel-unknown";
@@ -404,8 +426,8 @@ std::string to_json(const Topology& t) {
     o << "{\"index\":" << c.index << ",\"device_path\":\"" << json_escape(c.device_path)
       << "\",\"uuid\":\"" << c.uuid << "\",\"coords\":[" << c.coords[0] << ","
       << c.coords[1] << "," << c.coords[2] << "],\"hbm_bytes\":" << c.hbm_bytes
-      << ",\"cores\":" << c.cores << ",\"pci_address\":\"" << json_escape(c.pci_address)
-      << "\"}";
+      << ",\"cores\":" << c.cores << ",\"healthy\":" << (c.healthy ? "true" : "false")
+      << ",\"pci_address\":\"" << json_escape(c.pci_address) << "\"}";
   }
   o << "]}";
   return o.str();

@@ -1,0 +1,137 @@
+"""Device-health monitoring: dead-chip fault injection end to end."""
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import (
+    SUBSLICE_CLASS,
+    TPU_CLASS,
+    make_cluster,
+    simple_claim,
+)
+from k8s_dra_driver_tpu.plugin.device_state import PrepareError
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.scheduler.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+
+def fake_env(dead=""):
+    env = {"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"}
+    if dead:
+        env["TPUINFO_FAKE_DEAD_CHIPS"] = dead
+    return env
+
+
+class TestDeadChipEnumeration:
+    def test_shim_marks_dead_chips(self):
+        t = enumerate_topology(env=fake_env(dead="1,3"))
+        assert [c.healthy for c in t.chips] == [True, False, True, False]
+
+    def test_no_dead_env_all_healthy(self):
+        t = enumerate_topology(env=fake_env())
+        assert all(c.healthy for c in t.chips)
+
+
+@pytest.fixture
+def rig(tmp_path):
+    cluster = make_cluster(hosts=1, work_dir=str(tmp_path / "w"))
+    driver = Driver(
+        cluster.server,
+        DriverConfig(
+            node_name="tpu-host-0",
+            cdi_root=str(tmp_path / "cdi"),
+            checkpoint_path=str(tmp_path / "cp.json"),
+            topology_env=fake_env(),
+        ),
+    )
+    return cluster, driver
+
+
+class TestHealthSweep:
+    def test_dead_chip_unschedulable_after_refresh(self, rig):
+        cluster, driver = rig
+        # chip 1 dies between sweeps
+        driver.config.topology_env = fake_env(dead="1")
+        assert driver.refresh_inventory() is True
+        assert driver.refresh_inventory() is False  # stable now
+
+        # tpu-1 is published but health-gated out of the DeviceClass CEL
+        slices = [
+            s for s in cluster.server.list("ResourceSlice")
+            if s.spec.pool.name == "tpu-host-0"
+        ]
+        devices = {d.name: d for s in slices for d in s.spec.devices}
+        assert devices["tpu-1"].basic.attributes["healthy"].value is False
+        assert devices["tpu-0"].basic.attributes["healthy"].value is True
+        # subslices covering chip 1 are unhealthy too
+        assert devices["tpu-slice-2x2-0-0"].basic.attributes["healthy"].value is False
+        assert devices["tpu-slice-1x2-0-0"].basic.attributes["healthy"].value is True
+
+        # only 3 chips allocatable: a 4-chip claim must fail...
+        claim = cluster.server.create(simple_claim("four", count=4))
+        with pytest.raises(AllocationError):
+            Allocator(cluster.server).allocate(claim, node_name="tpu-host-0")
+        # ...while 3 chips still fit
+        claim3 = cluster.server.create(simple_claim("three", count=3))
+        updated = Allocator(cluster.server).allocate(claim3, node_name="tpu-host-0")
+        got = {r.device for r in updated.status.allocation.devices.results}
+        assert "tpu-1" not in got
+
+    def test_publish_failure_retried_next_sweep(self, rig, monkeypatch):
+        # refresh() commits the new topology before publish; a failed publish
+        # must be retried on the next sweep even though nothing changed again.
+        cluster, driver = rig
+        driver.config.topology_env = fake_env(dead="1")
+
+        calls = {"n": 0}
+        real_publish = driver.publish_resources
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient API error")
+            real_publish()
+
+        monkeypatch.setattr(driver, "publish_resources", flaky)
+        with pytest.raises(RuntimeError):
+            driver.refresh_inventory()
+        # next sweep: no topology change, but the pending publish retries
+        assert driver.refresh_inventory() is False
+        assert calls["n"] == 2
+        devices = {
+            d.name: d
+            for s in cluster.server.list("ResourceSlice")
+            if s.spec.pool.name == "tpu-host-0"
+            for d in s.spec.devices
+        }
+        assert devices["tpu-1"].basic.attributes["healthy"].value is False
+
+    def test_recovery_republishes(self, rig):
+        cluster, driver = rig
+        driver.config.topology_env = fake_env(dead="0")
+        driver.refresh_inventory()
+        driver.config.topology_env = fake_env()
+        assert driver.refresh_inventory() is True
+        slices = cluster.server.list("ResourceSlice")
+        devices = {d.name: d for s in slices for d in s.spec.devices}
+        assert devices["tpu-0"].basic.attributes["healthy"].value is True
+
+    def test_prepare_rejects_stale_allocation_on_dead_chip(self, rig):
+        # Allocation happened while healthy; the chip dies before Prepare.
+        cluster, driver = rig
+        claim = cluster.server.create(simple_claim("stale", count=4))
+        allocated = Allocator(cluster.server).allocate(claim, node_name="tpu-host-0")
+        driver.config.topology_env = fake_env(dead="2")
+        driver.refresh_inventory()
+        with pytest.raises(PrepareError, match="unhealthy chip"):
+            driver.state.prepare(allocated)
+
+    def test_subslice_class_health_gated(self, rig):
+        cluster, driver = rig
+        driver.config.topology_env = fake_env(dead="0,1,2,3")
+        driver.refresh_inventory()
+        claim = cluster.server.create(
+            simple_claim("slice", device_class=SUBSLICE_CLASS)
+        )
+        with pytest.raises(AllocationError):
+            Allocator(cluster.server).allocate(claim, node_name="tpu-host-0")
