@@ -1,0 +1,42 @@
+# Build/test entry points (reference Makefile:57-117 analog: cmds/test/lint/
+# coverage targets, adapted to a Python+C++ tree).
+
+PYTHON ?= python
+CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
+
+.PHONY: all native test asan-test bench demo dryrun lint clean
+
+all: native
+
+# Native components: libtpuinfo.so + tpu-ctl (the cgo/nvidia-smi boundary).
+native:
+	$(MAKE) -C $(CPP_DIR)
+
+# Full unit/integration suite (the reference's `go test -race -cover` slot).
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+# Native shim under ASAN/UBSAN (SURVEY.md §5: we add sanitizers the
+# reference's all-Go tree never needed).
+asan-test:
+	$(MAKE) -C $(CPP_DIR) libtpuinfo_asan.so
+
+# Headline benchmark (claim-to-running p50 + live data-plane proof).
+bench:
+	$(PYTHON) bench.py
+
+# Closed-loop quickstart walkthrough.
+demo:
+	$(PYTHON) -m k8s_dra_driver_tpu.e2e.demo
+
+# Single-chip compile check + 8-device sharded dry run.
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PYTHON) __graft_entry__.py
+
+lint:
+	$(PYTHON) -m compileall -q k8s_dra_driver_tpu tests
+
+clean:
+	$(MAKE) -C $(CPP_DIR) clean
+	rm -rf .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -39,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
         default=os.environ.get("FAKE_CLUSTER", "") == "true",
     )
     p.add_argument(
+        "--kubeconfig", default=os.environ.get("KUBECONFIG_PATH", ""),
+        help="kubeconfig path; empty = $KUBECONFIG, then in-cluster service account",
+    )
+    p.add_argument(
         "--http-port", type=int, default=int(os.environ.get("HTTP_PORT", "-1")),
         help="diagnostics endpoint port (/metrics,/healthz); -1 disables, 0 = ephemeral",
     )
@@ -47,11 +51,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    if not args.fake_cluster:
-        log.error("only --fake-cluster mode is wired in this build")
-        return 2
-    server = InMemoryAPIServer()
-    install_device_classes(server)
+    if args.fake_cluster:
+        server = InMemoryAPIServer()
+        install_device_classes(server)
+    else:
+        from k8s_dra_driver_tpu.kube.restclient import KubeClientConfig, RESTClient
+
+        try:
+            server = RESTClient(KubeClientConfig.load(args.kubeconfig))
+            server.probe()  # fail fast on unreachable server / bad auth
+        except Exception as exc:
+            log.error("cannot reach an API server (%s); use --fake-cluster for demos", exc)
+            return 2
 
     manager = None
     if "membership" in args.device_classes.split(","):

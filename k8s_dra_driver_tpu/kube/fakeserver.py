@@ -150,14 +150,21 @@ class InMemoryAPIServer:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             self._notify(WatchEvent("DELETED", objects.deepcopy(obj)))
 
-    def watch(self, kind: str, callback: Callable[[WatchEvent], None]) -> Watch:
+    def watch(
+        self, kind: str, callback: Callable[[WatchEvent], None], replay: bool = True
+    ) -> Watch:
         """Informer-style: replays existing objects as ADDED, then streams.
 
         Replay happens under the server lock so a concurrent mutation cannot
         interleave its event before the replay of older state.
-        """
+        ``replay=False`` subscribes to new events only (the raw k8s
+        ``?watch=true`` semantics, used by the REST facade)."""
         with self._lock:
-            existing = [objects.deepcopy(o) for (k, _, _), o in self._objects.items() if k == kind]
+            existing = (
+                [objects.deepcopy(o) for (k, _, _), o in self._objects.items() if k == kind]
+                if replay
+                else []
+            )
             w = Watch(self, kind, callback)
             self._watches.append(w)
             for obj in existing:
@@ -165,6 +172,33 @@ class InMemoryAPIServer:
             return w
 
     # -- internals ---------------------------------------------------------
+
+    def current_resource_version(self) -> str:
+        with self._lock:
+            return str(self._rv)
+
+    def watch_since(
+        self, kind: str, resource_version: str, callback: Callable[[WatchEvent], None]
+    ) -> Watch:
+        """Subscribe atomically, first replaying objects modified after
+        ``resource_version`` — closes the list→watch gap for REST clients
+        (deletions in the gap are not replayed, matching a real watch cache's
+        behavior of requiring a re-list for full recovery)."""
+        try:
+            since = int(resource_version)
+        except ValueError:
+            since = 0
+        with self._lock:
+            missed = [
+                objects.deepcopy(o)
+                for (k, _, _), o in self._objects.items()
+                if k == kind and int(o.metadata.resource_version) > since
+            ]
+            w = Watch(self, kind, callback)
+            self._watches.append(w)
+            for obj in missed:
+                callback(WatchEvent("MODIFIED", obj))
+            return w
 
     def _remove_watch(self, w: Watch) -> None:
         with self._lock:

@@ -176,9 +176,14 @@ class DeviceState:
     def refresh(self) -> bool:
         """Re-enumerate the hardware; True when the inventory changed
         (chip died/recovered, topology env changed).  On change the base CDI
-        spec is rewritten so future claims see current truth."""
+        spec is rewritten so future claims see current truth.
+
+        Enumeration runs OUTSIDE the state lock: sysfs reads on dying
+        hardware can block for seconds, and holding the lock would freeze
+        NodePrepareResources for the duration (the sweep exists precisely
+        for sick nodes)."""
+        new_topology = enumerate_topology(env=self.config.topology_env or None)
         with self._lock:
-            new_topology = enumerate_topology(env=self.config.topology_env or None)
             if new_topology == self.topology:
                 return False
             self.topology = new_topology

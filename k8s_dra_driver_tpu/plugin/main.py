@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve against an in-process API server (demo/e2e mode; env FAKE_CLUSTER=true)",
     )
     p.add_argument(
+        "--kubeconfig", default=env_default("KUBECONFIG_PATH", ""),
+        help="kubeconfig path; empty = $KUBECONFIG, then in-cluster service account",
+    )
+    p.add_argument(
         "--http-port", type=int, default=int(env_default("HTTP_PORT", "-1")),
         help="diagnostics endpoint port (/metrics,/healthz); -1 disables, 0 = ephemeral",
     )
@@ -76,15 +80,18 @@ def main(argv: list[str] | None = None) -> int:
     if not args.node_name:
         log.error("--node-name (or NODE_NAME) is required")
         return 2
-    if not args.fake_cluster:
-        log.error(
-            "only --fake-cluster mode is wired in this build; a real API-server "
-            "transport replaces the fake server behind the same client surface"
-        )
-        return 2
+    if args.fake_cluster:
+        server = InMemoryAPIServer()
+        install_device_classes(server)
+    else:
+        from k8s_dra_driver_tpu.kube.restclient import KubeClientConfig, RESTClient
 
-    server = InMemoryAPIServer()
-    install_device_classes(server)
+        try:
+            server = RESTClient(KubeClientConfig.load(args.kubeconfig))
+            server.probe()  # fail fast on unreachable server / bad auth
+        except Exception as exc:
+            log.error("cannot reach an API server (%s); use --fake-cluster for demos", exc)
+            return 2
     topology_env = {}
     if args.fake_topology:
         topology_env = {

@@ -1,0 +1,41 @@
+"""Driver-root discovery tests."""
+
+import pytest
+
+from k8s_dra_driver_tpu.plugin.root import DriverRoot, DriverRootError
+
+
+class TestDriverRoot:
+    def test_find_libtpu_under_chroot(self, tmp_path):
+        (tmp_path / "usr/lib").mkdir(parents=True)
+        (tmp_path / "usr/lib/libtpu.so").write_bytes(b"")
+        root = DriverRoot(root=str(tmp_path))
+        assert root.find_libtpu() == str(tmp_path / "usr/lib/libtpu.so")
+
+    def test_probe_order_prefers_lib(self, tmp_path):
+        for rel in ("lib", "usr/lib"):
+            (tmp_path / rel).mkdir(parents=True)
+            (tmp_path / rel / "libtpu.so").write_bytes(b"")
+        assert DriverRoot(root=str(tmp_path)).find_libtpu() == str(
+            tmp_path / "lib/libtpu.so"
+        )
+
+    def test_missing_libtpu_reports_probed_paths(self, tmp_path):
+        with pytest.raises(DriverRootError, match="probed"):
+            DriverRoot(root=str(tmp_path)).find_libtpu()
+
+    def test_host_path_translation(self):
+        root = DriverRoot(root="/driver-root", host_root="/")
+        assert root.to_host_path("/driver-root/lib/libtpu.so") == "/lib/libtpu.so"
+        assert root.to_host_path("/var/run/cdi/x.json") == "/var/run/cdi/x.json"
+        nested = DriverRoot(root="/driver-root", host_root="/opt/tpu")
+        assert nested.to_host_path("/driver-root/lib/libtpu.so") == "/opt/tpu/lib/libtpu.so"
+
+    def test_device_nodes(self, tmp_path):
+        (tmp_path / "dev").mkdir()
+        for name in ("accel0", "accel1", "accelX", "accel"):
+            (tmp_path / "dev" / name).write_bytes(b"")
+        assert DriverRoot(root=str(tmp_path)).device_nodes() == [
+            str(tmp_path / "dev/accel0"),
+            str(tmp_path / "dev/accel1"),
+        ]
