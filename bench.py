@@ -96,18 +96,21 @@ def run_data_plane() -> dict:
     params, opt_state = fns.init(jax.random.PRNGKey(0))
     tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=cfg.max_seq)
     params, opt_state, loss = fns.step(params, opt_state, tokens)  # compile
-    jax.block_until_ready(loss)
+    float(loss)  # host readback: sync the warmup before the timer starts —
+    # on tunneled devices (axon) block_until_ready alone does not guarantee
+    # remote completion.
     start = time.perf_counter()
     steps = 5
     for _ in range(steps):
         params, opt_state, loss = fns.step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    last_loss = float(loss)
     step_ms = (time.perf_counter() - start) / steps * 1000
     return {
         "backend": jax.default_backend(),
         "burnin_step_ms": round(step_ms, 2),
-        "burnin_loss": round(float(loss), 4),
-        "matmul_tflops": round(matmul_tflops(size=2048, iters=5), 2),
+        "burnin_loss": round(last_loss, 4),
+        # chained-scan measurement amortizing + subtracting tunnel RTT
+        "matmul_tflops": round(matmul_tflops(size=4096, chain=128), 1),
     }
 
 

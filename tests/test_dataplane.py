@@ -114,7 +114,7 @@ class TestCollectives:
         assert collectives.ring_latency_us(mesh8, axis="model", iters=5) > 0
 
     def test_matmul_tflops(self):
-        assert collectives.matmul_tflops(cpu_devices(1)[0], size=256, iters=2) > 0
+        assert collectives.matmul_tflops(cpu_devices(1)[0], size=256, chain=4) > 0
 
 
 class TestGraftEntry:
