@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--http-port", type=int, default=int(os.environ.get("HTTP_PORT", "-1")),
         help="diagnostics endpoint port (/metrics,/healthz); -1 disables, 0 = ephemeral",
     )
+    p.add_argument(
+        "--leader-elect", action="store_true",
+        default=os.environ.get("LEADER_ELECT", "") == "true",
+        help="coordinate multiple controller replicas via a coordination.k8s.io Lease",
+    )
     return p
 
 
@@ -65,10 +70,37 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     manager = None
+    elector_thread = None
+    elector_stop = threading.Event()
     if "membership" in args.device_classes.split(","):
         manager = SliceManager(server, retry_timeout_s=args.retry_timeout_s)
-        manager.start()
-        log.info("slice manager watching node slice-domain labels")
+        if args.leader_elect:
+            import socket
+
+            from k8s_dra_driver_tpu.controller.leaderelection import (
+                LeaderElectionConfig,
+                LeaderElector,
+            )
+
+            identity = os.environ.get("POD_NAME", socket.gethostname())
+            elector = LeaderElector(server, LeaderElectionConfig(identity=identity))
+
+            def started():
+                log.info("acquired leadership (%s); starting slice manager", identity)
+                manager.start()
+
+            def stopped():
+                log.info("lost leadership; stopping slice manager")
+                # Keep owned slices: the new leader publishes over them.
+                manager.stop(delete_owned=False)
+
+            elector_thread = threading.Thread(
+                target=elector.run, args=(started, stopped, elector_stop), daemon=True
+            )
+            elector_thread.start()
+        else:
+            manager.start()
+            log.info("slice manager watching node slice-domain labels")
 
     diagnostics = None
     if args.http_port >= 0:
@@ -92,7 +124,10 @@ def main(argv: list[str] | None = None) -> int:
             manager.retry_pending()
     if diagnostics is not None:
         diagnostics.stop()
-    if manager is not None:
+    if elector_thread is not None:
+        elector_stop.set()
+        elector_thread.join(timeout=5)
+    elif manager is not None:
         manager.stop()
     return 0
 

@@ -92,12 +92,23 @@ class SliceManager:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        # Reset domain state: across a stop/start leadership cycle the watch
+        # replay re-derives it from current Nodes, and stale entries would
+        # republish seats for nodes deleted while we were not leading.
+        with self._lock:
+            self._domains.clear()
+            self._offsets.clear()
+            self._retry.clear()
         self._watch = self._server.watch(Node.KIND, self._on_node_event)
 
-    def stop(self) -> None:
+    def stop(self, delete_owned: bool = True) -> None:
+        """``delete_owned=False`` for leadership hand-off: the new leader
+        owns the same slices (shared owner label) and deleting them would
+        wipe its freshly published state.  Full deletion (imex.go:298-316)
+        is for process shutdown only."""
         if self._watch is not None:
             self._watch.stop()
-        self._controller.stop(delete_owned=True)  # imex.go:298-316
+        self._controller.stop(delete_owned=delete_owned)
 
     def retry_pending(self) -> None:
         """Re-attempt domains parked on transient errors whose timeout has

@@ -26,6 +26,7 @@ _PLURALS = {
     "nodes": "Node",
     "pods": "Pod",
     "deployments": "Deployment",
+    "leases": "Lease",
 }
 
 _PATH_RE = re.compile(

@@ -333,6 +333,24 @@ class Deployment:
     status: Any = None
 
 
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: int = 0
+    acquire_time: str = ""
+    renew_time: str = ""
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    KIND = "Lease"
+    API_VERSION = "coordination.k8s.io/v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
 KINDS = {
     cls.KIND: cls
     for cls in (
@@ -343,6 +361,7 @@ KINDS = {
         Node,
         Pod,
         Deployment,
+        Lease,
     )
 }
 

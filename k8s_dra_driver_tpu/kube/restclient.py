@@ -54,6 +54,7 @@ _RESOURCES = {
     "Node": ("/api/v1", "nodes", False),
     "Pod": ("/api/v1", "pods", True),
     "Deployment": ("/apis/apps/v1", "deployments", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
 }
 
 _IN_CLUSTER_SA = Path("/var/run/secrets/kubernetes.io/serviceaccount")

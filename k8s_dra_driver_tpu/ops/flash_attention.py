@@ -9,9 +9,10 @@ accumulation (``preferred_element_type``); masking and softmax run on the
 VPU.  Causal q/k blocks strictly above the diagonal are predicated off with
 ``pl.when`` — they cost a grid step but no FLOPs.
 
-Forward-only for now (the training path keeps the jnp attention for autodiff;
-a custom VJP lands in a later round).  ``interpret=True`` runs the same
-kernel on CPU for tests.
+Fully differentiable: a custom VJP supplies pallas backward kernels — a dq
+pass (k innermost) and a dk/dv pass (q innermost) recomputing P from the
+saved log-sum-exp residual, the standard flash-attention backward.
+``interpret=True`` runs the same kernels on CPU for tests.
 """
 
 from __future__ import annotations
@@ -28,7 +29,7 @@ _NEG_INF = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, out_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, scale: float, block_q: int, block_k: int, causal: bool, num_k: int,
 ):
     qi = pl.program_id(1)
@@ -75,6 +76,212 @@ def _flash_kernel(
     @pl.when(ki == num_k - 1)
     def _finalize():
         out_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(out_ref.dtype)
+        # log-sum-exp residual for the backward pass: L = m + log(l),
+        # broadcast across the 128-lane tail the TPU layout requires.
+        lse = (m_ref[:, 0:1] + jnp.log(l_ref[:, 0:1])).astype(jnp.float32)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _forward_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    """[BH, S, D] forward returning (out, lse)."""
+    bh, s, d = q.shape
+    num_q = s // block_q
+    num_k = s // block_k
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=1.0 / math.sqrt(d), block_q=block_q, block_k=block_k,
+        causal=causal, num_k=num_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            # TPU lowering needs the last two block dims (÷8, ÷128): lse
+            # rides a broadcast 128-lane tail, sliced off by the caller.
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (value in lane 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, dout_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, block_q, block_k, causal, num_k,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, 0:1])  # [bq, bk]
+        dp = jax.lax.dot_general(
+            dout_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0:1])
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, dout_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, block_q, block_k, causal, num_q,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, 0:1])  # [bq, bk]
+        dout = dout_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(dout.dtype), dout, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dout, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0:1])
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _backward_bhsd(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    num_q = s // block_q
+    num_k = s // block_k
+    scale = 1.0 / math.sqrt(d)
+    # D_i = rowsum(dout ∘ out): cheap elementwise reduce, done outside pallas;
+    # broadcast over the 128-lane tail to satisfy the TPU block layout.
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, s, 128))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0))
+    row_q = pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal, num_k=num_k,
+        ),
+        grid=(bh, num_q, num_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    # k outermost, q innermost for the dk/dv accumulation.
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0))
+    row_q2 = pl.BlockSpec((1, block_q, 128), lambda b, ki, qi: (b, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal, num_q=num_q,
+        ),
+        grid=(bh, num_k, num_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_q2, row_q2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _forward_bhsd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _forward_bhsd(q, k, v, causal, block_q, block_k, interpret)
+    # The 128 lanes are identical; keep one as the residual (128x less HBM
+    # held across the fwd->bwd window on long-context shapes).
+    return out, (q, k, v, out, lse[..., :1])
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, residuals, dout):
+    q, k, v, out, lse1 = residuals
+    lse = jnp.broadcast_to(lse1, (*lse1.shape[:2], 128))
+    return _backward_bhsd(q, k, v, out, lse, dout, causal, block_q, block_k, interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(
@@ -86,7 +293,8 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
-    """q/k/v: [B, S, H, D] -> [B, S, H, D].
+    """q/k/v: [B, S, H, D] -> [B, S, H, D].  Differentiable (custom VJP with
+    pallas backward kernels — dq and dk/dv passes over the block grid).
 
     S must be a multiple of the block sizes (pad upstream); D should be a
     multiple of 128 for MXU efficiency but smaller D works.
@@ -96,33 +304,10 @@ def flash_attention(
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(f"sequence {s} not divisible by blocks ({block_q},{block_k})")
-    num_q = s // block_q
-    num_k = s // block_k
-    scale = 1.0 / math.sqrt(d)
 
     # [B, S, H, D] -> [B*H, S, D]: heads become grid rows.
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    kernel = functools.partial(
-        _flash_kernel,
-        scale=scale, block_q=block_q, block_k=block_k, causal=causal, num_k=num_k,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # m (value in lane 0)
-            pltpu.VMEM((block_q, 128), jnp.float32),  # l
-            pltpu.VMEM((block_q, d), jnp.float32),    # acc
-        ],
-        interpret=interpret,
-    )(to_bh(q), to_bh(k), to_bh(v))
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

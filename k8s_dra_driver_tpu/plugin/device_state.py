@@ -82,10 +82,24 @@ class DeviceState:
         self.config = config
         self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
         self.allocatable = AllocatableDevices.from_topology(self.topology)
+        # Resolve libtpu under the chroot-like driver root when one is
+        # mounted (root.go:25-109 pattern); fall back to the configured path.
+        libtpu_path = config.libtpu_path
+        if config.driver_root and config.driver_root != "/":
+            from k8s_dra_driver_tpu.plugin.root import DriverRoot, DriverRootError
+
+            try:
+                resolved = DriverRoot(root=config.driver_root).find_libtpu()
+                # find_libtpu returns the container-visible (root-prefixed)
+                # path; CDIHandler prefixes driver_root itself, so hand it
+                # the root-relative path to avoid a doubled prefix.
+                libtpu_path = "/" + resolved[len(config.driver_root):].lstrip("/")
+            except DriverRootError:
+                pass  # fake topologies / dev hosts have no real libtpu
         self.cdi = CDIHandler(
             cdi_root=config.cdi_root,
             driver_root=config.driver_root,
-            libtpu_path=config.libtpu_path,
+            libtpu_path=libtpu_path,
         )
         self.cdi.create_base_spec(self.allocatable)
         self.ts_manager = TimeSlicingManager()

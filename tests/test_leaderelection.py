@@ -1,0 +1,142 @@
+"""Lease-based leader-election tests with a deterministic clock."""
+
+import threading
+
+from k8s_dra_driver_tpu.controller.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1_000_000.0
+
+    def __call__(self):
+        return self.now
+
+
+def elector(server, identity, clock, duration=15.0):
+    return LeaderElector(
+        server,
+        LeaderElectionConfig(identity=identity, lease_duration_s=duration),
+        clock=clock,
+    )
+
+
+class TestLeaderElector:
+    def test_first_candidate_acquires(self):
+        server = InMemoryAPIServer()
+        clock = FakeClock()
+        a = elector(server, "a", clock)
+        assert a.tick() is True
+        lease = server.get("Lease", "tpu-dra-controller", "tpu-dra-driver")
+        assert lease.spec.holder_identity == "a"
+
+    def test_standby_blocked_until_expiry(self):
+        server = InMemoryAPIServer()
+        clock = FakeClock()
+        a = elector(server, "a", clock)
+        b = elector(server, "b", clock)
+        assert a.tick() and not b.tick()
+        clock.now += 10  # within lease duration
+        assert b.tick() is False
+        clock.now += 6  # renew_time + 15 < now: expired (a crashed)
+        assert b.tick() is True
+        lease = server.get("Lease", "tpu-dra-controller", "tpu-dra-driver")
+        assert lease.spec.holder_identity == "b"
+        assert lease.spec.lease_transitions == 1
+
+    def test_renewal_keeps_leadership(self):
+        server = InMemoryAPIServer()
+        clock = FakeClock()
+        a = elector(server, "a", clock)
+        b = elector(server, "b", clock)
+        a.tick()
+        for _ in range(5):
+            clock.now += 10
+            assert a.tick() is True  # renews before expiry
+            assert b.tick() is False
+
+    def test_clean_release_hands_over_immediately(self):
+        server = InMemoryAPIServer()
+        clock = FakeClock()
+        a = elector(server, "a", clock)
+        b = elector(server, "b", clock)
+        a.tick()
+        a.release()
+        assert b.tick() is True
+
+    def test_handover_keeps_published_slices(self):
+        # Leadership moves A -> B; A's step-down must not delete the slices
+        # B just published (shared owner label).
+        from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
+        from tests.test_controller import add_node, membership_slices
+
+        server = InMemoryAPIServer()
+        add_node(server, "h0", domain="d", host_id=0)
+        mgr_a = SliceManager(server)
+        mgr_a.start()
+        assert len(membership_slices(server)) == 1
+        # B takes over and republishes before A steps down (the racy order)
+        mgr_b = SliceManager(server)
+        mgr_b.start()
+        mgr_a.stop(delete_owned=False)  # leadership loss, not shutdown
+        assert len(membership_slices(server)) == 1
+        mgr_b.stop()  # process shutdown deletes
+
+    def test_transient_api_error_does_not_kill_run_loop(self):
+        server = InMemoryAPIServer()
+        clock = FakeClock()
+        a = elector(server, "a", clock, duration=5.0)
+        calls = {"n": 0}
+        real_get = server.get
+
+        def flaky_get(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient apiserver error")
+            return real_get(*args, **kwargs)
+
+        server.get = flaky_get
+        events = []
+        stop = threading.Event()
+        ticks = {"n": 0}
+
+        def sleeper(_):
+            ticks["n"] += 1
+            if ticks["n"] >= 3:
+                stop.set()
+
+        a.run(
+            on_started_leading=lambda: events.append("start"),
+            on_stopped_leading=lambda: events.append("stop"),
+            stop=stop,
+            sleeper=sleeper,
+        )
+        # first tick errored (survived), later tick acquired
+        assert events == ["start", "stop"]
+
+    def test_run_loop_transitions(self):
+        server = InMemoryAPIServer()
+        clock = FakeClock()
+        a = elector(server, "a", clock, duration=5.0)
+        events = []
+        stop = threading.Event()
+        ticks = {"n": 0}
+
+        def sleeper(_):
+            ticks["n"] += 1
+            if ticks["n"] >= 3:
+                stop.set()
+
+        a.run(
+            on_started_leading=lambda: events.append("start"),
+            on_stopped_leading=lambda: events.append("stop"),
+            stop=stop,
+            sleeper=sleeper,
+        )
+        assert events == ["start", "stop"]  # led, then released on shutdown
+        lease = server.get("Lease", "tpu-dra-controller", "tpu-dra-driver")
+        assert lease.spec.holder_identity == ""  # released
