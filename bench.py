@@ -92,7 +92,8 @@ def run_data_plane() -> dict:
     cfg = burnin.ModelConfig(
         vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048, max_seq=512
     )
-    fns = burnin.build_train_step(cfg)
+    attention = "flash" if jax.default_backend() == "tpu" else "dense"
+    fns = burnin.build_train_step(cfg, attention=attention)
     params, opt_state = fns.init(jax.random.PRNGKey(0))
     tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=cfg.max_seq)
     params, opt_state, loss = fns.step(params, opt_state, tokens)  # compile

@@ -179,6 +179,7 @@ def build_train_step(
     mesh: Mesh | None = None,
     lr: float = 3e-4,
     sequence_parallel: str = "auto",
+    attention: str = "dense",
 ) -> TrainStepFns:
     """Returns jitted (init, step).  With a mesh, params/opt-state/activations
     get DP/TP/SP shardings; without, everything runs single-device.
@@ -187,7 +188,10 @@ def build_train_step(
     ``seq`` axis is >1 (K/V blocks rotate over ICI, no full-sequence gather);
     'ring' forces it; 'ulysses' uses all-to-all head/sequence resharding
     (requires an unsharded head dim, i.e. model axis == 1); 'none' leaves
-    resharding to XLA."""
+    resharding to XLA.
+
+    ``attention``: 'dense' (jnp, XLA-fused) or 'flash' (the pallas fused
+    kernel, single-device path only — sharded meshes use ring/ulysses)."""
     valid = ("auto", "ring", "ulysses", "none")
     if sequence_parallel not in valid:
         raise ValueError(f"sequence_parallel must be one of {valid}, got {sequence_parallel!r}")
@@ -196,16 +200,30 @@ def build_train_step(
             f"sequence_parallel={sequence_parallel!r} requires a mesh; "
             "single-device training has no seq axis"
         )
+    if attention not in ("dense", "flash"):
+        raise ValueError(f"attention must be 'dense' or 'flash', got {attention!r}")
+    if attention == "flash" and mesh is not None:
+        raise ValueError("attention='flash' is the single-device path; "
+                         "sharded meshes select ring/ulysses via sequence_parallel")
     opt = make_optimizer(lr)
     if mesh is None:
         act_spec = None
+        flash_fn = None
+        if attention == "flash":
+            from k8s_dra_driver_tpu.ops.flash_attention import flash_attention
+
+            # Interpret mode off the MXU path (CPU tests); compiled on TPU.
+            interpret = jax.devices()[0].platform != "tpu"
+            flash_fn = functools.partial(flash_attention, interpret=interpret)
 
         def init(key):
             params = init_params(key, cfg)
             return params, opt.init(params)
 
         def step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, act_spec)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, cfg, act_spec, flash_fn
+            )
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
