@@ -1,0 +1,219 @@
+"""Property-based tests for the subtle invariants (SURVEY.md §7 hard part #3:
+"topology math for overlap capacities is easy to get subtly wrong").
+
+Hypothesis generates topologies/claims; the properties assert the safety
+invariants the whole scheduling scheme rests on.
+"""
+
+import itertools
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from k8s_dra_driver_tpu.api import HbmLimits
+from k8s_dra_driver_tpu.kube.quantity import format_bytes, parse
+from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+from k8s_dra_driver_tpu.plugin.geometry import enumerate_subslices
+from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+# Standard fake topologies + a couple of explicit odd ones.
+TOPOLOGIES = [
+    "v5e-1", "v5e-4", "v5e-8", "v5e-16", "v5e-32", "v5e-256",
+    "v4-4", "v4-8", "v4-16", "v4-64",
+    "v5e-6x1", "v5e-2x3", "v4-2x2x3",
+]
+
+
+def topo(spec, host_id=0):
+    return enumerate_topology(
+        env={"TPUINFO_FAKE_TOPOLOGY": spec, "TPUINFO_FAKE_HOST_ID": str(host_id)}
+    )
+
+
+@st.composite
+def host_topologies(draw):
+    spec = draw(st.sampled_from(TOPOLOGIES))
+    t = topo(spec)
+    host_id = draw(st.integers(0, t.host_count - 1))
+    return topo(spec, host_id)
+
+
+class TestGeometryProperties:
+    @settings(max_examples=40, deadline=None)
+    @given(host_topologies())
+    def test_overlap_markers_iff_shared_chip(self, t):
+        """Two published devices share a chip marker iff they share a chip —
+        the invariant that makes counter exclusion equal physical safety."""
+        devices = AllocatableDevices.from_topology(t)
+        chips = {}
+        markers = {}
+        for name, d in devices.devices.items():
+            if d.chip is not None:
+                chips[name] = {d.chip.local_pos}
+            else:
+                chips[name] = set(d.subslice.subslice.chip_indices)
+            markers[name] = {
+                c for c in d.get_device().basic.capacity if c.startswith("chip")
+            }
+        for a, b in itertools.combinations(devices.devices, 2):
+            assert bool(chips[a] & chips[b]) == bool(markers[a] & markers[b]), (a, b)
+
+    @settings(max_examples=40, deadline=None)
+    @given(host_topologies())
+    def test_subslices_within_block_and_contiguous(self, t):
+        hb = t.host_bounds
+        n = hb[0] * hb[1] * hb[2]
+        for s in enumerate_subslices(t):
+            assert all(0 <= i < n for i in s.chip_indices)
+            assert len(set(s.chip_indices)) == s.chip_count
+            # contiguity: covered coords form an axis-aligned box
+            coords = sorted(
+                (i % hb[0], (i // hb[0]) % hb[1], i // (hb[0] * hb[1]))
+                for i in s.chip_indices
+            )
+            xs, ys, zs = zip(*coords)
+            assert (max(xs) - min(xs) + 1) * (max(ys) - min(ys) + 1) * (
+                max(zs) - min(zs) + 1
+            ) == s.chip_count
+
+    @settings(max_examples=40, deadline=None)
+    @given(host_topologies())
+    def test_same_shape_placements_partition_block(self, t):
+        subs = enumerate_subslices(t)
+        for shape in {s.shape for s in subs}:
+            covered = [i for s in subs if s.shape == shape for i in s.chip_indices]
+            assert len(covered) == len(set(covered)), shape  # disjoint
+
+    @settings(max_examples=40, deadline=None)
+    @given(host_topologies())
+    def test_hbm_capacity_sums(self, t):
+        devices = AllocatableDevices.from_topology(t)
+        per_chip = t.chips[0].hbm_bytes
+        for d in devices:
+            cap = parse(d.get_device().basic.capacity["hbm"])
+            expected = per_chip * (
+                1 if d.chip is not None else d.subslice.subslice.chip_count
+            )
+            assert cap == expected
+
+
+class TestAllocatorSafetyProperty:
+    @settings(max_examples=25, deadline=None)
+    @given(
+        st.sampled_from(["v5e-8", "v5e-16", "v4-8"]),
+        st.lists(
+            st.tuples(
+                st.sampled_from(["chip", "1x2", "2x1", "2x2", "2x4", "any-slice"]),
+                st.integers(1, 2),
+            ),
+            min_size=1,
+            max_size=8,
+        ),
+    )
+    def test_no_chip_ever_double_booked(self, spec, requests):
+        """Whatever mix of chip/subslice claims is thrown at the allocator,
+        the union of physically covered chips across granted claims never
+        overlaps — the MIG memorySlice guarantee, generalized."""
+        from k8s_dra_driver_tpu import DRIVER_NAME
+        from k8s_dra_driver_tpu.e2e.harness import (
+            SUBSLICE_CLASS,
+            TPU_CLASS,
+            cel_selector,
+            install_device_classes,
+        )
+        from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+        from k8s_dra_driver_tpu.kube.objects import (
+            DeviceClaim,
+            DeviceRequest,
+            ObjectMeta,
+            ResourceClaim,
+            ResourceClaimSpec,
+        )
+        from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+            DriverResources,
+            Pool,
+            ResourceSliceController,
+            Slice,
+        )
+        from k8s_dra_driver_tpu.scheduler.allocator import AllocationError, Allocator
+
+        t = topo(spec)
+        server = InMemoryAPIServer()
+        install_device_classes(server)
+        devices = AllocatableDevices.from_topology(t)
+        ResourceSliceController(server, DRIVER_NAME, "n").update(
+            DriverResources(
+                pools={"n": Pool(slices=[Slice(devices=devices.get_devices())], node_name="n")}
+            )
+        )
+        allocator = Allocator(server)
+
+        chips_of = {
+            name: (
+                {d.chip.local_pos} if d.chip is not None
+                else set(d.subslice.subslice.chip_indices)
+            )
+            for name, d in devices.devices.items()
+        }
+        used: set = set()
+        for i, (kind, count) in enumerate(requests):
+            if kind == "chip":
+                req = DeviceRequest(name="r", device_class_name=TPU_CLASS, count=count)
+            elif kind == "any-slice":
+                req = DeviceRequest(name="r", device_class_name=SUBSLICE_CLASS, count=count)
+            else:
+                req = DeviceRequest(
+                    name="r",
+                    device_class_name=SUBSLICE_CLASS,
+                    count=count,
+                    selectors=[
+                        cel_selector(
+                            f"device.attributes['{DRIVER_NAME}'].shape == '{kind}'"
+                        )
+                    ],
+                )
+            claim = server.create(
+                ResourceClaim(
+                    metadata=ObjectMeta(name=f"c{i}", namespace="d"),
+                    spec=ResourceClaimSpec(devices=DeviceClaim(requests=[req])),
+                )
+            )
+            try:
+                granted = allocator.allocate(claim, node_name="n")
+            except AllocationError:
+                continue  # rejection is always safe
+            for r in granted.status.allocation.devices.results:
+                covered = chips_of[r.device]
+                assert not (covered & used), (
+                    f"chip double-booked: {r.device} overlaps {used}"
+                )
+                used |= covered
+
+
+class TestQuantityProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(st.integers(0, 2**62))
+    def test_format_parse_roundtrip(self, n):
+        assert parse(format_bytes(n)) == n
+
+
+class TestHbmLimitProperties:
+    @settings(max_examples=100, deadline=None)
+    @given(
+        st.lists(st.integers(1, 64), min_size=1, max_size=6, unique=True),
+        st.integers(1, 1024),
+        st.booleans(),
+    )
+    def test_wildcard_never_overrides_explicit(self, indices, gib, wildcard_first):
+        # Both insertion orders: explicit keys must win either way.
+        uuids = [f"u{i}" for i in indices]
+        explicit = {uuids[0]: f"{gib}Gi"}
+        limits = (
+            HbmLimits({"*": "1Gi", **explicit})
+            if wildcard_first
+            else HbmLimits({**explicit, "*": "1Gi"})
+        )
+        out = limits.normalize(uuids)
+        assert out[uuids[0]] == f"{gib * 1024}Mi"
+        for u in uuids[1:]:
+            assert out[u] == "1024Mi"

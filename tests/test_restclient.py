@@ -157,6 +157,48 @@ class TestWatchRecovery:
         assert any(n == "after" for _, n in events)
 
 
+class TestControllerOverREST:
+    def test_slice_manager_watches_nodes_over_http(self, api, client):
+        """The cluster controller stack runs unchanged over the REST
+        transport: node events stream in, membership pools publish out."""
+        from k8s_dra_driver_tpu.controller.slice_manager import (
+            SLICE_DOMAIN_LABEL,
+            SLICE_HOST_ID_LABEL,
+            SliceManager,
+        )
+
+        mgr = SliceManager(client)
+        mgr.start()
+        try:
+            deadline = time.time() + 5
+            while not api.server._watches and time.time() < deadline:
+                time.sleep(0.02)
+            # cluster-side node creation must reach the manager over the stream
+            api.server.create(
+                Node(
+                    metadata=ObjectMeta(
+                        name="h0",
+                        labels={SLICE_DOMAIN_LABEL: "d", SLICE_HOST_ID_LABEL: "0"},
+                    )
+                )
+            )
+            slices = []
+            while not slices and time.time() < deadline:
+                slices = [
+                    s
+                    for s in api.server.list("ResourceSlice")
+                    if s.spec.pool.name == "slice-d"
+                ]
+                time.sleep(0.05)
+            assert slices, "membership pool never published over the stream"
+            assert slices[0].spec.devices[0].basic.attributes["workerId"].value == 0
+        finally:
+            mgr.stop()
+        assert [
+            s for s in api.server.list("ResourceSlice") if s.spec.pool.name == "slice-d"
+        ] == []
+
+
 class TestKubeConfigLoading:
     def test_kubeconfig_parsing(self, tmp_path):
         import base64
