@@ -118,7 +118,12 @@ def run_data_plane() -> dict:
 def main() -> int:
     samples = run_control_plane()
     p50 = statistics.median(samples)
-    data = run_data_plane()
+    # The data-plane proof is best-effort reporting: a flaky accelerator
+    # tunnel must not suppress the headline control-plane metric.
+    try:
+        data = run_data_plane()
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        data = {"error": f"{type(exc).__name__}: {exc}"}
     print(
         f"# control-plane: {len(samples)} cycles, p50={p50:.2f}ms "
         f"p90={statistics.quantiles(samples, n=10)[8]:.2f}ms; data-plane: {data}",

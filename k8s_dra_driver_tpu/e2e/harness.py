@@ -90,10 +90,48 @@ class Cluster:
         return self.nodes[node_name].state.prepare(allocated)
 
     def unprepare_and_deallocate(self, claim: ResourceClaim, node_name: str) -> None:
-        self.nodes[node_name].state.unprepare(claim.metadata.uid)
-        self.allocator.deallocate(self.server.get(
+        """Direct teardown for unreserved claims; a claim with live consumers
+        must go through delete_pod (fail fast BEFORE any side effect so no
+        half-torn state is left behind)."""
+        current = self.server.get(
             ResourceClaim.KIND, claim.metadata.name, claim.metadata.namespace
-        ))
+        )
+        if current.status.reserved_for:
+            raise RuntimeError(
+                f"claim {claim.metadata.name!r} has consumers "
+                f"{[r.name for r in current.status.reserved_for]}; delete the pods"
+            )
+        self.nodes[node_name].state.unprepare(claim.metadata.uid)
+        self.allocator.deallocate(current)
+
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        """Pod teardown with resource-claim-controller semantics: unreserve,
+        and only when the LAST consumer goes do unprepare + deallocate run
+        (shared-claim lifecycle, gpu-test3 pattern)."""
+        pod = self.server.get("Pod", name, namespace)
+        node = pod.metadata.labels.get("_scheduled_node", "")
+        for ref in (pod.spec or {}).get("resourceClaims", []):
+            claim = self.server.get(
+                ResourceClaim.KIND, claim_name_for_ref(name, ref), namespace
+            )
+            claim = self.allocator.unreserve(claim, pod.metadata.uid)
+            if not claim.status.reserved_for:
+                if node in self.nodes:
+                    self.nodes[node].state.unprepare(claim.metadata.uid)
+                self.allocator.deallocate(claim)
+        self.server.delete("Pod", name, namespace)
+
+
+def claim_name_for_ref(pod_name: str, ref: dict) -> str:
+    """THE naming rule for a pod's claim reference: a direct claim keeps its
+    name; a template instantiation is ``<pod>-<claimref>`` (the upstream
+    resource-claim controller's generated-name convention).  Single source of
+    truth shared by the spec runner (creation) and pod teardown."""
+    if ref.get("resourceClaimName"):
+        return ref["resourceClaimName"]
+    if "name" not in ref:
+        raise ValueError(f"malformed resourceClaims entry {ref}")
+    return f"{pod_name}-{ref['name']}"
 
 
 def make_cluster(

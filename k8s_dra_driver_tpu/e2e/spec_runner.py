@@ -92,43 +92,64 @@ def _run_pod(cluster: Cluster, doc: dict, templates) -> RunningPod:
     spec = doc["spec"]
 
     # Resolve the pod's resourceClaims (template instantiation mirrors the
-    # resource-claim controller's `<pod>-<claimref>` naming).
+    # resource-claim controller's `<pod>-<claimref>` naming — one shared
+    # rule, harness.claim_name_for_ref).
+    from k8s_dra_driver_tpu.e2e.harness import claim_name_for_ref
+
     claim_names = []
     for ref in spec.get("resourceClaims", []):
-        if "resourceClaimName" in ref:
-            claim_names.append(ref["resourceClaimName"])
-        elif "resourceClaimTemplateName" in ref:
+        try:
+            name = claim_name_for_ref(pod_name, ref)
+        except ValueError as exc:
+            raise SpecError(f"pod {pod_name}: {exc}") from exc
+        if "resourceClaimTemplateName" in ref:
             tmpl = templates.get((ns, ref["resourceClaimTemplateName"]))
             if tmpl is None:
                 raise SpecError(f"unknown template {ref['resourceClaimTemplateName']!r}")
-            name = f"{pod_name}-{ref['name']}"
             cluster.server.create(
                 ResourceClaim(
                     metadata=ObjectMeta(name=name, namespace=ns),
                     spec=serde.from_json(ResourceClaimSpec, tmpl),
                 )
             )
-            claim_names.append(name)
-        else:
-            raise SpecError(f"pod {pod_name}: malformed resourceClaims entry {ref}")
+        claim_names.append(name)
 
     anti_affinity = "podAntiAffinity" in (spec.get("affinity") or {})
     node = _schedule(cluster, ns, pod_name, claim_names, anti_affinity)
-
-    devices: list[dict] = []
-    env: dict[str, str] = {}
-    for claim_name in claim_names:
-        claim = cluster.server.get(ResourceClaim.KIND, claim_name, ns)
-        devices.extend(cluster.nodes[node].state.prepare(claim))
-        env.update(_claim_env(cluster, node, claim))
 
     labels = {**doc["metadata"].get("labels", {}), "_scheduled_node": node}
     pod = objects.Pod(
         metadata=ObjectMeta(name=pod_name, namespace=ns, labels=labels),
         spec=spec,
     )
+    pod = cluster.server.create(pod)
+
+    devices: list[dict] = []
+    env: dict[str, str] = {}
+    reserved: list[str] = []
+    try:
+        for claim_name in claim_names:
+            claim = cluster.server.get(ResourceClaim.KIND, claim_name, ns)
+            # the scheduler reserves the claim for the consuming pod before
+            # the kubelet prepares it (resource-claim controller semantics)
+            claim = cluster.allocator.reserve(claim, pod.metadata.name, pod.metadata.uid)
+            reserved.append(claim_name)
+            devices.extend(cluster.nodes[node].state.prepare(claim))
+            env.update(_claim_env(cluster, node, claim))
+    except BaseException:
+        # Unwind: a pod that never ran must not pin reservations (which the
+        # deallocate guard would otherwise keep unfreeable) nor occupy an
+        # anti-affinity slot.
+        for claim_name in reserved:
+            claim = cluster.server.get(ResourceClaim.KIND, claim_name, ns)
+            claim = cluster.allocator.unreserve(claim, pod.metadata.uid)
+            if not claim.status.reserved_for:
+                cluster.nodes[node].state.unprepare(claim.metadata.uid)
+        cluster.server.delete("Pod", pod_name, ns)
+        raise
+
     pod.status.phase = "Running"
-    cluster.server.create(pod)
+    cluster.server.update(pod)
     return RunningPod(
         name=pod_name, namespace=ns, node=node, claim_names=claim_names,
         devices=devices, env=env,

@@ -39,6 +39,7 @@ from k8s_dra_driver_tpu.kube.objects import (
     NodeSelectorRequirement,
     NodeSelectorTerm,
     ResourceClaim,
+    ResourceClaimConsumerReference,
     ResourceSlice,
 )
 from k8s_dra_driver_tpu.scheduler import cel
@@ -196,7 +197,37 @@ class Allocator:
         return self._server.update(claim)
 
     def deallocate(self, claim: ResourceClaim) -> ResourceClaim:
+        if claim.status.reserved_for:
+            raise AllocationError(
+                f"claim {claim.metadata.name!r} still reserved by "
+                f"{[r.name for r in claim.status.reserved_for]}"
+            )
         claim.status.allocation = None
+        return self._server.update(claim)
+
+    # -- consumer reservation (resource-claim controller semantics) --------
+
+    RESERVED_FOR_LIMIT = 32  # upstream ResourceClaimReservedForMaxSize
+
+    def reserve(self, claim: ResourceClaim, pod_name: str, pod_uid: str) -> ResourceClaim:
+        """Record a pod as consumer (claim.status.reservedFor); shared claims
+        (gpu-test3 pattern) carry every consuming pod, capped at 32."""
+        if any(r.uid == pod_uid for r in claim.status.reserved_for):
+            return claim
+        if len(claim.status.reserved_for) >= self.RESERVED_FOR_LIMIT:
+            raise AllocationError(
+                f"claim {claim.metadata.name!r} already reserved by "
+                f"{self.RESERVED_FOR_LIMIT} consumers"
+            )
+        claim.status.reserved_for.append(
+            ResourceClaimConsumerReference(resource="pods", name=pod_name, uid=pod_uid)
+        )
+        return self._server.update(claim)
+
+    def unreserve(self, claim: ResourceClaim, pod_uid: str) -> ResourceClaim:
+        claim.status.reserved_for = [
+            r for r in claim.status.reserved_for if r.uid != pod_uid
+        ]
         return self._server.update(claim)
 
     # -- internals ---------------------------------------------------------

@@ -63,6 +63,30 @@ class TestQuickstart:
         pods = apply_spec(cluster, SPECS / "tpu-test6.yaml")
         assert pods[0].devices[0]["device_name"] in {"tpu-0", "tpu-1"}
 
+    def test_shared_claim_lifecycle(self, cluster):
+        # gpu-test3 semantics: the claim stays allocated while ANY consumer
+        # pod lives; the last deletion frees the chip.
+        apply_spec(cluster, SPECS / "tpu-test3.yaml")
+        claim = cluster.server.get("ResourceClaim", "shared-tpu", "tpu-test3")
+        assert len(claim.status.reserved_for) == 2
+        cluster.delete_pod("pod0", "tpu-test3")
+        claim = cluster.server.get("ResourceClaim", "shared-tpu", "tpu-test3")
+        assert claim.status.allocation is not None  # pod1 still consuming
+        assert len(claim.status.reserved_for) == 1
+        cluster.delete_pod("pod1", "tpu-test3")
+        claim = cluster.server.get("ResourceClaim", "shared-tpu", "tpu-test3")
+        assert claim.status.allocation is None
+        node = cluster.nodes["tpu-host-0"]
+        assert node.state.prepared_claim_uids() == []
+
+    def test_deallocate_refused_while_reserved(self, cluster):
+        from k8s_dra_driver_tpu.scheduler.allocator import AllocationError
+
+        apply_spec(cluster, SPECS / "tpu-test3.yaml")
+        claim = cluster.server.get("ResourceClaim", "shared-tpu", "tpu-test3")
+        with pytest.raises(AllocationError, match="still reserved"):
+            cluster.allocator.deallocate(claim)
+
     def test_whole_inventory_exhaustion_is_clean(self, cluster):
         apply_spec(cluster, SPECS / "tpu-test6.yaml")  # one of chips 0/1
         apply_spec(cluster, SPECS / "tpu-test3.yaml")  # one more
