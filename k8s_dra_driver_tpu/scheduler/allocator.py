@@ -140,16 +140,39 @@ class Allocator:
         classes = {dc.metadata.name: dc for dc in self._server.list(DeviceClass.KIND)}
 
         per_request: list[tuple[str, int, list[_Candidate]]] = []
+        admin_results: list[DeviceRequestAllocationResult] = []
         for req in requests:
             dc = classes.get(req.device_class_name)
             if dc is None:
                 raise AllocationError(f"unknown DeviceClass {req.device_class_name!r}")
+            # adminAccess requests (monitoring/diagnostics) see devices
+            # REGARDLESS of allocation and consume nothing — upstream DRA
+            # semantics for the admin-access feature gate.
+            pool = candidates if req.admin_access else free
             matching = [
                 c
-                for c in free
+                for c in pool
                 if _matches_selectors(c, dc.spec.selectors)
                 and _matches_selectors(c, req.selectors)
             ]
+            if req.admin_access:
+                count = len(matching) if req.allocation_mode == "All" else (req.count or 1)
+                if len(matching) < count or count == 0:
+                    # zero-match 'All' is a misconfiguration, same as the
+                    # normal path — silence would mask it exactly where
+                    # diagnostics claims need loudness.
+                    raise AllocationError(
+                        f"admin request {req.name!r}: {len(matching)} device(s) match, "
+                        f"need {max(count, 1)}"
+                    )
+                admin_results.extend(
+                    DeviceRequestAllocationResult(
+                        request=req.name, driver=c.driver, pool=c.pool,
+                        device=c.device.name, admin_access=True,
+                    )
+                    for c in matching[:count]
+                )
+                continue
             if req.allocation_mode == "All":
                 count = len(matching)
                 if count == 0:
@@ -158,11 +181,21 @@ class Allocator:
                 count = req.count or 1
             per_request.append((req.name, count, matching))
 
-        constraints = [
-            (set(con.requests or [r.name for r in requests]), con.match_attribute)
-            for con in claim.spec.devices.constraints
-            if con.match_attribute
-        ]
+        # Constraint scoping vs adminAccess: observers are placed outside the
+        # backtracking search, so explicitly constraining one is unsupported
+        # (loudly); a default-all constraint scopes to the consuming requests.
+        admin_names = {r.name for r in requests if r.admin_access}
+        constraints = []
+        for con in claim.spec.devices.constraints:
+            if not con.match_attribute:
+                continue
+            if con.requests and set(con.requests) & admin_names:
+                raise AllocationError(
+                    f"matchAttribute constraint over adminAccess request(s) "
+                    f"{sorted(set(con.requests) & admin_names)} is not supported"
+                )
+            scope = set(con.requests or [r.name for r in requests]) - admin_names
+            constraints.append((scope, con.match_attribute))
 
         chosen = self._search(per_request, constraints, used_markers)
         if chosen is None:
@@ -176,7 +209,7 @@ class Allocator:
                 request=req_name, driver=c.driver, pool=c.pool, device=c.device.name
             )
             for req_name, c in chosen
-        ]
+        ] + admin_results
         config = self._gather_config(claim, requests, classes)
         claim.status.allocation = AllocationResult(
             devices=DeviceAllocationResult(results=results, config=config),
@@ -264,6 +297,8 @@ class Allocator:
             if other.status.allocation is None:
                 continue
             for r in other.status.allocation.devices.results:
+                if r.admin_access:
+                    continue  # admin access observes, never consumes
                 in_use.add((r.driver, r.pool, r.device))
                 dev = device_index.get((r.driver, r.pool, r.device))
                 if dev is not None:

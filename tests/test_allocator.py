@@ -362,6 +362,90 @@ class TestConstraints:
             Allocator(api_server).allocate(claim, node_name="host0")
 
 
+class TestAdminAccess:
+    def test_admin_sees_allocated_devices_without_consuming(self, cluster):
+        a = Allocator(cluster)
+        # Exhaust all 4 chips with a normal claim.
+        normal = make_claim(
+            cluster, "all", [DeviceRequest(name="t", device_class_name=TPU_CLASS, count=4)]
+        )
+        a.allocate(normal, node_name="host0")
+        # A monitoring claim with adminAccess still allocates...
+        admin = make_claim(
+            cluster,
+            "monitor",
+            [
+                DeviceRequest(
+                    name="mon",
+                    device_class_name=TPU_CLASS,
+                    admin_access=True,
+                    allocation_mode="All",
+                )
+            ],
+        )
+        granted = a.allocate(admin, node_name="host0")
+        results = granted.status.allocation.devices.results
+        assert len(results) == 4
+        assert all(r.admin_access for r in results)
+        # ...and does not block further normal claims beyond the real usage.
+        another = make_claim(
+            cluster, "late", [DeviceRequest(name="t", device_class_name=TPU_CLASS)]
+        )
+        with pytest.raises(AllocationError):  # chips truly exhausted by "all"
+            a.allocate(another, node_name="host0")
+
+    def test_admin_zero_match_all_is_loud(self, cluster):
+        a = Allocator(cluster)
+        admin = make_claim(
+            cluster,
+            "typo",
+            [
+                DeviceRequest(
+                    name="mon",
+                    device_class_name=TPU_CLASS,
+                    admin_access=True,
+                    allocation_mode="All",
+                    selectors=[sel("device.attributes['missing.domain'].x == 1")],
+                )
+            ],
+        )
+        with pytest.raises(AllocationError, match="0 device"):
+            a.allocate(admin, node_name="host0")
+
+    def test_constraint_over_admin_request_rejected(self, cluster):
+        a = Allocator(cluster)
+        claim = make_claim(
+            cluster,
+            "bad",
+            [
+                DeviceRequest(name="mon", device_class_name=TPU_CLASS, admin_access=True),
+                DeviceRequest(name="w", device_class_name=TPU_CLASS),
+            ],
+            constraints=[
+                DeviceConstraint(
+                    requests=["mon", "w"], match_attribute=f"{DRIVER_NAME}/hostId"
+                )
+            ],
+        )
+        with pytest.raises(AllocationError, match="adminAccess"):
+            a.allocate(claim, node_name="host0")
+
+    def test_admin_results_do_not_mark_devices_used(self, cluster):
+        a = Allocator(cluster)
+        admin = make_claim(
+            cluster,
+            "monitor",
+            [DeviceRequest(name="mon", device_class_name=TPU_CLASS, admin_access=True)],
+        )
+        a.allocate(admin, node_name="host0")
+        # Normal allocation of every chip still succeeds afterwards.
+        normal = make_claim(
+            cluster, "all", [DeviceRequest(name="t", device_class_name=TPU_CLASS, count=4)]
+        )
+        granted = a.allocate(normal, node_name="host0")
+        assert len(granted.status.allocation.devices.results) == 4
+
+
 class TestBacktracking:
     def test_all_or_nothing_forces_disjoint_choice(self, cluster):
         # Request both a 2x1 and a 2x2... impossible (2x2 is the whole block

@@ -63,6 +63,14 @@ class TestQuickstart:
         pods = apply_spec(cluster, SPECS / "tpu-test6.yaml")
         assert pods[0].devices[0]["device_name"] in {"tpu-0", "tpu-1"}
 
+    def test_tpu_test_sharing_spatial_partition(self, cluster):
+        pods = apply_spec(cluster, SPECS / "tpu-test-sharing.yaml")
+        (pod,) = pods
+        assert pod.env["TPU_SHARING_STRATEGY"] == "spatial-partition"
+        assert pod.env["TPU_CORE_FRACTION"] == "50"
+        daemons = cluster.server.list("Deployment", namespace="tpu-dra-driver")
+        assert len(daemons) == 1
+
     def test_shared_claim_lifecycle(self, cluster):
         # gpu-test3 semantics: the claim stays allocated while ANY consumer
         # pod lives; the last deletion frees the chip.
