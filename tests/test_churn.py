@@ -1,0 +1,158 @@
+"""Soak test: randomized claim churn must leak nothing.
+
+Hundreds of interleaved create/schedule/prepare/delete cycles against one
+cluster; at every quiescent point the node must hold exactly the state of
+the live pods — no stray checkpoint entries, CDI spec files, topology
+daemons, reservations, or allocator usage.  This is the long-running-node
+confidence the reference's manual kind demos cannot give (SURVEY.md §4).
+"""
+
+import random
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import make_cluster
+from k8s_dra_driver_tpu.e2e.spec_runner import SpecError, apply_spec
+from k8s_dra_driver_tpu.kube import serde
+from k8s_dra_driver_tpu.kube.objects import ObjectMeta, ResourceClaim, ResourceClaimSpec
+
+POD_TEMPLATES = [
+    ("chip", {"requests": [{"name": "r", "deviceClassName": "tpu.google.com"}]}),
+    (
+        "pair",
+        {"requests": [{"name": "r", "deviceClassName": "tpu.google.com", "count": 2}]},
+    ),
+    (
+        "slice12",
+        {
+            "requests": [
+                {
+                    "name": "r",
+                    "deviceClassName": "subslice.tpu.google.com",
+                    "selectors": [
+                        {
+                            "cel": {
+                                "expression": "device.attributes['tpu.google.com'].shape == '1x2'"
+                            }
+                        }
+                    ],
+                }
+            ]
+        },
+    ),
+    (
+        "shared-ts",
+        {
+            "requests": [{"name": "r", "deviceClassName": "tpu.google.com"}],
+            "config": [
+                {
+                    "requests": ["r"],
+                    "opaque": {
+                        "driver": DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": "resource.tpu.google.com/v1alpha1",
+                            "kind": "TpuConfig",
+                            "sharing": {"strategy": "TimeSlicing"},
+                        },
+                    },
+                }
+            ],
+        },
+    ),
+    (
+        "spatial",
+        {
+            "requests": [{"name": "r", "deviceClassName": "tpu.google.com"}],
+            "config": [
+                {
+                    "requests": ["r"],
+                    "opaque": {
+                        "driver": DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": "resource.tpu.google.com/v1alpha1",
+                            "kind": "TpuConfig",
+                            "sharing": {"strategy": "SpatialPartition"},
+                        },
+                    },
+                }
+            ],
+        },
+    ),
+]
+
+
+def make_pod_doc(name, claim_name):
+    return {
+        "kind": "Pod",
+        "metadata": {"namespace": "churn", "name": name},
+        "spec": {
+            "containers": [{"name": "c", "resources": {"claims": [{"name": "r"}]}}],
+            "resourceClaims": [{"name": "r", "resourceClaimName": claim_name}],
+        },
+    }
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_churn_leaves_no_residue(tmp_path, seed):
+    rng = random.Random(seed)
+    cluster = make_cluster(hosts=2, topology="v5e-16", work_dir=str(tmp_path))
+    from k8s_dra_driver_tpu.e2e.spec_runner import _run_pod
+
+    live: list[str] = []
+    counter = 0
+    for step in range(150):
+        if live and (rng.random() < 0.45 or len(live) >= 6):
+            victim = rng.choice(live)
+            live.remove(victim)
+            cluster.delete_pod(victim, "churn")
+            cluster.server.delete("ResourceClaim", f"claim-{victim}", "churn")
+            continue
+        counter += 1
+        kind, claim_spec = rng.choice(POD_TEMPLATES)
+        pod_name = f"p{counter}-{kind}"
+        cluster.server.create(
+            ResourceClaim(
+                metadata=ObjectMeta(name=f"claim-{pod_name}", namespace="churn"),
+                spec=serde.from_json(ResourceClaimSpec, {"devices": claim_spec}),
+            )
+        )
+        try:
+            _run_pod(cluster, make_pod_doc(pod_name, f"claim-{pod_name}"), {})
+            live.append(pod_name)
+        except SpecError:
+            # capacity rejection: clean up the claim we just created
+            cluster.server.delete("ResourceClaim", f"claim-{pod_name}", "churn")
+
+    # drain everything
+    for pod_name in list(live):
+        cluster.delete_pod(pod_name, "churn")
+        cluster.server.delete("ResourceClaim", f"claim-{pod_name}", "churn")
+
+    # --- invariants at quiescence ---
+    for node in cluster.nodes.values():
+        assert node.state.prepared_claim_uids() == []
+        assert node.state.cdi.list_claim_spec_uids() == []
+    assert cluster.server.list("Deployment", namespace="tpu-dra-driver") == []
+    assert cluster.server.list("ResourceClaim", namespace="churn") == []
+    assert cluster.server.list("Pod", namespace="churn") == []
+    # the whole inventory is allocatable again
+    from k8s_dra_driver_tpu.kube.objects import DeviceClaim, DeviceRequest
+    from k8s_dra_driver_tpu.scheduler.allocator import Allocator
+
+    final = cluster.server.create(
+        ResourceClaim(
+            metadata=ObjectMeta(name="final", namespace="churn"),
+            spec=ResourceClaimSpec(
+                devices=DeviceClaim(
+                    requests=[
+                        DeviceRequest(
+                            name="all", device_class_name="tpu.google.com", count=4
+                        )
+                    ]
+                )
+            ),
+        )
+    )
+    granted = Allocator(cluster.server).allocate(final, node_name="tpu-host-0")
+    assert len(granted.status.allocation.devices.results) == 4
