@@ -154,14 +154,28 @@ def forward(
     return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
 
 
-def loss_fn(params, tokens, cfg: ModelConfig, act_spec=None, attn_fn=None) -> jax.Array:
-    # Forward runs on the full sequence (keeps S divisible by the seq mesh
-    # axis); the shift happens in the loss.
-    logits = forward(params, tokens, cfg, act_spec, attn_fn)
+def shift_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token NLL with the shift in the loss (forward runs on the full
+    sequence so S stays divisible by the seq mesh axis).  Single source of
+    truth for every training path (dense/sharded/pipeline)."""
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     targets = tokens[:, 1:]
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(-jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def loss_fn(params, tokens, cfg: ModelConfig, act_spec=None, attn_fn=None) -> jax.Array:
+    return shift_nll(forward(params, tokens, cfg, act_spec, attn_fn), tokens)
+
+
+def make_sgd_step(loss_fn_, opt):
+    """value_and_grad + optimizer-apply wiring shared by all train paths."""
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn_)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
 
 
 def make_optimizer(lr: float = 3e-4):
@@ -220,13 +234,9 @@ def build_train_step(
             params = init_params(key, cfg)
             return params, opt.init(params)
 
-        def step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, tokens, cfg, act_spec, flash_fn
-            )
-            updates, opt_state = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
+        step = make_sgd_step(
+            lambda params, tokens: loss_fn(params, tokens, cfg, act_spec, flash_fn), opt
+        )
         return TrainStepFns(init=jax.jit(init), step=jax.jit(step))
 
     act_spec = P("data", "seq", None)
@@ -264,13 +274,12 @@ def build_train_step(
         params = init_params(key, cfg)
         return params, opt.init(params)
 
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
+    step = make_sgd_step(
+        lambda params, tokens: loss_fn(
             params, tokens, cfg, NamedSharding(mesh, act_spec), attn_fn
-        )
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
+        ),
+        opt,
+    )
     jit_init = jax.jit(init, out_shardings=(param_shardings, None))
     jit_step = jax.jit(
         step,

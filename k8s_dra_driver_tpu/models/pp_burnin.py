@@ -1,0 +1,179 @@
+"""Pipeline-parallel burn-in training (pipe × data × model mesh).
+
+Depth-shards the burn-in transformer across the ``pipe`` axis using
+ops/pipeline.py's GPipe ring, with manual Megatron tensor parallelism inside
+the shard_map (column-sharded in-projections, row-sharded out-projections,
+explicit ``psum`` over ``model``).  Embedding/unembedding stay outside the
+shard_map under normal jit sharding.
+
+Constraints (validated): layers % pipe == 0, per-data-shard batch % n_micro
+== 0, seq axis unused (ring-attention SP composes with TP/DP, not with the
+pipeline path — pick one per workload, like every production stack).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from k8s_dra_driver_tpu.models.burnin import (
+    ModelConfig,
+    TrainStepFns,
+    _rms_norm,
+    init_params,
+    make_optimizer,
+    make_sgd_step,
+    shift_nll,
+)
+from k8s_dra_driver_tpu.ops.pipeline import pipeline_apply, stack_blocks, stage_scan
+
+
+def _headmajor_qkv(w, cfg: ModelConfig):
+    """[D, q|k|v packed] -> [D, head-major (h, 3, hd)] so TP column shards
+    hold whole heads."""
+    d = cfg.d_model
+    return (
+        w.reshape(d, 3, cfg.n_heads, cfg.head_dim)
+        .transpose(0, 2, 1, 3)
+        .reshape(d, 3 * d)
+    )
+
+
+def pp_params_from_dense(dense: dict, cfg: ModelConfig) -> dict:
+    """Convert burnin's dense param tree to the pipeline layout (stacked
+    blocks + head-major qkv)."""
+    blocks = [
+        {**blk, "qkv": _headmajor_qkv(blk["qkv"], cfg)} for blk in dense["blocks"]
+    ]
+    return {
+        "embed": dense["embed"],
+        "pos_embed": dense["pos_embed"],
+        "ln_f": dense["ln_f"],
+        "blocks": stack_blocks(blocks),
+    }
+
+# Stacked-block param layout: leading dim = layer, sharded over `pipe`;
+# Megatron TP layout on the trailing dims.
+_STACKED_SPECS = {
+    "ln1": P("pipe"),
+    "qkv": P("pipe", None, "model"),
+    "attn_out": P("pipe", "model", None),
+    "ln2": P("pipe"),
+    "mlp_up": P("pipe", None, "model"),
+    "mlp_down": P("pipe", "model", None),
+}
+
+
+def _manual_tp_block(x, p, cfg: ModelConfig, tp: int):
+    """One transformer block with weights TP-sliced over `model` (call inside
+    shard_map; x is model-replicated [b, s, D])."""
+    b, s, d = x.shape
+    h_loc = cfg.n_heads // tp
+    hd = cfg.head_dim
+
+    y = _rms_norm(x, p["ln1"])
+    # p["qkv"] is head-major (see _headmajor_qkv): each TP shard's columns
+    # are whole heads carrying their own q,k,v — a naive [q|k|v]-packed
+    # column shard would split k across devices.
+    qkv = jnp.einsum("bsd,de->bse", y, p["qkv"])  # [b, s, h_loc*3*hd]
+    qkv = qkv.reshape(b, s, h_loc, 3, hd)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, d // tp)
+    # Row-parallel out-projection: partial sums reduced over `model`.
+    x = x + jax.lax.psum(jnp.einsum("bse,ed->bsd", attn, p["attn_out"]), "model")
+
+    y = _rms_norm(x, p["ln2"])
+    y = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, p["mlp_up"]))
+    x = x + jax.lax.psum(jnp.einsum("bsf,fd->bsd", y, p["mlp_down"]), "model")
+    return x
+
+
+def build_pp_train_step(
+    cfg: ModelConfig, mesh: Mesh, lr: float = 3e-4, n_micro: int | None = None
+) -> TrainStepFns:
+    pp = mesh.shape.get("pipe", 1)
+    tp = mesh.shape.get("model", 1)
+    if pp < 2:
+        raise ValueError("build_pp_train_step needs a mesh with pipe >= 2")
+    if mesh.shape.get("seq", 1) != 1:
+        raise ValueError("the pipeline path composes with data/model axes only")
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide into {pp} stages")
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads ({cfg.n_heads}) not divisible by model axis {tp}")
+    if cfg.d_ff % tp or cfg.d_model % tp:
+        raise ValueError(
+            f"d_ff ({cfg.d_ff}) and d_model ({cfg.d_model}) must be divisible "
+            f"by model axis {tp}"
+        )
+    n_micro = n_micro or pp
+    opt = make_optimizer(lr)
+
+    outer_specs = {
+        "embed": P("model", None),
+        "pos_embed": P(),
+        "ln_f": P(),
+    }
+    param_shardings = {
+        **{k: NamedSharding(mesh, s) for k, s in outer_specs.items()},
+        "blocks": {k: NamedSharding(mesh, s) for k, s in _STACKED_SPECS.items()},
+    }
+    data_sharding = NamedSharding(mesh, P("data", None))
+
+    # Same remat tradeoff as the dense path: recompute block activations in
+    # backward instead of keeping every per-tick intermediate live.
+    block_fn = jax.checkpoint(functools.partial(_manual_tp_block, cfg=cfg, tp=tp))
+    stage_fn = functools.partial(stage_scan, block_fn)
+    data_axis = mesh.shape.get("data", 1)
+
+    pipe_body = jax.shard_map(
+        lambda blocks, x_mb: pipeline_apply(stage_fn, blocks, x_mb),
+        mesh=mesh,
+        in_specs=(
+            _STACKED_SPECS,
+            P(None, "data", None, None),  # [n_micro, B, S, D]
+        ),
+        out_specs=P(None, "data", None, None),
+        check_vma=False,  # psum-replicated output; collection mask confuses vma
+    )
+
+    def forward(params, tokens):
+        b, s = tokens.shape
+        if b % n_micro or (b // n_micro) % data_axis:
+            raise ValueError(
+                f"batch {b} must split into {n_micro} microbatches each "
+                f"divisible by the data axis ({data_axis})"
+            )
+        x = params["embed"][tokens] + params["pos_embed"][:s]
+        x_mb = x.reshape(n_micro, b // n_micro, s, cfg.d_model)
+        x = pipe_body(params["blocks"], x_mb).reshape(b, s, cfg.d_model)
+        x = _rms_norm(x, params["ln_f"])
+        return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+
+    def loss_fn(params, tokens):
+        return shift_nll(forward(params, tokens), tokens)
+
+    def init(key):
+        params = pp_params_from_dense(init_params(key, cfg), cfg)
+        return params, opt.init(params)
+
+    step = make_sgd_step(loss_fn, opt)
+
+    jit_init = jax.jit(init, out_shardings=(param_shardings, None))
+    jit_step = jax.jit(
+        step,
+        in_shardings=(param_shardings, None, data_sharding),
+        out_shardings=(param_shardings, None, None),
+        donate_argnums=(0, 1),
+    )
+    return TrainStepFns(init=jit_init, step=jit_step)

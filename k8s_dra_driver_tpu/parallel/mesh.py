@@ -18,7 +18,7 @@ from dataclasses import dataclass
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "seq", "model")
+AXES = ("pipe", "data", "seq", "model")
 
 
 @dataclass(frozen=True)
@@ -26,10 +26,14 @@ class MeshShape:
     data: int = 1
     seq: int = 1
     model: int = 1
+    # Pipeline stages ride the outermost axis: stage hand-off is a single
+    # neighbor transfer, so the slower inter-block links can carry it while
+    # model/seq collectives stay on the innermost ICI.
+    pipe: int = 1
 
     @property
     def total(self) -> int:
-        return self.data * self.seq * self.model
+        return self.data * self.seq * self.model * self.pipe
 
 
 def claimed_device_env() -> dict[str, str]:
@@ -63,7 +67,7 @@ def auto_mesh_shape(n_devices: int, want_seq: bool = False) -> MeshShape:
 def build_mesh(devices, shape: MeshShape) -> Mesh:
     if shape.total != len(devices):
         raise ValueError(f"mesh shape {shape} needs {shape.total} devices, got {len(devices)}")
-    arr = np.array(devices).reshape(shape.data, shape.seq, shape.model)
+    arr = np.array(devices).reshape(shape.pipe, shape.data, shape.seq, shape.model)
     return Mesh(arr, AXES)
 
 
