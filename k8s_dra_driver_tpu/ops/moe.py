@@ -1,0 +1,115 @@
+"""Expert parallelism: Switch-style top-1 MoE with all_to_all dispatch.
+
+The GShard/Switch pattern over an ``expert`` mesh axis (we reuse ``data``:
+tokens AND experts are sharded over the same axis, the canonical EP layout):
+
+1. each device routes its local tokens (top-1 softmax gate);
+2. tokens are packed into per-expert capacity slots and exchanged with
+   ``all_to_all`` so each device receives its experts' slots from everyone;
+3. local expert FFNs run (dense einsums — MXU-friendly);
+4. a reverse ``all_to_all`` returns expert outputs to the owning devices,
+   where they are combined weighted by the gate.
+
+Capacity-dropped tokens pass through with zero contribution (standard Switch
+behavior).  Fully differentiable; the all_to_alls transpose to all_to_alls.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def switch_moe_local(x, w_router, w_up, w_down, axis_name: str, capacity: int):
+    """Per-shard Switch MoE (call inside shard_map).
+
+    x: [T_loc, D] local tokens;  w_router: [D, E] replicated;
+    w_up: [E_loc, D, F], w_down: [E_loc, F, D] — this device's experts.
+    Returns [T_loc, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    t_loc, d = x.shape
+    e_loc = w_up.shape[0]
+    n_experts = e_loc * n
+
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(probs, axis=-1)  # [T_loc]
+    gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]  # [T_loc]
+
+    # Capacity slots per (expert, this device): position of each token within
+    # its chosen expert's queue; beyond-capacity tokens are dropped.
+    onehot = jax.nn.one_hot(choice, n_experts, dtype=jnp.int32)  # [T, E]
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E], -1 where not chosen
+    pos_in_expert = position.max(axis=-1)  # [T]
+    keep = pos_in_expert < capacity
+    slot = jnp.where(keep, pos_in_expert, 0)
+
+    # dispatch [E, C, D]: token t lands in (choice[t], slot[t]).
+    dispatch = (
+        jax.nn.one_hot(choice, n_experts, dtype=x.dtype)[:, :, None]
+        * jax.nn.one_hot(slot, capacity, dtype=x.dtype)[:, None, :]
+        * keep[:, None, None].astype(x.dtype)
+    )  # [T, E, C]
+    expert_in = jnp.einsum("td,tec->ecd", x, dispatch)  # [E, C, D]
+
+    # Exchange: device i keeps slots for ITS experts from every peer.
+    # [E, C, D] -> [E_loc, n*C, D]
+    expert_in = jax.lax.all_to_all(
+        expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_up))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)  # [E_loc, n*C, D]
+
+    # Reverse exchange: [E_loc, n*C, D] -> [E, C, D] back at the token owners.
+    expert_out = jax.lax.all_to_all(
+        expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    combined = jnp.einsum("ecd,tec->td", expert_out, dispatch)
+    return combined * gate[:, None].astype(x.dtype)
+
+
+def switch_moe(
+    x, w_router, w_up, w_down, mesh: Mesh, axis_name: str = "data",
+    capacity_factor: float = 2.0,
+):
+    """Sharded entry: x [T, D] sharded over ``axis_name``; experts E sharded
+    over the same axis (E % axis size == 0)."""
+    n = mesh.shape[axis_name]
+    n_experts = w_up.shape[0]
+    if n_experts % n:
+        raise ValueError(f"{n_experts} experts not divisible by axis {axis_name}={n}")
+    if w_router.shape[-1] != n_experts:
+        # A wider router would route tokens to nonexistent experts, which
+        # one_hot would silently zero — indistinguishable from drops.
+        raise ValueError(
+            f"router emits {w_router.shape[-1]} experts but weights hold {n_experts}"
+        )
+    t_loc = x.shape[0] // n
+    # Slots per (expert, source device): a capacity_factor-padded even spread
+    # of the source device's tokens across experts (Switch convention).
+    capacity = max(1, -(-int(capacity_factor * t_loc) // n_experts))
+    fn = jax.shard_map(
+        functools.partial(switch_moe_local, axis_name=axis_name, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(), P(axis_name, None, None), P(axis_name, None, None)),
+        out_specs=P(axis_name, None),
+    )
+    return fn(x, w_router, w_up, w_down)
+
+
+def reference_switch_moe(x, w_router, w_up, w_down):
+    """Dropless dense oracle: every token goes to its top-1 expert."""
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
+    h = jax.nn.gelu(jnp.einsum("td,edf->tef", x, w_up))
+    outs = jnp.einsum("tef,efd->ted", h, w_down)
+    picked = jnp.take_along_axis(outs, choice[:, None, None], axis=1)[:, 0]
+    return picked * gate[:, None].astype(x.dtype)
