@@ -55,6 +55,7 @@ from k8s_dra_driver_tpu.plugin.sharing import (
     TopologyDaemon,
 )
 from k8s_dra_driver_tpu.tpuinfo.binding import TopologyInfo, enumerate_topology
+from k8s_dra_driver_tpu.utils.tracing import TRACER
 
 
 class PrepareError(RuntimeError):
@@ -131,24 +132,27 @@ class DeviceState:
 
             undo: list[Callable[[], None]] = []
             try:
-                prepared = self._prepare_devices(claim, undo)
-                self.cdi.create_claim_spec_file(
-                    uid,
-                    [
-                        (
-                            [d.name for d in g.devices],
-                            ContainerEdits(env=g.config_state.env),
-                        )
-                        for g in prepared.groups
-                    ],
-                )
+                with TRACER.span("Prepare.resolveAndApplyConfigs"):
+                    prepared = self._prepare_devices(claim, undo)
+                with TRACER.span("Prepare.writeClaimCDISpec"):
+                    self.cdi.create_claim_spec_file(
+                        uid,
+                        [
+                            (
+                                [d.name for d in g.devices],
+                                ContainerEdits(env=g.config_state.env),
+                            )
+                            for g in prepared.groups
+                        ],
+                    )
                 undo.append(lambda: self.cdi.delete_claim_spec_file(uid))
                 self.prepared[uid] = prepared
                 # The in-memory entry must unwind too: if the checkpoint write
                 # below fails, a kubelet retry would otherwise hit the
                 # idempotence fast-path and report stale success.
                 undo.append(lambda: self.prepared.pop(uid, None))
-                self._write_checkpoint()
+                with TRACER.span("Prepare.writeCheckpoint"):
+                    self._write_checkpoint()
             except BaseException:
                 for fn in reversed(undo):
                     try:

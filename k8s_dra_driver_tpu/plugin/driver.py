@@ -25,6 +25,7 @@ from k8s_dra_driver_tpu.kube.resourceslice_controller import (
 )
 from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+from k8s_dra_driver_tpu.utils.tracing import TRACER
 
 # ResourceSlice device limit per object (upstream k8s constant): split pools
 # into slices of at most this many devices.
@@ -107,15 +108,21 @@ class Driver:
         out: dict[str, ClaimResult] = {}
         with self._lock:
             for ref in claims:
-                start = time.perf_counter()
-                try:
-                    out[ref.uid] = ClaimResult(devices=self._prepare_one(ref))
-                    self._prepare_seconds.observe(time.perf_counter() - start)
-                except Exception as exc:  # per-claim, not process-fatal
-                    self._claim_errors.inc(op="prepare")
-                    out[ref.uid] = ClaimResult(
-                        error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
-                    )
+                ok = False
+                with TRACER.span(
+                    "NodePrepareResources", claim=f"{ref.namespace}/{ref.name}"
+                ) as span:
+                    try:
+                        out[ref.uid] = ClaimResult(devices=self._prepare_one(ref))
+                        ok = True
+                    except Exception as exc:  # per-claim, not process-fatal
+                        self._claim_errors.inc(op="prepare")
+                        out[ref.uid] = ClaimResult(
+                            error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
+                        )
+                if ok:
+                    # single timing source: the span's measurement
+                    self._prepare_seconds.observe(span.duration_ms / 1000)
         return out
 
     def node_unprepare_resources(self, claims: list[ClaimRef]) -> dict[str, ClaimResult]:

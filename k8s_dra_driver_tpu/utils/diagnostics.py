@@ -12,6 +12,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY, Registry
+from k8s_dra_driver_tpu.utils.tracing import TRACER
 
 
 class DiagnosticsServer:
@@ -37,6 +38,9 @@ class DiagnosticsServer:
                     ctype = "text/plain"
                 elif self.path == "/debug/state":
                     body = json.dumps(state_ref(), indent=1, default=str).encode()
+                    ctype = "application/json"
+                elif self.path == "/debug/traces":
+                    body = json.dumps(TRACER.recent(), indent=1, default=str).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

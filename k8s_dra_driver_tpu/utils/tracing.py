@@ -1,0 +1,75 @@
+"""Lightweight span tracing for the claim hot path.
+
+SURVEY.md §5: the reference has no tracing spans (pprof only, controller
+only).  This is a minimal structured tracer: nested spans with wall-time,
+kept in a bounded ring buffer, exported via /debug/traces on the
+diagnostics endpoint.  Zero dependencies; overhead is two clock reads per
+span.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    duration_ms: float = 0.0
+    attributes: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "start": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self.start)),
+            "durationMs": round(self.duration_ms, 3),
+            **({"attributes": self.attributes} if self.attributes else {}),
+            **(
+                {"children": [c.to_json() for c in self.children]}
+                if self.children
+                else {}
+            ),
+        }
+
+
+class Tracer:
+    """Per-process tracer; completed root spans land in a ring buffer."""
+
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._finished: deque[Span] = deque(maxlen=capacity)
+        self._local = threading.local()
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        s = Span(name=name, start=time.time(), attributes=dict(attributes))
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent = stack[-1] if stack else None
+        stack.append(s)
+        t0 = time.perf_counter()
+        try:
+            yield s
+        finally:
+            s.duration_ms = (time.perf_counter() - t0) * 1000
+            stack.pop()
+            if parent is not None:
+                parent.children.append(s)
+            else:
+                with self._lock:
+                    self._finished.append(s)
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            spans = list(self._finished)[-limit:]
+        return [s.to_json() for s in reversed(spans)]
+
+
+TRACER = Tracer()

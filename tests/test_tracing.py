@@ -1,0 +1,79 @@
+"""Span tracer tests + end-to-end /debug/traces exposure."""
+
+import json
+import urllib.request
+
+from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+
+class TestTracer:
+    def test_nested_spans(self):
+        t = Tracer()
+        with t.span("outer", claim="default/c1"):
+            with t.span("inner-a"):
+                pass
+            with t.span("inner-b"):
+                pass
+        (root,) = t.recent()
+        assert root["name"] == "outer"
+        assert root["attributes"] == {"claim": "default/c1"}
+        assert [c["name"] for c in root["children"]] == ["inner-a", "inner-b"]
+        assert root["durationMs"] >= 0
+
+    def test_span_survives_exception(self):
+        t = Tracer()
+        try:
+            with t.span("boom"):
+                raise RuntimeError("x")
+        except RuntimeError:
+            pass
+        assert t.recent()[0]["name"] == "boom"
+
+    def test_ring_buffer_bounded(self):
+        t = Tracer(capacity=5)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        names = [s["name"] for s in t.recent()]
+        assert names == ["s9", "s8", "s7", "s6", "s5"]
+
+    def test_prepare_path_traced_and_exposed(self, tmp_path):
+        from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+        from k8s_dra_driver_tpu.plugin.driver import ClaimRef, Driver, DriverConfig
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        cluster = make_cluster(hosts=1, work_dir=str(tmp_path))
+        driver = Driver(
+            cluster.server,
+            DriverConfig(
+                node_name="tpu-host-0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "cp.json"),
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                    "TPUINFO_FAKE_HOST_ID": "0",
+                },
+                publish=False,
+            ),
+        )
+        claim = cluster.server.create(simple_claim("traced"))
+        allocated = cluster.allocator.allocate(claim, node_name="tpu-host-0")
+        driver.node_prepare_resources(
+            [ClaimRef(uid=allocated.metadata.uid, name="traced", namespace="default")]
+        )
+
+        srv = DiagnosticsServer(port=0)
+        srv.start()
+        try:
+            traces = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/traces"
+                ).read()
+            )
+        finally:
+            srv.stop()
+        prepare = next(t for t in traces if t["name"] == "NodePrepareResources")
+        assert prepare["attributes"]["claim"] == "default/traced"
+        child_names = [c["name"] for c in prepare["children"]]
+        assert "Prepare.resolveAndApplyConfigs" in child_names
+        assert "Prepare.writeCheckpoint" in child_names
