@@ -24,6 +24,7 @@ exactly what geometry encoding the driver relies on:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -59,16 +60,29 @@ class _Candidate:
     def key(self) -> tuple[str, str, str]:
         return (self.driver, self.pool, self.device.name)
 
+    @functools.cached_property
+    def env(self) -> dict:
+        """CEL env cached per candidate: capacities parse once, not per
+        (request, selector) evaluation on the allocation hot path."""
+        return _device_env(self)
+
 
 def _device_env(c: _Candidate) -> dict:
     """CEL environment for one device, mirroring k8s DRA's `device` variable:
-    attributes/capacity are maps keyed by qualified name then attribute."""
+    attributes/capacity are maps keyed by qualified name then attribute.
+    Capacities are parsed to integer base units so they compare against
+    ``quantity('16Gi')`` (k8s CEL's quantity semantics)."""
+    from k8s_dra_driver_tpu.kube import quantity as q
+
     attrs = cel.AttrBag()
     caps = cel.AttrBag()
     for name, attr in c.device.basic.attributes.items():
         attrs[name] = attr.value
     for name, qty in c.device.basic.capacity.items():
-        caps[name] = qty
+        try:
+            caps[name] = q.parse(qty)
+        except q.InvalidQuantity:
+            caps[name] = qty
     return {
         "device": cel.AttrBag(
             driver=c.driver,
@@ -79,7 +93,7 @@ def _device_env(c: _Candidate) -> dict:
 
 
 def _matches_selectors(c: _Candidate, selectors) -> bool:
-    env = _device_env(c)
+    env = c.env
     for sel in selectors or []:
         if sel.cel is None:
             continue

@@ -11,7 +11,10 @@ demo harness can run the same expressions a real cluster would:
 * operators: ``|| && ! == != < <= > >= in + - * / %``, ternary ``?:``
 * member access ``a.b``, indexing ``a['k']`` / ``a[0]``
 * functions: ``size(x)``, ``x.matches(re)``, ``x.startsWith(s)``,
-  ``x.endsWith(s)``, ``x.contains(s)``
+  ``x.endsWith(s)``, ``x.contains(s)``, ``quantity(s)`` (k8s resource
+  quantity → integer base units, so capacity comparisons like
+  ``device.capacity['d'].hbm >= quantity('16Gi')`` work — the allocator
+  exposes capacities pre-parsed to integers for exactly this)
 
 Evaluation errors (unknown identifier, missing map key) raise
 :class:`CELError`; per CEL-in-k8s semantics the caller treats an erroring
@@ -320,10 +323,21 @@ def _call(name, recv_node, args, env):
     if name == "size":
         target = args[0] if recv is None else recv
         return len(target)
+    if name == "quantity" and recv is None:
+        from k8s_dra_driver_tpu.kube import quantity as q
+
+        if len(args) != 1 or not isinstance(args[0], (str, int)):
+            raise CELError(f"quantity() takes one string argument, got {args!r}")
+        try:
+            return q.parse(args[0])
+        except q.InvalidQuantity as exc:
+            raise CELError(str(exc)) from exc
     if recv is None:
         raise CELError(f"unknown function {name!r}")
     if not isinstance(recv, str):
         raise CELError(f"{name}() receiver must be string")
+    if len(args) != 1:
+        raise CELError(f"{name}() takes exactly one argument, got {len(args)}")
     (arg,) = args
     if name == "matches":
         try:

@@ -188,6 +188,43 @@ class TestSelectors:
         updated = Allocator(cluster).allocate(claim, node_name="host0")
         assert updated.status.allocation.devices.results[0].device == "tpu-slice-2x2-0-0"
 
+    def test_capacity_quantity_selector(self, cluster):
+        # hbm >= quantity('48Gi'): only the 2x2 subslice (64Gi) qualifies.
+        claim = make_claim(
+            cluster,
+            "cap",
+            [
+                DeviceRequest(
+                    name="big",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[
+                        sel(
+                            f"device.capacity['{DRIVER_NAME}'].hbm >= quantity('48Gi')"
+                        )
+                    ],
+                )
+            ],
+        )
+        updated = Allocator(cluster).allocate(claim, node_name="host0")
+        assert updated.status.allocation.devices.results[0].device == "tpu-slice-2x2-0-0"
+
+    def test_bad_quantity_in_selector_is_nonmatch(self, cluster):
+        claim = make_claim(
+            cluster,
+            "badq",
+            [
+                DeviceRequest(
+                    name="t",
+                    device_class_name=TPU_CLASS,
+                    selectors=[
+                        sel(f"device.capacity['{DRIVER_NAME}'].hbm >= quantity('banana')")
+                    ],
+                )
+            ],
+        )
+        with pytest.raises(AllocationError):
+            Allocator(cluster).allocate(claim, node_name="host0")
+
     def test_erroring_selector_is_nonmatch(self, cluster):
         claim = make_claim(
             cluster,

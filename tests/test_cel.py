@@ -67,11 +67,21 @@ def test_eval(expr, expected):
         "device.attributes['tpu.google.com'].index.matches('x')",  # non-string recv
         "'a'.matches('[')",  # bad regex
         "1 && true",  # non-bool operand
+        "quantity()",  # arity
+        "quantity(1.5)",  # non-string/int arg
+        "quantity('bananas')",  # malformed quantity
+        "'abc'.contains()",  # method arity
+        "'abc'.startsWith('a', 'b')",  # method arity
     ],
 )
 def test_errors(expr):
     with pytest.raises(CELError):
         evaluate(expr, ENV)
+
+
+def test_quantity_function():
+    assert evaluate("quantity('16Gi')", ENV) == 16 * 1024**3
+    assert evaluate("quantity('1500M') > quantity('1Gi')", ENV) is True
 
 
 def test_short_circuit_does_not_mask_type_sanity():
