@@ -216,9 +216,9 @@ def build_train_step(
         )
     if attention not in ("dense", "flash"):
         raise ValueError(f"attention must be 'dense' or 'flash', got {attention!r}")
-    if attention == "flash" and mesh is not None:
-        raise ValueError("attention='flash' is the single-device path; "
-                         "sharded meshes select ring/ulysses via sequence_parallel")
+    if attention == "flash" and mesh is not None and mesh.shape.get("seq", 1) > 1:
+        raise ValueError("attention='flash' needs an unsharded sequence; "
+                         "seq-sharded meshes use ring/ulysses via sequence_parallel")
     opt = make_optimizer(lr)
     if mesh is None:
         act_spec = None
@@ -261,6 +261,21 @@ def build_train_step(
             )
         attn_fn = functools.partial(
             ulysses_attention, mesh=mesh, axis_name="seq", batch_axis="data"
+        )
+    if attention == "flash" and attn_fn is not None:
+        raise ValueError(
+            f"attention='flash' conflicts with sequence_parallel={scheme!r}; "
+            "flash owns attention only when no SP scheme is active"
+        )
+    if attention == "flash" and attn_fn is None:
+        from k8s_dra_driver_tpu.ops.flash_attention import sharded_flash_attention
+
+        attn_fn = functools.partial(
+            sharded_flash_attention,
+            mesh=mesh,
+            # interpret follows the MESH's devices (a CPU test mesh may
+            # coexist with a TPU default backend on tunneled hosts)
+            interpret=mesh.devices.flat[0].platform != "tpu",
         )
     pspecs = param_pspecs(cfg)
     param_shardings = jax.tree.map(

@@ -284,6 +284,41 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, residuals, dout):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+def sharded_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    batch_axis: str = "data",
+    head_axis: str = "model",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention on a DP×TP mesh: batch sharded over ``batch_axis``,
+    heads over ``head_axis``; each shard runs the fused kernel on its local
+    heads (attention needs no cross-head communication, so the shard_map body
+    is collective-free).  Sequence must be unsharded — ring attention owns
+    the SP case."""
+
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, None, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            flash_attention,
+            causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # pallas_call outputs carry no varying-manual-axes metadata yet.
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -321,13 +321,21 @@ def _get(obj, key):
 def _call(name, recv_node, args, env):
     recv = _eval(recv_node, env) if recv_node is not None else None
     if name == "size":
+        if recv is None and len(args) != 1:
+            raise CELError(f"size() takes exactly one argument, got {len(args)}")
         target = args[0] if recv is None else recv
+        if not isinstance(target, (str, list, dict)):
+            raise CELError(f"size() argument must be sized, got {type(target).__name__}")
         return len(target)
     if name == "quantity" and recv is None:
         from k8s_dra_driver_tpu.kube import quantity as q
 
-        if len(args) != 1 or not isinstance(args[0], (str, int)):
-            raise CELError(f"quantity() takes one string argument, got {args!r}")
+        if (
+            len(args) != 1
+            or isinstance(args[0], bool)  # no bool->int coercion in CEL
+            or not isinstance(args[0], (str, int))
+        ):
+            raise CELError(f"quantity() takes one string/int argument, got {args!r}")
         try:
             return q.parse(args[0])
         except q.InvalidQuantity as exc:

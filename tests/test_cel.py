@@ -69,6 +69,9 @@ def test_eval(expr, expected):
         "1 && true",  # non-bool operand
         "quantity()",  # arity
         "quantity(1.5)",  # non-string/int arg
+        "quantity(true)",  # no bool->int coercion in CEL
+        "size()",  # arity
+        "size(5)",  # unsized argument
         "quantity('bananas')",  # malformed quantity
         "'abc'.contains()",  # method arity
         "'abc'.startsWith('a', 'b')",  # method arity
