@@ -20,6 +20,7 @@ so >1.0 means faster than budget; later rounds compare against BENCH_r1.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import tempfile
@@ -115,15 +116,37 @@ def run_data_plane() -> dict:
     }
 
 
+def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:
+    """Data plane behind a watchdog: a hung accelerator tunnel (jax backend
+    init can block forever when the device link dies) must not stop the
+    JSON line from printing.  Daemon thread: a stuck jax import cannot keep
+    the process alive at exit."""
+    result: dict = {}
+
+    def worker():
+        try:
+            result.update(run_data_plane())
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            result["error"] = f"{type(exc).__name__}: {exc}"
+
+    import threading
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return {"error": f"data plane timed out after {timeout_s:.0f}s (hung device link?)"}
+    return result
+
+
 def main() -> int:
     samples = run_control_plane()
     p50 = statistics.median(samples)
     # The data-plane proof is best-effort reporting: a flaky accelerator
     # tunnel must not suppress the headline control-plane metric.
-    try:
-        data = run_data_plane()
-    except Exception as exc:  # noqa: BLE001 - report, don't die
-        data = {"error": f"{type(exc).__name__}: {exc}"}
+    data = _run_data_plane_guarded(
+        timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "600"))
+    )
     print(
         f"# control-plane: {len(samples)} cycles, p50={p50:.2f}ms "
         f"p90={statistics.quantiles(samples, n=10)[8]:.2f}ms; data-plane: {data}",
