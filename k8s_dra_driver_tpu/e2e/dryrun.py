@@ -1,0 +1,179 @@
+"""Multi-chip dry run, runnable as ``python -m k8s_dra_driver_tpu.e2e.dryrun N``.
+
+Validates that the FULL training step — DP x SP x TP (ring-attention
+sequence parallelism), PP x DP x TP (GPipe pipeline), and expert-parallel
+Switch-MoE — jits and executes over an ``n_devices`` mesh.  On hosts
+without n real chips the mesh is built from virtual CPU devices
+(``--xla_force_host_platform_device_count``).
+
+The ``__main__`` path bootstraps its own environment BEFORE the first jax
+import: a forced-CPU platform and no accelerator plugin.  Round 1 shipped
+``MULTICHIP_r01.json ok=false rc=124`` because the dry run inherited
+``JAX_PLATFORMS=axon`` from the harness env and a dead device tunnel hangs
+backend init forever; this module exists so the dry run can never touch a
+device link (see ``__graft_entry__.dryrun_multichip``, which runs it in a
+sanitized subprocess with a watchdog).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Env vars that hand jax an accelerator plugin; a CPU dry run must never
+# see them (the sitecustomize-registered tunnel plugin hangs backend init
+# when the device link is down).
+ACCELERATOR_ENV_VARS = (
+    "PALLAS_AXON_POOL_IPS",  # gates the axon PJRT plugin registration
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "AXON_LOOPBACK_RELAY",
+    "PJRT_NAMES_AND_LIBRARY_PATHS",
+)
+
+
+def force_cpu_env(environ: dict, n_devices: int) -> None:
+    """Mutate ``environ`` so a fresh jax in that environment is CPU-only
+    with ``n_devices`` virtual devices.  Must run before the first jax
+    import in the target process."""
+    for var in ACCELERATOR_ENV_VARS:
+        environ.pop(var, None)
+    environ["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def force_cpu(n_devices: int) -> None:
+    """Force THIS process onto the virtual-CPU platform.
+
+    ``force_cpu_env`` alone is not enough in-process: the harness
+    sitecustomize imports jax at interpreter start, and jax freezes
+    ``JAX_PLATFORMS`` into its config at import — later environ edits are
+    ignored and ``jax.devices("cpu")`` still initializes the (possibly
+    dead) accelerator plugin via ``backends()`` (observed: the round-2
+    suite hang).  So when jax is already imported, rewrite its live
+    config too.  XLA_FLAGS is still honored here because the CPU client
+    is only created later, on first backend use."""
+    force_cpu_env(os.environ, n_devices)
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def run_dryrun(n_devices: int) -> None:
+    """The dry run body.  Imports jax lazily so callers control the env."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin
+    from k8s_dra_driver_tpu.parallel.mesh import MeshShape, auto_mesh_shape, build_mesh
+
+    devices = _pick_devices(n_devices)
+    shape = auto_mesh_shape(n_devices, want_seq=True)
+    mesh = build_mesh(devices, shape)
+    cfg = burnin.TINY
+    fns = burnin.build_train_step(cfg, mesh=mesh)
+    with mesh:
+        params, opt_state = fns.init(jax.random.PRNGKey(0))
+        tokens = jax.device_put(
+            burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4 * shape.data, seq=64),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data", None)),
+        )
+        params, opt_state, loss = fns.step(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+    print(
+        f"dryrun_multichip: mesh data={shape.data} seq={shape.seq} model={shape.model} "
+        f"loss={float(loss):.4f}"
+    )
+
+    if n_devices >= 4 and n_devices % 4 == 0:
+        from k8s_dra_driver_tpu.models import pp_burnin
+
+        pp_shape = MeshShape(pipe=2, data=2, model=n_devices // 4)
+        if cfg.n_heads % pp_shape.model != 0:
+            print(
+                f"dryrun_multichip: pipeline path SKIPPED "
+                f"({cfg.n_heads} heads not divisible by model={pp_shape.model})"
+            )
+        else:
+            pp_mesh = build_mesh(devices, pp_shape)
+            pp_fns = pp_burnin.build_pp_train_step(cfg, pp_mesh)
+            with pp_mesh:
+                params, opt_state = pp_fns.init(jax.random.PRNGKey(0))
+                tokens = jax.device_put(
+                    burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=64),
+                    jax.sharding.NamedSharding(
+                        pp_mesh, jax.sharding.PartitionSpec("data", None)
+                    ),
+                )
+                params, opt_state, loss = pp_fns.step(params, opt_state, tokens)
+                jax.block_until_ready(loss)
+            print(
+                f"dryrun_multichip: mesh pipe={pp_shape.pipe} data={pp_shape.data} "
+                f"model={pp_shape.model} (pipeline) loss={float(loss):.4f}"
+            )
+
+    # Expert parallelism: a Switch-MoE grad step with all_to_all dispatch
+    # over the data/expert axis.
+    from k8s_dra_driver_tpu.ops.moe import switch_moe
+
+    ep_mesh = build_mesh(devices, MeshShape(data=n_devices))
+    keys = jax.random.split(jax.random.PRNGKey(2), 4)
+    t, d, f, e = 8 * n_devices, 32, 64, 2 * n_devices
+    x = jax.random.normal(keys[0], (t, d))
+    wr = jax.random.normal(keys[1], (d, e)) * 0.5
+    wu = jax.random.normal(keys[2], (e, d, f)) / d**0.5
+    wd = jax.random.normal(keys[3], (e, f, d)) / f**0.5
+    moe_loss = jax.jit(
+        jax.grad(
+            lambda up, down: (
+                switch_moe(x, wr, up, down, mesh=ep_mesh, capacity_factor=2.0) ** 2
+            ).sum(),
+            argnums=(0, 1),  # both expert weights: cover the full backward
+        )
+    )
+    jax.block_until_ready(moe_loss(wu, wd))
+    print(f"dryrun_multichip: mesh expert={n_devices} (switch-moe grad) ok")
+
+
+def _pick_devices(n_devices: int):
+    """Prefer the forced-CPU virtual platform for dry runs; on hosts where
+    a TPU plugin wins the default-backend race, ask for CPU devices
+    explicitly before falling back to the default backend."""
+    import jax
+
+    errors = []
+    try:
+        cpus = jax.devices("cpu")
+        if len(cpus) >= n_devices:
+            return cpus[:n_devices]
+        errors.append(f"cpu backend has only {len(cpus)} devices")
+    except Exception as exc:  # backend init failures vary by plugin
+        errors.append(f"cpu backend: {exc}")
+    try:
+        devs = jax.devices()
+        if len(devs) >= n_devices:
+            return devs[:n_devices]
+        errors.append(f"default backend has only {len(devs)} devices")
+    except Exception as exc:
+        errors.append(f"default backend: {exc}")
+    raise RuntimeError(
+        f"need {n_devices} devices ({'; '.join(errors)}); "
+        "set JAX_PLATFORMS=cpu with XLA_FLAGS=--xla_force_host_platform_device_count="
+        f"{n_devices}"
+    )
+
+
+def main(argv: list[str]) -> int:
+    n_devices = int(argv[1]) if len(argv) > 1 else 8
+    force_cpu(n_devices)
+    run_dryrun(n_devices)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -71,6 +71,7 @@ class DeviceStateConfig:
     driver_root: str = ""
     libtpu_path: str = "/lib/libtpu.so"
     topology_env: dict[str, str] = field(default_factory=dict)
+    socket_dir: str = "/run/tpu-topology"
     # Readiness backoff overrides for tests.
     daemon_backoff_initial: float = 1.0
     daemon_backoff_steps: int = 4
@@ -103,11 +104,12 @@ class DeviceState:
             libtpu_path=libtpu_path,
         )
         self.cdi.create_base_spec(self.allocatable)
-        self.ts_manager = TimeSlicingManager()
+        self.ts_manager = TimeSlicingManager(socket_dir=config.socket_dir)
         self.sp_manager = SpatialPartitionManager(
             server,
             namespace=config.namespace,
             node_name=config.node_name,
+            socket_dir=config.socket_dir,
             backoff_initial=config.daemon_backoff_initial,
             backoff_steps=config.daemon_backoff_steps,
         )
@@ -135,14 +137,26 @@ class DeviceState:
                 with TRACER.span("Prepare.resolveAndApplyConfigs"):
                     prepared = self._prepare_devices(claim, undo)
                 with TRACER.span("Prepare.writeClaimCDISpec"):
+                    # Per-device entries: group env, overridden by the
+                    # device's disjoint partition slot when the config
+                    # produced one (SpatialPartition per-container division).
                     self.cdi.create_claim_spec_file(
                         uid,
                         [
                             (
-                                [d.name for d in g.devices],
-                                ContainerEdits(env=g.config_state.env),
+                                [d.name],
+                                ContainerEdits(
+                                    env={
+                                        **g.config_state.env,
+                                        **g.config_state.per_device_env.get(d.name, {}),
+                                    },
+                                    mounts=[
+                                        (m[0], m[1]) for m in g.config_state.mounts
+                                    ],
+                                ),
                             )
                             for g in prepared.groups
+                            for d in g.devices
                         ],
                     )
                 undo.append(lambda: self.cdi.delete_claim_spec_file(uid))
@@ -281,6 +295,7 @@ class DeviceState:
                     self._prepared_device(claim, result.request, result.pool, device)
                 )
             group.config_state.env = {**self._wiring_env(devices), **edits.env}
+            group.config_state.mounts = [[host, cont] for host, cont in edits.mounts]
             prepared.groups.append(group)
         return prepared
 
@@ -372,12 +387,13 @@ class DeviceState:
             edits = self.ts_manager.apply(devices, sharing.get_time_slicing_config())
             return edits, DeviceConfigState(strategy="TimeSlicing")
         if strategy == SharingStrategy.SPATIAL_PARTITION:
-            edits, daemon = self.sp_manager.start(
+            edits, daemon, per_device_env = self.sp_manager.start(
                 claim.metadata.uid, devices, sharing.get_spatial_partition_config()
             )
             undo.append(lambda: self.sp_manager.stop(daemon))
             return edits, DeviceConfigState(
                 strategy="SpatialPartition",
+                per_device_env=per_device_env,
                 daemon_name=daemon.name,
                 daemon_namespace=daemon.namespace,
             )

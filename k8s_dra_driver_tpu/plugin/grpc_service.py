@@ -178,10 +178,10 @@ class PluginServer:
         self._server.add_generic_rpc_handlers(
             (
                 grpc.method_handlers_generic_handler(
-                    "tpu.dra.v1beta1.DRAPlugin", _dra_handlers(self.driver)
+                    "k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin", _dra_handlers(self.driver)
                 ),
                 grpc.method_handlers_generic_handler(
-                    "tpu.pluginregistration.v1.Registration",
+                    "pluginregistration.Registration",
                     _registration_handlers(self.plugin_socket, self.registered),
                 ),
             )
@@ -206,12 +206,12 @@ class DRAClient:
         self._channel = grpc.insecure_channel(f"unix:{socket_path}")
         d = pb2("dra")
         self._prepare = self._channel.unary_unary(
-            "/tpu.dra.v1beta1.DRAPlugin/NodePrepareResources",
+            "/k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin/NodePrepareResources",
             request_serializer=d.NodePrepareResourcesRequest.SerializeToString,
             response_deserializer=d.NodePrepareResourcesResponse.FromString,
         )
         self._unprepare = self._channel.unary_unary(
-            "/tpu.dra.v1beta1.DRAPlugin/NodeUnprepareResources",
+            "/k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin/NodeUnprepareResources",
             request_serializer=d.NodeUnprepareResourcesRequest.SerializeToString,
             response_deserializer=d.NodeUnprepareResourcesResponse.FromString,
         )
@@ -242,12 +242,12 @@ class RegistrationClient:
         self._channel = grpc.insecure_channel(f"unix:{socket_path}")
         r = pb2("registration")
         self._get_info = self._channel.unary_unary(
-            "/tpu.pluginregistration.v1.Registration/GetInfo",
+            "/pluginregistration.Registration/GetInfo",
             request_serializer=r.InfoRequest.SerializeToString,
             response_deserializer=r.PluginInfo.FromString,
         )
         self._notify = self._channel.unary_unary(
-            "/tpu.pluginregistration.v1.Registration/NotifyRegistrationStatus",
+            "/pluginregistration.Registration/NotifyRegistrationStatus",
             request_serializer=r.RegistrationStatus.SerializeToString,
             response_deserializer=r.RegistrationStatusResponse.FromString,
         )

@@ -31,6 +31,17 @@ class DeviceConfigState:
 
     strategy: str = "Exclusive"
     env: dict[str, str] = field(default_factory=dict)
+    # Disjoint per-consumer env slots (SpatialPartition): device name → env
+    # overriding the group env in that device's CDI entry, so a 2-container
+    # claim over 4 chips yields disjoint TPU_VISIBLE_DEVICES per container
+    # (the MPS per-client division, sharing.go:346-366).
+    per_device_env: dict[str, dict[str, str]] = field(default_factory=dict)
+    # (host, container) bind mounts the sharing strategy needs in consumer
+    # containers — the topology-daemon socket dir; the reference's MPS
+    # equivalent bind-mounts pipe/shm dirs (sharing.go:346-366).  Stored as
+    # 2-lists, not tuples: this struct round-trips through the JSON
+    # checkpoint.
+    mounts: list[list[str]] = field(default_factory=list)
     daemon_name: str = ""  # SpatialPartition topology-daemon Deployment name
     daemon_namespace: str = ""
 

@@ -5,22 +5,26 @@ Mirror of cmd/nvidia-dra-plugin/sharing.go (442 LoC), re-imagined for TPU:
 * ``TimeSlicingManager`` — the reference shells out to nvidia-smi to set a
   preemptive compute-policy timeslice (nvlib.go:521-539).  libtpu has no
   preemptive timeslicing (SURVEY.md §2.10), so the TPU realization is
-  cooperative: the claim's containers get queue-quantum env consumed by the
-  per-host topology daemon, and exclusivity is dropped so several containers
-  can open the chip.
+  cooperative: the claim's containers get a queue quantum plus the socket of
+  the per-host ``tpu-topology-daemon`` (host mode, a kubelet-plugin sidecar),
+  which arbitrates the run lease between consumers
+  (plugin/topology_daemon.py).
 * ``SpatialPartitionManager`` — the MPS analog.  Spawns a per-claim topology
   daemon Deployment (template render + API create + readiness poll with the
-  same 1s→10s×4 exponential backoff, sharing.go:185-344) and computes the
-  ``TPU_PROCESS_BOUNDS``-family env that subdivides the claimed chips among
-  consumer containers, plus normalized per-chip HBM limits.
+  same 1s→10s×4 exponential backoff, sharing.go:185-344) and computes a real
+  geometric division of the claimed chips: each consumer container gets a
+  DISJOINT ``TPU_VISIBLE_DEVICES`` / ``TPU_PROCESS_COORD`` slot in a process
+  grid derived from actual chip coordinates — the TPU counterpart of MPS
+  dividing SMs/pinned memory among clients (sharing.go:346-366).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import string
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 
 import yaml
@@ -30,11 +34,18 @@ from k8s_dra_driver_tpu.kube import objects
 from k8s_dra_driver_tpu.kube.fakeserver import NotFound
 from k8s_dra_driver_tpu.plugin.cdi import ContainerEdits
 from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevice
+from k8s_dra_driver_tpu.plugin.topology_daemon import (
+    claim_socket_path,
+    host_socket_path,
+)
 
 _TEMPLATE_PATH = Path(__file__).parent.parent.parent / "templates" / "topology-daemon.tmpl.yaml"
 
-# Cooperative scheduler quantum per named interval, milliseconds.
-_QUANTUM_MS = {0: 5, 1: 1, 2: 5, 3: 20}
+# Cooperative scheduler quantum per named interval, milliseconds.  The four
+# named intervals (Default/Short/Medium/Long → levels 0..3) map to four
+# DISTINCT quanta, mirroring the reference's four distinct timeslice values
+# (sharing.go:34-39); round 1 shipped Default==Medium by typo.
+_QUANTUM_MS = {0: 5, 1: 1, 2: 10, 3: 20}
 
 
 class SharingError(RuntimeError):
@@ -51,6 +62,9 @@ def _require_chips(devices: list[AllocatableDevice], strategy: str) -> None:
 
 
 class TimeSlicingManager:
+    def __init__(self, socket_dir: str = "/run/tpu-topology"):
+        self.socket_dir = socket_dir
+
     def apply(
         self, devices: list[AllocatableDevice], config: TimeSlicingConfig
     ) -> ContainerEdits:
@@ -61,7 +75,11 @@ class TimeSlicingManager:
             env={
                 "TPU_SHARING_STRATEGY": "time-slicing",
                 "TPU_QUEUE_QUANTUM_MS": str(_QUANTUM_MS[level]),
-            }
+                # The motor: consumers acquire/release their run lease from
+                # the host-mode daemon (kubelet-plugin sidecar) on this socket.
+                "TPU_TOPOLOGY_DAEMON_SOCKET": host_socket_path(self.socket_dir),
+            },
+            mounts=[(self.socket_dir, self.socket_dir)],
         )
 
 
@@ -71,6 +89,89 @@ class TopologyDaemon:
 
     name: str
     namespace: str
+
+
+@dataclass
+class PartitionPlan:
+    """Geometric division of a claim's chips among its consumer containers.
+
+    One partition per allocated chip device: the allocation result is the
+    per-container binding unit in DRA (a pod container references a request,
+    kubelet hands it that request's CDI ids), so per-result division IS
+    per-container division.
+    """
+
+    # "dx,dy,dz" bounds of the claimed region (the daemon's TPU_PARTITION_SPEC).
+    region_bounds: str
+    # Process grid over the region — common to every consumer.
+    process_bounds: str
+    # device name -> its disjoint env slot.
+    per_device_env: dict[str, dict[str, str]] = field(default_factory=dict)
+    # Partition table handed to the daemon (TPU_PARTITIONS, JSON).
+    partitions: list[dict] = field(default_factory=list)
+
+
+def plan_partitions(
+    devices: list[AllocatableDevice], limits: dict[str, str]
+) -> PartitionPlan:
+    """Derive the division from actual chip coordinates.
+
+    When the claimed chips exactly tile their bounding box the process grid
+    is that box and each consumer's ``TPU_PROCESS_COORD`` is its chip's
+    offset within it; a gappy allocation falls back to a linear 1D grid.
+    Either way every consumer sees exactly ONE chip
+    (``TPU_CHIPS_PER_PROCESS_BOUNDS=1,1,1``) — consistent with the subslice
+    wiring convention (device_state._wiring_env: PROCESS_BOUNDS = process
+    grid, CHIPS_PER_PROCESS_BOUNDS = chips each process sees)."""
+    chips = [(d, d.chip.chip) for d in devices]
+    coords = [c.coords for _, c in chips]
+    origin = tuple(min(c[i] for c in coords) for i in range(3))
+    box = tuple(max(c[i] for c in coords) - origin[i] + 1 for i in range(3))
+    exact = (
+        box[0] * box[1] * box[2] == len(chips)
+        and len(set(coords)) == len(coords)
+    )
+    # Deterministic partition order: by coordinate, z-major (matches the
+    # row-major chip order geometry._local_index uses).
+    chips.sort(key=lambda dc: (dc[1].coords[2], dc[1].coords[1], dc[1].coords[0]))
+    if not exact:
+        box = (len(chips), 1, 1)
+
+    plan = PartitionPlan(
+        region_bounds=",".join(str(b) for b in box),
+        process_bounds=",".join(str(b) for b in box),
+    )
+    for k, (device, chip) in enumerate(chips):
+        if exact:
+            coord = tuple(chip.coords[i] - origin[i] for i in range(3))
+        else:
+            coord = (k, 0, 0)
+        env = {
+            "TPU_VISIBLE_DEVICES": str(chip.index),
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+            "TPU_PROCESS_COORD": ",".join(str(c) for c in coord),
+            "TPU_PARTITION_INDEX": str(k),
+        }
+        limit = limits.get(chip.uuid)
+        if limit:
+            env["TPU_HBM_LIMIT_MIB"] = str(_mib(limit))
+        plan.per_device_env[device.name] = env
+        plan.partitions.append(
+            {
+                "index": k,
+                "device": device.name,
+                "uuid": chip.uuid,
+                "visible_devices": str(chip.index),
+                "process_coord": env["TPU_PROCESS_COORD"],
+                "hbm_limit_mib": _mib(limit) if limit else None,
+            }
+        )
+    return plan
+
+
+def _mib(limit: str) -> int:
+    """'4096Mi' (HbmLimits.normalize output) → 4096."""
+    return int(limit[:-2]) if limit.endswith("Mi") else int(limit)
 
 
 class SpatialPartitionManager:
@@ -105,10 +206,11 @@ class SpatialPartitionManager:
         claim_uid: str,
         devices: list[AllocatableDevice],
         config: SpatialPartitionConfig,
-    ) -> tuple[ContainerEdits, TopologyDaemon]:
+    ) -> tuple[ContainerEdits, TopologyDaemon, dict[str, dict[str, str]]]:
         _require_chips(devices, "SpatialPartition")
         uuids = [u for d in devices for u in d.uuids()]
         limits = config.normalized_limits(uuids)
+        plan = plan_partitions(devices, limits)
 
         name = self.daemon_name(claim_uid, uuids)
         rendered = string.Template(_TEMPLATE_PATH.read_text()).substitute(
@@ -118,7 +220,8 @@ class SpatialPartitionManager:
             NODE_NAME=self.node_name,
             DAEMON_IMAGE=self.daemon_image,
             SOCKET_DIR=self.socket_dir,
-            PARTITION_SPEC=self._partition_spec(devices, config),
+            PARTITION_SPEC=plan.region_bounds,
+            PARTITIONS=json.dumps(plan.partitions),
             HBM_LIMITS=",".join(f"{k}={v}" for k, v in sorted(limits.items())),
         )
         deployment = objects.from_json(yaml.safe_load(rendered))
@@ -140,8 +243,8 @@ class SpatialPartitionManager:
         edits = ContainerEdits(
             env={
                 "TPU_SHARING_STRATEGY": "spatial-partition",
-                "TPU_PROCESS_BOUNDS": self._partition_spec(devices, config),
-                "TPU_TOPOLOGY_DAEMON_SOCKET": f"{self.socket_dir}/{claim_uid}.sock",
+                "TPU_PROCESS_BOUNDS": plan.process_bounds,
+                "TPU_TOPOLOGY_DAEMON_SOCKET": claim_socket_path(self.socket_dir, claim_uid),
                 "TPU_CORE_FRACTION": str(config.default_core_fraction or 100),
                 **(
                     {"TPU_HBM_LIMITS": ",".join(f"{k}={v}" for k, v in sorted(limits.items()))}
@@ -151,7 +254,7 @@ class SpatialPartitionManager:
             },
             mounts=[(self.socket_dir, self.socket_dir)],
         )
-        return edits, TopologyDaemon(name=name, namespace=self.namespace)
+        return edits, TopologyDaemon(name=name, namespace=self.namespace), plan.per_device_env
 
     def assert_ready(self, name: str) -> None:
         """Poll the daemon Deployment's availability with exponential backoff
@@ -177,14 +280,6 @@ class SpatialPartitionManager:
             self._server.delete(objects.Deployment.KIND, daemon.name, daemon.namespace)
         except NotFound:
             pass
-
-    # -- internals ---------------------------------------------------------
-
-    def _partition_spec(
-        self, devices: list[AllocatableDevice], config: SpatialPartitionConfig
-    ) -> str:
-        """1D split of the claimed chips among consumers: 'N,1,1' bounds."""
-        return f"{len(devices)},1,1"
 
 
 def _deployment_ready(dep) -> bool:

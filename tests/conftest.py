@@ -1,14 +1,20 @@
 """Test bootstrap.
 
 Sharding tests run on a virtual 8-device CPU mesh: the XLA flag must be set
-before the first jax import.  On hosts where a TPU plugin still wins the
-default-backend race, tests explicitly ask for ``jax.devices("cpu")``.
-"""
+before the first jax import.  ``JAX_PLATFORMS=cpu`` is FORCED (not
+defaulted): the harness env pre-sets ``JAX_PLATFORMS=axon``, and when that
+accelerator tunnel is down jax backend init blocks forever — a setdefault
+here let the whole suite hang instead of running CPU-only (observed round
+2).  No test needs a real device; the bench owns the live-chip path."""
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from k8s_dra_driver_tpu.e2e.dryrun import force_cpu
+
+# force_cpu (not just env edits): the harness sitecustomize imports jax at
+# interpreter start, freezing JAX_PLATFORMS=axon into jax's config — the
+# live config must be rewritten too or backends() still dials the tunnel.
+force_cpu(n_devices=8)
 os.environ.setdefault("TPUINFO_FAKE_TOPOLOGY", "v5e-16")
 
 import pytest  # noqa: E402

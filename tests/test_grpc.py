@@ -40,6 +40,32 @@ def served(tmp_path):
 
 
 class TestGRPC:
+    def test_method_paths_match_upstream_kubelet_api(self):
+        """A real kubelet dials the UPSTREAM proto package paths
+        (reference vendor k8s.io/kubelet dra/v1beta1 api.pb.go and
+        pluginregistration/v1 api.pb.go) — custom package names would make
+        every call fail UNIMPLEMENTED on a real cluster while
+        driver-side tests still pass (round-1 advisor finding, high)."""
+        import inspect
+
+        from k8s_dra_driver_tpu.plugin import grpc_service
+
+        src = inspect.getsource(grpc_service)
+        for path in (
+            "/k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin/NodePrepareResources",
+            "/k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin/NodeUnprepareResources",
+            "/pluginregistration.Registration/GetInfo",
+            "/pluginregistration.Registration/NotifyRegistrationStatus",
+        ):
+            assert path in src, f"gRPC method path {path} not served/dialed"
+        # and the generated descriptors carry the upstream packages too
+        from k8s_dra_driver_tpu.plugin.proto.gen import dra_pb2, registration_pb2
+
+        assert (
+            dra_pb2.DESCRIPTOR.package == "k8s.io.kubelet.pkg.apis.dra.v1beta1"
+        )
+        assert registration_pb2.DESCRIPTOR.package == "pluginregistration"
+
     def test_registration_handshake(self, served):
         _, server = served
         client = RegistrationClient(server.registry_socket)
