@@ -82,7 +82,7 @@ class SliceManager:
         self._server = server
         self._lock = threading.Lock()
         self._domains: dict[str, _Domain] = {}
-        self._offsets: dict[str, int] = {}  # domain -> window start
+        self._offsets: dict[str, list[int]] = {}  # domain -> reserved window starts
         self._retry: dict[str, float] = {}  # domain -> earliest retry time
         self._retry_timeout = retry_timeout_s
         self._clock = clock
@@ -173,18 +173,32 @@ class SliceManager:
 
     # -- seat-window assignment (imex.go:319-351) ---------------------------
 
-    def _assign_offset(self, domain: str) -> int:
-        if domain in self._offsets:
-            return self._offsets[domain]
-        used = set(self._offsets.values())
-        for offset in range(0, DRIVER_MEMBERSHIP_LIMIT, MEMBERSHIP_PER_SLICE_LIMIT):
-            if offset not in used:
-                self._offsets[domain] = offset
-                return offset
-        raise TransientError(
-            f"all {DRIVER_MEMBERSHIP_LIMIT // MEMBERSHIP_PER_SLICE_LIMIT} "
-            f"membership windows in use; cannot admit domain {domain!r}"
-        )
+    def _assign_offset(self, domain: str, seats: int = 1) -> int:
+        """Reserve enough 128-seat windows of the 2048-seat global budget to
+        cover ``seats``.  The reference reserves exactly one channel window
+        per IMEX domain (imex.go:319-351); TPU slice domains can exceed one
+        window (>128 hosts), so the reservation scales with
+        ceil(seats/128) — otherwise chunked publication would quietly bust
+        the DRIVER_MEMBERSHIP_LIMIT the window accounting enforces."""
+        needed = max(1, -(-seats // MEMBERSHIP_PER_SLICE_LIMIT))
+        windows = self._offsets.get(domain, [])
+        if len(windows) >= needed:
+            return windows[0]
+        used = {w for ws in self._offsets.values() for w in ws}
+        free = [
+            o
+            for o in range(0, DRIVER_MEMBERSHIP_LIMIT, MEMBERSHIP_PER_SLICE_LIMIT)
+            if o not in used
+        ]
+        grab = needed - len(windows)
+        if len(free) < grab:
+            raise TransientError(
+                f"need {grab} more of {DRIVER_MEMBERSHIP_LIMIT // MEMBERSHIP_PER_SLICE_LIMIT} "
+                f"membership windows ({len(free)} free); cannot admit domain "
+                f"{domain!r} with {seats} seats"
+            )
+        self._offsets[domain] = windows + free[:grab]
+        return self._offsets[domain][0]
 
     # -- pool publication (imex.go:371-416) ---------------------------------
 
@@ -193,14 +207,14 @@ class SliceManager:
         for domain, d in sorted(self._domains.items()):
             if domain in self._retry:
                 continue
-            try:
-                self._assign_offset(domain)
-            except TransientError:
-                self._retry[domain] = self._clock() + self._retry_timeout
-                continue
             host_count = len(d.nodes)
             coordinator = self._coordinator_address(d)
             worker_ids = sorted(set(d.nodes.values()))
+            try:
+                self._assign_offset(domain, seats=len(worker_ids))
+            except TransientError:
+                self._retry[domain] = self._clock() + self._retry_timeout
+                continue
             if len(worker_ids) != len(d.nodes):
                 log.warning(
                     "domain %s: duplicate slice-host-id labels across nodes %s; "
@@ -217,8 +231,16 @@ class SliceManager:
                 ).get_device()
                 for worker_id in worker_ids
             ]
+            # ≤128 devices per Slice: the upstream API server rejects
+            # larger ResourceSlices, which would park the whole pool (the
+            # node driver applies the same split; reference
+            # ResourceSliceImexChannelLimit=128, imex.go:43).
+            chunks = [
+                Slice(devices=devices[i : i + MEMBERSHIP_PER_SLICE_LIMIT])
+                for i in range(0, len(devices), MEMBERSHIP_PER_SLICE_LIMIT)
+            ] or [Slice(devices=[])]
             pools[f"slice-{domain}"] = Pool(
-                slices=[Slice(devices=devices)],
+                slices=chunks,
                 node_selector=NodeSelector(
                     node_selector_terms=[
                         NodeSelectorTerm(

@@ -53,6 +53,10 @@ class Watch:
         self.kind = kind
         self.callback = callback
         self.stopped = False
+        # Last-known object per (namespace, name) — maintained by RESTClient
+        # for reflector Replace semantics (synthesized DELETED after a watch
+        # gap); unused by the in-memory server, which never loses events.
+        self.known: dict = {}
 
     def stop(self) -> None:
         self.stopped = True

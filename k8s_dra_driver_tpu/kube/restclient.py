@@ -220,15 +220,29 @@ class RESTClient:
     def watch(self, kind: str, callback: Callable[[WatchEvent], None]) -> Watch:
         """List + ADDED replay, then stream; reconnects on stream EOF."""
         items, rv = self._list_raw(kind, None, None)
+        # w.known tracks the last-known object per key, for reflector
+        # Replace semantics: after a watch gap we must synthesize DELETED
+        # for objects that vanished during the outage (client-go
+        # DeletedFinalStateUnknown), or consumers like SliceManager keep
+        # publishing seats for dead nodes.
         w = Watch(self, kind, callback)
         self._watches.append(w)
         for obj in items:
-            callback(WatchEvent("ADDED", obj))
+            self._deliver(w, WatchEvent("ADDED", obj))
         thread = threading.Thread(
             target=self._watch_loop, args=(w, kind, rv), daemon=True
         )
         thread.start()
         return w
+
+    @staticmethod
+    def _deliver(w: Watch, event: WatchEvent) -> None:
+        key = (event.object.metadata.namespace, event.object.metadata.name)
+        if event.type == "DELETED":
+            w.known.pop(key, None)
+        else:
+            w.known[key] = event.object
+        w.callback(event)
 
     def _remove_watch(self, w: Watch) -> None:
         if w in self._watches:
@@ -276,7 +290,7 @@ class RESTClient:
                             break
                         obj = objects.from_json(frame["object"])
                         rv = obj.metadata.resource_version or rv
-                        w.callback(WatchEvent(frame["type"], obj))
+                        self._deliver(w, WatchEvent(frame["type"], obj))
             except urllib.error.HTTPError as exc:
                 if w.stopped:
                     return
@@ -293,14 +307,21 @@ class RESTClient:
                 time.sleep(1.0)  # reconnect backoff
 
     def _relist(self, w: Watch, kind: str) -> str:
-        """Reflector recovery: list again and replay everything as ADDED
-        (consumers are level-triggered/idempotent, like client-go informer
-        handlers after a resync)."""
+        """Reflector recovery (client-go Replace semantics): list again,
+        replay current objects as ADDED (consumers are level-triggered/
+        idempotent), then synthesize DELETED — with the last-known object —
+        for everything that vanished during the watch outage."""
         items, rv = self._list_raw(kind, None, None)
+        fresh = {(o.metadata.namespace, o.metadata.name) for o in items}
+        vanished = [obj for key, obj in list(w.known.items()) if key not in fresh]
         for obj in items:
             if w.stopped:
-                break
-            w.callback(WatchEvent("ADDED", obj))
+                return rv
+            self._deliver(w, WatchEvent("ADDED", obj))
+        for obj in vanished:
+            if w.stopped:
+                return rv
+            self._deliver(w, WatchEvent("DELETED", obj))
         return rv
 
     def _make_request(self, method: str, url: str, body: Optional[dict] = None):

@@ -136,6 +136,84 @@ def matmul_tflops(
     return chain * 2 * size**3 / (total - rtt) / 1e12
 
 
+def attention_speedup(
+    device=None,
+    batch: int = 4,
+    heads: int = 8,
+    seq: int = 2048,
+    d: int = 128,
+    dtype=jnp.bfloat16,
+    chain: int = 8,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> dict:
+    """Fused pallas flash attention vs XLA dense attention, forward pass.
+
+    Same measurement discipline as ``matmul_tflops``: ``chain`` calls in ONE
+    jit ending in a scalar host readback, dispatch RTT subtracted — naive
+    per-call timing through a tunneled device reads garbage.
+    """
+    import functools
+
+    from k8s_dra_driver_tpu.ops.flash_attention import flash_attention
+
+    if device is None:
+        device = jax.devices()[0]
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (batch, seq, heads, d)
+    q, k, v = (
+        jax.device_put(jax.random.normal(kk, shape, dtype) / math.sqrt(d), device)
+        for kk in keys
+    )
+
+    def dense(q, k, v):
+        scale = 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def timed_ms(attn) -> float:
+        @jax.jit
+        def f(q0):
+            def body(y, _):
+                return attn(y, k, v), None
+
+            y, _ = jax.lax.scan(body, q0, None, length=chain)
+            return jnp.sum(y).astype(jnp.float32)
+
+        float(f(q))  # compile + sync
+        start = time.perf_counter()
+        float(f(q))
+        total = time.perf_counter() - start
+        rtt = dispatch_rtt_seconds(device)
+        if total <= 1.5 * rtt:
+            raise RuntimeError(
+                f"attention timing dominated by dispatch RTT "
+                f"({total*1e3:.1f}ms vs {rtt*1e3:.1f}ms); raise `chain`"
+            )
+        return (total - rtt) / chain * 1e3
+
+    flash_ms = round(
+        timed_ms(
+            functools.partial(
+                flash_attention, block_q=block_q, block_k=block_k, interpret=interpret
+            )
+        ),
+        3,
+    )
+    dense_ms = round(timed_ms(dense), 3)
+    return {
+        "flash_ms": flash_ms,
+        "dense_ms": dense_ms,
+        # derived from the rounded values so the dict is self-consistent
+        "speedup": round(dense_ms / flash_ms, 2),
+        "shape": f"b{batch} h{heads} s{seq} d{d}",
+    }
+
+
 def ring_latency_us(mesh: Mesh, axis: str = "model", iters: int = 50) -> float:
     """One-hop ppermute latency around the ring — the ICI hop probe."""
     n = mesh.shape[axis]

@@ -249,7 +249,12 @@ def _eval(node, env):
     if op == "!":
         return not _truthy(_eval(node[1], env))
     if op == "neg":
-        return -_eval(node[1], env)
+        try:
+            return -_eval(node[1], env)
+        except TypeError as exc:
+            # Same CELError conversion the binary arithmetic ops get: a
+            # type mismatch is a non-matching selector, not a crash.
+            raise CELError(f"cannot negate: {exc}") from exc
     if op == "||":
         return _truthy(_eval(node[1], env)) or _truthy(_eval(node[2], env))
     if op == "&&":
@@ -268,7 +273,10 @@ def _eval(node, env):
     if op == "in":
         item = _eval(node[1], env)
         container = _eval(node[2], env)
-        return item in container
+        try:
+            return item in container
+        except TypeError as exc:
+            raise CELError(f"'in' needs a list/map/string container: {exc}") from exc
     left = _eval(node[1], env)
     right = _eval(node[2], env)
     if op == "==":

@@ -75,6 +75,10 @@ def test_eval(expr, expected):
         "quantity('bananas')",  # malformed quantity
         "'abc'.contains()",  # method arity
         "'abc'.startsWith('a', 'b')",  # method arity
+        # neg / in must raise CELError, not a raw TypeError that escapes
+        # the allocator's non-matching-selector handling (advisor, round 1)
+        "-device.attributes['tpu.google.com'].type == 1",  # negate a string
+        "1 in 5",  # unsized container
     ],
 )
 def test_errors(expr):

@@ -63,6 +63,53 @@ class TestSliceManager:
         assert all(d.basic.attributes["hostCount"].value == 4 for d in devices)
         mgr.stop()
 
+    def test_large_domain_chunks_into_128_device_slices(self):
+        """>128 hosts in a domain must split across several ResourceSlices:
+        the upstream API server rejects slices over 128 devices, which
+        would park the whole pool (advisor, round 1; reference splits the
+        same way, imex.go:43)."""
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        n = MEMBERSHIP_PER_SLICE_LIMIT + 7  # 135 hosts
+        for hid in range(n):
+            add_node(server, f"h{hid}", domain="big", host_id=hid)
+        slices = membership_slices(server)
+        assert len(slices) == 2
+        sizes = sorted(len(s.spec.devices) for s in slices)
+        assert sizes == [7, MEMBERSHIP_PER_SLICE_LIMIT]
+        assert all(len(s.spec.devices) <= MEMBERSHIP_PER_SLICE_LIMIT for s in slices)
+        # every worker id is published exactly once across the chunks
+        ids = sorted(
+            d.basic.attributes["workerId"].value
+            for s in slices
+            for d in s.spec.devices
+        )
+        assert ids == list(range(n))
+        assert all(
+            s.spec.pool.resource_slice_count == 2 for s in slices
+        )
+        mgr.stop()
+
+    def test_large_domain_reserves_windows_proportional_to_seats(self):
+        """A 135-seat domain must charge ceil(135/128)=2 windows against the
+        2048-seat global budget — chunked publication must not let big
+        domains bust the cap the window accounting enforces."""
+        server = InMemoryAPIServer()
+        mgr = SliceManager(server)
+        mgr.start()
+        for hid in range(MEMBERSHIP_PER_SLICE_LIMIT + 7):
+            add_node(server, f"big{hid}", domain="big", host_id=hid)
+        assert len(mgr._offsets["big"]) == 2
+        # 14 windows remain: 14 singleton domains are admitted, the 15th parks
+        for i in range(14):
+            add_node(server, f"s{i}", domain=f"small{i}", host_id=0)
+        add_node(server, "sx", domain="overflow", host_id=0)
+        names = {s.spec.pool.name for s in membership_slices(server)}
+        assert "slice-small13" in names
+        assert "slice-overflow" not in names
+        mgr.stop()
+
     def test_domain_disappears_with_last_node(self):
         server = InMemoryAPIServer()
         mgr = SliceManager(server)

@@ -173,3 +173,18 @@ class TestFlashAttentionVJP:
 
         g = step(q, k, v)
         assert jnp.all(jnp.isfinite(g))
+
+
+class TestAttentionSpeedupBench:
+    def test_speedup_probe_runs_on_cpu_interpret(self):
+        """The bench's flash-vs-dense probe (collectives.attention_speedup)
+        must execute and return well-formed numbers; speed itself is only
+        meaningful on the real chip."""
+        from k8s_dra_driver_tpu.ops.collectives import attention_speedup
+
+        out = attention_speedup(
+            batch=1, heads=1, seq=128, d=64, chain=2,
+            block_q=64, block_k=64, interpret=True,
+        )
+        assert out["flash_ms"] > 0 and out["dense_ms"] > 0
+        assert out["speedup"] == round(out["dense_ms"] / out["flash_ms"], 2)

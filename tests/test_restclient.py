@@ -136,6 +136,29 @@ class TestWatchRecovery:
     def test_probe(self, client):
         assert client.probe()["major"] == "1"
 
+    def test_relist_synthesizes_deleted_for_vanished_objects(self, api, client):
+        """Reflector Replace semantics: objects removed during a watch
+        outage must surface as DELETED on recovery, or consumers like
+        SliceManager keep stale membership seats forever (round-1 advisor
+        finding, medium)."""
+        from k8s_dra_driver_tpu.kube.fakeserver import Watch, WatchEvent
+
+        events = []
+        w = Watch(api.server, "Node", lambda e: events.append((e.type, e.object.metadata.name)))
+        client.create(Node(metadata=ObjectMeta(name="stale")))
+        client.create(Node(metadata=ObjectMeta(name="kept")))
+        for obj in client.list("Node"):  # delivered before the gap
+            client._deliver(w, WatchEvent("ADDED", obj))
+        client.delete("Node", "stale")  # vanishes during the outage
+        client._relist(w, "Node")
+        deleted = [n for t, n in events if t == "DELETED"]
+        assert deleted == ["stale"]  # synthesized; survivor not deleted
+        added = [n for t, n in events if t == "ADDED"]
+        assert added.count("kept") == 2  # level-triggered replay
+        # a second relist is stable: nothing further vanished
+        client._relist(w, "Node")
+        assert [n for t, n in events if t == "DELETED"] == ["stale"]
+
     def test_error_frame_triggers_relist(self, api, client):
         # An ERROR frame (expired rv) must not kill the watch thread: the
         # client re-lists and keeps streaming.
