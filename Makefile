@@ -33,8 +33,11 @@ demo:
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PYTHON) __graft_entry__.py
 
+# Static analysis (the reference's golangci-lint slot, .golangci.yaml:2-12):
+# syntax via compileall + the first-party AST linter (tools/lint.py).
 lint:
-	$(PYTHON) -m compileall -q k8s_dra_driver_tpu tests
+	$(PYTHON) -m compileall -q k8s_dra_driver_tpu tests tools bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py k8s_dra_driver_tpu tests bench.py __graft_entry__.py tools/lint.py
 
 clean:
 	$(MAKE) -C $(CPP_DIR) clean

@@ -1,13 +1,32 @@
 #!/usr/bin/env bash
-# Create a kind cluster wired for DRA + CDI, with the fake TPU topology so
-# the full driver stack runs with zero TPU hardware (the reference needs real
-# GPUs injected into the kind worker — demo/clusters/kind/scripts/
-# kind-cluster-config.yaml:56-63; our fake libtpuinfo backend removes that
-# requirement entirely).
-set -euo pipefail
+# Create a MULTI-NODE kind cluster wired for DRA + CDI, with the fake TPU
+# topology so the full driver stack — including the multi-host slice
+# controller — runs with zero TPU hardware.  Each kind worker impersonates
+# one host of a ${FAKE_TOPOLOGY} slice via node labels:
+#
+#   tpu.google.com/fake-topology   what the worker's plugin enumerates
+#   tpu.google.com/fake-host-id    which host block of the slice it owns
+#   tpu.google.com/slice-domain    groups workers into one logical slice
+#   tpu.google.com/slice-host-id   the worker id the controller publishes
+#
+# (The reference needs real GPUs injected into the kind worker and nvkind
+# params masking for per-node subsets — demo/clusters/kind/scripts/
+# kind-cluster-config.yaml:56-63, values.yaml:41-48; the fake libtpuinfo
+# backend plus label-driven knobs replace both.)
+source "$(dirname "${BASH_SOURCE[0]}")/scripts/common.sh"
 
-CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
-FAKE_TOPOLOGY="${FAKE_TOPOLOGY:-v5e-16}"
+workers() {
+  for ((i = 0; i < NUM_WORKERS; i++)); do
+    cat <<EOF
+  - role: worker
+    labels:
+      tpu.google.com/fake-topology: "${FAKE_TOPOLOGY}"
+      tpu.google.com/fake-host-id: "${i}"
+      tpu.google.com/slice-domain: "${SLICE_DOMAIN}"
+      tpu.google.com/slice-host-id: "${i}"
+EOF
+  done
+}
 
 cat <<EOF | kind create cluster --name "${CLUSTER_NAME}" --config=-
 kind: Cluster
@@ -26,10 +45,12 @@ nodes:
         apiServer:
           extraArgs:
             runtime-config: "resource.k8s.io/v1beta1=true"
-  - role: worker
-    labels:
-      tpu.google.com/fake-topology: "${FAKE_TOPOLOGY}"
+$(workers)
 EOF
 
-echo "cluster ${CLUSTER_NAME} ready; install the driver with:"
-echo "  helm install tpu-dra-driver deployments/helm/tpu-dra-driver --set fakeTopology=${FAKE_TOPOLOGY}"
+echo "cluster ${CLUSTER_NAME} ready (${NUM_WORKERS} fake ${FAKE_TOPOLOGY} hosts)."
+echo "next:"
+echo "  demo/clusters/kind/scripts/build-driver-image.sh"
+echo "  demo/clusters/kind/scripts/load-driver-image-into-kind.sh"
+echo "  demo/clusters/kind/scripts/install-dra-driver.sh"
+echo "  kubectl apply -f demo/specs/quickstart/tpu-test1.yaml"

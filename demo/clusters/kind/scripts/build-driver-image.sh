@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Build the driver image (reference scripts/build-driver-image.sh analog).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+docker build \
+  -t "${DRIVER_IMAGE}:${DRIVER_IMAGE_TAG}" \
+  -f "${REPO_ROOT}/deployments/container/Dockerfile" \
+  "${REPO_ROOT}"
+
+echo "built ${DRIVER_IMAGE}:${DRIVER_IMAGE_TAG}"

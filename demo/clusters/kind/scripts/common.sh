@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Shared settings for the kind demo harness (reference
+# demo/clusters/kind/scripts/common.sh analog).
+set -euo pipefail
+
+: "${CLUSTER_NAME:=tpu-dra-driver-cluster}"
+: "${DRIVER_IMAGE:=tpu-dra-driver}"
+: "${DRIVER_IMAGE_TAG:=v0.1.0}"
+# Per-worker fake topology: each kind worker impersonates one host of this
+# multi-host slice (v5e-16 = 4 hosts x 4 chips).
+: "${FAKE_TOPOLOGY:=v5e-16}"
+# Workers in the cluster == fake hosts of the slice.  slice-test1.yaml runs
+# 4 replicas with pod anti-affinity, so 4 workers exercise the full flow.
+: "${NUM_WORKERS:=2}"
+: "${SLICE_DOMAIN:=${FAKE_TOPOLOGY}-demo}"
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../../.." && pwd)"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Tear the demo cluster down (reference scripts/delete-kind-cluster.sh).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+kind delete cluster --name "${CLUSTER_NAME}"

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Install the driver via helm (reference nvkind install-dra-driver.sh
+# analog).  Per-node fake topology/host-id come from node LABELS (set by
+# create-cluster.sh), so no per-node values overrides are needed — the
+# plugin falls back to its node's tpu.google.com/fake-{topology,host-id}
+# labels when the env knobs are unset.
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+helm upgrade --install tpu-dra-driver \
+  "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+  --namespace tpu-dra-driver --create-namespace \
+  --set image.repository="${DRIVER_IMAGE}" \
+  --set image.tag="${DRIVER_IMAGE_TAG}" \
+  --set image.pullPolicy=Never \
+  "$@"
+
+kubectl -n tpu-dra-driver rollout status daemonset/tpu-dra-driver-kubelet-plugin --timeout=180s
+kubectl -n tpu-dra-driver rollout status deployment/tpu-dra-driver-controller --timeout=180s || true
+kubectl get resourceslices

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Load the locally built driver image into every kind node (reference
+# scripts/load-driver-image-into-kind.sh analog).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+kind load docker-image \
+  --name "${CLUSTER_NAME}" \
+  "${DRIVER_IMAGE}:${DRIVER_IMAGE_TAG}"

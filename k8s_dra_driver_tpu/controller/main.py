@@ -12,7 +12,6 @@ import os
 import signal
 import sys
 import threading
-import time
 
 from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
 from k8s_dra_driver_tpu.e2e.harness import install_device_classes

@@ -183,6 +183,10 @@ class SliceManager:
         needed = max(1, -(-seats // MEMBERSHIP_PER_SLICE_LIMIT))
         windows = self._offsets.get(domain, [])
         if len(windows) >= needed:
+            if len(windows) > needed:
+                # Shrink with the domain: a scaled-down domain must return
+                # budget, or stranded reservations starve other domains.
+                self._offsets[domain] = windows[:needed]
             return windows[0]
         used = {w for ws in self._offsets.values() for w in ws}
         free = [

@@ -20,7 +20,7 @@ from k8s_dra_driver_tpu.kube.objects import (
     ResourceClaim,
     ResourceClaimSpec,
 )
-from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
 from k8s_dra_driver_tpu.scheduler.allocator import Allocator
 
 TPU_CLASS = "tpu.google.com"

@@ -20,7 +20,6 @@ HBM, loss in f32 for stability.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from dataclasses import dataclass
 

@@ -72,6 +72,9 @@ class DeviceStateConfig:
     libtpu_path: str = "/lib/libtpu.so"
     topology_env: dict[str, str] = field(default_factory=dict)
     socket_dir: str = "/run/tpu-topology"
+    # tpu-parted applied-state file (out-of-band subslice-layout
+    # partitioning, plugin/parted.py); empty = publish all shapes.
+    parted_state_path: str = ""
     # Readiness backoff overrides for tests.
     daemon_backoff_initial: float = 1.0
     daemon_backoff_steps: int = 4
@@ -83,7 +86,8 @@ class DeviceState:
         self._server = server
         self.config = config
         self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
-        self.allocatable = AllocatableDevices.from_topology(self.topology)
+        self._layout = self._load_layout()
+        self.allocatable = AllocatableDevices.from_topology(self.topology, self._layout)
         # Resolve libtpu under the chroot-like driver root when one is
         # mounted (root.go:25-109 pattern); fall back to the configured path.
         libtpu_path = config.libtpu_path
@@ -206,22 +210,46 @@ class DeviceState:
             return list(self.prepared)
 
     def refresh(self) -> bool:
-        """Re-enumerate the hardware; True when the inventory changed
-        (chip died/recovered, topology env changed).  On change the base CDI
-        spec is rewritten so future claims see current truth.
+        """Re-enumerate the hardware AND re-read the tpu-parted layout; True
+        when the inventory changed (chip died/recovered, topology env
+        changed, layout re-applied).  On change the base CDI spec is
+        rewritten so future claims see current truth — this is the LIVE
+        repartitioning path the reference never shipped (its dynamic MIG
+        create/delete is commented out, nvlib.go:560-669).
 
         Enumeration runs OUTSIDE the state lock: sysfs reads on dying
         hardware can block for seconds, and holding the lock would freeze
         NodePrepareResources for the duration (the sweep exists precisely
         for sick nodes)."""
         new_topology = enumerate_topology(env=self.config.topology_env or None)
+        new_layout = self._load_layout()
         with self._lock:
-            if new_topology == self.topology:
+            if new_topology == self.topology and new_layout == self._layout:
                 return False
             self.topology = new_topology
-            self.allocatable = AllocatableDevices.from_topology(new_topology)
+            self._layout = new_layout
+            self.allocatable = AllocatableDevices.from_topology(new_topology, new_layout)
             self.cdi.create_base_spec(self.allocatable)
             return True
+
+    def _load_layout(self):
+        """This host's applied subslice layout; a corrupt state file keeps
+        everything published (never brick enumeration on a bad push)."""
+        from k8s_dra_driver_tpu.plugin import parted
+
+        if not self.config.parted_state_path:
+            return parted.ALL_SHAPES
+        try:
+            return parted.load_applied_layout(
+                self.config.parted_state_path, int(self.topology.host_id)
+            )
+        except parted.PartedError:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "ignoring corrupt tpu-parted state at %s", self.config.parted_state_path
+            )
+            return parted.ALL_SHAPES
 
     # ------------------------------------------------------------------
     # internals

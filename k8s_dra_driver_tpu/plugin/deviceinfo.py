@@ -216,7 +216,10 @@ class AllocatableDevices:
     devices: dict[str, AllocatableDevice] = field(default_factory=dict)
 
     @staticmethod
-    def from_topology(topology: TopologyInfo) -> "AllocatableDevices":
+    def from_topology(topology: TopologyInfo, layout=None) -> "AllocatableDevices":
+        """``layout`` (plugin.parted.SubsliceLayout) restricts which subslice
+        shapes publish — the out-of-band tpu-parted partitioning; chips
+        always publish."""
         from k8s_dra_driver_tpu.plugin.geometry import enumerate_subslices
 
         out: dict[str, AllocatableDevice] = {}
@@ -224,6 +227,8 @@ class AllocatableDevices:
             info = TpuChipInfo(chip, topology, local_pos=pos)
             out[info.name] = AllocatableDevice(chip=info)
         for sub in enumerate_subslices(topology):
+            if layout is not None and not layout.allows(sub.shape_name(topology.ndims)):
+                continue
             info = TpuSubsliceInfo(sub, topology)
             out[info.name] = AllocatableDevice(subslice=info)
         return AllocatableDevices(out)

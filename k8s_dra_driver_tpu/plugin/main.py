@@ -50,10 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--libtpu-path", default=env_default("LIBTPU_PATH", "/lib/libtpu.so"))
     p.add_argument(
         "--fake-topology", default=env_default("TPUINFO_FAKE_TOPOLOGY", ""),
-        help="run against a synthetic topology (e.g. v5e-16) instead of /dev/accel*",
+        help="run against a synthetic topology (e.g. v5e-16) instead of /dev/accel*; "
+        "empty falls back to this node's tpu.google.com/fake-topology label",
     )
     p.add_argument(
-        "--fake-host-id", default=env_default("TPUINFO_FAKE_HOST_ID", "0"))
+        "--fake-host-id", default=env_default("TPUINFO_FAKE_HOST_ID", ""),
+        help="host index within the fake topology; empty falls back to this "
+        "node's tpu.google.com/fake-host-id label, then 0 (per-node labels "
+        "let ONE DaemonSet drive a heterogeneous multi-node fake cluster)",
+    )
     p.add_argument(
         "--fake-cluster", action="store_true",
         default=env_default("FAKE_CLUSTER", "") == "true",
@@ -72,7 +77,41 @@ def build_parser() -> argparse.ArgumentParser:
         default=float(env_default("CLEANUP_INTERVAL_S", "60")),
         help="orphan-cleanup sweep period",
     )
+    p.add_argument(
+        "--parted-state-path",
+        default=env_default("PARTED_STATE_PATH", "/etc/tpu-dra-driver/tpu-parted-state.json"),
+        help="tpu-parted applied-layout file; shapes republish live when it "
+        "changes (mig-parted analog, plugin/parted.py)",
+    )
     return p
+
+
+def _node_labels(server, node_name: str) -> dict[str, str]:
+    """This node's labels, or {} when the Node object is unreadable (the
+    fake-knob fallback must never block startup on real hardware)."""
+    try:
+        node = server.get("Node", node_name)
+        return dict(node.metadata.labels or {})
+    except Exception:
+        return {}
+
+
+def resolve_topology_env(server, node_name, fake_topology, fake_host_id) -> dict[str, str]:
+    """Fake-backend knobs: flag/env first, then this node's labels — so a
+    single DaemonSet drives a multi-node fake cluster where every kind
+    worker carries its own topology/host-id labels (the reference needs
+    nvkind + params masking for per-node device subsets, values.yaml:41-48;
+    our fake backend makes it declarative).  {} = real hardware mode."""
+    if not fake_topology or not fake_host_id:
+        labels = _node_labels(server, node_name)
+        fake_topology = fake_topology or labels.get("tpu.google.com/fake-topology", "")
+        fake_host_id = fake_host_id or labels.get("tpu.google.com/fake-host-id", "0")
+    if not fake_topology:
+        return {}
+    return {
+        "TPUINFO_FAKE_TOPOLOGY": fake_topology,
+        "TPUINFO_FAKE_HOST_ID": fake_host_id,
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -92,12 +131,9 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as exc:
             log.error("cannot reach an API server (%s); use --fake-cluster for demos", exc)
             return 2
-    topology_env = {}
-    if args.fake_topology:
-        topology_env = {
-            "TPUINFO_FAKE_TOPOLOGY": args.fake_topology,
-            "TPUINFO_FAKE_HOST_ID": args.fake_host_id,
-        }
+    topology_env = resolve_topology_env(
+        server, args.node_name, args.fake_topology, args.fake_host_id
+    )
     driver = Driver(
         server,
         DriverConfig(
@@ -108,6 +144,7 @@ def main(argv: list[str] | None = None) -> int:
             driver_root=args.driver_root,
             libtpu_path=args.libtpu_path,
             topology_env=topology_env,
+            parted_state_path=args.parted_state_path,
         ),
     )
     plugin = PluginServer(driver, plugin_dir=args.plugin_path, registry_dir=args.registry_path)

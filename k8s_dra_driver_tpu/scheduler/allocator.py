@@ -25,7 +25,7 @@ exactly what geometry encoding the driver relies on:
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer

@@ -7,7 +7,6 @@ never co-allocated (the memorySlice%d analog)."""
 import pytest
 
 from k8s_dra_driver_tpu import DRIVER_NAME
-from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
 from k8s_dra_driver_tpu.kube.objects import (
     CELDeviceSelector,
     DeviceClaim,

@@ -13,7 +13,7 @@ import pytest
 
 from k8s_dra_driver_tpu import DRIVER_NAME
 from k8s_dra_driver_tpu.e2e.harness import make_cluster
-from k8s_dra_driver_tpu.e2e.spec_runner import SpecError, apply_spec
+from k8s_dra_driver_tpu.e2e.spec_runner import SpecError
 from k8s_dra_driver_tpu.kube import serde
 from k8s_dra_driver_tpu.kube.objects import ObjectMeta, ResourceClaim, ResourceClaimSpec
 

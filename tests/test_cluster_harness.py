@@ -1,0 +1,105 @@
+"""Multi-node kind harness: generated cluster config + per-node fake knobs.
+
+The reference's multi-node story needs nvkind + params masking
+(values.yaml:41-48); ours is label-driven.  These tests run
+create-cluster.sh against a stub `kind` binary that captures the generated
+config, and pin the plugin's label-fallback knob resolution."""
+
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+import yaml
+
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import Node, ObjectMeta
+from k8s_dra_driver_tpu.plugin.main import resolve_topology_env
+
+REPO = Path(__file__).parent.parent
+
+
+class TestCreateClusterScript:
+    def generate_config(self, tmp_path, env):
+        """Run create-cluster.sh with a stub `kind` that captures stdin."""
+        captured = tmp_path / "config.yaml"
+        stub = tmp_path / "kind"
+        stub.write_text(f"#!/bin/sh\ncat > {captured}\n")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        subprocess.run(
+            [str(REPO / "demo/clusters/kind/create-cluster.sh")],
+            env={
+                **os.environ,
+                "PATH": f"{tmp_path}:{os.environ['PATH']}",
+                **env,
+            },
+            check=True,
+            capture_output=True,
+        )
+        return yaml.safe_load(captured.read_text())
+
+    def test_generates_n_labeled_workers(self, tmp_path):
+        cfg = self.generate_config(
+            tmp_path, {"NUM_WORKERS": "4", "FAKE_TOPOLOGY": "v5e-16"}
+        )
+        assert cfg["kind"] == "Cluster"
+        assert cfg["featureGates"]["DynamicResourceAllocation"] is True
+        roles = [n["role"] for n in cfg["nodes"]]
+        assert roles == ["control-plane"] + ["worker"] * 4
+        for i, worker in enumerate(cfg["nodes"][1:]):
+            labels = worker["labels"]
+            assert labels["tpu.google.com/fake-topology"] == "v5e-16"
+            assert labels["tpu.google.com/fake-host-id"] == str(i)
+            assert labels["tpu.google.com/slice-domain"] == "v5e-16-demo"
+            assert labels["tpu.google.com/slice-host-id"] == str(i)
+        # CDI must be enabled for kubelet->containerd device injection
+        assert "enable_cdi = true" in cfg["containerdConfigPatches"][0]
+
+    def test_install_script_exists_and_parses(self):
+        for script in (
+            "scripts/common.sh",
+            "scripts/build-driver-image.sh",
+            "scripts/load-driver-image-into-kind.sh",
+            "scripts/install-dra-driver.sh",
+            "scripts/delete-cluster.sh",
+            "create-cluster.sh",
+        ):
+            path = REPO / "demo/clusters/kind" / script
+            assert path.exists(), script
+            assert os.access(path, os.X_OK) or script.endswith("common.sh"), script
+            subprocess.run(["bash", "-n", str(path)], check=True)
+
+
+class TestFakeKnobResolution:
+    def make_node(self, server, labels):
+        return server.create(
+            Node(metadata=ObjectMeta(name="worker-1", labels=labels))
+        )
+
+    def test_explicit_flags_win(self):
+        server = InMemoryAPIServer()
+        self.make_node(server, {"tpu.google.com/fake-topology": "v5e-32"})
+        env = resolve_topology_env(server, "worker-1", "v4-8", "3")
+        assert env == {"TPUINFO_FAKE_TOPOLOGY": "v4-8", "TPUINFO_FAKE_HOST_ID": "3"}
+
+    def test_labels_fill_missing_knobs(self):
+        server = InMemoryAPIServer()
+        self.make_node(
+            server,
+            {
+                "tpu.google.com/fake-topology": "v5e-16",
+                "tpu.google.com/fake-host-id": "2",
+            },
+        )
+        env = resolve_topology_env(server, "worker-1", "", "")
+        assert env == {"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "2"}
+
+    def test_no_knobs_no_labels_is_real_hardware_mode(self):
+        server = InMemoryAPIServer()
+        self.make_node(server, {})
+        assert resolve_topology_env(server, "worker-1", "", "") == {}
+
+    def test_unreadable_node_defaults_host_zero(self):
+        server = InMemoryAPIServer()  # node object absent entirely
+        env = resolve_topology_env(server, "worker-1", "v5e-16", "")
+        assert env == {"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"}

@@ -89,6 +89,11 @@ class TestSliceManager:
         assert all(
             s.spec.pool.resource_slice_count == 2 for s in slices
         )
+        # Scale-down returns budget: dropping below one window's worth of
+        # seats must release the extra window, not strand it.
+        for hid in range(8, n):
+            server.delete("Node", f"h{hid}")
+        assert len(mgr._offsets["big"]) == 1
         mgr.stop()
 
     def test_large_domain_reserves_windows_proportional_to_seats(self):

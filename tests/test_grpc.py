@@ -3,7 +3,7 @@
 import pytest
 
 from k8s_dra_driver_tpu import DRIVER_NAME
-from k8s_dra_driver_tpu.e2e.harness import TPU_CLASS, make_cluster, simple_claim
+from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
 from k8s_dra_driver_tpu.plugin.driver import ClaimRef
 from k8s_dra_driver_tpu.plugin.grpc_service import (
     DRAClient,

@@ -2,10 +2,8 @@
 
 import pytest
 
-from k8s_dra_driver_tpu import DRIVER_NAME
 from k8s_dra_driver_tpu.e2e.harness import (
     SUBSLICE_CLASS,
-    TPU_CLASS,
     make_cluster,
     simple_claim,
 )

@@ -1,0 +1,113 @@
+"""First-party linter (tools/lint.py) — the golangci-lint slot.
+
+Unit-tests each check on synthetic sources, then self-enforces: the repo
+itself must lint clean (reference runs 9 linters on every PR,
+.github/workflows/golang.yaml:27-49)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint  # noqa: E402
+
+
+def findings_for(tmp_path, source):
+    f = tmp_path / "case.py"
+    f.write_text(source)
+    return [x.check for x in lint.check_file(f)]
+
+
+class TestChecks:
+    def test_unused_import_flagged(self, tmp_path):
+        assert findings_for(tmp_path, "import os\nimport sys\nprint(sys.path)\n") == [
+            "unused-import"
+        ]
+
+    def test_used_import_clean(self, tmp_path):
+        assert findings_for(tmp_path, "import os\nprint(os.sep)\n") == []
+
+    def test_string_annotation_counts_as_use(self, tmp_path):
+        src = "import numpy as np\n\ndef f(x: 'np.ndarray'):\n    return x\n"
+        assert findings_for(tmp_path, src) == []
+
+    def test_mutable_default(self, tmp_path):
+        assert findings_for(tmp_path, "def f(x=[]):\n    return x\n") == [
+            "mutable-default"
+        ]
+        assert findings_for(tmp_path, "def f(x=dict()):\n    return x\n") == [
+            "mutable-default"
+        ]
+
+    def test_bare_except(self, tmp_path):
+        src = "try:\n    pass\nexcept:\n    pass\n"
+        assert findings_for(tmp_path, src) == ["bare-except"]
+        src_ok = "try:\n    pass\nexcept Exception:\n    pass\n"
+        assert findings_for(tmp_path, src_ok) == []
+
+    def test_fstring_without_placeholder(self, tmp_path):
+        assert findings_for(tmp_path, "x = f'plain'\n") == ["fstring-no-field"]
+        assert findings_for(tmp_path, "y = 1\nx = f'{y}'\n") == []
+        # implicit concatenation where ANY part has a field is fine
+        assert findings_for(tmp_path, "y = 1\nx = f'a ' f'{y}'\n") == []
+
+    def test_none_compare(self, tmp_path):
+        assert findings_for(tmp_path, "x = 1\nprint(x == None)\n") == ["none-compare"]
+        assert findings_for(tmp_path, "x = 1\nprint(x is None)\n") == []
+
+    def test_duplicate_def_in_class(self, tmp_path):
+        src = "class A:\n    def m(self): pass\n    def m(self): pass\n"
+        assert findings_for(tmp_path, src) == ["duplicate-def"]
+
+    def test_branch_scoped_redefinition_in_function_ok(self, tmp_path):
+        src = (
+            "def outer(flag):\n"
+            "    if flag:\n"
+            "        def inner(): return 1\n"
+            "        return inner\n"
+            "    def inner(): return 2\n"
+            "    return inner\n"
+        )
+        assert findings_for(tmp_path, src) == []
+
+    def test_property_setter_not_flagged(self, tmp_path):
+        src = (
+            "class A:\n"
+            "    @property\n"
+            "    def x(self): return 1\n"
+            "    @x.setter\n"
+            "    def x(self, v): pass\n"
+        )
+        assert findings_for(tmp_path, src) == []
+
+    def test_ignore_pragma(self, tmp_path):
+        src = "import os  # lint: ignore[unused-import]\n"
+        assert findings_for(tmp_path, src) == []
+
+    def test_skip_file_pragma(self, tmp_path):
+        src = "# lint: skip-file\nimport os\n"
+        assert findings_for(tmp_path, src) == []
+
+    def test_syntax_error_reported_not_raised(self, tmp_path):
+        assert findings_for(tmp_path, "def broken(:\n") == ["syntax"]
+
+
+class TestMain:
+    def test_missing_target_fails_loudly(self, capsys):
+        rc = lint.main(["lint", "no/such/dir"])
+        assert rc == 2
+        assert "not a directory" in capsys.readouterr().err
+
+
+class TestRepoIsClean:
+    def test_repo_lints_clean(self):
+        targets = [
+            REPO / "k8s_dra_driver_tpu",
+            REPO / "tests",
+            REPO / "bench.py",
+            REPO / "__graft_entry__.py",
+            REPO / "tools" / "lint.py",
+        ]
+        rc = lint.main(["lint", *map(str, targets)])
+        assert rc == 0, "repo has lint findings (see stdout)"

@@ -1,0 +1,164 @@
+"""tpu-parted: out-of-band subslice-layout partitioning (mig-parted analog).
+
+Covers the config contract, the apply CLI, the plugin-side publication
+filter, and the LIVE re-shape through DeviceState.refresh() — the dynamic
+repartitioning path the reference carries only as commented-out code
+(nvlib.go:560-669)."""
+
+import json
+from pathlib import Path
+
+import pytest
+import yaml
+
+from k8s_dra_driver_tpu.plugin import parted
+
+REPO = Path(__file__).parent.parent
+DEMO_CONFIG = REPO / "demo" / "specs" / "quickstart" / "tpu-parted-config.yaml"
+
+
+class TestConfigContract:
+    def test_demo_config_parses(self):
+        layouts = parted.parse_config(yaml.safe_load(DEMO_CONFIG.read_text()))
+        assert {"all-shapes", "whole-host-only", "half-balanced", "chips-only"} <= set(
+            layouts
+        )
+
+    @pytest.mark.parametrize(
+        "doc",
+        [
+            {"version": "v2", "subslice-configs": {"a": [{"hosts": "all", "shapes": "all"}]}},
+            {"version": "v1"},
+            {"version": "v1", "subslice-configs": {}},
+            {"version": "v1", "subslice-configs": {"a": []}},
+            {"version": "v1", "subslice-configs": {"a": [{"hosts": "some", "shapes": "all"}]}},
+            {"version": "v1", "subslice-configs": {"a": [{"hosts": "all", "shapes": 5}]}},
+        ],
+    )
+    def test_invalid_configs_rejected(self, doc):
+        with pytest.raises(parted.PartedError):
+            parted.parse_config(doc)
+
+    def test_per_host_resolution_first_match_wins(self):
+        entries = [
+            {"hosts": [0, 1], "shapes": ["2x2"]},
+            {"hosts": "all", "shapes": []},
+        ]
+        assert parted.resolve_layout("l", entries, 0).allows("2x2")
+        assert not parted.resolve_layout("l", entries, 0).allows("2x1")
+        assert not parted.resolve_layout("l", entries, 3).allows("2x2")
+
+    def test_unmatched_host_keeps_all_shapes(self):
+        entries = [{"hosts": [7], "shapes": []}]
+        assert parted.resolve_layout("l", entries, 0).allows("2x2")
+
+
+class TestApplyCLI:
+    def test_apply_and_export_roundtrip(self, tmp_path, capsys):
+        state = tmp_path / "state.json"
+        rc = parted.main(
+            ["apply", "-f", str(DEMO_CONFIG), "-c", "whole-host-only",
+             f"--state-path={state}"]
+        )
+        assert rc == 0
+        doc = json.loads(state.read_text())
+        assert doc["layout"] == "whole-host-only"
+        rc = parted.main(["export", f"--state-path={state}"])
+        assert rc == 0
+        assert "whole-host-only" in capsys.readouterr().out
+
+    def test_apply_unknown_layout_fails(self, tmp_path):
+        with pytest.raises(parted.PartedError, match="no layout"):
+            parted.apply_config(str(DEMO_CONFIG), "nope", str(tmp_path / "s.json"))
+
+    def test_missing_state_means_all_shapes(self, tmp_path):
+        layout = parted.load_applied_layout(tmp_path / "absent.json", 0)
+        assert layout.allows("2x2") and layout.allows("1x2")
+
+
+class TestPluginPublication:
+    def make_state(self, tmp_path, layout_name):
+        state = tmp_path / "tpu-parted-state.json"
+        parted.apply_config(str(DEMO_CONFIG), layout_name, str(state))
+        return state
+
+    def device_state(self, server, tmp_path, state_path):
+        from k8s_dra_driver_tpu.plugin.device_state import (
+            DeviceState,
+            DeviceStateConfig,
+        )
+
+        return DeviceState(
+            server,
+            DeviceStateConfig(
+                node_name="host0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "cp.json"),
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                    "TPUINFO_FAKE_HOST_ID": "0",
+                },
+                parted_state_path=str(state_path),
+            ),
+        )
+
+    def shapes_published(self, state):
+        return {
+            d.subslice.subslice.shape_name(d.subslice.topology.ndims)
+            for d in state.allocatable
+            if d.subslice is not None
+        }
+
+    def test_layout_filters_published_subslices(self, api_server, tmp_path):
+        state_path = self.make_state(tmp_path, "whole-host-only")
+        ds = self.device_state(api_server, tmp_path, state_path)
+        assert self.shapes_published(ds) == {"2x2"}
+        # chips always publish
+        assert any(d.chip is not None for d in ds.allocatable)
+
+    def test_chips_only_layout(self, api_server, tmp_path):
+        state_path = self.make_state(tmp_path, "chips-only")
+        ds = self.device_state(api_server, tmp_path, state_path)
+        assert self.shapes_published(ds) == set()
+
+    def test_live_reshape_via_refresh(self, api_server, tmp_path):
+        """Re-apply a different layout and the refresh sweep republishes —
+        dynamic repartitioning without a plugin restart."""
+        state_path = self.make_state(tmp_path, "all-shapes")
+        ds = self.device_state(api_server, tmp_path, state_path)
+        assert "2x1" in self.shapes_published(ds)
+        parted.apply_config(str(DEMO_CONFIG), "whole-host-only", str(state_path))
+        assert ds.refresh() is True
+        assert self.shapes_published(ds) == {"2x2"}
+        assert ds.refresh() is False  # stable until the next change
+
+    def test_corrupt_state_publishes_everything(self, api_server, tmp_path):
+        state_path = tmp_path / "tpu-parted-state.json"
+        state_path.write_text("{not json")
+        ds = self.device_state(api_server, tmp_path, state_path)
+        assert "2x2" in self.shapes_published(ds)
+
+    def test_half_balanced_differs_per_host(self, api_server, tmp_path):
+        state_path = self.make_state(tmp_path, "half-balanced")
+        from k8s_dra_driver_tpu.plugin.device_state import (
+            DeviceState,
+            DeviceStateConfig,
+        )
+
+        def for_host(hid):
+            return DeviceState(
+                api_server,
+                DeviceStateConfig(
+                    node_name=f"host{hid}",
+                    cdi_root=str(tmp_path / f"cdi{hid}"),
+                    checkpoint_path=str(tmp_path / f"cp{hid}.json"),
+                    topology_env={
+                        "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                        "TPUINFO_FAKE_HOST_ID": str(hid),
+                    },
+                    parted_state_path=str(state_path),
+                ),
+            )
+
+        assert self.shapes_published(for_host(0)) == {"2x2"}
+        assert self.shapes_published(for_host(2)) == {"2x1", "1x2"}

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""First-party static analysis — the golangci-lint slot in CI.
+
+The reference runs nine linters on every PR (.golangci.yaml:2-12,
+.github/workflows/golang.yaml:27-49); this image bakes no Python linter and
+the build may not install one, so this module implements the checks that
+catch real bugs with near-zero false positives, over ast/tokenize only:
+
+  unused-import      goimports analog: imported name never referenced
+  mutable-default    def f(x=[]) / f(x={}) / f(x=set())
+  bare-except        `except:` swallows KeyboardInterrupt/SystemExit
+  fstring-no-field   f-string without any {placeholder}
+  none-compare       `== None` / `!= None` instead of `is (not) None`
+  nonascii-ident     asciicheck analog: non-ASCII identifiers
+  duplicate-def      same name bound twice by def/class in one scope
+  tab-indent         literal tabs in indentation (gofmt analog)
+
+Suppress a line with ``# lint: ignore[<check>]`` or a whole file with
+``# lint: skip-file`` in its first five lines.
+
+Usage: python tools/lint.py PATH [PATH...]   (exit 1 on findings)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+import tokenize
+from pathlib import Path
+
+IGNORE_RE = re.compile(r"#\s*lint:\s*ignore\[([a-z-]+)\]")
+SKIP_FILE_RE = re.compile(r"#\s*lint:\s*skip-file")
+
+# Names whose import is a side effect or a re-export by convention.
+SIDE_EFFECT_IMPORTS = {"__future__"}
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, check: str, message: str):
+        self.path, self.line, self.check, self.message = path, line, check, message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.check}: {self.message}"
+
+
+def _ignored(source_lines: list[str], line: int, check: str) -> bool:
+    if 1 <= line <= len(source_lines):
+        m = IGNORE_RE.search(source_lines[line - 1])
+        if m and m.group(1) == check:
+            return True
+    return False
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collect imported bindings and every referenced name/attribute root."""
+
+    def __init__(self):
+        self.imports: dict[str, tuple[int, str]] = {}  # bound name -> (line, display)
+        self.used: set[str] = set()
+        self.string_annotations: list[str] = []
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if alias.name in SIDE_EFFECT_IMPORTS:
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            self.imports[bound] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module in SIDE_EFFECT_IMPORTS:
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name
+            self.imports[bound] = (node.lineno, f"{node.module}.{alias.name}")
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        # only the root name matters for import usage
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant):
+        # string annotations / docstring references like "np.ndarray"
+        if isinstance(node.value, str):
+            self.string_annotations.append(node.value)
+
+
+def check_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    lines = source.splitlines()
+    for head in lines[:5]:
+        if SKIP_FILE_RE.search(head):
+            return []
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, "syntax", str(exc.msg))]
+
+    findings: list[Finding] = []
+
+    def add(line: int, check: str, message: str):
+        if not _ignored(lines, line, check):
+            findings.append(Finding(path, line, check, message))
+
+    # ---- unused-import ----------------------------------------------------
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    # names used inside string annotations ("np.ndarray") count as used
+    annotation_blob = " ".join(tracker.string_annotations)
+    is_package_init = path.name == "__init__.py"
+    for bound, (line, display) in tracker.imports.items():
+        if bound in tracker.used:
+            continue
+        if re.search(rf"\b{re.escape(bound)}\b", annotation_blob):
+            continue
+        if is_package_init:
+            continue  # __init__ re-exports are the public surface
+        if bound == "_":
+            continue
+        add(line, "unused-import", f"{display!r} imported but unused")
+
+    # ---- AST-walk checks --------------------------------------------------
+    # (name-set, flag-duplicates?) — duplicates are only flagged at module/
+    # class level: function bodies legitimately redefine names across
+    # early-return branches.
+    scopes: list[tuple[set[str], bool]] = [(set(), True)]
+
+    def walk(node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in [*node.args.defaults, *node.args.kw_defaults]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in {"list", "dict", "set"}
+                    and not default.args
+                    and not default.keywords
+                ):
+                    add(
+                        default.lineno,
+                        "mutable-default",
+                        f"mutable default argument in {node.name}()",
+                    )
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            add(node.lineno, "bare-except", "bare `except:` (catch Exception instead)")
+        if isinstance(node, ast.JoinedStr):
+            # Implicitly concatenated f-strings parse as nested/sibling
+            # JoinedStr parts; only flag when the WHOLE expression has no
+            # placeholder anywhere, and don't recurse (no double reports).
+            if not any(isinstance(n, ast.FormattedValue) for n in ast.walk(node)):
+                add(node.lineno, "fstring-no-field", "f-string without placeholders")
+            return
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (
+                    isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(comp, ast.Constant)
+                    and comp.value is None
+                ):
+                    add(node.lineno, "none-compare", "use `is None` / `is not None`")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            name = node.name
+            if not name.isascii():
+                add(node.lineno, "nonascii-ident", f"non-ASCII identifier {name!r}")
+            scope, flag_dupes = scopes[-1]
+            # decorated redefinitions (@overload, @property/setter) are legit
+            if flag_dupes and name in scope and not node.decorator_list:
+                add(node.lineno, "duplicate-def", f"{name!r} redefined in same scope")
+            scope.add(name)
+            scopes.append((set(), isinstance(node, ast.ClassDef)))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+            scopes.pop()
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(tree)
+
+    # ---- token-level checks ----------------------------------------------
+    try:
+        with tokenize.open(path) as fh:
+            for tok in tokenize.generate_tokens(fh.readline):
+                if tok.type == tokenize.INDENT and "\t" in tok.string:
+                    add(tok.start[0], "tab-indent", "tab in indentation")
+    except (tokenize.TokenError, SyntaxError):
+        pass  # ast.parse above is the authority on syntax findings
+
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    targets: list[Path] = []
+    for arg in argv[1:] or ["k8s_dra_driver_tpu", "tests"]:
+        p = Path(arg)
+        if p.is_dir():
+            targets.extend(sorted(p.rglob("*.py")))
+        elif p.is_file() and p.suffix == ".py":
+            targets.append(p)
+        else:
+            # A vanished/typo'd target must fail loudly, not lint nothing.
+            print(f"lint: target {arg!r} is not a directory or .py file", file=sys.stderr)
+            return 2
+    targets = [t for t in targets if "proto/gen" not in str(t) and "__pycache__" not in str(t)]
+    all_findings: list[Finding] = []
+    for t in targets:
+        all_findings.extend(check_file(t))
+    for f in all_findings:
+        print(f)
+    print(
+        f"lint: {len(targets)} files, {len(all_findings)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
