@@ -76,7 +76,10 @@ def run_dryrun(n_devices: int) -> None:
     shape = auto_mesh_shape(n_devices, want_seq=True)
     mesh = build_mesh(devices, shape)
     cfg = burnin.TINY
-    fns = burnin.build_train_step(cfg, mesh=mesh)
+    # attention="flash" on a seq-sharded mesh = flash RING attention (pallas
+    # kernel per k/v block, lse merge over the ring) — the flagship
+    # long-context path must be what the multi-chip artifact proves.
+    fns = burnin.build_train_step(cfg, mesh=mesh, attention="flash")
     with mesh:
         params, opt_state = fns.init(jax.random.PRNGKey(0))
         tokens = jax.device_put(

@@ -204,7 +204,9 @@ def build_train_step(
     resharding to XLA.
 
     ``attention``: 'dense' (jnp, XLA-fused) or 'flash' (the pallas fused
-    kernel, single-device path only — sharded meshes use ring/ulysses)."""
+    kernel).  Flash composes with every SP scheme: on a seq-sharded mesh it
+    becomes flash RING attention (pallas kernel per k/v block, lse merge
+    across the ring) or the flash inner of Ulysses."""
     valid = ("auto", "ring", "ulysses", "none")
     if sequence_parallel not in valid:
         raise ValueError(f"sequence_parallel must be one of {valid}, got {sequence_parallel!r}")
@@ -215,9 +217,6 @@ def build_train_step(
         )
     if attention not in ("dense", "flash"):
         raise ValueError(f"attention must be 'dense' or 'flash', got {attention!r}")
-    if attention == "flash" and mesh is not None and mesh.shape.get("seq", 1) > 1:
-        raise ValueError("attention='flash' needs an unsharded sequence; "
-                         "seq-sharded meshes use ring/ulysses via sequence_parallel")
     opt = make_optimizer(lr)
     if mesh is None:
         act_spec = None
@@ -242,14 +241,25 @@ def build_train_step(
     scheme = sequence_parallel
     if scheme == "auto":
         scheme = "ring" if mesh.shape.get("seq", 1) > 1 else "none"
+    # interpret follows the MESH's devices (a CPU test mesh may coexist
+    # with a TPU default backend on tunneled hosts)
+    interpret = mesh.devices.flat[0].platform != "tpu"
     attn_fn = None
     if scheme == "ring":
-        from k8s_dra_driver_tpu.ops.ring_attention import ring_attention
+        if attention == "flash":
+            from k8s_dra_driver_tpu.ops.ring_attention import ring_flash_attention
 
-        attn_fn = functools.partial(
-            ring_attention, mesh=mesh, axis_name="seq",
-            batch_axis="data", head_axis="model",
-        )
+            attn_fn = functools.partial(
+                ring_flash_attention, mesh=mesh, axis_name="seq",
+                batch_axis="data", head_axis="model", interpret=interpret,
+            )
+        else:
+            from k8s_dra_driver_tpu.ops.ring_attention import ring_attention
+
+            attn_fn = functools.partial(
+                ring_attention, mesh=mesh, axis_name="seq",
+                batch_axis="data", head_axis="model",
+            )
     elif scheme == "ulysses":
         from k8s_dra_driver_tpu.ops.ring_attention import ulysses_attention
 
@@ -259,22 +269,22 @@ def build_train_step(
                 "shard; use model axis 1 or sequence_parallel='ring'"
             )
         attn_fn = functools.partial(
-            ulysses_attention, mesh=mesh, axis_name="seq", batch_axis="data"
-        )
-    if attention == "flash" and attn_fn is not None:
-        raise ValueError(
-            f"attention='flash' conflicts with sequence_parallel={scheme!r}; "
-            "flash owns attention only when no SP scheme is active"
+            ulysses_attention, mesh=mesh, axis_name="seq", batch_axis="data",
+            use_flash=attention == "flash", interpret=interpret,
         )
     if attention == "flash" and attn_fn is None:
+        if mesh.shape.get("seq", 1) > 1:
+            # scheme == "none" was explicit: the plain sharded flash kernel
+            # would silently all-gather the whole sequence per device.
+            raise ValueError(
+                "attention='flash' with sequence_parallel='none' needs an "
+                "unsharded sequence; use sequence_parallel='ring'/'ulysses' "
+                "(flash composes with both)"
+            )
         from k8s_dra_driver_tpu.ops.flash_attention import sharded_flash_attention
 
         attn_fn = functools.partial(
-            sharded_flash_attention,
-            mesh=mesh,
-            # interpret follows the MESH's devices (a CPU test mesh may
-            # coexist with a TPU default backend on tunneled hosts)
-            interpret=mesh.devices.flat[0].platform != "tpu",
+            sharded_flash_attention, mesh=mesh, interpret=interpret,
         )
     pspecs = param_pspecs(cfg)
     param_shardings = jax.tree.map(

@@ -210,15 +210,18 @@ def _dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _backward_bhsd(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
+def _backward_bhsd(q, k, v, out, lse, dout, causal, block_q, block_k, interpret, delta=None):
     bh, s, d = q.shape
     num_q = s // block_q
     num_k = s // block_k
     scale = 1.0 / math.sqrt(d)
     # D_i = rowsum(dout ∘ out): cheap elementwise reduce, done outside pallas;
     # broadcast over the 128-lane tail to satisfy the TPU block layout.
-    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (bh, s, 128))
+    # Callers that invoke this per k/v block (flash RING backward) pass a
+    # precomputed delta — it depends only on dout/out, not the block.
+    if delta is None:
+        delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+        delta = jnp.broadcast_to(delta[..., None], (bh, s, 128))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0))

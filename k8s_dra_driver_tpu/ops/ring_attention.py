@@ -114,14 +114,200 @@ def ring_attention(
 
 
 # ---------------------------------------------------------------------------
+# Ring attention with the fused pallas flash kernel per block
+# ---------------------------------------------------------------------------
+#
+# The jnp ring kernel above materializes [S_loc, S_loc] block scores on the
+# VPU; this variant runs the MXU-fused flash kernel on every (q, k-block)
+# pair and merges the per-block outputs with their log-sum-exp residuals —
+# the standard two-level online softmax: pallas handles the intra-block
+# accumulation, the ring handles the inter-block merge.  Differentiable: the
+# backward rotates k/v again and calls the pallas backward kernels per block
+# with the GLOBAL lse (p = exp(s - lse) makes per-block grads exact), with
+# dk/dv accumulators riding the rotation home.
+
+
+def _merge_blocks(out_a, lse_a, out_b, lse_b):
+    """Merge two normalized attention partials via their lse (f32)."""
+    lse = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - lse)[..., None]
+    w_b = jnp.exp(lse_b - lse)[..., None]
+    return w_a * out_a + w_b * out_b, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_flash_attention_local(
+    q, k, v, axis_name: str, causal: bool = True,
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+):
+    """Per-shard flash ring attention (call inside shard_map).
+
+    q/k/v: [B, S_local, H, D]; S_local must divide by the block sizes
+    (blocks are clamped to S_local first).
+    """
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret)
+    return out
+
+
+def _bh(x):  # [b, s, h, d] -> [b*h, s, d]
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unbh(x, b, h):  # [b*h, s, d] -> [b, s, h, d]
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    from k8s_dra_driver_tpu.ops.flash_attention import _forward_bhsd
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    block_q = min(block_q, s_loc)
+    block_k = min(block_k, s_loc)
+    if s_loc % block_q or s_loc % block_k:
+        raise ValueError(
+            f"local sequence {s_loc} not divisible by blocks ({block_q},{block_k})"
+        )
+    q_bh = _bh(q)
+
+    def flash(k_blk, v_blk, blk_causal):
+        out, lse = _forward_bhsd(
+            q_bh, _bh(k_blk), _bh(v_blk), blk_causal, block_q, block_k, interpret
+        )
+        return out.astype(jnp.float32), lse[..., 0]  # [bh,s,d], [bh,s]
+
+    # Step 0: the local block (the only one needing the triangular mask).
+    out, lse = flash(k, v, causal)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, out, lse = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        origin = (idx - i) % n
+
+        def merge_in(args):
+            out, lse = args
+            o_i, l_i = flash(k_cur, v_cur, False)
+            return _merge_blocks(out, lse, o_i, l_i)
+
+        if causal:
+            # Blocks from future devices contribute nothing — skip their
+            # FLOPs entirely (the jnp kernel merely masks them).
+            out, lse = jax.lax.cond(origin > idx, lambda a: a, merge_in, (out, lse))
+        else:
+            out, lse = merge_in((out, lse))
+        return (k_cur, v_cur, out, lse), None
+
+    (_, _, out, lse), _ = jax.lax.scan(step, (k, v, out, lse), jnp.arange(1, n))
+    out = _unbh(out, b, h).astype(q.dtype)
+    return out, lse  # lse stays [bh, s] for the backward
+
+
+def _ring_flash_fwd_vjp(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, dout):
+    from k8s_dra_driver_tpu.ops.flash_attention import _backward_bhsd
+
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    bq = min(block_q, s_loc)
+    bk = min(block_k, s_loc)
+    q_bh, out_bh, dout_bh = _bh(q), _bh(out), _bh(dout)
+    lse128 = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+    # delta depends only on dout/out — compute once, not per ring step.
+    delta = jnp.sum(dout_bh.astype(jnp.float32) * out_bh.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    def block_grads(k_blk, v_blk, blk_causal):
+        dq_bh, dk_bh, dv_bh = _backward_bhsd(
+            q_bh, _bh(k_blk), _bh(v_blk), out_bh, lse128, dout_bh,
+            blk_causal, bq, bk, interpret, delta=delta,
+        )
+        return (
+            dq_bh.astype(jnp.float32),
+            _unbh(dk_bh, b, h).astype(jnp.float32),
+            _unbh(dv_bh, b, h).astype(jnp.float32),
+        )
+
+    # Step 0: this device's own block.
+    dq, dk_cur, dv_cur = block_grads(k, v, causal)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        # The k/v block and its gradient accumulators travel together.
+        k_cur, v_cur, dk_cur, dv_cur = (
+            jax.lax.ppermute(x, axis_name, perm) for x in (k_cur, v_cur, dk_cur, dv_cur)
+        )
+        origin = (idx - i) % n
+
+        def contribute(args):
+            dk_cur, dv_cur, dq = args
+            dq_i, dk_i, dv_i = block_grads(k_cur, v_cur, False)
+            return dk_cur + dk_i, dv_cur + dv_i, dq + dq_i
+
+        if causal:
+            dk_cur, dv_cur, dq = jax.lax.cond(
+                origin > idx, lambda a: a, contribute, (dk_cur, dv_cur, dq)
+            )
+        else:
+            dk_cur, dv_cur, dq = contribute((dk_cur, dv_cur, dq))
+        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+
+    (_, _, dk_cur, dv_cur, dq), _ = jax.lax.scan(
+        step, (k, v, dk_cur, dv_cur, dq), jnp.arange(1, n)
+    )
+    # After n-1 rotations the accumulators sit one hop short of home.
+    dk = jax.lax.ppermute(dk_cur, axis_name, perm)
+    dv = jax.lax.ppermute(dv_cur, axis_name, perm)
+    return _unbh(dq, b, h).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_flash_attention_local.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)
+
+
+def ring_flash_attention(
+    q, k, v, mesh: Mesh, axis_name: str = "seq", causal: bool = True,
+    batch_axis: str = "data", head_axis: str | None = "model",
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+):
+    """Sharded flash ring attention: q/k/v [B,S,H,D] with S on ``axis_name``."""
+    spec = P(batch_axis, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ring_flash_attention_local,
+            axis_name=axis_name, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # pallas_call outputs carry no varying-manual-axes metadata yet.
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
 # Ulysses (all-to-all head/sequence resharding)
 # ---------------------------------------------------------------------------
 
 
-def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True):
+def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True, attn_fn=None):
     """Per-shard Ulysses kernel (call inside shard_map).
 
     q/k/v: [B, S_local, H, D] with full heads; requires H % n == 0.
+    ``attn_fn(q, k, v, causal=...)`` is the full-sequence inner attention —
+    defaults to the jnp reference; pass the pallas flash kernel to fuse it.
     """
     n = jax.lax.psum(1, axis_name)
     h = q.shape[2]
@@ -134,19 +320,32 @@ def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True):
     def to_heads(x):  # [b, s, h/n, d] -> [b, s/n, h, d]
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    out = reference_attention(to_seq(q), to_seq(k), to_seq(v), causal=causal)
+    inner = attn_fn if attn_fn is not None else reference_attention
+    out = inner(to_seq(q), to_seq(k), to_seq(v), causal=causal)
     return to_heads(out)
 
 
 def ulysses_attention(
     q, k, v, mesh: Mesh, axis_name: str = "seq", causal: bool = True,
-    batch_axis: str = "data",
+    batch_axis: str = "data", use_flash: bool = False,
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
 ):
+    attn_fn = None
+    if use_flash:
+        from k8s_dra_driver_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = functools.partial(
+            flash_attention, block_q=block_q, block_k=block_k, interpret=interpret
+        )
     spec = P(batch_axis, axis_name, None, None)
     fn = jax.shard_map(
-        functools.partial(ulysses_attention_local, axis_name=axis_name, causal=causal),
+        functools.partial(
+            ulysses_attention_local, axis_name=axis_name, causal=causal, attn_fn=attn_fn
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs carry no varying-manual-axes metadata yet.
+        check_vma=not use_flash,
     )
     return fn(q, k, v)
