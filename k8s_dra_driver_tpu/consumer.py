@@ -1,0 +1,210 @@
+"""Consumer-side runtime: what a pod does with a prepared TPU claim.
+
+The reference leaves the consumer side to CUDA — its demo pods just run
+``nvidia-smi -L`` and NCCL picks up the injected devices.  JAX pods need a
+little more glue: read the ``TPU_*`` wiring the CDI spec injected, bring up
+``jax.distributed`` for multi-host claims, build the mesh, and (for shared
+claims) cooperate through the topology daemon.  This module is that glue —
+the single call a claim container makes before training:
+
+    from k8s_dra_driver_tpu import consumer
+    ctx = consumer.attach()           # env -> ClaimContext (+ jax.distributed)
+    mesh = ctx.build_mesh()           # claimed chips as a jax Mesh
+    with ctx.lease():                 # no-op unless TimeSlicing
+        train(mesh)
+
+``python -m k8s_dra_driver_tpu.consumer`` prints the resolved context and
+runs a device check — the TPU analog of the demo pods' ``nvidia-smi -L``
+verification (reference demo/specs/quickstart/README.md:17-36), used as the
+container command in the quickstart specs.
+
+Reference provenance: env contract produced by plugin/device_state.py
+(`_wiring_env`) and plugin/sharing.py; daemon protocol in
+plugin/topology_daemon.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ClaimContext:
+    """Everything the driver wired into this container, resolved."""
+
+    visible_devices: list[int] = field(default_factory=list)
+    chips_per_process_bounds: str = ""
+    process_bounds: str = ""
+    process_coord: str = ""
+    partition_index: Optional[int] = None
+    sharing_strategy: str = "exclusive"
+    queue_quantum_ms: Optional[int] = None
+    hbm_limit_mib: Optional[int] = None
+    daemon_socket: str = ""
+    worker_id: Optional[int] = None
+    host_count: Optional[int] = None
+    coordinator_address: str = ""
+
+    @property
+    def multi_host(self) -> bool:
+        return self.host_count is not None and self.host_count > 1
+
+    @property
+    def shared(self) -> bool:
+        return self.sharing_strategy in ("time-slicing", "spatial-partition")
+
+    # -- jax wiring ---------------------------------------------------------
+
+    def initialize_distributed(self) -> None:
+        """Bring up jax.distributed from the claim's membership wiring
+        (worker id / host count / coordinator injected by the slice
+        controller seat — the IMEX-channel analog)."""
+        import jax
+
+        if not self.multi_host:
+            return
+        kwargs: dict = {
+            "num_processes": self.host_count,
+            "process_id": self.worker_id,
+        }
+        if self.coordinator_address:
+            kwargs["coordinator_address"] = self.coordinator_address
+        jax.distributed.initialize(**kwargs)
+
+    def build_mesh(self, want_seq: bool = False):
+        """The claimed chips as a Mesh (all visible devices, every host)."""
+        import jax
+
+        from k8s_dra_driver_tpu.parallel.mesh import auto_mesh_shape, build_mesh
+
+        devices = jax.devices()
+        shape = auto_mesh_shape(len(devices), want_seq=want_seq)
+        return build_mesh(devices, shape)
+
+    # -- daemon cooperation -------------------------------------------------
+
+    def daemon_client(self, consumer_id: Optional[str] = None):
+        """Connect to the claim's topology daemon (None when not shared)."""
+        if not self.daemon_socket:
+            return None
+        from k8s_dra_driver_tpu.plugin.topology_daemon import TopologyDaemonClient
+
+        name = consumer_id or os.environ.get("HOSTNAME", f"pid-{os.getpid()}")
+        return TopologyDaemonClient(self.daemon_socket, name)
+
+    def register(self, consumer_id: Optional[str] = None) -> Optional[dict]:
+        """Announce this consumer; SpatialPartition consumers observe their
+        partition record (the MPS-client handshake analog)."""
+        client = self.daemon_client(consumer_id)
+        if client is None:
+            return None
+        try:
+            return client.register(partition=self.partition_index)
+        finally:
+            client.close()
+
+    @contextlib.contextmanager
+    def lease(self, consumer_id: Optional[str] = None, timeout_ms: int = 60_000):
+        """Cooperative run-lease for TimeSlicing claims; a no-op context for
+        every other strategy, so training code is strategy-agnostic."""
+        if self.sharing_strategy != "time-slicing" or not self.daemon_socket:
+            yield None
+            return
+        client = self.daemon_client(consumer_id)
+        scope = ",".join(str(i) for i in self.visible_devices) or "*"
+        try:
+            grant = client.acquire(
+                quantum_ms=self.queue_quantum_ms, timeout_ms=timeout_ms, scope=scope
+            )
+            if not grant.get("ok"):
+                raise TimeoutError(
+                    f"run lease not granted: {grant.get('error')} "
+                    f"(holder: {grant.get('holder')})"
+                )
+            yield grant
+        finally:
+            try:
+                client.release(scope=scope)
+            finally:
+                client.close()
+
+    def to_json(self) -> dict:
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if v not in (None, "", [])
+        }
+
+
+def attach(environ=None, init_distributed: bool = True) -> ClaimContext:
+    """Resolve the claim wiring from the container environment."""
+    env = os.environ if environ is None else environ
+
+    def _int(name):
+        raw = env.get(name, "")
+        return int(raw) if raw not in ("", None) else None
+
+    ctx = ClaimContext(
+        visible_devices=[
+            int(x) for x in env.get("TPU_VISIBLE_DEVICES", "").split(",") if x != ""
+        ],
+        chips_per_process_bounds=env.get("TPU_CHIPS_PER_PROCESS_BOUNDS", ""),
+        process_bounds=env.get("TPU_PROCESS_BOUNDS", ""),
+        process_coord=env.get("TPU_PROCESS_COORD", ""),
+        partition_index=_int("TPU_PARTITION_INDEX"),
+        sharing_strategy=env.get("TPU_SHARING_STRATEGY", "exclusive"),
+        queue_quantum_ms=_int("TPU_QUEUE_QUANTUM_MS"),
+        hbm_limit_mib=_int("TPU_HBM_LIMIT_MIB"),
+        daemon_socket=env.get("TPU_TOPOLOGY_DAEMON_SOCKET", ""),
+        worker_id=_int("TPU_WORKER_ID"),
+        host_count=_int("TPU_HOST_COUNT"),
+        coordinator_address=env.get("JAX_COORDINATOR_ADDRESS", ""),
+    )
+    if init_distributed:
+        ctx.initialize_distributed()
+    return ctx
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """`python -m k8s_dra_driver_tpu.consumer` — the pod-log verification
+    command (nvidia-smi -L analog): print the claim context and the devices
+    JAX actually sees."""
+    argv = sys.argv[1:] if argv is None else argv
+    check = "--check" in argv
+    ctx = attach()
+    print(json.dumps({"claim_context": ctx.to_json()}, sort_keys=True))
+    if ctx.shared:
+        reg = ctx.register()
+        if reg is not None:
+            print(json.dumps({"daemon": reg}, sort_keys=True))
+    import jax
+
+    local = jax.local_devices()
+    print(
+        json.dumps(
+            {
+                "jax_local_devices": [str(d) for d in local],
+                "jax_global_device_count": jax.device_count(),
+            }
+        )
+    )
+    # TPU_VISIBLE_DEVICES wires THIS HOST's chips, so the check compares the
+    # local device list; on multi-host claims jax.devices() is the global
+    # slice and would mismatch on every worker.
+    if check and ctx.visible_devices and len(local) != len(ctx.visible_devices):
+        print(
+            f"DEVICE MISMATCH: claim wired {len(ctx.visible_devices)} chips, "
+            f"jax sees {len(local)} locally",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,8 +82,23 @@ def _flash_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _forward_bhsd(q, k, v, causal, block_q, block_k, interpret):
-    """[BH, S, D] forward returning (out, lse)."""
+def to_bh(x):
+    """[B, S, H, D] -> [B*H, S, D]: heads become grid rows (the pallas
+    kernels' layout contract — shared with the ring composition)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def from_bh(x, b, h):
+    """[B*H, S, D] -> [B, S, H, D]."""
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _forward_bhsd(q, k, v, causal, block_q, block_k, interpret, out_dtype=None):
+    """[BH, S, D] forward returning (out, lse).  ``out_dtype`` overrides the
+    output dtype (the ring composition keeps f32 partials so per-block
+    rounding does not accumulate across the merge)."""
     bh, s, d = q.shape
     num_q = s // block_q
     num_k = s // block_k
@@ -107,7 +122,7 @@ def _forward_bhsd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, s, 128), jnp.float32),
         ],
         scratch_shapes=[
@@ -343,9 +358,5 @@ def flash_attention(
     if s % block_q or s % block_k:
         raise ValueError(f"sequence {s} not divisible by blocks ({block_q},{block_k})")
 
-    # [B, S, H, D] -> [B*H, S, D]: heads become grid rows.
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
     out = _flash_core(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return from_bh(out, b, h)

@@ -149,35 +149,31 @@ def ring_flash_attention_local(
     return out
 
 
-def _bh(x):  # [b, s, h, d] -> [b*h, s, d]
-    b, s, h, d = x.shape
-    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-
-def _unbh(x, b, h):  # [b*h, s, d] -> [b, s, h, d]
-    bh, s, d = x.shape
-    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+def _ring_blocks(s_loc: int, block_q: int, block_k: int) -> tuple[int, int]:
+    """Largest divisors of ``s_loc`` not exceeding the requested blocks —
+    unlike plain flash (which raises), the ring path degrades gracefully on
+    awkward shard lengths (e.g. s_loc=192, block=128 → 64) so every shape
+    the jnp ring handles also works here."""
+    return math.gcd(block_q, s_loc) or s_loc, math.gcd(block_k, s_loc) or s_loc
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):
-    from k8s_dra_driver_tpu.ops.flash_attention import _forward_bhsd
+    from k8s_dra_driver_tpu.ops.flash_attention import _forward_bhsd, from_bh, to_bh
 
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-    block_q = min(block_q, s_loc)
-    block_k = min(block_k, s_loc)
-    if s_loc % block_q or s_loc % block_k:
-        raise ValueError(
-            f"local sequence {s_loc} not divisible by blocks ({block_q},{block_k})"
-        )
-    q_bh = _bh(q)
+    block_q, block_k = _ring_blocks(s_loc, block_q, block_k)
+    q_bh = to_bh(q)
 
     def flash(k_blk, v_blk, blk_causal):
+        # f32 partials: the per-block output feeds the cross-ring merge, and
+        # rounding it to bf16 at every step would accumulate O(n) error.
         out, lse = _forward_bhsd(
-            q_bh, _bh(k_blk), _bh(v_blk), blk_causal, block_q, block_k, interpret
+            q_bh, to_bh(k_blk), to_bh(v_blk), blk_causal, block_q, block_k,
+            interpret, out_dtype=jnp.float32,
         )
-        return out.astype(jnp.float32), lse[..., 0]  # [bh,s,d], [bh,s]
+        return out, lse[..., 0]  # [bh,s,d] f32, [bh,s]
 
     # Step 0: the local block (the only one needing the triangular mask).
     out, lse = flash(k, v, causal)
@@ -203,7 +199,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):
         return (k_cur, v_cur, out, lse), None
 
     (_, _, out, lse), _ = jax.lax.scan(step, (k, v, out, lse), jnp.arange(1, n))
-    out = _unbh(out, b, h).astype(q.dtype)
+    out = from_bh(out, b, h).astype(q.dtype)
     return out, lse  # lse stays [bh, s] for the backward
 
 
@@ -213,15 +209,14 @@ def _ring_flash_fwd_vjp(q, k, v, axis_name, causal, block_q, block_k, interpret)
 
 
 def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, dout):
-    from k8s_dra_driver_tpu.ops.flash_attention import _backward_bhsd
+    from k8s_dra_driver_tpu.ops.flash_attention import _backward_bhsd, from_bh, to_bh
 
     q, k, v, out, lse = res
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-    bq = min(block_q, s_loc)
-    bk = min(block_k, s_loc)
-    q_bh, out_bh, dout_bh = _bh(q), _bh(out), _bh(dout)
+    bq, bk = _ring_blocks(s_loc, block_q, block_k)
+    q_bh, out_bh, dout_bh = to_bh(q), to_bh(out), to_bh(dout)
     lse128 = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
     # delta depends only on dout/out — compute once, not per ring step.
     delta = jnp.sum(dout_bh.astype(jnp.float32) * out_bh.astype(jnp.float32), axis=-1)
@@ -229,13 +224,13 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, dout):
 
     def block_grads(k_blk, v_blk, blk_causal):
         dq_bh, dk_bh, dv_bh = _backward_bhsd(
-            q_bh, _bh(k_blk), _bh(v_blk), out_bh, lse128, dout_bh,
+            q_bh, to_bh(k_blk), to_bh(v_blk), out_bh, lse128, dout_bh,
             blk_causal, bq, bk, interpret, delta=delta,
         )
         return (
             dq_bh.astype(jnp.float32),
-            _unbh(dk_bh, b, h).astype(jnp.float32),
-            _unbh(dv_bh, b, h).astype(jnp.float32),
+            from_bh(dk_bh, b, h).astype(jnp.float32),
+            from_bh(dv_bh, b, h).astype(jnp.float32),
         )
 
     # Step 0: this device's own block.
@@ -269,7 +264,7 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, dout):
     # After n-1 rotations the accumulators sit one hop short of home.
     dk = jax.lax.ppermute(dk_cur, axis_name, perm)
     dv = jax.lax.ppermute(dv_cur, axis_name, perm)
-    return _unbh(dq, b, h).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return from_bh(dq, b, h).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 ring_flash_attention_local.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)

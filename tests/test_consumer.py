@@ -1,0 +1,183 @@
+"""Consumer-side runtime: ClaimContext resolution, daemon cooperation, and
+the closed loop from a PREPARED claim's CDI env to an attached consumer.
+
+The reference's consumer story is `nvidia-smi -L` in pod logs; ours is
+`consumer.attach()` → mesh/lease, so the whole env contract gets an
+executable consumer-side test."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu import consumer
+from k8s_dra_driver_tpu.plugin.topology_daemon import TopologyDaemonServer
+
+
+class TestAttach:
+    def test_exclusive_defaults(self):
+        ctx = consumer.attach(environ={}, init_distributed=False)
+        assert ctx.sharing_strategy == "exclusive"
+        assert not ctx.shared and not ctx.multi_host
+        assert ctx.visible_devices == []
+
+    def test_full_wiring_resolution(self):
+        env = {
+            "TPU_VISIBLE_DEVICES": "1,3",
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+            "TPU_PROCESS_BOUNDS": "2,2,1",
+            "TPU_PROCESS_COORD": "1,0,0",
+            "TPU_PARTITION_INDEX": "1",
+            "TPU_SHARING_STRATEGY": "spatial-partition",
+            "TPU_HBM_LIMIT_MIB": "4096",
+            "TPU_TOPOLOGY_DAEMON_SOCKET": "/run/tpu-topology/u.sock",
+            "TPU_WORKER_ID": "2",
+            "TPU_HOST_COUNT": "4",
+            "JAX_COORDINATOR_ADDRESS": "h0:8476",
+        }
+        ctx = consumer.attach(environ=env, init_distributed=False)
+        assert ctx.visible_devices == [1, 3]
+        assert ctx.partition_index == 1
+        assert ctx.shared and ctx.multi_host
+        assert ctx.hbm_limit_mib == 4096
+        doc = ctx.to_json()
+        assert doc["process_coord"] == "1,0,0"
+        assert "queue_quantum_ms" not in doc  # empty fields dropped
+
+
+class TestDaemonCooperation:
+    @pytest.fixture
+    def daemon(self, tmp_path):
+        server = TopologyDaemonServer(
+            str(tmp_path / "claim.sock"),
+            claim_uid="uid-c",
+            partition_spec="2,1,1",
+            partitions=[
+                {"index": 0, "visible_devices": "0", "process_coord": "0,0,0"},
+                {"index": 1, "visible_devices": "1", "process_coord": "1,0,0"},
+            ],
+            quantum_ms=10,
+        )
+        server.start()
+        yield server
+        server.stop()
+
+    def ctx(self, daemon, strategy, **extra):
+        env = {
+            "TPU_SHARING_STRATEGY": strategy,
+            "TPU_TOPOLOGY_DAEMON_SOCKET": daemon.socket_path,
+            **extra,
+        }
+        return consumer.attach(environ=env, init_distributed=False)
+
+    def test_spatial_consumer_observes_partition(self, daemon):
+        ctx = self.ctx(daemon, "spatial-partition", TPU_PARTITION_INDEX="1")
+        reg = ctx.register(consumer_id="container-b")
+        assert reg["ok"]
+        assert reg["partition"]["visible_devices"] == "1"
+
+    def test_lease_roundtrip_and_scoping(self, daemon):
+        ctx0 = self.ctx(
+            daemon, "time-slicing",
+            TPU_VISIBLE_DEVICES="0", TPU_QUEUE_QUANTUM_MS="1000",
+        )
+        ctx1 = self.ctx(
+            daemon, "time-slicing",
+            TPU_VISIBLE_DEVICES="1", TPU_QUEUE_QUANTUM_MS="10",
+        )
+        with ctx0.lease(consumer_id="a") as grant:
+            assert grant["ok"]
+            # a different chip's consumer is not serialized behind us
+            start = time.time()
+            with ctx1.lease(consumer_id="b") as g2:
+                assert g2["ok"]
+            assert time.time() - start < 1.0
+        # after release the same scope can be re-acquired immediately
+        with ctx0.lease(consumer_id="c") as g3:
+            assert g3["ok"]
+
+    def test_lease_contention_blocks_same_scope(self, daemon):
+        ctx = self.ctx(
+            daemon, "time-slicing",
+            TPU_VISIBLE_DEVICES="0", TPU_QUEUE_QUANTUM_MS="2000",
+        )
+        entered = []
+
+        def holder():
+            with ctx.lease(consumer_id="holder"):
+                entered.append("holder")
+                time.sleep(0.3)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        time.sleep(0.05)
+        start = time.time()
+        with ctx.lease(consumer_id="waiter", timeout_ms=5000) as g:
+            assert g["ok"]
+        assert time.time() - start > 0.1  # actually waited for the release
+        t.join()
+
+    def test_exclusive_lease_is_noop(self):
+        ctx = consumer.attach(environ={}, init_distributed=False)
+        with ctx.lease() as grant:
+            assert grant is None
+
+
+class TestClosedLoop:
+    def test_prepared_claim_env_attaches(self, api_server, tmp_path):
+        """claim → allocate → prepare → CDI env → consumer.attach():
+        the full env contract, both sides."""
+        from k8s_dra_driver_tpu import DRIVER_NAME
+        from k8s_dra_driver_tpu.kube.objects import DeviceRequest
+        from tests.test_prepare import allocate, daemon_controller, opaque
+        from tests.test_allocator import install_classes, publish_host, TPU_CLASS
+        from k8s_dra_driver_tpu.api import API_VERSION
+        from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
+
+        install_classes(api_server)
+        publish_host(api_server)
+        state = DeviceState(
+            api_server,
+            DeviceStateConfig(
+                node_name="host0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "cp.json"),
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                    "TPUINFO_FAKE_HOST_ID": "0",
+                },
+                daemon_backoff_initial=0.001,
+                daemon_backoff_steps=2,
+            ),
+        )
+        watch = daemon_controller(api_server)
+        claim = allocate(
+            api_server,
+            "consumer-loop",
+            [DeviceRequest(name="t", device_class_name=TPU_CLASS, count=2)],
+            config=[
+                opaque(
+                    {
+                        "apiVersion": API_VERSION,
+                        "kind": "TpuConfig",
+                        "sharing": {"strategy": "SpatialPartition"},
+                    }
+                )
+            ],
+        )
+        state.prepare(claim)
+        spec = json.loads(
+            (tmp_path / "cdi" / f"k8s.{DRIVER_NAME}-claim-{claim.metadata.uid}.json").read_text()
+        )
+        # each CDI device entry is one container's env: attach both
+        coords = set()
+        for dev in spec["devices"]:
+            env = dict(e.split("=", 1) for e in dev["containerEdits"]["env"])
+            ctx = consumer.attach(environ=env, init_distributed=False)
+            assert ctx.sharing_strategy == "spatial-partition"
+            assert len(ctx.visible_devices) == 1
+            assert ctx.daemon_socket.endswith(f"{claim.metadata.uid}.sock")
+            coords.add(ctx.process_coord)
+        assert len(coords) == 2  # disjoint slots
+        watch.stop()

@@ -136,6 +136,51 @@ class TestRingFlashAttention:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-4)
 
 
+    def test_bf16_inputs_merge_in_f32(self, seq_mesh):
+        """Per-block partials stay f32 through the ring merge: bf16 inputs
+        see ONE final rounding, not O(n_ring) accumulated roundings."""
+        cpu = cpu_devices(1)[0]
+        keys = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (
+            jax.device_put(
+                jax.random.normal(kk, (B, S, H, D), jnp.float32), cpu
+            ).astype(jnp.bfloat16)
+            for kk in keys
+        )
+        want = reference_attention(
+            *(x.astype(jnp.float32) for x in (q, k, v))
+        )
+        spec = P("data", "seq", None, None)
+        got = jax.jit(
+            lambda a, b, c: ring_flash_attention(
+                a, b, c, mesh=seq_mesh, head_axis=None,
+                block_q=8, block_k=8, interpret=True,
+            )
+        )(*(shard(x, seq_mesh, spec) for x in (q, k, v)))
+        # single-rounding scale (~bf16 eps), not n-times that
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(want), atol=2.5e-2
+        )
+
+    def test_awkward_shard_length_degrades_block_size(self, seq_mesh):
+        """s_loc=24 with default 128 blocks: the ring path falls back to the
+        largest divisor (gcd) instead of raising like plain flash."""
+        cpu = cpu_devices(1)[0]
+        keys = jax.random.split(jax.random.PRNGKey(5), 3)
+        q, k, v = (
+            jax.device_put(jax.random.normal(kk, (2, 96, 2, 8), jnp.float32), cpu)
+            for kk in keys
+        )
+        want = reference_attention(q, k, v)
+        spec = P("data", "seq", None, None)
+        got = jax.jit(
+            lambda a, b, c: ring_flash_attention(
+                a, b, c, mesh=seq_mesh, head_axis=None, interpret=True
+            )
+        )(*(shard(x, seq_mesh, spec) for x in (q, k, v)))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
 class TestUlyssesFlashAttention:
     def test_flash_inner_matches_reference(self, qkv, seq_mesh):
         q, k, v = qkv
