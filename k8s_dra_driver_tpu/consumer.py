@@ -26,9 +26,11 @@ plugin/topology_daemon.py.
 from __future__ import annotations
 
 import contextlib
+import functools
 import json
 import os
 import sys
+import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -94,8 +96,18 @@ class ClaimContext:
             return None
         from k8s_dra_driver_tpu.plugin.topology_daemon import TopologyDaemonClient
 
-        name = consumer_id or os.environ.get("HOSTNAME", f"pid-{os.getpid()}")
-        return TopologyDaemonClient(self.daemon_socket, name)
+        return TopologyDaemonClient(self.daemon_socket, consumer_id or self._consumer_id)
+
+    @functools.cached_property
+    def _consumer_id(self) -> str:
+        # HOSTNAME alone is the POD name — identical in every container of
+        # the pod, which would make same-pod sharers look like one consumer
+        # and defeat the lease's mutual exclusion (pids are also reused
+        # across container PID namespaces, hence the random suffix).
+        return (
+            f"{os.environ.get('HOSTNAME', 'consumer')}-{os.getpid()}-"
+            f"{uuid.uuid4().hex[:6]}"
+        )
 
     def register(self, consumer_id: Optional[str] = None) -> Optional[dict]:
         """Announce this consumer; SpatialPartition consumers observe their

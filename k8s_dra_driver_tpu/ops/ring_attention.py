@@ -150,11 +150,17 @@ def ring_flash_attention_local(
 
 
 def _ring_blocks(s_loc: int, block_q: int, block_k: int) -> tuple[int, int]:
-    """Largest divisors of ``s_loc`` not exceeding the requested blocks —
-    unlike plain flash (which raises), the ring path degrades gracefully on
-    awkward shard lengths (e.g. s_loc=192, block=128 → 64) so every shape
-    the jnp ring handles also works here."""
-    return math.gcd(block_q, s_loc) or s_loc, math.gcd(block_k, s_loc) or s_loc
+    """Largest usable block sizes ≤ the requested ones — unlike plain flash
+    (which raises), the ring path degrades gracefully on awkward shard
+    lengths (e.g. s_loc=192, block=128 → 64) so every shape the jnp ring
+    handles also works here.  ``min`` first: a short shard runs as ONE
+    s_loc-wide block, not the needlessly fine gcd tiling."""
+
+    def pick(block: int) -> int:
+        clamped = min(block, s_loc)
+        return clamped if s_loc % clamped == 0 else math.gcd(block, s_loc)
+
+    return pick(block_q), pick(block_k)
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):

@@ -66,6 +66,15 @@ class _Candidate:
         (request, selector) evaluation on the allocation hot path."""
         return _device_env(self)
 
+    @functools.cached_property
+    def markers(self) -> frozenset:
+        """This device's (pool, chip-marker) overlap set (geometry.py)."""
+        return frozenset(
+            (self.pool, cap)
+            for cap in self.device.basic.capacity
+            if cap.startswith("chip")
+        )
+
 
 def _device_env(c: _Candidate) -> dict:
     """CEL environment for one device, mirroring k8s DRA's `device` variable:
@@ -211,7 +220,7 @@ class Allocator:
             scope = set(con.requests or [r.name for r in requests]) - admin_names
             constraints.append((scope, con.match_attribute))
 
-        chosen = self._search(per_request, constraints, used_markers)
+        chosen = self._search(per_request, constraints, used_markers, free)
         if chosen is None:
             raise AllocationError(
                 f"claim {claim.metadata.name!r}: cannot satisfy "
@@ -320,9 +329,22 @@ class Allocator:
                         used_markers.add((r.pool, cap))
         return in_use, used_markers
 
-    def _search(self, per_request, constraints, used_markers):
+    def _search(self, per_request, constraints, used_markers, free):
         """Backtracking all-or-nothing assignment honoring markers +
-        matchAttribute constraints."""
+        matchAttribute constraints, with BEST-FIT candidate ordering.
+
+        The upstream scheduler allocates first-feasible; we additionally
+        score candidates so placements fragment the geometry as little as
+        possible (the bin-packing concern MIG operators handle by hand):
+
+        1. fewer chips first — a selector matching several subslice shapes
+           takes the smallest that satisfies it;
+        2. lower overlap degree first — prefer devices whose allocation
+           invalidates the fewest still-allocatable devices, so single-chip
+           claims land in already-broken regions and intact blocks survive
+           for whole-subslice claims;
+        3. device name last, for determinism.
+        """
         flat: list[tuple[str, list[_Candidate]]] = []
         for name, count, matching in per_request:
             if len(matching) < count:
@@ -336,6 +358,24 @@ class Allocator:
         # Constraints are independent of one another even when they name the
         # same attribute: agreement is tracked per constraint *instance*.
         attr_value: dict[int, object] = {}
+
+        def order(matching: list[_Candidate]) -> list[_Candidate]:
+            def degree(c: _Candidate) -> int:
+                if not c.markers:
+                    return 0
+                return sum(
+                    1
+                    for o in free
+                    if o.key != c.key
+                    and o.key not in taken
+                    and o.markers
+                    and not (o.markers & markers)  # already infeasible: no loss
+                    and (o.markers & c.markers)
+                )
+
+            return sorted(
+                matching, key=lambda c: (len(c.markers), degree(c), c.device.name)
+            )
 
         def constraint_ok(req_name: str, c: _Candidate) -> bool:
             for ci, (req_set, attr) in enumerate(constraints):
@@ -352,14 +392,12 @@ class Allocator:
             if i == len(flat):
                 return True
             req_name, matching = flat[i]
-            for c in matching:
+            for c in order(matching):
                 if c.key in taken:
                     continue
                 # hbm is a real quantity, not an exclusion marker; only the
                 # synthetic markers participate in overlap exclusion.
-                dev_markers = {
-                    (c.pool, cap) for cap in c.device.basic.capacity if cap.startswith("chip")
-                }
+                dev_markers = c.markers
                 if dev_markers & markers:
                     continue
                 if not constraint_ok(req_name, c):

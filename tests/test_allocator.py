@@ -18,6 +18,7 @@ from k8s_dra_driver_tpu.kube.objects import (
     ObjectMeta,
     ResourceClaim,
     ResourceClaimSpec,
+    ResourceSlice,
 )
 from k8s_dra_driver_tpu.kube.resourceslice_controller import (
     DriverResources,
@@ -529,3 +530,86 @@ class TestBacktracking:
         updated = Allocator(cluster).allocate(claim, node_name="host0")
         devices = {r.device for r in updated.status.allocation.devices.results}
         assert devices == {"tpu-slice-1x2-0-0", "tpu-slice-1x2-1-0"}
+
+
+class TestBestFitScoring:
+    """Placement scoring: smallest-fit shapes, fragmentation-minimizing chip
+    choice (the bin-packing concern MIG operators handle out-of-band)."""
+
+    @pytest.fixture
+    def wide_host(self, api_server):
+        # v5e-8 = one host, 2x4 chip block: two disjoint 2x2 quadrants.
+        install_classes(api_server)
+        publish_host(api_server, spec="v5e-8")
+        return api_server
+
+    def chip_req(self, name):
+        return DeviceRequest(name=name, device_class_name=TPU_CLASS)
+
+    def test_smallest_matching_subslice_wins(self, wide_host):
+        # chipCount >= 2 matches 2x1/1x2 (2), 2x2 (4), wider shapes — the
+        # 2-chip shape must be chosen, conserving the rest.
+        claim = make_claim(
+            wide_host,
+            "smallest",
+            [
+                DeviceRequest(
+                    name="s",
+                    device_class_name=SUBSLICE_CLASS,
+                    selectors=[
+                        sel(f"device.attributes['{DRIVER_NAME}'].chipCount >= 2")
+                    ],
+                )
+            ],
+        )
+        allocated = Allocator(wide_host).allocate(claim, node_name="host0")
+        device = allocated.status.allocation.devices.results[0].device
+        slices = wide_host.list(ResourceSlice.KIND)
+        dev = [d for s in slices for d in s.spec.devices if d.name == device][0]
+        assert dev.basic.attributes["chipCount"].value == 2
+
+    def test_chip_claims_pack_into_broken_quadrant(self, wide_host):
+        # First chip breaks one 2x2 quadrant; the second must land in the
+        # SAME quadrant so the other 2x2 stays allocatable.
+        alloc = Allocator(wide_host)
+        c1 = alloc.allocate(
+            make_claim(wide_host, "c1", [self.chip_req("t")]), node_name="host0"
+        )
+        first = c1.status.allocation.devices.results[0].device
+        c2 = alloc.allocate(
+            make_claim(wide_host, "c2", [self.chip_req("t")]), node_name="host0"
+        )
+        second = c2.status.allocation.devices.results[0].device
+        # local index = x + 2*y on the 2x4 block: quadrant A = {0,1,2,3}
+        quadrant = lambda name: int(name.split("-")[1]) // 4  # noqa: E731
+        assert quadrant(first) == quadrant(second), (first, second)
+        # and a whole 2x2 subslice claim still fits afterwards
+        c3 = alloc.allocate(
+            make_claim(
+                wide_host,
+                "c3",
+                [
+                    DeviceRequest(
+                        name="s",
+                        device_class_name=SUBSLICE_CLASS,
+                        selectors=[
+                            sel(f"device.attributes['{DRIVER_NAME}'].shape == '2x2'")
+                        ],
+                    )
+                ],
+            ),
+            node_name="host0",
+        )
+        assert c3.status.allocation is not None
+
+    def test_determinism(self, wide_host):
+        # Same cluster state -> same placement (scores tie-break by name).
+        a1 = Allocator(wide_host).allocate(
+            make_claim(wide_host, "d1", [self.chip_req("t")]), node_name="host0"
+        )
+        chosen = a1.status.allocation.devices.results[0].device
+        Allocator(wide_host).deallocate(a1)
+        a2 = Allocator(wide_host).allocate(
+            make_claim(wide_host, "d2", [self.chip_req("t")]), node_name="host0"
+        )
+        assert a2.status.allocation.devices.results[0].device == chosen

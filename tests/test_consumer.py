@@ -181,3 +181,33 @@ class TestClosedLoop:
             coords.add(ctx.process_coord)
         assert len(coords) == 2  # disjoint slots
         watch.stop()
+
+
+class TestConsumerIdentity:
+    def test_same_pod_containers_get_distinct_ids(self, tmp_path):
+        """HOSTNAME is the POD name — identical across a pod's containers;
+        default consumer ids must still differ or same-pod TimeSlicing
+        sharers would alias into one lease holder."""
+        server = TopologyDaemonServer(str(tmp_path / "c.sock"), quantum_ms=1000)
+        server.start()
+        try:
+            env = {
+                "TPU_SHARING_STRATEGY": "time-slicing",
+                "TPU_TOPOLOGY_DAEMON_SOCKET": server.socket_path,
+                "TPU_VISIBLE_DEVICES": "0",
+                "TPU_QUEUE_QUANTUM_MS": "1000",
+            }
+            a = consumer.attach(environ=env, init_distributed=False)
+            b = consumer.attach(environ=env, init_distributed=False)
+            assert a._consumer_id != b._consumer_id
+            # and the daemon really serializes them on the same chip scope
+            with a.lease() as g1:
+                assert g1["ok"]
+                client = b.daemon_client()
+                try:
+                    resp = client.acquire(quantum_ms=1000, timeout_ms=50, scope="0")
+                    assert not resp["ok"] and resp["error"] == "timeout"
+                finally:
+                    client.close()
+        finally:
+            server.stop()

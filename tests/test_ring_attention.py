@@ -293,3 +293,17 @@ class TestBurninRingIntegration:
             burnin.build_train_step(
                 burnin.TINY, mesh=mesh, sequence_parallel="none", attention="flash"
             )
+
+
+class TestRingBlocks:
+    def test_block_selection(self):
+        from k8s_dra_driver_tpu.ops.ring_attention import _ring_blocks
+
+        # short shard: one full-width block, not a gcd sliver
+        assert _ring_blocks(24, 128, 128) == (24, 24)
+        assert _ring_blocks(96, 128, 128) == (96, 96)
+        # longer-than-block shard that 128 doesn't divide: gcd fallback
+        assert _ring_blocks(192, 128, 128) == (64, 64)
+        # exact multiples keep the requested block
+        assert _ring_blocks(256, 128, 128) == (128, 128)
+        assert _ring_blocks(256, 128, 64) == (128, 64)
