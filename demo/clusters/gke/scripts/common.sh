@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Shared settings for the GKE demo harness (reference demo/clusters/gke
+# analog, re-flavored for TPU nodepools).
+set -euo pipefail
+
+: "${PROJECT:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [[ -z "${PROJECT}" ]]; then
+  echo "no GCP project configured; run 'gcloud config set project <id>'" >&2
+  exit 1
+fi
+
+: "${CLUSTER_NAME:=tpu-dra-driver-cluster}"
+: "${LOCATION:=us-central2-b}"        # a zone with v5e/v4 capacity
+: "${CLUSTER_VERSION:=1.32}"          # DRA structured parameters need >=1.32
+: "${NODEPOOL_NAME:=tpu-slice}"
+# Multi-host v5e: 4 chips/host machine, 4x8 topology = 8 hosts.
+: "${TPU_MACHINE_TYPE:=ct5lp-hightpu-4t}"
+: "${TPU_TOPOLOGY:=4x8}"
+: "${CHIPS_PER_HOST:=4}"   # ct5lp-hightpu-4t exposes 4 chips per VM
+: "${SLICE_DOMAIN:=${NODEPOOL_NAME}-${TPU_TOPOLOGY}}"
+
+# Host count follows the topology product / chips-per-host, so overriding
+# TPU_TOPOLOGY keeps --num-nodes consistent (gcloud rejects mismatches).
+topology_hosts() {
+  local product=1
+  IFS=x read -ra dims <<< "${TPU_TOPOLOGY}"
+  for d in "${dims[@]}"; do product=$((product * d)); done
+  echo $((product / CHIPS_PER_HOST))
+}
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../../.." && pwd)"

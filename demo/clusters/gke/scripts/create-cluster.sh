@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Create a GKE cluster with DRA enabled plus a multi-host TPU nodepool.
+# GKE provisions every host of the slice atomically and labels the nodes
+# with cloud.google.com/gke-tpu-* — the pre-labeled slice-membership model
+# the controller consumes (ARCHITECTURE.md hard-parts decision: don't solve
+# cross-host bin-packing in-cluster, consume the provisioner's truth).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --project "${PROJECT}" \
+  --location "${LOCATION}" \
+  --cluster-version "${CLUSTER_VERSION}" \
+  --enable-kubernetes-unstable-apis=resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices \
+  --num-nodes 1
+
+gcloud container node-pools create "${NODEPOOL_NAME}" \
+  --project "${PROJECT}" \
+  --location "${LOCATION}" \
+  --cluster "${CLUSTER_NAME}" \
+  --machine-type "${TPU_MACHINE_TYPE}" \
+  --tpu-topology "${TPU_TOPOLOGY}" \
+  --num-nodes "$(topology_hosts)"
+
+echo "cluster ${CLUSTER_NAME} ready; next:"
+echo "  demo/clusters/gke/scripts/label-slice-nodes.sh"
+echo "  demo/clusters/gke/scripts/install-dra-driver.sh"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+gcloud container clusters delete "${CLUSTER_NAME}" \
+  --project "${PROJECT}" --location "${LOCATION}" --quiet

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Install the driver on GKE in REAL mode (no fakeTopology: libtpuinfo
+# enumerates /dev/accel* and the GKE TPU runtime env).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+helm upgrade --install tpu-dra-driver \
+  "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+  --namespace tpu-dra-driver --create-namespace \
+  "$@"
+
+kubectl -n tpu-dra-driver rollout status daemonset/tpu-dra-driver-kubelet-plugin --timeout=300s
+kubectl get resourceslices
+echo "apply demo/specs/quickstart/slice-test1.yaml to run the multi-host JAX job"

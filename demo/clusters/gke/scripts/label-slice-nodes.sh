@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Map GKE's TPU nodepool labels onto the slice-controller contract:
+#   tpu.google.com/slice-domain   groups the slice's hosts
+#   tpu.google.com/slice-host-id  each host's worker index
+# GKE already exports the worker index as
+# cloud.google.com/gke-tpu-worker-id on multi-host nodepools; this script
+# just bridges the namespaces (the in-cluster label-sync sidecar equivalent,
+# runnable from any admin shell and idempotent).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+selector="cloud.google.com/gke-tpu-topology=${TPU_TOPOLOGY}"
+nodes=$(kubectl get nodes -l "${selector}" -o name)
+if [[ -z "${nodes}" ]]; then
+  echo "no nodes match ${selector}" >&2
+  exit 1
+fi
+
+for node in ${nodes}; do
+  worker_id=$(kubectl get "${node}" \
+    -o jsonpath='{.metadata.labels.cloud\.google\.com/gke-tpu-worker-id}')
+  if [[ -z "${worker_id}" ]]; then
+    # Defaulting would label every such node host-id 0 and silently corrupt
+    # the membership set; a missing worker id means this is not a multi-host
+    # TPU nodepool (or the selector matched the wrong nodes).
+    echo "ERROR: ${node} has no cloud.google.com/gke-tpu-worker-id label" >&2
+    exit 1
+  fi
+  kubectl label --overwrite "${node}" \
+    "tpu.google.com/slice-domain=${SLICE_DOMAIN}" \
+    "tpu.google.com/slice-host-id=${worker_id}"
+done
+
+kubectl get nodes -l "tpu.google.com/slice-domain=${SLICE_DOMAIN}" \
+  -L tpu.google.com/slice-host-id

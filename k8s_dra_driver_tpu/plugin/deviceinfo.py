@@ -76,6 +76,14 @@ class TpuChipInfo:
             # Health surfaces as an attribute so DeviceClass CEL gates on it
             # (the k8s-idiomatic mechanism: publish truth, select in class).
             "healthy": DeviceAttribute.of(bool(c.healthy)),
+            # Why, when unhealthy: pci-disabled | aer-fatal |
+            # node-unopenable | fault-injected — operators/CEL can
+            # distinguish a fenced chip from a dead link.
+            **(
+                {"healthReason": DeviceAttribute.of(c.health_reason)}
+                if c.health_reason
+                else {}
+            ),
             "coordX": DeviceAttribute.of(c.coords[0]),
             "coordY": DeviceAttribute.of(c.coords[1]),
             "coordZ": DeviceAttribute.of(c.coords[2]),
@@ -113,10 +121,18 @@ class TpuSubsliceInfo:
         s = self.subslice
         t = self.topology
         chips = [t.chips[i] for i in s.chip_indices]
+        unhealthy = [c for c in chips if not c.healthy]
         attrs = {
             "type": DeviceAttribute.of(DEVICE_TYPE_SUBSLICE),
             "uuid": DeviceAttribute.of(self.uuid),
-            "healthy": DeviceAttribute.of(all(c.healthy for c in chips)),
+            "healthy": DeviceAttribute.of(not unhealthy),
+            # Same reason surface as per-chip devices (first bad chip wins);
+            # claims bind at this granularity, so the reason must exist here.
+            **(
+                {"healthReason": DeviceAttribute.of(unhealthy[0].health_reason)}
+                if unhealthy and unhealthy[0].health_reason
+                else {}
+            ),
             "shape": DeviceAttribute.of(s.shape_name(t.ndims)),
             "chipCount": DeviceAttribute.of(s.chip_count),
             "originX": DeviceAttribute.of(s.origin[0]),

@@ -34,6 +34,7 @@ class ChipInfo:
     cores: int
     pci_address: str
     healthy: bool = True
+    health_reason: str = ""  # why unhealthy: pci-disabled|aer-fatal|node-unopenable|fault-injected
 
 
 @dataclass(frozen=True)
@@ -133,6 +134,7 @@ def enumerate_topology(env: dict[str, str] | None = None) -> TopologyInfo:
             cores=c["cores"],
             pci_address=c["pci_address"],
             healthy=c.get("healthy", True),
+            health_reason=c.get("health_reason", ""),
         )
         for c in data["chips"]
     )

@@ -28,6 +28,7 @@ struct Chip {
   int64_t hbm_bytes = 0;
   int cores = 1;
   bool healthy = true;
+  std::string health_reason;  // empty when healthy
   std::string pci_address;
 };
 
@@ -276,6 +277,7 @@ int enumerate_fake(Topology* t, std::string* err) {
       int pos = std::atoi(part.c_str());
       if (pos >= 0 && pos < static_cast<int>(t->chips.size())) {
         t->chips[pos].healthy = false;
+        t->chips[pos].health_reason = "fault-injected";
       }
     }
   }
@@ -382,13 +384,33 @@ int enumerate_real(Topology* t, std::string* err) {
       c.pci_address = pci.substr(pos + 14, end == std::string::npos ? std::string::npos
                                                                     : end - (pos + 14));
     }
-    // Real health source: the PCI `enable` flag.  A chip whose function is
-    // disabled (surprise-removed, firmware-fenced) reads "0" and is marked
-    // unhealthy rather than dropped, so the driver can publish the truth.
-    // Deeper health (libtpu runtime self-test) is a later-round source.
+    // Real health sources, most-specific reason wins (a chip is marked
+    // unhealthy rather than dropped, so the driver publishes the truth):
+    // 1. PCI `enable` flag — a disabled function (surprise-removed,
+    //    firmware-fenced) reads "0".
     std::string enable = first_line(read_file(sys + "enable"));
     if (!enable.empty() && enable == "0") {
       c.healthy = false;
+      c.health_reason = "pci-disabled";
+    }
+    // 2. AER fatal error counters — any recorded fatal PCIe error means the
+    //    link cannot be trusted even if the function still enumerates.
+    if (c.healthy) {
+      std::string aer = read_file(sys + "aer_dev_fatal");
+      auto tpos = aer.find("TOTAL_ERR_FATAL");
+      if (tpos != std::string::npos) {
+        int total = std::atoi(aer.c_str() + tpos + std::strlen("TOTAL_ERR_FATAL"));
+        if (total > 0) {
+          c.healthy = false;
+          c.health_reason = "aer-fatal";
+        }
+      }
+    }
+    // 3. Device-node accessibility — a node the runtime cannot open would
+    //    hand pods a dead fd at container start.
+    if (c.healthy && access(c.device_path.c_str(), R_OK | W_OK) != 0) {
+      c.healthy = false;
+      c.health_reason = "node-unopenable";
     }
   }
   t->driver_version = first_line(read_file("/sys/module/tpu/version"));
@@ -427,6 +449,7 @@ std::string to_json(const Topology& t) {
       << "\",\"uuid\":\"" << c.uuid << "\",\"coords\":[" << c.coords[0] << ","
       << c.coords[1] << "," << c.coords[2] << "],\"hbm_bytes\":" << c.hbm_bytes
       << ",\"cores\":" << c.cores << ",\"healthy\":" << (c.healthy ? "true" : "false")
+      << ",\"health_reason\":\"" << json_escape(c.health_reason) << "\""
       << ",\"pci_address\":\"" << json_escape(c.pci_address) << "\"}";
   }
   o << "]}";

@@ -69,6 +69,19 @@ class TestCreateClusterScript:
             assert os.access(path, os.X_OK) or script.endswith("common.sh"), script
             subprocess.run(["bash", "-n", str(path)], check=True)
 
+    def test_gke_script_family_exists_and_parses(self):
+        for script in (
+            "common.sh",
+            "create-cluster.sh",
+            "label-slice-nodes.sh",
+            "install-dra-driver.sh",
+            "delete-cluster.sh",
+        ):
+            path = REPO / "demo/clusters/gke/scripts" / script
+            assert path.exists(), script
+            assert os.access(path, os.X_OK) or script == "common.sh", script
+            subprocess.run(["bash", "-n", str(path)], check=True)
+
 
 class TestFakeKnobResolution:
     def make_node(self, server, labels):

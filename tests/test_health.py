@@ -133,3 +133,93 @@ class TestHealthSweep:
         )
         with pytest.raises(AllocationError):
             Allocator(cluster.server).allocate(claim, node_name="tpu-host-0")
+
+
+class TestHealthReason:
+    def test_fault_injected_reason_published(self):
+        """A dead chip's reason flows C++ shim -> binding -> published
+        device attributes, so CEL/operators can tell WHY it is out."""
+        from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+        from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+        topo = enumerate_topology(
+            env={
+                "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                "TPUINFO_FAKE_HOST_ID": "0",
+                "TPUINFO_FAKE_DEAD_CHIPS": "2",
+            }
+        )
+        assert topo.chips[2].health_reason == "fault-injected"
+        assert topo.chips[0].health_reason == ""
+        devices = {d.name: d.get_device() for d in AllocatableDevices.from_topology(topo)}
+        dead = devices["tpu-2"]
+        assert dead.basic.attributes["healthy"].value is False
+        assert dead.basic.attributes["healthReason"].value == "fault-injected"
+        alive = devices["tpu-0"]
+        assert alive.basic.attributes["healthy"].value is True
+        assert "healthReason" not in alive.basic.attributes
+
+    def test_health_reason_selectable_in_cel(self, api_server):
+        from k8s_dra_driver_tpu import DRIVER_NAME
+        from k8s_dra_driver_tpu.kube.objects import DeviceRequest
+        from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+            DriverResources,
+            Pool,
+            ResourceSliceController,
+            Slice,
+        )
+        from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+        from k8s_dra_driver_tpu.scheduler.allocator import Allocator
+        from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+        from tests.test_allocator import TPU_CLASS, install_classes, make_claim, sel
+
+        install_classes(api_server)
+        topo = enumerate_topology(
+            env={
+                "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                "TPUINFO_FAKE_HOST_ID": "0",
+                "TPUINFO_FAKE_DEAD_CHIPS": "1",
+            }
+        )
+        devices = AllocatableDevices.from_topology(topo).get_devices()
+        ResourceSliceController(api_server, DRIVER_NAME, "host0").update(
+            DriverResources(
+                pools={"host0": Pool(slices=[Slice(devices=devices)], node_name="host0")}
+            )
+        )
+        claim = make_claim(
+            api_server,
+            "diagnose-dead",
+            [
+                DeviceRequest(
+                    name="t",
+                    device_class_name=TPU_CLASS,
+                    selectors=[
+                        sel(
+                            f"device.attributes['{DRIVER_NAME}'].healthReason"
+                            " == 'fault-injected'"
+                        )
+                    ],
+                )
+            ],
+        )
+        # the reason attribute is matchable: a diagnostics claim can target
+        # exactly the fault-injected chip
+        allocated = Allocator(api_server).allocate(claim, node_name="host0")
+        assert allocated.status.allocation.devices.results[0].device == "tpu-1"
+
+    def test_subslice_aggregates_health_reason(self):
+        from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+        from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+        topo = enumerate_topology(
+            env={
+                "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                "TPUINFO_FAKE_HOST_ID": "0",
+                "TPUINFO_FAKE_DEAD_CHIPS": "1",
+            }
+        )
+        devices = {d.name: d.get_device() for d in AllocatableDevices.from_topology(topo)}
+        block = devices["tpu-slice-2x2-0-0"]  # covers the dead chip
+        assert block.basic.attributes["healthy"].value is False
+        assert block.basic.attributes["healthReason"].value == "fault-injected"
