@@ -34,10 +34,12 @@ dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PYTHON) __graft_entry__.py
 
 # Static analysis (the reference's golangci-lint slot, .golangci.yaml:2-12):
-# syntax via compileall + the first-party AST linter (tools/lint.py).
+# syntax via compileall + the first-party AST linter (tools/lint.py) + the
+# helm chart consistency check (render-test substitute; no helm binary).
 lint:
 	$(PYTHON) -m compileall -q k8s_dra_driver_tpu tests tools bench.py __graft_entry__.py
-	$(PYTHON) tools/lint.py k8s_dra_driver_tpu tests bench.py __graft_entry__.py tools/lint.py
+	$(PYTHON) tools/lint.py k8s_dra_driver_tpu tests bench.py __graft_entry__.py tools
+	$(PYTHON) tools/helm_check.py
 
 clean:
 	$(MAKE) -C $(CPP_DIR) clean

@@ -53,10 +53,19 @@ int cmd_list(const char* json) {
   int n = 0;
   for (const char* p = chips; (p = std::strstr(p, "{\"index\":")); n++) {
     char uuid[64] = "?", path[64] = "?", idx[16] = "?";
+    char healthy[8] = "true", reason[32] = "";
     find_raw(p, "index", idx, sizeof(idx));
     find_raw(p, "device_path", path, sizeof(path));
     find_raw(p, "uuid", uuid, sizeof(uuid));
-    std::printf("TPU %s: %s %s (UUID: %s)\n", idx, gen, path, uuid);
+    find_raw(p, "healthy", healthy, sizeof(healthy));
+    find_raw(p, "health_reason", reason, sizeof(reason));
+    if (std::strcmp(healthy, "true") == 0) {
+      std::printf("TPU %s: %s %s (UUID: %s)\n", idx, gen, path, uuid);
+    } else {
+      // nvidia-smi likewise surfaces degraded state inline in -L output.
+      std::printf("TPU %s: %s %s (UUID: %s) [UNHEALTHY: %s]\n", idx, gen, path,
+                  uuid, reason[0] ? reason : "unknown");
+    }
     p += 9;
   }
   std::printf("topology %s, host %s, %d local chip(s)\n", topo, host, n);

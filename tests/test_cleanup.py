@@ -149,10 +149,16 @@ class TestTpuCtl:
     def build(self):
         subprocess.run(["make", "-s", "-C", str(CPP_DIR), "tpu-ctl"], check=True)
 
-    def run_ctl(self, *args, topo="v5e-16", host="1"):
+    def run_ctl(self, *args, topo="v5e-16", host="1", extra_env=None):
+        env = {
+            "TPUINFO_FAKE_TOPOLOGY": topo,
+            "TPUINFO_FAKE_HOST_ID": host,
+            "PATH": "/usr/bin",
+            **(extra_env or {}),
+        }
         return subprocess.run(
             [str(CPP_DIR / "tpu-ctl"), *args],
-            env={"TPUINFO_FAKE_TOPOLOGY": topo, "TPUINFO_FAKE_HOST_ID": host, "PATH": "/usr/bin"},
+            env=env,
             capture_output=True,
             text=True,
         )
@@ -162,6 +168,15 @@ class TestTpuCtl:
         assert r.returncode == 0
         assert r.stdout.count("TPU ") == 4
         assert "topology 4x4, host 1, 4 local chip(s)" in r.stdout
+        assert "UNHEALTHY" not in r.stdout
+
+    def test_list_shows_unhealthy_reason(self):
+        # nvidia-smi -L style inline degraded-state display
+        r = self.run_ctl("list", extra_env={"TPUINFO_FAKE_DEAD_CHIPS": "2"})
+        assert r.returncode == 0
+        lines = r.stdout.splitlines()
+        assert "[UNHEALTHY: fault-injected]" in lines[2]
+        assert sum("[UNHEALTHY" in ln for ln in lines) == 1
 
     def test_topology_json(self):
         import json

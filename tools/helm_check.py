@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Helm chart consistency check — the render-test substitute.
+
+No helm binary exists in this image, so template output cannot be rendered
+in CI; this checker statically pins the contract that most often breaks:
+
+  * every ``.Values.x.y`` referenced by a template exists in values.yaml;
+  * every ``include "name"`` resolves to a ``define`` in the chart;
+  * every value defined in values.yaml is referenced somewhere (dead
+    values are usually a renamed-but-not-updated template).
+
+Usage: python tools/helm_check.py [chart_dir]   (exit 1 on findings)
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+import yaml
+
+DEFAULT_CHART = Path(__file__).parent.parent / "deployments" / "helm" / "tpu-dra-driver"
+
+VALUES_RE = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+INCLUDE_RE = re.compile(r'include\s+"([^"]+)"')
+DEFINE_RE = re.compile(r'define\s+"([^"]+)"')
+
+
+def value_paths(doc, prefix=()) -> set[tuple[str, ...]]:
+    """All key paths in the values document (internal nodes included)."""
+    out: set[tuple[str, ...]] = set()
+    if isinstance(doc, dict):
+        for key, val in doc.items():
+            path = prefix + (str(key),)
+            out.add(path)
+            out |= value_paths(val, path)
+    return out
+
+
+def check_chart(chart: Path) -> list[str]:
+    values_file = chart / "values.yaml"
+    values = yaml.safe_load(values_file.read_text()) or {}
+    defined = value_paths(values)
+
+    findings: list[str] = []
+    referenced: set[tuple[str, ...]] = set()
+    defines: set[str] = set()
+    includes: list[tuple[Path, int, str]] = []
+
+    templates = sorted(p for p in (chart / "templates").rglob("*") if p.is_file())
+    for tpl in templates:
+        raw = tpl.read_text()
+        for name in DEFINE_RE.findall(raw):
+            defines.add(name)
+        # Pragmas are read from the RAW text (they live in comments), then
+        # {{/* ... */}} blocks are blanked so documentation mentions of
+        # .Values.* neither fail the check nor mask dead values.
+        pragma_lines = {
+            i for i, line in enumerate(raw.splitlines(), 1) if "helm-check: allow" in line
+        }
+        text = re.sub(
+            r"\{\{-?\s*/\*.*?\*/\s*-?\}\}",
+            lambda m: re.sub(r"[^\n]", " ", m.group(0)),
+            raw,
+            flags=re.DOTALL,
+        )
+        lines = text.splitlines()
+        for lineno, line in enumerate(lines, 1):
+            # A `helm-check: allow` pragma within the 4 preceding lines (or
+            # inline) skips the defined-in-values requirement — for guards
+            # that must reference a value users are FORBIDDEN to set, like
+            # .Values.namespace.
+            allowed = any(
+                i in pragma_lines for i in range(max(1, lineno - 4), lineno + 1)
+            )
+            for ref in VALUES_RE.findall(line):
+                path = tuple(ref.split("."))
+                referenced.add(path)
+                if path not in defined and not allowed:
+                    findings.append(
+                        f"{tpl.name}:{lineno}: .Values.{ref} is not defined in values.yaml"
+                    )
+            for name in INCLUDE_RE.findall(line):
+                includes.append((tpl, lineno, name))
+
+    for tpl, lineno, name in includes:
+        if name not in defines:
+            findings.append(f'{tpl.name}:{lineno}: include "{name}" has no define')
+
+    # dead values: no leaf nor ancestor referenced anywhere
+    for path in sorted(defined):
+        # internal nodes are fine if any descendant is referenced
+        if any(r[: len(path)] == path for r in referenced):
+            continue
+        if any(path[: len(r)] == r for r in referenced):
+            continue  # whole-subtree reference (`with .Values.x` style)
+        findings.append(
+            f"values.yaml: {'.'.join(path)} is never referenced by any template"
+        )
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    chart = Path(argv[1]) if len(argv) > 1 else DEFAULT_CHART
+    findings = check_chart(chart)
+    for f in findings:
+        print(f)
+    print(f"helm-check: {chart.name}: {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
