@@ -222,3 +222,35 @@ class TestResourceSliceController:
         c.update(DriverResources(pools={}))
         c.stop()
         assert [x.metadata.name for x in s.list(ResourceSlice.KIND)] == ["other"]
+
+
+class TestFastDeepcopy:
+    def test_isolation_and_fidelity(self):
+        from k8s_dra_driver_tpu.kube import objects
+
+        claim = objects.ResourceClaim(
+            metadata=objects.ObjectMeta(name="c", labels={"a": "1"}),
+            spec=objects.ResourceClaimSpec(
+                devices=objects.DeviceClaim(
+                    requests=[objects.DeviceRequest(name="r", device_class_name="x")]
+                )
+            ),
+        )
+        cp = objects.deepcopy(claim)
+        assert cp is not claim and cp == claim
+        cp.metadata.labels["a"] = "2"
+        cp.spec.devices.requests[0].name = "mut"
+        assert claim.metadata.labels["a"] == "1"
+        assert claim.spec.devices.requests[0].name == "r"
+
+    def test_subclasses_keep_their_type(self):
+        import collections
+
+        from k8s_dra_driver_tpu.kube import objects
+
+        dd = collections.defaultdict(list)
+        dd["k"].append(1)
+        out = objects.deepcopy({"raw": dd})
+        assert isinstance(out["raw"], collections.defaultdict)
+        out["raw"]["new"].append(2)  # default_factory survived
+        assert "new" not in dd

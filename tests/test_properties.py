@@ -217,3 +217,55 @@ class TestHbmLimitProperties:
         assert out[uuids[0]] == f"{gib * 1024}Mi"
         for u in uuids[1:]:
             assert out[u] == "1024Mi"
+
+
+class TestPartitionPlanProperties:
+    """plan_partitions (the MPS-division analog) must always produce
+    disjoint, in-bounds consumer slots, for ANY subset of a host's chips
+    the scheduler may have picked."""
+
+    @given(
+        spec=st.sampled_from(["v5e-16", "v5e-8", "v4-16"]),
+        data=st.data(),
+    )
+    @settings(max_examples=40, deadline=None)
+    def test_slots_disjoint_and_in_bounds(self, spec, data):
+        from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevice, TpuChipInfo
+        from k8s_dra_driver_tpu.plugin.sharing import plan_partitions
+
+        topo = enumerate_topology(
+            env={"TPUINFO_FAKE_TOPOLOGY": spec, "TPUINFO_FAKE_HOST_ID": "0"}
+        )
+        n_chips = len(topo.chips)
+        positions = data.draw(
+            st.lists(
+                st.integers(min_value=0, max_value=n_chips - 1),
+                min_size=1, max_size=n_chips, unique=True,
+            )
+        )
+        devices = [
+            AllocatableDevice(chip=TpuChipInfo(topo.chips[p], topo, local_pos=p))
+            for p in positions
+        ]
+        plan = plan_partitions(devices, {})
+
+        assert len(plan.per_device_env) == len(devices)
+        # disjoint single-chip visibility
+        visible = [env["TPU_VISIBLE_DEVICES"] for env in plan.per_device_env.values()]
+        assert len(set(visible)) == len(visible)
+        # coords distinct and within the advertised process grid
+        bounds = tuple(int(x) for x in plan.process_bounds.split(","))
+        coords = set()
+        for env in plan.per_device_env.values():
+            coord = tuple(int(x) for x in env["TPU_PROCESS_COORD"].split(","))
+            assert all(0 <= c < b for c, b in zip(coord, bounds)), (coord, bounds)
+            coords.add(coord)
+            assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+        assert len(coords) == len(devices)
+        # grid is either the exact region box (volume == n) or the linear
+        # fallback (n,1,1)
+        volume = bounds[0] * bounds[1] * bounds[2]
+        assert volume == len(devices) or bounds == (len(devices), 1, 1)
+        # the daemon table mirrors the env slots
+        assert [p["index"] for p in plan.partitions] == list(range(len(devices)))
+        assert sorted(p["visible_devices"] for p in plan.partitions) == sorted(visible)
