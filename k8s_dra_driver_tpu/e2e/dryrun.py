@@ -120,9 +120,11 @@ def run_dryrun(n_devices: int) -> None:
                 f"model={pp_shape.model} (pipeline) loss={float(loss):.4f}"
             )
 
-    # Expert parallelism: a Switch-MoE grad step with all_to_all dispatch
-    # over the data/expert axis.
-    from k8s_dra_driver_tpu.ops.moe import switch_moe
+    # Expert parallelism: a top-2 GShard-MoE grad step with all_to_all
+    # dispatch over the data/expert axis (k=1 Switch is the same code path
+    # with one routing rank; top-2 additionally proves the rank-priority
+    # capacity queues and the multi-copy combine).
+    from k8s_dra_driver_tpu.ops.moe import topk_moe
 
     ep_mesh = build_mesh(devices, MeshShape(data=n_devices))
     keys = jax.random.split(jax.random.PRNGKey(2), 4)
@@ -134,13 +136,13 @@ def run_dryrun(n_devices: int) -> None:
     moe_loss = jax.jit(
         jax.grad(
             lambda up, down: (
-                switch_moe(x, wr, up, down, mesh=ep_mesh, capacity_factor=2.0) ** 2
+                topk_moe(x, wr, up, down, mesh=ep_mesh, capacity_factor=2.0, k=2) ** 2
             ).sum(),
             argnums=(0, 1),  # both expert weights: cover the full backward
         )
     )
     jax.block_until_ready(moe_loss(wu, wd))
-    print(f"dryrun_multichip: mesh expert={n_devices} (switch-moe grad) ok")
+    print(f"dryrun_multichip: mesh expert={n_devices} (top-2 moe grad) ok")
 
 
 def _pick_devices(n_devices: int):

@@ -24,12 +24,29 @@ from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 
-def switch_moe_local(x, w_router, w_up, w_down, axis_name: str, capacity: int):
-    """Per-shard Switch MoE (call inside shard_map).
+def _topk_gates(probs, k: int):
+    """(gates, indices) for top-k routing.  One definition shared by the
+    sharded kernel and the dense oracle so the gating convention cannot
+    drift: k=1 keeps the raw top-1 probability (Switch); k>1 normalizes
+    the selected gates to sum to 1 (GShard)."""
+    top_probs, top_idx = jax.lax.top_k(probs, k)  # [T, k]
+    if k == 1:
+        return top_probs, top_idx
+    return top_probs / jnp.maximum(top_probs.sum(-1, keepdims=True), 1e-9), top_idx
+
+
+def topk_moe_local(x, w_router, w_up, w_down, axis_name: str, capacity: int, k: int = 1):
+    """Per-shard top-k MoE (call inside shard_map) — GShard routing with
+    Switch (k=1) as the special case.
 
     x: [T_loc, D] local tokens;  w_router: [D, E] replicated;
     w_up: [E_loc, D, F], w_down: [E_loc, F, D] — this device's experts.
     Returns [T_loc, D].
+
+    Gate convention: k=1 keeps the raw top-1 probability (Switch); k>1
+    normalizes the selected gates to sum to 1 (GShard).  Capacity queues
+    fill rank-by-rank, so first choices always beat second choices for
+    slots.
     """
     n = jax.lax.psum(1, axis_name)
     t_loc, d = x.shape
@@ -38,23 +55,30 @@ def switch_moe_local(x, w_router, w_up, w_down, axis_name: str, capacity: int):
 
     logits = jnp.einsum("td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
-    choice = jnp.argmax(probs, axis=-1)  # [T_loc]
-    gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]  # [T_loc]
+    gates, top_idx = _topk_gates(probs, k)
 
-    # Capacity slots per (expert, this device): position of each token within
-    # its chosen expert's queue; beyond-capacity tokens are dropped.
-    onehot = jax.nn.one_hot(choice, n_experts, dtype=jnp.int32)  # [T, E]
-    position = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E], -1 where not chosen
-    pos_in_expert = position.max(axis=-1)  # [T]
-    keep = pos_in_expert < capacity
-    slot = jnp.where(keep, pos_in_expert, 0)
+    # Capacity slots per (expert, this device): queues fill rank 0 first,
+    # then rank 1, ... (counts carry across ranks); beyond-capacity copies
+    # are dropped with zero contribution (standard Switch/GShard behavior).
+    counts = jnp.zeros((n_experts,), jnp.int32)
+    dispatch = jnp.zeros((t_loc, n_experts, capacity), x.dtype)
+    combine = jnp.zeros((t_loc, n_experts, capacity), x.dtype)
+    for r in range(k):  # k is small and static: unrolled
+        choice = top_idx[:, r]
+        oh = jax.nn.one_hot(choice, n_experts, dtype=jnp.int32)  # [T, E]
+        position = (jnp.cumsum(oh, axis=0) - 1) * oh + counts[None, :] * oh
+        pos_in_expert = position.sum(axis=-1)  # one nonzero (or 0) per row
+        keep = pos_in_expert < capacity
+        slot = jnp.where(keep, pos_in_expert, 0)
+        d_r = (
+            jax.nn.one_hot(choice, n_experts, dtype=x.dtype)[:, :, None]
+            * jax.nn.one_hot(slot, capacity, dtype=x.dtype)[:, None, :]
+            * keep[:, None, None].astype(x.dtype)
+        )  # [T, E, C]
+        dispatch = dispatch + d_r
+        combine = combine + d_r * gates[:, r][:, None, None].astype(x.dtype)
+        counts = counts + oh.sum(axis=0)
 
-    # dispatch [E, C, D]: token t lands in (choice[t], slot[t]).
-    dispatch = (
-        jax.nn.one_hot(choice, n_experts, dtype=x.dtype)[:, :, None]
-        * jax.nn.one_hot(slot, capacity, dtype=x.dtype)[:, None, :]
-        * keep[:, None, None].astype(x.dtype)
-    )  # [T, E, C]
     expert_in = jnp.einsum("td,tec->ecd", x, dispatch)  # [E, C, D]
 
     # Exchange: device i keeps slots for ITS experts from every peer.
@@ -70,16 +94,20 @@ def switch_moe_local(x, w_router, w_up, w_down, axis_name: str, capacity: int):
     expert_out = jax.lax.all_to_all(
         expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
     )
-    combined = jnp.einsum("ecd,tec->td", expert_out, dispatch)
-    return combined * gate[:, None].astype(x.dtype)
+    return jnp.einsum("ecd,tec->td", expert_out, combine)
 
 
-def switch_moe(
+def switch_moe_local(x, w_router, w_up, w_down, axis_name: str, capacity: int):
+    """Per-shard Switch MoE — top-1 routing (kept as the named classic)."""
+    return topk_moe_local(x, w_router, w_up, w_down, axis_name, capacity, k=1)
+
+
+def topk_moe(
     x, w_router, w_up, w_down, mesh: Mesh, axis_name: str = "data",
-    capacity_factor: float = 2.0,
+    capacity_factor: float = 2.0, k: int = 1,
 ):
     """Sharded entry: x [T, D] sharded over ``axis_name``; experts E sharded
-    over the same axis (E % axis size == 0)."""
+    over the same axis (E % axis size == 0).  ``k``: experts per token."""
     n = mesh.shape[axis_name]
     n_experts = w_up.shape[0]
     if n_experts % n:
@@ -90,12 +118,17 @@ def switch_moe(
         raise ValueError(
             f"router emits {w_router.shape[-1]} experts but weights hold {n_experts}"
         )
+    if not 1 <= k <= n_experts:
+        raise ValueError(f"k={k} must be in [1, {n_experts}]")
     t_loc = x.shape[0] // n
     # Slots per (expert, source device): a capacity_factor-padded even spread
-    # of the source device's tokens across experts (Switch convention).
-    capacity = max(1, -(-int(capacity_factor * t_loc) // n_experts))
+    # of the source device's k token-copies across experts (GShard scales
+    # capacity with k; Switch convention at k=1).
+    capacity = max(1, -(-int(capacity_factor * t_loc * k) // n_experts))
     fn = jax.shard_map(
-        functools.partial(switch_moe_local, axis_name=axis_name, capacity=capacity),
+        functools.partial(
+            topk_moe_local, axis_name=axis_name, capacity=capacity, k=k
+        ),
         mesh=mesh,
         in_specs=(P(axis_name, None), P(), P(axis_name, None, None), P(axis_name, None, None)),
         out_specs=P(axis_name, None),
@@ -103,13 +136,28 @@ def switch_moe(
     return fn(x, w_router, w_up, w_down)
 
 
-def reference_switch_moe(x, w_router, w_up, w_down):
-    """Dropless dense oracle: every token goes to its top-1 expert."""
+def switch_moe(
+    x, w_router, w_up, w_down, mesh: Mesh, axis_name: str = "data",
+    capacity_factor: float = 2.0,
+):
+    """Switch = top-1 (the name the dryrun/tests use)."""
+    return topk_moe(
+        x, w_router, w_up, w_down, mesh, axis_name=axis_name,
+        capacity_factor=capacity_factor, k=1,
+    )
+
+
+def reference_topk_moe(x, w_router, w_up, w_down, k: int = 1):
+    """Dropless dense oracle: every token runs its top-k experts."""
     logits = jnp.einsum("td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
-    choice = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
+    gates, top_idx = _topk_gates(probs, k)
     h = jax.nn.gelu(jnp.einsum("td,edf->tef", x, w_up))
-    outs = jnp.einsum("tef,efd->ted", h, w_down)
-    picked = jnp.take_along_axis(outs, choice[:, None, None], axis=1)[:, 0]
-    return picked * gate[:, None].astype(x.dtype)
+    outs = jnp.einsum("tef,efd->ted", h, w_down)  # [T, E, D]
+    picked = jnp.take_along_axis(outs, top_idx[:, :, None], axis=1)  # [T, k, D]
+    return jnp.einsum("tkd,tk->td", picked, gates.astype(x.dtype))
+
+
+def reference_switch_moe(x, w_router, w_up, w_down):
+    """Dropless dense oracle: every token goes to its top-1 expert."""
+    return reference_topk_moe(x, w_router, w_up, w_down, k=1)
