@@ -1,0 +1,81 @@
+"""Training-state checkpoint/resume for the data plane (orbax-backed).
+
+The DRIVER's checkpointing (plugin/checkpoint.py) covers prepared-claim
+state; this module covers the other half a training framework owes its
+users: saving and restoring the JAX train state (params + optimizer state +
+step) so a preempted slice job resumes where it left off.  Orbax handles
+the sharded-array plumbing — on a mesh, arrays are saved/restored with
+their shardings, each host writing its own shards (the standard multi-host
+checkpoint pattern; works unchanged on a single device).
+
+Usage:
+
+    ckpt = TrainCheckpointer(dir, keep=3)
+    step = ckpt.latest_step()             # None on a fresh run
+    if step is not None:
+        params, opt_state = ckpt.restore(step, like=(params, opt_state))
+    ...
+    ckpt.save(step, (params, opt_state))  # async-safe, atomic per step
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+
+class TrainCheckpointer:
+    """Thin, opinionated wrapper over orbax's CheckpointManager."""
+
+    def __init__(self, directory: str | Path, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = Path(directory).absolute()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        """Persist ``state`` (any pytree of arrays) for ``step``."""
+        import orbax.checkpoint as ocp
+
+        self._manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._manager.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        """Restore the pytree for ``step`` (default: latest).
+
+        ``like``: an abstract/concrete pytree matching the saved structure;
+        on a mesh, pass state built under the target shardings so arrays
+        come back sharded the same way (resharding on restore is how a
+        resumed job can even CHANGE its mesh shape)."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        if like is not None:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        return self._manager.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._manager.all_steps())
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()

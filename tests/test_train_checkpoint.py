@@ -1,0 +1,79 @@
+"""Training-state checkpoint/resume (models/train_checkpoint.py).
+
+The driver's claim checkpoint is covered in test_prepare; this covers the
+data-plane half: a preempted training job resumes bit-exact, including on
+a sharded mesh with restore-under-shardings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from k8s_dra_driver_tpu.models import burnin
+from k8s_dra_driver_tpu.models.train_checkpoint import TrainCheckpointer
+from k8s_dra_driver_tpu.parallel.mesh import MeshShape, build_mesh
+from tests.conftest import cpu_devices
+
+
+class TestTrainCheckpoint:
+    def test_single_device_roundtrip_resumes_bit_exact(self, tmp_path):
+        cfg = burnin.TINY
+        fns = burnin.build_train_step(cfg, lr=1e-2)
+        params, opt_state = fns.init(jax.random.PRNGKey(0))
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=32)
+
+        # run 2 steps, checkpoint, run 1 more -> loss L3
+        for _ in range(2):
+            params, opt_state, loss = fns.step(params, opt_state, tokens)
+        ckpt = TrainCheckpointer(tmp_path / "ckpt", keep=2)
+        ckpt.save(2, (params, opt_state))
+        params, opt_state, l3 = fns.step(params, opt_state, tokens)
+
+        # resume from the checkpoint and repeat step 3: bit-exact
+        assert ckpt.latest_step() == 2
+        r_params, r_opt = ckpt.restore(like=(params, opt_state))
+        _, _, l3b = fns.step(r_params, r_opt, tokens)
+        assert float(l3) == float(l3b)
+        ckpt.close()
+
+    def test_keep_limit_garbage_collects(self, tmp_path):
+        ckpt = TrainCheckpointer(tmp_path / "ckpt", keep=2)
+        state = {"w": jnp.arange(4.0)}
+        for step in (1, 2, 3):
+            ckpt.save(step, state)
+        assert ckpt.all_steps() == [2, 3]
+        ckpt.close()
+
+    def test_restore_missing_raises(self, tmp_path):
+        ckpt = TrainCheckpointer(tmp_path / "empty")
+        with pytest.raises(FileNotFoundError, match="no checkpoints"):
+            ckpt.restore()
+        ckpt.close()
+
+    def test_sharded_save_restore_under_mesh(self, tmp_path):
+        """Sharded params round-trip with their shardings intact — the
+        multi-host resume pattern (each host writes its own shards)."""
+        mesh = build_mesh(cpu_devices(8), MeshShape(data=2, seq=2, model=2))
+        cfg = burnin.TINY
+        fns = burnin.build_train_step(cfg, mesh=mesh)
+        with mesh:
+            params, opt_state = fns.init(jax.random.PRNGKey(0))
+            ckpt = TrainCheckpointer(tmp_path / "ckpt")
+            ckpt.save(0, params)
+            restored = ckpt.restore(0, like=params)
+        flat, _ = jax.tree.flatten(params)
+        rflat, _ = jax.tree.flatten(restored)
+        for a, b in zip(flat, rflat):
+            assert a.sharding == b.sharding, (a.sharding, b.sharding)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and the restored state trains
+        with mesh:
+            tokens = jax.device_put(
+                burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=64),
+                NamedSharding(mesh, P("data", None)),
+            )
+            _, _, loss = fns.step(restored, opt_state, tokens)
+        assert np.isfinite(float(loss))
+        ckpt.close()
