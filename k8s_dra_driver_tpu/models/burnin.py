@@ -116,25 +116,41 @@ def _full_attention(q, k, v):
     return reference_attention(q, k, v, causal=True)
 
 
-def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
-    b, s, d = x.shape
+def qkv_proj(x, p, cfg: ModelConfig):
+    """ln1 + fused QKV projection -> q/k/v [B, S, H, hd].  Shared with the
+    incremental decode path (models/decode.py) so the two can't drift."""
+    b, s, _ = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
-
     y = _rms_norm(x, p["ln1"])
     qkv = jnp.einsum("bsd,de->bse", y, p["qkv"])
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, h, hd)
-    k = k.reshape(b, s, h, hd)
-    v = v.reshape(b, s, h, hd)
+    return (
+        q.reshape(b, s, h, hd),
+        k.reshape(b, s, h, hd),
+        v.reshape(b, s, h, hd),
+    )
+
+
+def mlp_residual(x, p):
+    """ln2 + gelu MLP with residual (shared with decode)."""
+    y = _rms_norm(x, p["ln2"])
+    y = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, p["mlp_up"]))
+    return x + jnp.einsum("bsf,fd->bsd", y, p["mlp_down"])
+
+
+def tied_logits(x, params):
+    """Final norm + tied-embedding head (shared with decode)."""
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+
+
+def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
+    b, s, d = x.shape
+    q, k, v = qkv_proj(x, p, cfg)
     attn = attn_fn(q, k, v).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
     x = _constrain(x, act_spec)
-
-    y = _rms_norm(x, p["ln2"])
-    y = jnp.einsum("bsd,df->bsf", y, p["mlp_up"])
-    y = jax.nn.gelu(y)
-    x = x + jnp.einsum("bsf,fd->bsd", y, p["mlp_down"])
-    return _constrain(x, act_spec)
+    return _constrain(mlp_residual(x, p), act_spec)
 
 
 def forward(
@@ -149,8 +165,7 @@ def forward(
     )
     for p in params["blocks"]:
         x = jax.checkpoint(block)(x, p)  # remat: HBM for FLOPs
-    x = _rms_norm(x, params["ln_f"])
-    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+    return tied_logits(x, params)
 
 
 def shift_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:

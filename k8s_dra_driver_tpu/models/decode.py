@@ -1,0 +1,144 @@
+"""Autoregressive decoding with a KV cache — the inference half.
+
+TPU-idiomatic incremental decode for the burn-in transformer: static
+shapes throughout (the cache is allocated at ``max_seq`` and written with
+``dynamic_update_slice``), the generation loop is one ``lax.scan`` over
+positions (no Python control flow under jit), and attention over the cache
+masks by position instead of re-slicing — so XLA compiles ONE step program
+reused for every token.
+
+The weights are the training checkpoints' (`models/burnin.py` layout);
+teacher-forced decode reproduces ``burnin.forward`` logits exactly, which
+is the correctness contract the tests pin.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from k8s_dra_driver_tpu.models.burnin import (
+    ModelConfig,
+    mlp_residual,
+    qkv_proj,
+    tied_logits,
+)
+
+
+class KVCache(NamedTuple):
+    """Per-layer stacked K/V: [L, B, max_seq, H, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+
+def init_cache(
+    cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.float32
+) -> KVCache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _cached_attention(q, k_cache, v_cache, pos):
+    """q: [B, 1, H, hd]; caches: [B, S_max, H, hd]; attend over
+    positions <= pos (the rest of the cache is masked, not sliced —
+    static shapes keep the step program reusable).
+
+    Operands stay in the cache dtype with f32 ACCUMULATION
+    (``preferred_element_type``) — the MXU-native bf16-in/f32-out path,
+    so a bf16 cache actually saves the bandwidth it exists to save."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(k_cache.dtype),
+            k_cache,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    k_pos = jnp.arange(k_cache.shape[1])
+    scores = jnp.where(k_pos[None, None, None, :] <= pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        probs.astype(v_cache.dtype),
+        v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def decode_step(params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig):
+    """One incremental step.
+
+    token: [B] int32 — the token at ``pos``;  pos: scalar int32.
+    Returns (logits [B, V] f32 for position ``pos``, updated cache).
+    """
+    b = token.shape[0]
+    x = params["embed"][token][:, None, :] + jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"], pos, 1, axis=0
+    )  # [B, 1, D]
+
+    new_k, new_v = cache.k, cache.v
+    for li, p in enumerate(params["blocks"]):
+        q, k, v = qkv_proj(x, p, cfg)  # [B, 1, H, hd] each
+        new_k = new_k.at[li].set(
+            jax.lax.dynamic_update_slice_in_dim(new_k[li], k.astype(new_k.dtype), pos, axis=1)
+        )
+        new_v = new_v.at[li].set(
+            jax.lax.dynamic_update_slice_in_dim(new_v[li], v.astype(new_v.dtype), pos, axis=1)
+        )
+        attn = _cached_attention(q, new_k[li], new_v[li], pos).reshape(b, 1, cfg.d_model)
+        x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
+        x = mlp_residual(x, p)
+
+    logits = tied_logits(x, params)
+    return logits[:, 0], KVCache(k=new_k, v=new_v)
+
+
+def greedy_decode(
+    params, prompt: jax.Array, steps: int, cfg: ModelConfig, cache_dtype=jnp.float32
+) -> jax.Array:
+    """Greedy continuation: prompt [B, P] int32 -> [B, P+steps].
+
+    One fused scan covers prefill AND generation: at prompt positions the
+    next input comes from the prompt (teacher forcing), afterwards from the
+    argmax — so there is a single compiled step, no separate prefill
+    program."""
+    b, p_len = prompt.shape
+    total = p_len + steps
+    if total > cfg.max_seq:
+        # dynamic_slice would silently clamp to the last positional
+        # embedding past max_seq — wrong logits with no error.
+        raise ValueError(
+            f"prompt {p_len} + steps {steps} = {total} exceeds max_seq {cfg.max_seq}"
+        )
+    cache = init_cache(cfg, b, total, dtype=cache_dtype)
+    padded = jnp.concatenate(
+        [prompt, jnp.zeros((b, steps), dtype=prompt.dtype)], axis=1
+    )
+
+    step_fn = functools.partial(decode_step, cfg=cfg)
+
+    def body(carry, pos):
+        cache, tokens = carry
+        token_in = jax.lax.dynamic_slice_in_dim(tokens, pos, 1, axis=1)[:, 0]
+        logits, cache = step_fn(params, cache, token_in, pos)
+        next_tok = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        # Prompt positions keep their token; generated positions take argmax.
+        write_pos = pos + 1
+        keep_prompt = write_pos < p_len
+        current = jax.lax.dynamic_slice_in_dim(tokens, write_pos, 1, axis=1)[:, 0]
+        written = jnp.where(keep_prompt, current, next_tok)
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, written[:, None], write_pos, axis=1
+        )
+        return (cache, tokens), None
+
+    (_, tokens), _ = jax.lax.scan(body, (cache, padded), jnp.arange(total - 1))
+    return tokens
