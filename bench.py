@@ -121,7 +121,47 @@ def run_data_plane() -> dict:
             out["attention"] = attention_speedup()
         except Exception as exc:  # noqa: BLE001 - partial data beats none
             out["attention"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # KV-cache serving throughput on the same weights.
+        try:
+            out["decode"] = _decode_throughput(cfg, params)
+        except Exception as exc:  # noqa: BLE001
+            out["decode"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out
+
+
+def _decode_throughput(cfg, params, batch=8, prompt_len=16, steps=112) -> dict:
+    """Greedy tokens/second with a bf16 KV cache (RTT subtracted)."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_tpu.models import burnin, decode
+    from k8s_dra_driver_tpu.ops.collectives import dispatch_rtt_seconds
+
+    prompt = burnin.sample_tokens(
+        jax.random.PRNGKey(3), cfg, batch=batch, seq=prompt_len
+    )
+    fn = jax.jit(
+        lambda p, t: decode.greedy_decode(
+            p, t, steps, cfg=cfg, cache_dtype=jnp.bfloat16
+        )
+    )
+    int(fn(params, prompt)[0, -1])  # compile + sync via host readback
+    start = time.perf_counter()
+    int(fn(params, prompt)[0, -1])
+    total = time.perf_counter() - start
+    rtt = dispatch_rtt_seconds()
+    if total <= 1.5 * rtt:
+        raise RuntimeError("decode timing dominated by dispatch RTT")
+    # The fused scan runs prompt_len+steps-1 identical per-position steps
+    # (prefill included) — credit what actually executed, or the metric
+    # skews with the prompt/steps ratio.
+    positions = prompt_len + steps - 1
+    tok_s = batch * positions / (total - rtt)
+    return {
+        "tokens_per_s": round(tok_s, 1),
+        "batch": batch,
+        "positions": positions,
+    }
 
 
 def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:

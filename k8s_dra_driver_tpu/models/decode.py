@@ -106,15 +106,36 @@ def greedy_decode(
 ) -> jax.Array:
     """Greedy continuation: prompt [B, P] int32 -> [B, P+steps].
 
-    One fused scan covers prefill AND generation: at prompt positions the
-    next input comes from the prompt (teacher forcing), afterwards from the
-    argmax — so there is a single compiled step, no separate prefill
-    program."""
+    The temperature=0 case of :func:`sample_decode` (one shared scan body —
+    the write-back indexing is the subtlest code here and must exist once).
+    """
+    return sample_decode(
+        params, prompt, steps, cfg,
+        key=jax.random.PRNGKey(0),  # unused at temperature 0
+        temperature=0.0, cache_dtype=cache_dtype,
+    )
+
+
+def sample_decode(
+    params,
+    prompt: jax.Array,
+    steps: int,
+    cfg: ModelConfig,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    cache_dtype=jnp.float32,
+) -> jax.Array:
+    """Continuation: temperature + optional top-k filtering.
+
+    ``temperature=0`` is exact greedy (argmax, rng unused); ``top_k=0``
+    disables filtering.  One fused scan covers prefill AND generation: at
+    prompt positions the next input comes from the prompt (teacher
+    forcing), afterwards from the sampler — a single compiled step, no
+    separate prefill program."""
     b, p_len = prompt.shape
     total = p_len + steps
     if total > cfg.max_seq:
-        # dynamic_slice would silently clamp to the last positional
-        # embedding past max_seq — wrong logits with no error.
         raise ValueError(
             f"prompt {p_len} + steps {steps} = {total} exceeds max_seq {cfg.max_seq}"
         )
@@ -122,15 +143,23 @@ def greedy_decode(
     padded = jnp.concatenate(
         [prompt, jnp.zeros((b, steps), dtype=prompt.dtype)], axis=1
     )
-
     step_fn = functools.partial(decode_step, cfg=cfg)
 
-    def body(carry, pos):
+    def pick(logits, k_rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        scaled = logits / jnp.float32(temperature)
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        return jax.random.categorical(k_rng, scaled, axis=-1)
+
+    def body(carry, inp):
         cache, tokens = carry
+        pos, k_rng = inp
         token_in = jax.lax.dynamic_slice_in_dim(tokens, pos, 1, axis=1)[:, 0]
         logits, cache = step_fn(params, cache, token_in, pos)
-        next_tok = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
-        # Prompt positions keep their token; generated positions take argmax.
+        next_tok = pick(logits, k_rng).astype(tokens.dtype)
         write_pos = pos + 1
         keep_prompt = write_pos < p_len
         current = jax.lax.dynamic_slice_in_dim(tokens, write_pos, 1, axis=1)[:, 0]
@@ -140,5 +169,6 @@ def greedy_decode(
         )
         return (cache, tokens), None
 
-    (_, tokens), _ = jax.lax.scan(body, (cache, padded), jnp.arange(total - 1))
+    keys = jax.random.split(key, total - 1)
+    (_, tokens), _ = jax.lax.scan(body, (cache, padded), (jnp.arange(total - 1), keys))
     return tokens

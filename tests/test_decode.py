@@ -105,3 +105,37 @@ class TestDecode:
                 lambda p, t: decode.greedy_decode(p, t, 5, cfg=cfg)
             )(sharded, prompt)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSampling:
+    def test_temperature_zero_is_greedy(self):
+        cfg, params, tokens = setup(seq=20)
+        prompt = tokens[:, :6]
+        greedy = decode.greedy_decode(params, prompt, 5, cfg=cfg)
+        sampled = decode.sample_decode(
+            params, prompt, 5, cfg=cfg, key=jax.random.PRNGKey(0), temperature=0.0
+        )
+        np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+    def test_sampling_is_seeded_and_in_vocab(self):
+        cfg, params, tokens = setup(seq=20)
+        prompt = tokens[:, :4]
+        a = decode.sample_decode(
+            params, prompt, 8, cfg=cfg, key=jax.random.PRNGKey(7), temperature=1.5
+        )
+        b = decode.sample_decode(
+            params, prompt, 8, cfg=cfg, key=jax.random.PRNGKey(7), temperature=1.5
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # deterministic
+        assert int(a.max()) < cfg.vocab_size and int(a.min()) >= 0
+
+    def test_top_k_restricts_to_topk_of_distribution(self):
+        cfg, params, tokens = setup(seq=20)
+        prompt = tokens[:, :4]
+        out = decode.sample_decode(
+            params, prompt, 6, cfg=cfg, key=jax.random.PRNGKey(3),
+            temperature=2.0, top_k=1,
+        )
+        # top_k=1 forces the argmax regardless of temperature
+        greedy = decode.greedy_decode(params, prompt, 6, cfg=cfg)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
