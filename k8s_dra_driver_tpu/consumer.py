@@ -90,13 +90,37 @@ class ClaimContext:
 
     # -- daemon cooperation -------------------------------------------------
 
-    def daemon_client(self, consumer_id: Optional[str] = None):
-        """Connect to the claim's topology daemon (None when not shared)."""
+    def daemon_client(
+        self,
+        consumer_id: Optional[str] = None,
+        retries: int = 10,
+        retry_delay_s: float = 0.5,
+    ):
+        """Connect to the claim's topology daemon (None when not shared).
+
+        Retries with a flat delay: the daemon Deployment may still be
+        starting when the consumer container does (the same race the
+        plugin's readiness backoff tolerates on the other side)."""
         if not self.daemon_socket:
             return None
+        import time
+
         from k8s_dra_driver_tpu.plugin.topology_daemon import TopologyDaemonClient
 
-        return TopologyDaemonClient(self.daemon_socket, consumer_id or self._consumer_id)
+        name = consumer_id or self._consumer_id
+        retries = max(1, retries)
+        last: Exception = RuntimeError("unreachable")
+        for attempt in range(retries):
+            try:
+                return TopologyDaemonClient(self.daemon_socket, name)
+            except OSError as exc:
+                last = exc
+                if attempt + 1 < retries:
+                    time.sleep(retry_delay_s)
+        raise ConnectionError(
+            f"topology daemon at {self.daemon_socket} not reachable "
+            f"after {retries} attempts: {last}"
+        )
 
     @functools.cached_property
     def _consumer_id(self) -> str:

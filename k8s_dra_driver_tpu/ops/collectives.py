@@ -83,6 +83,31 @@ def all_gather_bandwidth(
     return BandwidthResult("all_gather", axis, n, payload, secs, algbw)
 
 
+def all_to_all_bandwidth(
+    mesh: Mesh, axis: str = "data", mib: int = 64, dtype=jnp.bfloat16, iters: int = 10
+) -> BandwidthResult:
+    """The expert-parallel collective: each device exchanges 1/n of its
+    shard with every peer (MoE dispatch/return traffic)."""
+    n = mesh.shape[axis]
+    elems = mib * 1024 * 1024 // jnp.dtype(dtype).itemsize
+    # [n, elems/n] per device so split_axis=0 divides evenly.
+    per = max(n, (elems // n) * n)
+    spec = P(axis, None)
+    x = jax.device_put(
+        jnp.ones((n * n, per // n), dtype), NamedSharding(mesh, spec)
+    )
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=spec, out_specs=spec)
+    def exchange(shard):
+        return jax.lax.all_to_all(shard, axis, split_axis=0, concat_axis=0, tiled=True)
+
+    secs = _time_fn(exchange, x, iters=iters)
+    payload = n * (per // n) * jnp.dtype(dtype).itemsize  # bytes per device
+    algbw = ((n - 1) / max(n, 1)) * payload / secs / 1e9 if n > 1 else payload / secs / 1e9
+    return BandwidthResult("all_to_all", axis, n, payload, secs, algbw)
+
+
 def dispatch_rtt_seconds(device=None, iters: int = 5) -> float:
     """Round-trip latency of a trivial jit + host readback.  On tunneled
     devices (axon) this dominates per-call timings and must be subtracted."""

@@ -211,3 +211,39 @@ class TestConsumerIdentity:
                     client.close()
         finally:
             server.stop()
+
+
+class TestDaemonConnectRetry:
+    def test_client_waits_for_late_daemon(self, tmp_path):
+        """The daemon Deployment may start after the consumer container:
+        daemon_client retries instead of crash-looping the pod."""
+        import threading
+
+        sock = tmp_path / "late.sock"
+        env = {
+            "TPU_SHARING_STRATEGY": "spatial-partition",
+            "TPU_TOPOLOGY_DAEMON_SOCKET": str(sock),
+        }
+        ctx = consumer.attach(environ=env, init_distributed=False)
+        server = TopologyDaemonServer(str(sock), quantum_ms=5)
+
+        t = threading.Timer(0.4, server.start)
+        t.start()
+        try:
+            client = ctx.daemon_client(retries=20, retry_delay_s=0.1)
+            assert client.info()["ok"]
+            client.close()
+        finally:
+            t.join()
+            server.stop()
+
+    def test_absent_daemon_fails_loudly(self, tmp_path):
+        import pytest
+
+        env = {
+            "TPU_SHARING_STRATEGY": "spatial-partition",
+            "TPU_TOPOLOGY_DAEMON_SOCKET": str(tmp_path / "never.sock"),
+        }
+        ctx = consumer.attach(environ=env, init_distributed=False)
+        with pytest.raises(ConnectionError, match="not reachable"):
+            ctx.daemon_client(retries=2, retry_delay_s=0.05)

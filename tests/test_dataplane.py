@@ -110,6 +110,12 @@ class TestCollectives:
         r = collectives.all_gather_bandwidth(mesh8, axis="model", mib=1, iters=3)
         assert r.algbw_gbps > 0
 
+    def test_all_to_all_bandwidth(self, mesh8):
+        # the expert-parallel dispatch/return collective
+        r = collectives.all_to_all_bandwidth(mesh8, axis="data", mib=1, iters=3)
+        assert r.collective == "all_to_all"
+        assert r.algbw_gbps > 0
+
     def test_ring_latency(self, mesh8):
         assert collectives.ring_latency_us(mesh8, axis="model", iters=5) > 0
 
