@@ -127,4 +127,19 @@ class TestSliceTest1:
             if d["device_name"].startswith("membership-")
         }
         assert len(seats) == 4
+        # consumer side of the same env: every pod resolves a distinct
+        # worker identity with a common coordinator — what
+        # `python -m k8s_dra_driver_tpu.consumer` does at container start.
+        from k8s_dra_driver_tpu import consumer
+
+        worker_ids = set()
+        coordinators = set()
+        for p in pods:
+            ctx = consumer.attach(environ=p.env, init_distributed=False)
+            assert ctx.multi_host and ctx.host_count == 4
+            assert len(ctx.visible_devices) == 4  # the 2x2 block's chips
+            worker_ids.add(ctx.worker_id)
+            coordinators.add(ctx.coordinator_address)
+        assert worker_ids == {0, 1, 2, 3}
+        assert len(coordinators) == 1 and next(iter(coordinators)).endswith(":8476")
         manager.stop()
