@@ -301,8 +301,14 @@ def _eval(node, env):
         if op == "/":
             return left // right if isinstance(left, int) and isinstance(right, int) else left / right
         if op == "%":
+            # CEL % is numeric-only; Python would silently %-format a
+            # string left operand (or raise ValueError on a bad format).
+            if isinstance(left, str) or isinstance(right, str):
+                raise CELError("% requires numeric operands")
             return left % right
-    except TypeError as exc:
+    except (TypeError, ZeroDivisionError) as exc:
+        # CEL-in-k8s semantics: an evaluation error (type mismatch, division
+        # by zero) makes the selector a non-match, never a crash.
         raise CELError(str(exc)) from exc
     raise CELError(f"unsupported operator {op!r}")
 
@@ -372,10 +378,31 @@ def _call(name, recv_node, args, env):
 class CompiledExpr:
     def __init__(self, src: str):
         self.src = src
-        self.ast = _Parser(_lex(src)).parse()
+        try:
+            self.ast = _Parser(_lex(src)).parse()
+        except RecursionError as exc:
+            # A pathologically nested user expression must not blow the
+            # interpreter stack out of the allocator (fuzz finding).
+            raise CELError("expression too deeply nested") from exc
 
     def evaluate(self, env: dict[str, Any]) -> Any:
-        return _eval(self.ast, env)
+        """The only-CELError boundary.
+
+        Callers (allocator._matches_selectors) treat CELError as
+        "selector does not match" and anything else as a crash — so EVERY
+        runtime error converts here, not just the types we have met so
+        far: patching leak classes one exception at a time (TypeError,
+        then ZeroDivisionError, then ValueError from str %, then
+        unhashable-key TypeError...) was whack-a-mole; a user-authored
+        expression must never take down allocation."""
+        try:
+            return _eval(self.ast, env)
+        except CELError:
+            raise
+        except RecursionError as exc:
+            raise CELError("expression too deeply nested") from exc
+        except Exception as exc:
+            raise CELError(f"evaluation error: {type(exc).__name__}: {exc}") from exc
 
 
 _cache: dict[str, CompiledExpr] = {}

@@ -1,6 +1,8 @@
 """CEL-subset evaluator tests."""
 
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from k8s_dra_driver_tpu.scheduler.cel import AttrBag, CELError, evaluate
 
@@ -79,6 +81,16 @@ def test_eval(expr, expected):
         # the allocator's non-matching-selector handling (advisor, round 1)
         "-device.attributes['tpu.google.com'].type == 1",  # negate a string
         "1 in 5",  # unsized container
+        # fuzz findings: evaluation errors that leaked as raw exceptions
+        "1 / 0",  # ZeroDivisionError
+        "1 % (1 - 1)",  # ZeroDivisionError (modulo)
+        "(" * 500 + "1" + ")" * 500,  # RecursionError (parser depth)
+        "'%' % 1",  # ValueError from Python str-formatting
+        "'%d' % 2",  # CEL % is numeric-only (Python would format silently)
+        "'a'.startsWith(1)",  # method arg type -> raw TypeError
+        "'a'.matches(1)",
+        "'a'.contains(1)",
+        "device[[1,2]]",  # unhashable map key -> raw TypeError
     ],
 )
 def test_errors(expr):
@@ -95,3 +107,49 @@ def test_short_circuit_does_not_mask_type_sanity():
     # && short-circuits like CEL: the erroring RHS is never evaluated.
     assert evaluate("false && unknownVar == 1", ENV) is False
     assert evaluate("true || unknownVar == 1", ENV) is True
+
+
+class TestFuzzOnlyCELErrorEscapes:
+    """The allocator's selector handling catches exactly CELError
+    (allocator._matches_selectors); any other exception type crashing out
+    of evaluate() would take down allocation for every claim.  Fuzz the
+    full pipeline: arbitrary garbage must parse-or-CELError, never leak
+    TypeError/AttributeError/RecursionError/etc."""
+
+    @settings(max_examples=300, deadline=None)
+    @given(
+        st.text(
+            # full lowercase so method names (matches/startsWith/size/
+            # quantity...) are reachable — a narrower alphabet left the
+            # method-call region unfuzzed and its leaks unfound
+            alphabet="abcdefghijklmnopqrstuvwxyzSW.att rs[]()'\"0123456789+-*/%&|!<>=,?:_",
+            min_size=1,
+            max_size=60,
+        )
+    )
+    def test_arbitrary_source(self, src):
+        try:
+            evaluate(src, dict(ENV))
+        except CELError:
+            pass
+
+    @settings(max_examples=150, deadline=None)
+    @given(
+        st.recursive(
+            st.sampled_from(
+                ["1", "'a'", "true", "device.driver", "[1,2]",
+                 "device.attributes['tpu.google.com'].index"]
+            ),
+            lambda inner: st.tuples(
+                inner,
+                st.sampled_from(["+", "-", "*", "/", "%", "==", "<", "in", "&&", "||"]),
+                inner,
+            ).map(lambda t: f"({t[0]} {t[1]} {t[2]})"),
+            max_leaves=6,
+        )
+    )
+    def test_structured_expressions(self, src):
+        try:
+            evaluate(src, dict(ENV))
+        except CELError:
+            pass
