@@ -9,6 +9,8 @@ milli is intentionally unsupported — device capacities are integral).
 
 from __future__ import annotations
 
+import math
+
 _BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4, "Pi": 1024**5, "Ei": 1024**6}
 _DECIMAL = {"k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18}
 
@@ -34,6 +36,11 @@ def parse(s: str | int) -> int:
         value = float(num) if "." in num else int(num)
     except ValueError as exc:
         raise InvalidQuantity(f"invalid quantity {s!r}") from exc
+    if isinstance(value, float) and not math.isfinite(value):
+        # float('9.9e999') is inf; int(inf) would leak OverflowError out of
+        # the parse-or-InvalidQuantity contract (HbmLimits.normalize and the
+        # CEL quantity() both catch exactly InvalidQuantity).
+        raise InvalidQuantity(f"quantity {s!r} is not finite")
     result = value * mult
     if result != int(result):
         raise InvalidQuantity(f"quantity {s!r} is not integral")

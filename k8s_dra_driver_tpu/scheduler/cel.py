@@ -362,6 +362,9 @@ def _call(name, recv_node, args, env):
         raise CELError(f"{name}() takes exactly one argument, got {len(args)}")
     (arg,) = args
     if name == "matches":
+        if not isinstance(arg, str):
+            raise CELError("matches() argument must be string")
+        _guard_regex(arg)
         try:
             return re.search(arg, recv) is not None
         except re.error as exc:
@@ -373,6 +376,45 @@ def _call(name, recv_node, args, env):
     if name == "contains":
         return arg in recv
     raise CELError(f"unknown method {name!r}")
+
+
+_MAX_REGEX_LEN = 256
+
+
+def _guard_regex(pattern: str) -> None:
+    """Reject patterns that can backtrack catastrophically.
+
+    Real CEL mandates RE2 (linear time); Python's ``re`` backtracks, so a
+    user-authored selector like ``(a+)+b`` could hang allocation for every
+    claim.  Conservative static screen: a quantifier applied to a group
+    whose body itself contains a quantifier (the classic exponential
+    shape) is rejected, as are oversized patterns.  Legitimate device
+    selectors (``v5e|v6e``, ``tpu-.*``, anchored literals) pass."""
+    if len(pattern) > _MAX_REGEX_LEN:
+        raise CELError(f"regex longer than {_MAX_REGEX_LEN} chars")
+    depth_has_quant: list[bool] = [False]
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == "(":
+            depth_has_quant.append(False)
+        elif c == ")":
+            inner = depth_has_quant.pop() if len(depth_has_quant) > 1 else False
+            if inner and i + 1 < len(pattern) and pattern[i + 1] in "*+{":
+                raise CELError(
+                    "regex rejected: quantified group containing a quantifier "
+                    "(catastrophic backtracking risk; CEL proper uses RE2)"
+                )
+            # a group that contained a quantifier makes the ENCLOSING
+            # group quantifier-bearing too
+            if inner and depth_has_quant:
+                depth_has_quant[-1] = True
+        elif c in "*+{" or (c == "?" and i > 0 and pattern[i - 1] not in "(*+{?"):
+            depth_has_quant[-1] = True
+        i += 1
 
 
 class CompiledExpr:

@@ -7,6 +7,8 @@ extends coverage to the strict decoder and validation, per SURVEY.md §4's
 """
 
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from k8s_dra_driver_tpu.api import (
     API_VERSION,
@@ -183,3 +185,43 @@ class TestDecoder:
                     "coordinatorPort": "8476",
                 }
             )
+
+
+class TestFuzzDecoderOnlyDecodeError:
+    """The decoder parses USER-authored opaque parameters at Prepare time;
+    its contract is typed failure (DecodeError) for any malformed input.
+    The gRPC fan-out contains failures per claim either way, but a raw
+    TypeError/KeyError would surface as an opaque internal error instead
+    of the actionable message the reference's strict decoder produces."""
+
+    json_values = st.recursive(
+        st.one_of(
+            st.none(),
+            st.booleans(),
+            st.integers(min_value=-(2**31), max_value=2**31),
+            st.text(max_size=12),
+        ),
+        lambda inner: st.one_of(
+            st.lists(inner, max_size=4),
+            st.dictionaries(st.text(max_size=10), inner, max_size=4),
+        ),
+        max_leaves=8,
+    )
+
+    @settings(max_examples=200, deadline=None)
+    @given(data=json_values)
+    def test_arbitrary_json(self, data):
+        try:
+            Decoder().decode(data)
+        except DecodeError:
+            pass
+
+    @settings(max_examples=150, deadline=None)
+    @given(body=json_values)
+    def test_wellformed_envelope_garbage_body(self, body):
+        """A valid kind/apiVersion envelope with arbitrary spec inside."""
+        doc = {"apiVersion": API_VERSION, "kind": "TpuConfig", "sharing": body}
+        try:
+            Decoder().decode(doc)
+        except DecodeError:
+            pass

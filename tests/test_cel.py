@@ -153,3 +153,17 @@ class TestFuzzOnlyCELErrorEscapes:
             evaluate(src, dict(ENV))
         except CELError:
             pass
+
+
+class TestRegexGuard:
+    def test_catastrophic_patterns_rejected(self):
+        for bad in ("(a+)+b", "(a*)*", "((a+)b)+", "(\\d+)*x", "a" * 300):
+            with pytest.raises(CELError):
+                evaluate(f"'aaaaaaaaaaaaaaaaaaaaaaaaaaaaaa'.matches('{bad}')", ENV)
+
+    def test_legitimate_patterns_pass(self):
+        assert evaluate("'tpu-v5e'.matches('v5e|v6e')", ENV) is True
+        assert evaluate("'tpu-v5e'.matches('tpu-.*')", ENV) is True
+        assert evaluate("'tpu-v5e'.matches('^tpu-v[0-9]+e$')", ENV) is True
+        assert evaluate("'abab'.matches('(ab)+')", ENV) is True
+        assert evaluate("'xy'.matches('a{2,3}')", ENV) is False

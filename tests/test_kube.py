@@ -1,6 +1,8 @@
 """Tests for the kube object model, fake API server and slice reconciler."""
 
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from k8s_dra_driver_tpu.kube import objects
 from k8s_dra_driver_tpu.kube.fakeserver import Conflict, InMemoryAPIServer, NotFound
@@ -254,3 +256,23 @@ class TestFastDeepcopy:
         assert isinstance(out["raw"], collections.defaultdict)
         out["raw"]["new"].append(2)  # default_factory survived
         assert "new" not in dd
+
+
+class TestFuzzQuantityParse:
+    """quantity.parse feeds CEL capacity comparison and HBM-limit
+    normalization; any string must parse or raise InvalidQuantity."""
+
+    @settings(max_examples=300, deadline=None)
+    @given(st.text(alphabet="0123456789.eEkKmMgGtTiI+- x", max_size=16))
+    def test_arbitrary_strings(self, s):
+        try:
+            value = parse(s)
+            assert isinstance(value, int)
+        except InvalidQuantity:
+            pass
+
+    @settings(max_examples=100, deadline=None)
+    @given(st.integers(min_value=0, max_value=2**53))
+    def test_format_parse_roundtrip(self, n):
+        # format_bytes output must re-parse to the same value
+        assert parse(format_bytes(n)) == n
