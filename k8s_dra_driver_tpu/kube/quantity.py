@@ -42,6 +42,10 @@ def parse(s: str | int) -> int:
         # CEL quantity() both catch exactly InvalidQuantity).
         raise InvalidQuantity(f"quantity {s!r} is not finite")
     result = value * mult
+    if isinstance(result, float) and not math.isfinite(result):
+        # finite mantissa x suffix multiplier can still overflow
+        # (e.g. '9.9e307M'); int(inf) would leak OverflowError.
+        raise InvalidQuantity(f"quantity {s!r} overflows")
     if result != int(result):
         raise InvalidQuantity(f"quantity {s!r} is not integral")
     return int(result)

@@ -385,35 +385,55 @@ def _guard_regex(pattern: str) -> None:
     """Reject patterns that can backtrack catastrophically.
 
     Real CEL mandates RE2 (linear time); Python's ``re`` backtracks, so a
-    user-authored selector like ``(a+)+b`` could hang allocation for every
-    claim.  Conservative static screen: a quantifier applied to a group
-    whose body itself contains a quantifier (the classic exponential
-    shape) is rejected, as are oversized patterns.  Legitimate device
-    selectors (``v5e|v6e``, ``tpu-.*``, anchored literals) pass."""
+    user-authored selector like ``(a+)+b`` or ``(a|a)+$`` could hang
+    allocation for every claim.  Conservative static screen: a quantifier
+    applied to a group whose body contains a quantifier OR an alternation
+    (the two classic exponential shapes) is rejected, as are oversized
+    patterns.  Character classes are skipped (literal ``+`` inside
+    ``[...]`` is not a quantifier).  Legitimate device selectors
+    (``v5e|v6e``, ``tpu-.*``, ``[0-9+]+`` , anchored literals) pass;
+    quantified alternation groups like ``(ab|cd)+`` are rejected — a
+    price of not having RE2."""
     if len(pattern) > _MAX_REGEX_LEN:
         raise CELError(f"regex longer than {_MAX_REGEX_LEN} chars")
-    depth_has_quant: list[bool] = [False]
+    # per open group: does its body contain a quantifier or alternation?
+    depth_danger: list[bool] = [False]
     i = 0
     while i < len(pattern):
         c = pattern[i]
         if c == "\\":
             i += 2
             continue
+        if c == "[":
+            # skip the character class: ']' is literal when first (possibly
+            # after '^'), escapes respected
+            j = i + 1
+            if j < len(pattern) and pattern[j] == "^":
+                j += 1
+            if j < len(pattern) and pattern[j] == "]":
+                j += 1
+            while j < len(pattern) and pattern[j] != "]":
+                j += 2 if pattern[j] == "\\" else 1
+            i = j + 1
+            continue
         if c == "(":
-            depth_has_quant.append(False)
+            depth_danger.append(False)
         elif c == ")":
-            inner = depth_has_quant.pop() if len(depth_has_quant) > 1 else False
+            inner = depth_danger.pop() if len(depth_danger) > 1 else False
             if inner and i + 1 < len(pattern) and pattern[i + 1] in "*+{":
                 raise CELError(
                     "regex rejected: quantified group containing a quantifier "
-                    "(catastrophic backtracking risk; CEL proper uses RE2)"
+                    "or alternation (catastrophic backtracking risk; CEL "
+                    "proper uses RE2)"
                 )
-            # a group that contained a quantifier makes the ENCLOSING
-            # group quantifier-bearing too
-            if inner and depth_has_quant:
-                depth_has_quant[-1] = True
-        elif c in "*+{" or (c == "?" and i > 0 and pattern[i - 1] not in "(*+{?"):
-            depth_has_quant[-1] = True
+            # a dangerous group makes the ENCLOSING group dangerous too
+            if inner and depth_danger:
+                depth_danger[-1] = True
+        elif (
+            c in "*+{|"
+            or (c == "?" and i > 0 and pattern[i - 1] not in "(*+{?")
+        ):
+            depth_danger[-1] = True
         i += 1
 
 

@@ -157,7 +157,10 @@ class TestFuzzOnlyCELErrorEscapes:
 
 class TestRegexGuard:
     def test_catastrophic_patterns_rejected(self):
-        for bad in ("(a+)+b", "(a*)*", "((a+)b)+", "(\\d+)*x", "a" * 300):
+        for bad in (
+            "(a+)+b", "(a*)*", "((a+)b)+", "(\\d+)*x", "a" * 300,
+            "(a|a)+", "(a|ab)*x",  # alternation-overlap ReDoS shape
+        ):
             with pytest.raises(CELError):
                 evaluate(f"'aaaaaaaaaaaaaaaaaaaaaaaaaaaaaa'.matches('{bad}')", ENV)
 
@@ -167,3 +170,5 @@ class TestRegexGuard:
         assert evaluate("'tpu-v5e'.matches('^tpu-v[0-9]+e$')", ENV) is True
         assert evaluate("'abab'.matches('(ab)+')", ENV) is True
         assert evaluate("'xy'.matches('a{2,3}')", ENV) is False
+        # literal '+' inside a character class is NOT a quantifier
+        assert evaluate("'1+2'.matches('([0-9+])+')", ENV) is True

@@ -111,3 +111,67 @@ class TestGRPC:
         assert resp.claims[allocated.metadata.uid].error == ""
         assert "missing" in resp.claims["nope"].error
         client.close()
+
+
+class TestConcurrentLoad:
+    def test_parallel_prepare_unprepare_over_the_wire(self, served):
+        """The -race analog for the driver's mutex paths: many clients
+        hammer NodePrepare/NodeUnprepare concurrently over the real unix
+        socket; every claim must prepare exactly once, the checkpoint must
+        end clean, and no cross-claim state may leak."""
+        import threading
+
+        cluster, server = served
+        # the fake host publishes 4 chips: 3 concurrent holders always fit
+        n_workers, claims_per_worker = 3, 5
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def worker(wid: int):
+            client = DRAClient(server.plugin_socket)
+            try:
+                for i in range(claims_per_worker):
+                    name = f"load-{wid}-{i}"
+                    claim = cluster.server.create(simple_claim(name))
+                    with lock:
+                        # the allocator stands in for kube-scheduler, which
+                        # serializes allocation; Prepare below runs unlocked
+                        allocated = cluster.allocator.allocate(
+                            claim, node_name="tpu-host-0"
+                        )
+                    ref = ClaimRef(
+                        uid=allocated.metadata.uid, name=name, namespace="default"
+                    )
+                    resp = client.node_prepare_resources([ref])
+                    result = resp.claims[ref.uid]
+                    if result.error:
+                        errors.append(f"{name}: {result.error}")
+                        continue
+                    # idempotent double-prepare from a second in-flight call
+                    again = client.node_prepare_resources([ref])
+                    if [d.device_name for d in again.claims[ref.uid].devices] != [
+                        d.device_name for d in result.devices
+                    ]:
+                        errors.append(f"{name}: non-idempotent prepare")
+                    un = client.node_unprepare_resources([ref])
+                    if un.claims[ref.uid].error:
+                        errors.append(f"{name}: unprepare {un.claims[ref.uid].error}")
+                    with lock:
+                        cluster.allocator.deallocate(
+                            cluster.server.get("ResourceClaim", name, "default")
+                        )
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        # a deadlocked NodePrepare must fail the test, not pass it vacuously
+        assert not any(t.is_alive() for t in threads), "worker thread hung"
+        assert not errors, errors[:5]
+        # no residue: nothing prepared, no leftover transient CDI specs
+        state = server.driver.state
+        assert state.prepared_claim_uids() == []
+        assert state.cdi.list_claim_spec_uids() == []

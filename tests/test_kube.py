@@ -271,6 +271,12 @@ class TestFuzzQuantityParse:
         except InvalidQuantity:
             pass
 
+    @pytest.mark.parametrize("s", ["9.9e999", "9.9e307M", "1.0e308Ei"])
+    def test_overflow_is_typed(self, s):
+        # finite-mantissa x multiplier overflow must not leak OverflowError
+        with pytest.raises(InvalidQuantity):
+            parse(s)
+
     @settings(max_examples=100, deadline=None)
     @given(st.integers(min_value=0, max_value=2**53))
     def test_format_parse_roundtrip(self, n):
