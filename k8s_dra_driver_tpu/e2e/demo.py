@@ -61,6 +61,75 @@ def main() -> None:
     print(f"\n== CDI spec on disk: {spec_path.name} ==")
     print(spec_path.read_text())
 
+    cluster.unprepare_and_deallocate(claim, node)
+
+    print("== tpu-test-sharing: SpatialPartition divides chips among containers ==")
+    from k8s_dra_driver_tpu.api import API_VERSION
+    from k8s_dra_driver_tpu.kube.objects import (
+        DeviceClaimConfiguration,
+        OpaqueDeviceConfiguration,
+    )
+
+    shared = simple_claim("shared", count=2)
+    shared.spec.devices.config = [
+        DeviceClaimConfiguration(
+            opaque=OpaqueDeviceConfiguration(
+                driver=DRIVER_NAME,
+                parameters={
+                    "apiVersion": API_VERSION,
+                    "kind": "TpuConfig",
+                    "sharing": {
+                        "strategy": "SpatialPartition",
+                        "spatialPartitionConfig": {"defaultHbmLimit": "4Gi"},
+                    },
+                },
+            )
+        )
+    ]
+    shared = server.create(shared)
+    cluster.schedule_and_prepare(shared, node)
+    daemons = server.list("Deployment", namespace="tpu-dra-driver")
+    print(f"  topology daemon running: {daemons[0].metadata.name}")
+    spec = json.loads(state.cdi.claim_spec_path(shared.metadata.uid).read_text())
+    from k8s_dra_driver_tpu import consumer
+
+    for dev in spec["devices"]:
+        env = dict(e.split("=", 1) for e in dev["containerEdits"]["env"])
+        ctx = consumer.attach(environ=env, init_distributed=False)
+        print(
+            f"  container slot: chips={ctx.visible_devices} "
+            f"coord={ctx.process_coord} grid={ctx.process_bounds} "
+            f"hbm={ctx.hbm_limit_mib}MiB"
+        )
+    cluster.unprepare_and_deallocate(shared, node)
+
+    print("\n== tpu-parted: re-shape the advertised subslice inventory LIVE ==")
+    import pathlib
+    import tempfile
+
+    from k8s_dra_driver_tpu.plugin import parted
+
+    cfg_path = (
+        pathlib.Path(__file__).parent.parent.parent
+        / "demo" / "specs" / "quickstart" / "tpu-parted-config.yaml"
+    )
+    state_path = pathlib.Path(tempfile.mkdtemp()) / "tpu-parted-state.json"
+    state.config.parted_state_path = str(state_path)
+
+    def shapes():
+        return sorted(
+            {
+                d.subslice.subslice.shape_name(d.subslice.topology.ndims)
+                for d in state.allocatable
+                if d.subslice is not None
+            }
+        )
+
+    print(f"  before: subslice shapes published = {shapes()}")
+    parted.apply_config(str(cfg_path), "whole-host-only", str(state_path))
+    state.refresh()
+    print(f"  after `tpu-parted apply -c whole-host-only`: {shapes()}")
+
 
 if __name__ == "__main__":
     main()
