@@ -171,12 +171,17 @@ def attention_speedup(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    block_candidates: "list[tuple[int, int]] | None" = None,
 ) -> dict:
     """Fused pallas flash attention vs XLA dense attention, forward pass.
 
     Same measurement discipline as ``matmul_tflops``: ``chain`` calls in ONE
     jit ending in a scalar host readback, dispatch RTT subtracted — naive
     per-call timing through a tunneled device reads garbage.
+
+    ``block_candidates``: when given, every (block_q, block_k) pair is
+    timed and the best wins — the bench self-tunes on whatever chip it
+    lands on instead of trusting a hardcoded 128x128.
     """
     import functools
 
@@ -220,22 +225,34 @@ def attention_speedup(
             )
         return (total - rtt) / chain * 1e3
 
-    flash_ms = round(
-        timed_ms(
-            functools.partial(
-                flash_attention, block_q=block_q, block_k=block_k, interpret=interpret
-            )
-        ),
-        3,
-    )
+    candidates = block_candidates or [(block_q, block_k)]
+    by_blocks: dict[str, float] = {}
+    best_ms, best_blocks = float("inf"), candidates[0]
+    for bq, bk in candidates:
+        ms = round(
+            timed_ms(
+                functools.partial(
+                    flash_attention, block_q=bq, block_k=bk, interpret=interpret
+                )
+            ),
+            3,
+        )
+        by_blocks[f"{bq}x{bk}"] = ms
+        if ms < best_ms:
+            best_ms, best_blocks = ms, (bq, bk)
+    flash_ms = by_blocks[f"{best_blocks[0]}x{best_blocks[1]}"]
     dense_ms = round(timed_ms(dense), 3)
-    return {
+    out = {
         "flash_ms": flash_ms,
         "dense_ms": dense_ms,
         # derived from the rounded values so the dict is self-consistent
         "speedup": round(dense_ms / flash_ms, 2),
         "shape": f"b{batch} h{heads} s{seq} d{d}",
     }
+    if len(candidates) > 1:
+        out["blocks"] = f"{best_blocks[0]}x{best_blocks[1]}"
+        out["block_sweep_ms"] = by_blocks
+    return out
 
 
 def ring_latency_us(mesh: Mesh, axis: str = "model", iters: int = 50) -> float:

@@ -190,3 +190,14 @@ class TestAttentionSpeedupBench:
         )
         assert out["flash_ms"] > 0 and out["dense_ms"] > 0
         assert out["speedup"] == round(out["dense_ms"] / out["flash_ms"], 2)
+
+    def test_block_sweep_reports_best(self):
+        from k8s_dra_driver_tpu.ops.collectives import attention_speedup
+
+        out = attention_speedup(
+            batch=1, heads=1, seq=128, d=64, chain=2, interpret=True,
+            block_candidates=[(32, 32), (64, 64)],
+        )
+        assert set(out["block_sweep_ms"]) == {"32x32", "64x64"}
+        assert out["blocks"] in out["block_sweep_ms"]
+        assert out["flash_ms"] == min(out["block_sweep_ms"].values())
