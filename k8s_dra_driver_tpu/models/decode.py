@@ -102,7 +102,8 @@ def decode_step(params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConf
 
 
 def greedy_decode(
-    params, prompt: jax.Array, steps: int, cfg: ModelConfig, cache_dtype=jnp.float32
+    params, prompt: jax.Array, steps: int, cfg: ModelConfig,
+    cache_dtype=jnp.float32, batch_prefill: bool = False,
 ) -> jax.Array:
     """Greedy continuation: prompt [B, P] int32 -> [B, P+steps].
 
@@ -112,7 +113,7 @@ def greedy_decode(
     return sample_decode(
         params, prompt, steps, cfg,
         key=jax.random.PRNGKey(0),  # unused at temperature 0
-        temperature=0.0, cache_dtype=cache_dtype,
+        temperature=0.0, cache_dtype=cache_dtype, batch_prefill=batch_prefill,
     )
 
 
@@ -125,21 +126,24 @@ def sample_decode(
     temperature: float = 1.0,
     top_k: int = 0,
     cache_dtype=jnp.float32,
+    batch_prefill: bool = False,
 ) -> jax.Array:
     """Continuation: temperature + optional top-k filtering.
 
     ``temperature=0`` is exact greedy (argmax, rng unused); ``top_k=0``
-    disables filtering.  One fused scan covers prefill AND generation: at
-    prompt positions the next input comes from the prompt (teacher
-    forcing), afterwards from the sampler — a single compiled step, no
-    separate prefill program."""
+    disables filtering.  Generation is one ``lax.scan`` of the incremental
+    step; the prompt is consumed either inside the same scan (teacher
+    forcing — one compiled program total) or, with ``batch_prefill=True``,
+    by ONE parallel forward pass over the whole prompt (O(1) steps instead
+    of O(prompt); the long-prompt serving path).  RNG keys are indexed by
+    position, so both prefill modes sample identically (with a
+    reduced-precision cache, up to accumulation order)."""
     b, p_len = prompt.shape
     total = p_len + steps
     if total > cfg.max_seq:
         raise ValueError(
             f"prompt {p_len} + steps {steps} = {total} exceeds max_seq {cfg.max_seq}"
         )
-    cache = init_cache(cfg, b, total, dtype=cache_dtype)
     padded = jnp.concatenate(
         [prompt, jnp.zeros((b, steps), dtype=prompt.dtype)], axis=1
     )
@@ -169,6 +173,90 @@ def sample_decode(
         )
         return (cache, tokens), None
 
-    keys = jax.random.split(key, total - 1)
-    (_, tokens), _ = jax.lax.scan(body, (cache, padded), (jnp.arange(total - 1), keys))
+    keys = jax.random.split(key, max(total - 1, 1))
+    if batch_prefill:
+        if steps == 0:
+            return prompt
+        cache, last_logits = prefill(
+            params, prompt, cfg, max_seq=total, cache_dtype=cache_dtype
+        )
+        first = pick(last_logits, keys[p_len - 1]).astype(padded.dtype)
+        padded = jax.lax.dynamic_update_slice_in_dim(
+            padded, first[:, None], p_len, axis=1
+        )
+        positions = jnp.arange(p_len, total - 1)
+        (_, tokens), _ = jax.lax.scan(
+            body, (cache, padded), (positions, keys[p_len : total - 1])
+        )
+        return tokens
+    cache = init_cache(cfg, b, total, dtype=cache_dtype)
+    (_, tokens), _ = jax.lax.scan(
+        body, (cache, padded), (jnp.arange(total - 1), keys[: total - 1])
+    )
     return tokens
+
+
+def _prefill_attention(q, k, v):
+    """Causal attention over the prompt, with the SAME dtype discipline as
+    ``_cached_attention`` (operands in cache dtype, f32 accumulation) so
+    batched prefill and sequential decode see the same numerics."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(k.dtype),
+            k,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,
+            cache_dtype=jnp.float32):
+    """Fill the KV cache for the whole prompt in ONE forward pass.
+
+    Sequential per-token prefill wastes the MXU: the prompt is fully known,
+    so each layer can project q/k/v for every position at once and run
+    causal attention over the prompt (the training forward's shape), writing
+    k/v into the cache as it goes — O(1) steps instead of O(prompt).
+    Attention runs over the CACHE-dtype k/v (like the incremental step), so
+    the two prefill modes agree up to accumulation order.
+
+    Returns (cache, logits[B, V] for the LAST prompt position).
+    """
+    b, p_len = prompt.shape
+    if p_len > max_seq:
+        raise ValueError(f"prompt {p_len} exceeds max_seq {max_seq}")
+    cache = init_cache(cfg, b, max_seq, dtype=cache_dtype)
+    x = params["embed"][prompt] + params["pos_embed"][:p_len]
+
+    new_k, new_v = cache.k, cache.v
+    for li, p in enumerate(params["blocks"]):
+        q, k, v = qkv_proj(x, p, cfg)  # [B, P, H, hd]
+        k_c = k.astype(new_k.dtype)
+        v_c = v.astype(new_v.dtype)
+        new_k = new_k.at[li].set(
+            jax.lax.dynamic_update_slice_in_dim(new_k[li], k_c, 0, axis=1)
+        )
+        new_v = new_v.at[li].set(
+            jax.lax.dynamic_update_slice_in_dim(new_v[li], v_c, 0, axis=1)
+        )
+        attn = _prefill_attention(q, k_c, v_c).reshape(b, p_len, cfg.d_model)
+        x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
+        x = mlp_residual(x, p)
+
+    logits = tied_logits(x, params)[:, -1]
+    return KVCache(k=new_k, v=new_v), logits

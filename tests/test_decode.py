@@ -139,3 +139,53 @@ class TestSampling:
         # top_k=1 forces the argmax regardless of temperature
         greedy = decode.greedy_decode(params, prompt, 6, cfg=cfg)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+
+
+class TestBatchPrefill:
+    def test_prefill_cache_matches_incremental(self):
+        """One parallel forward must fill the cache with the same k/v the
+        sequential steps would (f32, exact to accumulation tolerance)."""
+        cfg, params, tokens = setup(seq=12)
+        b, s = tokens.shape
+        cache_seq = decode.init_cache(cfg, b, s)
+        for pos in range(s):
+            _, cache_seq = decode.decode_step(
+                params, cache_seq, tokens[:, pos], pos, cfg=cfg
+            )
+        cache_par, last_logits = decode.prefill(params, tokens, cfg, max_seq=s)
+        np.testing.assert_allclose(
+            np.asarray(cache_par.k), np.asarray(cache_seq.k), atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache_par.v), np.asarray(cache_seq.v), atol=2e-5
+        )
+        # and the last-position logits match the full forward
+        want = burnin.forward(params, tokens, cfg)[:, -1]
+        np.testing.assert_allclose(np.asarray(last_logits), np.asarray(want), atol=2e-4)
+
+    def test_batch_prefill_generation_matches_sequential(self):
+        cfg, params, tokens = setup(seq=20)
+        prompt = tokens[:, :8]
+        seq_out = decode.greedy_decode(params, prompt, 6, cfg=cfg)
+        par_out = decode.sample_decode(
+            params, prompt, 6, cfg=cfg, key=jax.random.PRNGKey(0),
+            temperature=0.0, batch_prefill=True,
+        )
+        np.testing.assert_array_equal(np.asarray(par_out), np.asarray(seq_out))
+
+    def test_batch_prefill_sampling_matches_sequential(self):
+        # position-indexed keys: both prefill modes sample the same tokens
+        cfg, params, tokens = setup(seq=20)
+        prompt = tokens[:, :8]
+        kwargs = dict(cfg=cfg, key=jax.random.PRNGKey(5), temperature=1.3)
+        a = decode.sample_decode(params, prompt, 6, **kwargs)
+        b = decode.sample_decode(params, prompt, 6, batch_prefill=True, **kwargs)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_zero_steps_returns_prompt(self):
+        cfg, params, tokens = setup()
+        out = decode.sample_decode(
+            params, tokens[:, :5], 0, cfg=cfg, key=jax.random.PRNGKey(0),
+            batch_prefill=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(tokens[:, :5]))
