@@ -181,11 +181,47 @@ def loss_fn(params, tokens, cfg: ModelConfig, act_spec=None, attn_fn=None) -> ja
     return shift_nll(forward(params, tokens, cfg, act_spec, attn_fn), tokens)
 
 
-def make_sgd_step(loss_fn_, opt):
-    """value_and_grad + optimizer-apply wiring shared by all train paths."""
+def make_sgd_step(loss_fn_, opt, accum_steps: int = 1):
+    """value_and_grad + optimizer-apply wiring shared by all train paths.
+
+    ``accum_steps > 1``: gradient accumulation — the batch is split into
+    that many microbatches, gradients are averaged over a ``lax.scan``
+    (one compiled microstep, activation memory of ONE microbatch) and the
+    optimizer applies once.  The TPU-idiomatic large-batch recipe when the
+    full batch's activations exceed HBM even after remat."""
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn_)(params, tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn_)(params, tokens)
+        else:
+            if tokens.shape[0] % accum_steps:
+                raise ValueError(
+                    f"batch {tokens.shape[0]} not divisible by "
+                    f"accum_steps {accum_steps}"
+                )
+            # Interleaved split (every accum_steps-th row), NOT contiguous
+            # blocks: each microbatch stays evenly sharded over the `data`
+            # mesh axis, so accumulation adds no cross-axis resharding.
+            # The averaged gradient is identical either way.
+            micro = tokens.reshape(-1, accum_steps, *tokens.shape[1:]).swapaxes(0, 1)
+
+            def micro_step(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn_)(params, mb)
+                return (
+                    loss_sum + loss,
+                    jax.tree.map(jnp.add, grad_sum, grads),
+                ), None
+
+            zeros = jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                micro_step, (jnp.float32(0.0), zeros), micro
+            )
+            inv = 1.0 / accum_steps
+            loss = loss_sum * inv
+            grads = jax.tree.map(
+                lambda g, p: (g * inv).astype(p.dtype), grad_sum, params
+            )
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -208,6 +244,7 @@ def build_train_step(
     lr: float = 3e-4,
     sequence_parallel: str = "auto",
     attention: str = "dense",
+    accum_steps: int = 1,
 ) -> TrainStepFns:
     """Returns jitted (init, step).  With a mesh, params/opt-state/activations
     get DP/TP/SP shardings; without, everything runs single-device.
@@ -248,7 +285,9 @@ def build_train_step(
             return params, opt.init(params)
 
         step = make_sgd_step(
-            lambda params, tokens: loss_fn(params, tokens, cfg, act_spec, flash_fn), opt
+            lambda params, tokens: loss_fn(params, tokens, cfg, act_spec, flash_fn),
+            opt,
+            accum_steps=accum_steps,
         )
         return TrainStepFns(init=jax.jit(init), step=jax.jit(step))
 
@@ -318,6 +357,7 @@ def build_train_step(
             params, tokens, cfg, NamedSharding(mesh, act_spec), attn_fn
         ),
         opt,
+        accum_steps=accum_steps,
     )
     jit_init = jax.jit(init, out_shardings=(param_shardings, None))
     jit_step = jax.jit(

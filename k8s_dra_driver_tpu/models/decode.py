@@ -42,35 +42,44 @@ def init_cache(
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def _cached_attention(q, k_cache, v_cache, pos):
-    """q: [B, 1, H, hd]; caches: [B, S_max, H, hd]; attend over
-    positions <= pos (the rest of the cache is masked, not sliced —
-    static shapes keep the step program reusable).
+def _masked_attention(q, k, v, mask):
+    """Shared attention core for BOTH decode paths: operands stay in the
+    k/v (cache) dtype with f32 ACCUMULATION (``preferred_element_type``) —
+    the MXU-native bf16-in/f32-out path, so a bf16 cache actually saves the
+    bandwidth it exists to save.  One implementation so the numerics parity
+    between batched prefill and sequential decode cannot drift.
 
-    Operands stay in the cache dtype with f32 ACCUMULATION
-    (``preferred_element_type``) — the MXU-native bf16-in/f32-out path,
-    so a bf16 cache actually saves the bandwidth it exists to save."""
+    mask: broadcastable to [B, H, Q, K]; masked-out scores get -1e30."""
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     scores = (
         jnp.einsum(
             "bqhd,bkhd->bhqk",
-            q.astype(k_cache.dtype),
-            k_cache,
+            q.astype(k.dtype),
+            k,
             preferred_element_type=jnp.float32,
         )
         * scale
     )
-    k_pos = jnp.arange(k_cache.shape[1])
-    scores = jnp.where(k_pos[None, None, None, :] <= pos, scores, -1e30)
+    scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhqk,bkhd->bqhd",
-        probs.astype(v_cache.dtype),
-        v_cache,
+        probs.astype(v.dtype),
+        v,
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)
+
+
+def _cached_attention(q, k_cache, v_cache, pos):
+    """q: [B, 1, H, hd]; caches: [B, S_max, H, hd]; attend over
+    positions <= pos (the rest of the cache is masked, not sliced —
+    static shapes keep the step program reusable)."""
+    k_pos = jnp.arange(k_cache.shape[1])
+    return _masked_attention(
+        q, k_cache, v_cache, (k_pos <= pos)[None, None, None, :]
+    )
 
 
 def decode_step(params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig):
@@ -197,31 +206,12 @@ def sample_decode(
 
 
 def _prefill_attention(q, k, v):
-    """Causal attention over the prompt, with the SAME dtype discipline as
-    ``_cached_attention`` (operands in cache dtype, f32 accumulation) so
-    batched prefill and sequential decode see the same numerics."""
-    d = q.shape[-1]
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
-    scores = (
-        jnp.einsum(
-            "bqhd,bkhd->bhqk",
-            q.astype(k.dtype),
-            k,
-            preferred_element_type=jnp.float32,
-        )
-        * scale
-    )
+    """Causal attention over the prompt — the same ``_masked_attention``
+    core as the sequential step, so the two prefill modes see identical
+    numerics by construction."""
     s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    scores = jnp.where(mask[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd",
-        probs.astype(v.dtype),
-        v,
-        preferred_element_type=jnp.float32,
-    )
-    return out.astype(q.dtype)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+    return _masked_attention(q, k, v, mask)
 
 
 def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,

@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
@@ -137,3 +138,69 @@ class TestGraftEntry:
 
         fn, (params, tokens) = ge.entry()
         assert callable(fn) and tokens.ndim == 2
+
+
+class TestGradientAccumulation:
+    def test_accumulated_step_matches_full_batch(self):
+        """accum_steps=2 over half-size microbatches must equal the one-shot
+        full-batch step (mean loss, averaged grads) to accumulation
+        tolerance — the large-batch recipe when activations exceed HBM."""
+        import jax
+
+        from k8s_dra_driver_tpu.models import burnin
+
+        cfg = burnin.TINY
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        params = jax.tree.map(lambda a: a.astype(jnp.float32), params)
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=32)
+
+        # SGD: the param delta is LINEAR in the gradient, so this compares
+        # the accumulated gradient itself.  (Through adamw a near-zero grad
+        # element can flip sign under accumulation-order noise and the
+        # normalized update flips with it — that would test float luck.)
+        import optax
+
+        opt = optax.sgd(0.1)
+        loss_fn_ = lambda p, t: burnin.loss_fn(p, t, cfg)  # noqa: E731
+        full = jax.jit(burnin.make_sgd_step(loss_fn_, opt))
+        acc = jax.jit(burnin.make_sgd_step(loss_fn_, opt, accum_steps=2))
+        opt_state = opt.init(params)
+        p1, _, l1 = full(params, opt_state, tokens)
+        p2, _, l2 = acc(params, opt_state, tokens)
+        assert abs(float(l1) - float(l2)) < 1e-5
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            )
+
+    def test_indivisible_batch_rejected(self):
+        import jax
+        import pytest
+
+        from k8s_dra_driver_tpu.models import burnin
+
+        cfg = burnin.TINY
+        fns = burnin.build_train_step(cfg, accum_steps=3)
+        params, opt_state = fns.init(jax.random.PRNGKey(0))
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=32)
+        with pytest.raises(ValueError, match="not divisible"):
+            fns.step(params, opt_state, tokens)
+
+    def test_sharded_accumulation_runs(self):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from k8s_dra_driver_tpu.models import burnin
+        from k8s_dra_driver_tpu.parallel.mesh import MeshShape, build_mesh
+
+        mesh = build_mesh(cpu_devices(8), MeshShape(data=2, seq=2, model=2))
+        fns = burnin.build_train_step(burnin.TINY, mesh=mesh, accum_steps=2)
+        with mesh:
+            params, opt_state = fns.init(jax.random.PRNGKey(0))
+            tokens = jax.device_put(
+                burnin.sample_tokens(jax.random.PRNGKey(1), burnin.TINY, batch=8, seq=64),
+                NamedSharding(mesh, P("data", None)),
+            )
+            _, _, loss = fns.step(params, opt_state, tokens)
+        assert np.isfinite(float(loss))
