@@ -228,8 +228,39 @@ def make_sgd_step(loss_fn_, opt, accum_steps: int = 1):
     return step
 
 
-def make_optimizer(lr: float = 3e-4):
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+def make_optimizer(
+    lr: float = 3e-4,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    grad_clip: float = 0.0,
+):
+    """adamw with the standard LLM training schedule knobs.
+
+    ``warmup_steps``/``decay_steps``: linear warmup into cosine decay (the
+    de-facto pretraining schedule); both 0 = constant lr, and a PARTIAL
+    spec is an error — silently clamping one of them produces schedules
+    nobody asked for (zero-lr first steps or lr pinned at the end value).
+    ``grad_clip``: global-norm clipping before the update (>0 enables)."""
+    if warmup_steps or decay_steps:
+        if not (warmup_steps > 0 and decay_steps > warmup_steps):
+            raise ValueError(
+                "schedule needs warmup_steps > 0 and decay_steps > "
+                f"warmup_steps (got {warmup_steps}, {decay_steps}); "
+                "leave both 0 for constant lr"
+            )
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=decay_steps,
+            end_value=lr * 0.1,
+        )
+    else:
+        schedule = lr
+    opt = optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=0.01)
+    if grad_clip > 0:
+        opt = optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+    return opt
 
 
 @dataclass

@@ -204,3 +204,77 @@ class TestGradientAccumulation:
             )
             _, _, loss = fns.step(params, opt_state, tokens)
         assert np.isfinite(float(loss))
+
+
+class TestOptimizerKnobs:
+    def test_warmup_cosine_schedule_shapes_lr(self):
+        from k8s_dra_driver_tpu.models import burnin
+
+        cfg = burnin.TINY
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        opt = burnin.make_optimizer(1e-2, warmup_steps=2, decay_steps=10)
+        state = opt.init(params)
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=16)
+        step = jax.jit(
+            burnin.make_sgd_step(lambda p, t: burnin.loss_fn(p, t, cfg), opt)
+        )
+        # warmup: the very first update is ~zero (lr starts at 0)
+        p1, state, _ = step(params, state, tokens)
+        d1 = max(
+            float(jnp.abs(a - b).max())
+            for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(params))
+        )
+        p2, state, _ = step(p1, state, tokens)
+        d2 = max(
+            float(jnp.abs(a - b).max())
+            for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(p1))
+        )
+        assert d1 < d2  # lr ramped up between step 0 and step 1
+
+    def test_grad_clip_changes_the_update(self):
+        """Clipping must actually engage: with plain SGD the param delta is
+        the (clipped) gradient times lr, so a tiny clip bounds the global
+        update norm where the unclipped step exceeds it."""
+        import optax
+
+        from k8s_dra_driver_tpu.models import burnin
+
+        cfg = burnin.TINY
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.float32),
+            burnin.init_params(jax.random.PRNGKey(0), cfg),
+        )
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=16)
+        loss_fn_ = lambda p, t: burnin.loss_fn(p, t, cfg)  # noqa: E731
+        clip = 1e-3
+
+        def delta_norm(opt):
+            state = opt.init(params)
+            p1, _, _ = jax.jit(burnin.make_sgd_step(loss_fn_, opt))(
+                params, state, tokens
+            )
+            return float(
+                optax.global_norm(jax.tree.map(lambda a, b: a - b, p1, params))
+            )
+
+        unclipped = delta_norm(optax.sgd(1.0))
+        clipped = delta_norm(
+            optax.chain(optax.clip_by_global_norm(clip), optax.sgd(1.0))
+        )
+        via_factory_sees_clip = burnin.make_optimizer(1e-2, grad_clip=clip)
+        assert unclipped > clip * 2  # the clip is actually binding here
+        assert clipped <= clip * 1.01
+        # and the factory wires the same transform (structural check)
+        assert delta_norm(via_factory_sees_clip) < delta_norm(
+            burnin.make_optimizer(1e-2)
+        )
+
+    def test_partial_schedule_spec_rejected(self):
+        import pytest
+
+        from k8s_dra_driver_tpu.models import burnin
+
+        with pytest.raises(ValueError, match="decay_steps > "):
+            burnin.make_optimizer(1e-3, warmup_steps=100)
+        with pytest.raises(ValueError, match="warmup_steps > 0"):
+            burnin.make_optimizer(1e-3, decay_steps=100)
