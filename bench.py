@@ -133,9 +133,14 @@ def run_data_plane() -> dict:
     return out
 
 
-def _decode_throughput(cfg, params, batch=8, prompt_len=16, steps=112) -> dict:
+def _decode_throughput(cfg, params, batch=16, prompt_len=16, steps=496, chain=4) -> dict:
     """Greedy tokens/second with a bf16 KV cache and batched prefill
-    (the serving configuration; RTT subtracted)."""
+    (the serving configuration; RTT subtracted).
+
+    ``chain`` full decode passes run inside ONE jit (each re-seeded from the
+    tail of the previous pass), so the tunnel's ~50-70 ms dispatch RTT is
+    paid once while the timed region generates chain x steps tokens per
+    sequence — the matmul-probe measurement discipline applied to serving."""
     import jax
     import jax.numpy as jnp
 
@@ -145,11 +150,20 @@ def _decode_throughput(cfg, params, batch=8, prompt_len=16, steps=112) -> dict:
     prompt = burnin.sample_tokens(
         jax.random.PRNGKey(3), cfg, batch=batch, seq=prompt_len
     )
-    fn = jax.jit(
-        lambda p, t: decode.greedy_decode(
-            p, t, steps, cfg=cfg, cache_dtype=jnp.bfloat16, batch_prefill=True
-        )
-    )
+
+    @jax.jit
+    def fn(p, t):
+        out = t
+        for _ in range(chain):
+            full = decode.greedy_decode(
+                p, out, steps, cfg=cfg, cache_dtype=jnp.bfloat16, batch_prefill=True
+            )
+            # re-seed the next pass with the last prompt_len generated tokens
+            out = jax.lax.dynamic_slice_in_dim(
+                full, full.shape[1] - prompt_len, prompt_len, axis=1
+            )
+        return full
+
     int(fn(params, prompt)[0, -1])  # compile + sync via host readback
     start = time.perf_counter()
     int(fn(params, prompt)[0, -1])
@@ -157,13 +171,12 @@ def _decode_throughput(cfg, params, batch=8, prompt_len=16, steps=112) -> dict:
     rtt = dispatch_rtt_seconds()
     if total <= 1.5 * rtt:
         raise RuntimeError("decode timing dominated by dispatch RTT")
-    # batched prefill handles the prompt in one parallel pass; the timed
-    # region generates `steps` tokens per sequence.
-    tok_s = batch * steps / (total - rtt)
+    tok_s = batch * steps * chain / (total - rtt)
     return {
         "tokens_per_s": round(tok_s, 1),
         "batch": batch,
         "steps": steps,
+        "chain": chain,
         "prompt_len": prompt_len,
     }
 
