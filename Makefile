@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench demo dryrun lint clean
+.PHONY: all native test asan-test bench demo dryrun lint helm-template clean
 
 all: native
 
@@ -35,11 +35,17 @@ dryrun:
 
 # Static analysis (the reference's golangci-lint slot, .golangci.yaml:2-12):
 # syntax via compileall + the first-party AST linter (tools/lint.py) + the
-# helm chart consistency check (render-test substitute; no helm binary).
+# helm chart consistency check + a full hermetic chart render
+# (tools/helm_render.py — the `helm template` substitute; no helm binary).
 lint:
 	$(PYTHON) -m compileall -q k8s_dra_driver_tpu tests tools bench.py __graft_entry__.py
 	$(PYTHON) tools/lint.py k8s_dra_driver_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) tools/helm_check.py
+	$(PYTHON) -m tools.helm_render deployments/helm/tpu-dra-driver >/dev/null
+
+# Render the chart to stdout (helm template substitute).
+helm-template:
+	$(PYTHON) -m tools.helm_render deployments/helm/tpu-dra-driver
 
 clean:
 	$(MAKE) -C $(CPP_DIR) clean

@@ -1,0 +1,275 @@
+"""Chart render tests through the first-party renderer (tools/helm_render).
+
+The dev image has no helm binary; these tests close the "template output is
+only exercised on a real cluster" gap by rendering the chart hermetically —
+the render-test slot of the reference's CI (the reference itself only
+validates via `helm install` on a live kind cluster,
+demo/clusters/kind/scripts/install-dra-driver.sh)."""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+import yaml
+
+from tools.helm_render import (
+    ChartFail,
+    RenderError,
+    render_chart,
+    render_chart_docs,
+)
+
+CHART = pathlib.Path(__file__).resolve().parent.parent / "deployments/helm/tpu-dra-driver"
+
+
+def _by_kind(docs):
+    out = {}
+    for d in docs:
+        out.setdefault(d["kind"], []).append(d)
+    return out
+
+
+@pytest.fixture(scope="module")
+def default_docs():
+    return render_chart_docs(CHART)
+
+
+class TestDefaultRender:
+    def test_all_templates_emit_valid_yaml(self, default_docs):
+        assert len(default_docs) >= 8
+
+    def test_expected_kinds_present(self, default_docs):
+        kinds = _by_kind(default_docs)
+        for kind in (
+            "DaemonSet",
+            "Deployment",
+            "DeviceClass",
+            "ServiceAccount",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "ValidatingAdmissionPolicy",
+            "ValidatingAdmissionPolicyBinding",
+        ):
+            assert kind in kinds, f"missing {kind}"
+
+    def test_three_deviceclasses_with_driver_cel(self, default_docs):
+        classes = _by_kind(default_docs)["DeviceClass"]
+        names = {c["metadata"]["name"] for c in classes}
+        assert names == {
+            "tpu.google.com",
+            "subslice.tpu.google.com",
+            "membership.tpu.google.com",
+        }
+        for c in classes:
+            exprs = [s["cel"]["expression"] for s in c["spec"]["selectors"]]
+            assert any("device.driver == 'tpu.google.com'" in e for e in exprs)
+
+    def test_daemonset_wiring(self, default_docs):
+        ds = _by_kind(default_docs)["DaemonSet"][0]
+        assert ds["metadata"]["name"] == "tpu-dra-driver-kubelet-plugin"
+        assert ds["metadata"]["namespace"] == "tpu-dra-driver"
+        spec = ds["spec"]["template"]["spec"]
+        names = [c["name"] for c in spec["containers"]]
+        assert names == ["plugin", "topology-daemon"]
+        plugin = spec["containers"][0]
+        assert plugin["securityContext"]["privileged"] is True
+        env = {e["name"]: e.get("value") for e in plugin["env"]}
+        assert env["CDI_ROOT"] == "/var/run/cdi"
+        assert env["LIBTPU_PATH"] == "/lib/libtpu.so"
+        assert "TPUINFO_FAKE_TOPOLOGY" not in env  # real mode by default
+        # helpers resolved inside labels
+        assert ds["metadata"]["labels"]["app.kubernetes.io/name"] == "tpu-dra-driver"
+        assert ds["metadata"]["labels"]["app.kubernetes.io/instance"] == "tpu-dra-driver"
+        # toYaml|nindent blocks round-trip as structures
+        assert spec["tolerations"] == [{"operator": "Exists", "effect": "NoSchedule"}]
+        affinity = spec["affinity"]["nodeAffinity"]
+        terms = affinity["requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"]
+        assert len(terms) == 2
+        # volumes referenced by mounts all exist
+        volumes = {v["name"] for v in spec["volumes"]}
+        for c in spec["containers"]:
+            for m in c.get("volumeMounts", []):
+                assert m["name"] in volumes, f"dangling mount {m['name']}"
+
+    def test_probes_rendered_when_port_enabled(self, default_docs):
+        plugin = _by_kind(default_docs)["DaemonSet"][0]["spec"]["template"]["spec"]["containers"][0]
+        assert plugin["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        assert plugin["ports"][0]["containerPort"] == 8080
+
+    def test_vap_scopes_to_service_account_and_handles_delete(self, default_docs):
+        vap = _by_kind(default_docs)["ValidatingAdmissionPolicy"][0]
+        cond = vap["spec"]["matchConditions"][0]["expression"]
+        assert (
+            "system:serviceaccount:tpu-dra-driver:tpu-dra-driver-service-account"
+            in cond
+        )
+        validation = vap["spec"]["validations"][0]["expression"]
+        assert "DELETE" in validation and "oldObject" in validation
+
+    def test_rbac_binds_the_rendered_service_account(self, default_docs):
+        kinds = _by_kind(default_docs)
+        sa = kinds["ServiceAccount"][0]["metadata"]
+        binding = kinds["ClusterRoleBinding"][0]
+        subject = binding["subjects"][0]
+        assert subject["name"] == sa["name"]
+        assert subject["namespace"] == sa["namespace"]
+        assert binding["roleRef"]["name"] == kinds["ClusterRole"][0]["metadata"]["name"]
+
+    def test_controller_env_joins_device_classes(self, default_docs):
+        dep = _by_kind(default_docs)["Deployment"][0]
+        env = {
+            e["name"]: e.get("value")
+            for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["DEVICE_CLASSES"] == "tpu,subslice,membership"
+
+
+class TestVariants:
+    def test_membership_disabled_drops_controller(self):
+        docs = render_chart_docs(
+            CHART, values_override={"deviceClasses": ["tpu", "subslice"]}
+        )
+        kinds = _by_kind(docs)
+        assert "Deployment" not in kinds
+        names = {c["metadata"]["name"] for c in kinds["DeviceClass"]}
+        assert "membership.tpu.google.com" not in names
+        assert len(names) == 2
+
+    def test_fake_topology_env_injected(self):
+        docs = render_chart_docs(
+            CHART, values_override={"fakeTopology": "v5e-16", "fakeCluster": True}
+        )
+        plugin = _by_kind(docs)["DaemonSet"][0]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in plugin["env"]}
+        assert env["TPUINFO_FAKE_TOPOLOGY"] == "v5e-16"
+        assert env["FAKE_CLUSTER"] == "true"
+
+    def test_http_port_disabled_drops_probes(self):
+        docs = render_chart_docs(CHART, values_override={"httpPort": -1})
+        plugin = _by_kind(docs)["DaemonSet"][0]["spec"]["template"]["spec"]["containers"][0]
+        assert "ports" not in plugin
+        assert "livenessProbe" not in plugin
+
+    def test_name_override_truncates_and_propagates(self):
+        docs = render_chart_docs(
+            CHART, values_override={"nameOverride": "x" * 70}
+        )
+        ds = _by_kind(docs)["DaemonSet"][0]
+        assert ds["metadata"]["name"].startswith("x" * 63)
+        assert len(ds["metadata"]["name"]) == 63 + len("-kubelet-plugin")
+
+    def test_namespace_override_beats_release_namespace(self):
+        docs = render_chart_docs(
+            CHART, values_override={"namespaceOverride": "tpu-system"}, namespace="other"
+        )
+        assert _by_kind(docs)["DaemonSet"][0]["metadata"]["namespace"] == "tpu-system"
+
+
+class TestValidationGuards:
+    """validation.yaml must fail the render with actionable messages
+    (reference templates/validation.yaml:17-63 behavior)."""
+
+    def test_default_values_pass(self):
+        render_chart(CHART)  # no ChartFail
+
+    @pytest.mark.parametrize(
+        "override,needle",
+        [
+            ({"deviceClasses": []}, "at least one class"),
+            ({"deviceClasses": ["tpu", "bogus"]}, "invalid deviceClasses entry"),
+            ({"deviceClasses": "tpu"}, "must be a list"),
+            ({"namespace": "oops"}, "not supported"),
+            ({"image": {"tag": ""}}, "image.tag"),
+            ({"image": {"repository": ""}}, "image.repository"),
+            ({"socketDir": "relative/path"}, "socketDir"),
+            ({"cdiRoot": "no-slash"}, "cdiRoot"),
+            ({"partedStateDir": "x"}, "partedStateDir"),
+            ({"fakeTopology": "not-a-slice"}, "fakeTopology"),
+        ],
+    )
+    def test_bad_values_fail_with_message(self, override, needle):
+        with pytest.raises(ChartFail) as exc:
+            render_chart(CHART, values_override=override)
+        assert needle in str(exc.value)
+
+    def test_default_namespace_guard_and_bypass(self):
+        with pytest.raises(ChartFail) as exc:
+            render_chart(CHART, namespace="default")
+        assert "default" in str(exc.value)
+        render_chart(
+            CHART, namespace="default", values_override={"allowDefaultNamespace": True}
+        )
+        render_chart(
+            CHART, namespace="default", values_override={"namespaceOverride": "ok-ns"}
+        )
+
+
+class TestRendererEngine:
+    """The template-language subset itself (unit level)."""
+
+    def test_unsupported_function_is_loud(self, tmp_path):
+        chart = tmp_path / "c"
+        (chart / "templates").mkdir(parents=True)
+        (chart / "Chart.yaml").write_text("name: c\nversion: 0.1.0\nappVersion: 1\n")
+        (chart / "values.yaml").write_text("x: 1\n")
+        (chart / "templates" / "t.yaml").write_text("a: {{ sha256sum .Values.x }}\n")
+        with pytest.raises(RenderError, match="unknown function"):
+            render_chart(chart)
+
+    def test_go_printf_list_formatting(self):
+        from tools.helm_render import _go_printf
+
+        assert _go_printf("got: %v", [["a", "b"]]) == "got: [a b]"
+        assert _go_printf("%q", ["x"]) == '"x"'
+        assert _go_printf("%d items", [3]) == "3 items"
+
+    def test_pipe_appends_final_argument(self, tmp_path):
+        chart = tmp_path / "c"
+        (chart / "templates").mkdir(parents=True)
+        (chart / "Chart.yaml").write_text("name: c\nversion: 0.1.0\nappVersion: 1\n")
+        (chart / "values.yaml").write_text("name: verylongname\n")
+        (chart / "templates" / "t.yaml").write_text(
+            'a: {{ .Values.name | trunc 4 | quote }}\n'
+        )
+        out = render_chart(chart)["t.yaml"]
+        assert yaml.safe_load(out) == {"a": "very"}
+
+    def test_whitespace_trim_markers(self, tmp_path):
+        chart = tmp_path / "c"
+        (chart / "templates").mkdir(parents=True)
+        (chart / "Chart.yaml").write_text("name: c\nversion: 0.1.0\nappVersion: 1\n")
+        (chart / "values.yaml").write_text("enabled: true\n")
+        (chart / "templates" / "t.yaml").write_text(
+            "a: 1\n{{- if .Values.enabled }}\nb: 2\n{{- end }}\n"
+        )
+        assert yaml.safe_load(render_chart(chart)["t.yaml"]) == {"a": 1, "b": 2}
+
+    def test_range_rebinds_dot_and_keeps_vars(self, tmp_path):
+        chart = tmp_path / "c"
+        (chart / "templates").mkdir(parents=True)
+        (chart / "Chart.yaml").write_text("name: c\nversion: 0.1.0\nappVersion: 1\n")
+        (chart / "values.yaml").write_text("items: [a, b]\n")
+        (chart / "templates" / "t.yaml").write_text(
+            '{{- $pfx := "p" }}\n'
+            "{{- range .Values.items }}\n"
+            "- {{ $pfx }}{{ . }}\n"
+            "{{- end }}\n"
+        )
+        assert yaml.safe_load(render_chart(chart)["t.yaml"]) == ["pa", "pb"]
+
+    def test_cli_smoke(self, capsys):
+        from tools.helm_render import main
+
+        rc = main([str(CHART), "--set", "fakeTopology=v5e-16"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        docs = [d for d in yaml.safe_load_all(out) if d]
+        assert any(d["kind"] == "DaemonSet" for d in docs)
+
+    def test_cli_fail_exits_nonzero(self, capsys):
+        from tools.helm_render import main
+
+        rc = main([str(CHART), "--set", "deviceClasses=[]"])
+        assert rc == 1
+        assert "at least one class" in capsys.readouterr().err

@@ -1,0 +1,795 @@
+"""First-party `helm template` substitute for the in-repo chart.
+
+The dev image carries no helm binary, so chart template OUTPUT was only
+exercised on a real cluster (ARCHITECTURE.md known gap).  This module
+implements the Go text/template + sprig subset the chart actually uses —
+pipelines, define/include, if/else/range/with, variables, whitespace trim
+markers, and the ~25 functions referenced by `templates/*.yaml` — enough to
+render the chart hermetically and parse every emitted document as YAML in
+tests (the render-test slot of the reference's CI; the reference relies on
+`helm install` on a live kind cluster instead, demo/clusters/kind/scripts/
+install-dra-driver.sh).
+
+Not a general helm reimplementation: unsupported constructs raise
+``RenderError`` loudly rather than misrender silently.
+
+CLI: ``python -m tools.helm_render CHARTDIR [--set k=v ...]
+[--release NAME] [--namespace NS]`` prints the multi-document YAML stream,
+mirroring ``helm template``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import pathlib
+import re
+import sys
+from typing import Any, Callable
+
+import yaml
+
+
+class RenderError(Exception):
+    """Template could not be rendered (parse error or unsupported form)."""
+
+
+class ChartFail(RenderError):
+    """The template called ``fail`` — mirrors helm's render-time abort."""
+
+
+# ---------------------------------------------------------------------------
+# Lexing: split a template into literal text and {{ action }} nodes.
+
+_ACTION_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.DOTALL)
+
+
+@dataclasses.dataclass
+class _Action:
+    src: str          # the action body, stripped
+    trim_before: bool  # {{- : strip whitespace left of the action
+    trim_after: bool   # -}} : strip whitespace right of the action
+
+
+def _lex(template: str) -> list[Any]:
+    """Return interleaved text strings and _Action nodes, trims applied."""
+    nodes: list[Any] = []
+    pos = 0
+    for m in _ACTION_RE.finditer(template):
+        text = template[m.start() : m.end()]
+        before = template[pos : m.start()]
+        act = _Action(
+            src=m.group(1),
+            trim_before=text.startswith("{{-"),
+            trim_after=text.endswith("-}}"),
+        )
+        nodes.append(before)
+        nodes.append(act)
+        pos = m.end()
+    nodes.append(template[pos:])
+    # apply whitespace trim markers to neighbouring text nodes
+    for i, node in enumerate(nodes):
+        if not isinstance(node, _Action):
+            continue
+        if node.trim_before and i > 0:
+            nodes[i - 1] = nodes[i - 1].rstrip(" \t\n\r")
+        if node.trim_after and i + 1 < len(nodes):
+            nodes[i + 1] = nodes[i + 1].lstrip(" \t\n\r")
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# Expression (pipeline) parsing.  Grammar, per Go text/template:
+#   pipeline := command ('|' command)*
+#   command  := operand operand*
+#   operand  := literal | '.' field-path | '$var' field-path? | '(' pipeline ')'
+# A piped value is appended as the FINAL argument of the next command.
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<pipe>\|)
+  | (?P<lparen>\() | (?P<rparen>\))
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<dotpath>\.[A-Za-z_][\w.]*|\.)
+  | (?P<var>\$[A-Za-z_]\w*|\$)
+  | (?P<ident>[A-Za-z_][\w]*)
+""",
+    re.VERBOSE,
+)
+
+_GO_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\"}
+
+
+def _unquote(tok: str) -> str:
+    body = tok[1:-1]
+    out = []
+    i = 0
+    while i < len(body):
+        ch = body[i]
+        if ch == "\\" and i + 1 < len(body):
+            out.append(_GO_ESCAPES.get(body[i + 1], body[i + 1]))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _tokenize_expr(src: str) -> list[tuple[str, str]]:
+    toks = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise RenderError(f"cannot tokenize expression at: {src[pos:]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind != "ws":
+            toks.append((kind, m.group()))
+    return toks
+
+
+# Parsed operand forms
+@dataclasses.dataclass
+class _Lit:
+    value: Any
+
+
+@dataclasses.dataclass
+class _Dot:
+    path: list[str]  # [] means bare '.'
+
+
+@dataclasses.dataclass
+class _Var:
+    name: str        # '$' means the root variable
+    path: list[str]
+
+
+@dataclasses.dataclass
+class _Call:
+    name: str
+    args: list[Any]
+
+
+@dataclasses.dataclass
+class _Paren:
+    pipeline: "_Pipeline"
+
+
+@dataclasses.dataclass
+class _Pipeline:
+    commands: list[Any]  # each command: _Lit | _Dot | _Var | _Call | _Paren
+
+
+class _ExprParser:
+    def __init__(self, toks: list[tuple[str, str]]):
+        self.toks = toks
+        self.pos = 0
+
+    def peek(self):
+        return self.toks[self.pos] if self.pos < len(self.toks) else (None, None)
+
+    def next(self):
+        tok = self.peek()
+        self.pos += 1
+        return tok
+
+    def parse_pipeline(self) -> _Pipeline:
+        commands = [self.parse_command()]
+        while self.peek()[0] == "pipe":
+            self.next()
+            commands.append(self.parse_command())
+        return _Pipeline(commands)
+
+    def parse_command(self):
+        operands = []
+        while True:
+            kind, _tok = self.peek()
+            if kind in (None, "pipe", "rparen"):
+                break
+            operands.append(self.parse_operand())
+        if not operands:
+            raise RenderError("empty command in pipeline")
+        head, rest = operands[0], operands[1:]
+        if isinstance(head, _Call) or rest:
+            # `f a b` — head must be a function name
+            if not isinstance(head, _Call):
+                raise RenderError(f"cannot apply arguments to {head}")
+            head.args.extend(rest)
+            return head
+        return head
+
+    def parse_operand(self):
+        kind, tok = self.next()
+        if kind == "string":
+            return _Lit(_unquote(tok))
+        if kind == "number":
+            return _Lit(float(tok) if "." in tok else int(tok))
+        if kind == "dotpath":
+            path = [] if tok == "." else tok[1:].split(".")
+            return _Dot(path)
+        if kind == "var":
+            return _Var(tok, [])
+        if kind == "ident":
+            if tok in ("true", "false"):
+                return _Lit(tok == "true")
+            if tok == "nil":
+                return _Lit(None)
+            return _Call(tok, [])
+        if kind == "lparen":
+            inner = self.parse_pipeline()
+            k, _ = self.next()
+            if k != "rparen":
+                raise RenderError("unbalanced parenthesis in expression")
+            return _Paren(inner)
+        raise RenderError(f"unexpected token {tok!r}")
+
+
+def _parse_expr(src: str) -> _Pipeline:
+    parser = _ExprParser(_tokenize_expr(src))
+    pipeline = parser.parse_pipeline()
+    if parser.peek()[0] is not None:
+        raise RenderError(f"trailing tokens in expression: {src!r}")
+    return pipeline
+
+
+# ---------------------------------------------------------------------------
+# Structural parsing: nest if/range/with/define blocks.
+
+@dataclasses.dataclass
+class _Text:
+    value: str
+
+
+@dataclasses.dataclass
+class _Output:
+    pipeline: _Pipeline
+
+
+@dataclasses.dataclass
+class _Assign:
+    var: str
+    pipeline: _Pipeline
+
+
+@dataclasses.dataclass
+class _Cond:
+    # list of (pipeline-or-None, body); None pipeline = else branch
+    branches: list[tuple[Any, list]]
+
+
+@dataclasses.dataclass
+class _Range:
+    var: str | None
+    pipeline: _Pipeline
+    body: list
+
+
+@dataclasses.dataclass
+class _With:
+    pipeline: _Pipeline
+    body: list
+
+
+@dataclasses.dataclass
+class _Define:
+    name: str
+    body: list
+
+
+def _parse_nodes(nodes: list[Any]) -> list:
+    """Parse the lexed node stream into a tree; returns top-level body."""
+    pos = 0
+
+    def parse_block(stop_on: tuple[str, ...]) -> tuple[list, str, _Action | None]:
+        nonlocal pos
+        body: list = []
+        while pos < len(nodes):
+            node = nodes[pos]
+            pos += 1
+            if isinstance(node, str):
+                if node:
+                    body.append(_Text(node))
+                continue
+            src = node.src
+            if src.startswith("/*"):
+                continue  # comment
+            word = src.split(None, 1)[0] if src else ""
+            if word in stop_on or (word == "else" and "else" in stop_on):
+                return body, src, node
+            if word == "if":
+                body.append(parse_if(src[2:].strip()))
+            elif word == "range":
+                body.append(parse_range(src[5:].strip()))
+            elif word == "with":
+                inner, term, _ = parse_block_after()
+                if not term == "end":
+                    raise RenderError(f"'with' terminated by {term!r}, want 'end'")
+                body.append(_With(_parse_expr(src[4:].strip()), inner))
+            elif word == "define":
+                m = re.match(r'define\s+"([^"]+)"$', src)
+                if not m:
+                    raise RenderError(f"malformed define: {src!r}")
+                inner, term, _ = parse_block_after()
+                if term != "end":
+                    raise RenderError("'define' not closed with 'end'")
+                body.append(_Define(m.group(1), inner))
+            elif word == "end":
+                raise RenderError("unexpected 'end'")
+            elif re.match(r"^\$[A-Za-z_]\w*\s*:?=", src):
+                var, _, rhs = src.partition("=")
+                var = var.rstrip(": \t")
+                body.append(_Assign(var, _parse_expr(rhs.strip())))
+            else:
+                body.append(_Output(_parse_expr(src)))
+        return body, "", None
+
+    def parse_block_after():
+        return parse_block(("end", "else"))
+
+    def parse_if(cond_src: str) -> _Cond:
+        branches: list[tuple[Any, list]] = []
+        cond: Any = _parse_expr(cond_src)
+        while True:
+            inner, term, _node = parse_block_after()
+            branches.append((cond, inner))
+            if term == "end":
+                return _Cond(branches)
+            if term == "else":
+                final, term2, _ = parse_block_after()
+                if term2 != "end":
+                    raise RenderError("'else' block not closed with 'end'")
+                branches.append((None, final))
+                return _Cond(branches)
+            if term.startswith("else if"):
+                cond = _parse_expr(term[len("else if") :].strip())
+                continue
+            raise RenderError(f"'if' terminated by {term!r}")
+
+    def parse_range(src: str) -> _Range:
+        var = None
+        m = re.match(r"^(\$[A-Za-z_]\w*)\s*:?=\s*(.*)$", src)
+        if m:
+            var, src = m.group(1), m.group(2)
+        inner, term, _ = parse_block_after()
+        if term != "end":
+            raise RenderError("'range' not closed with 'end'")
+        return _Range(var, _parse_expr(src), inner)
+
+    body, term, _ = parse_block(())
+    if term:
+        raise RenderError(f"stray block terminator {term!r} at top level")
+    return body
+
+
+# ---------------------------------------------------------------------------
+# Evaluation.
+
+
+def _truthy(v: Any) -> bool:
+    """Go template truthiness: zero values are false."""
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v != 0
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v) > 0
+    return True
+
+
+def _go_str(v: Any) -> str:
+    """%v-style formatting (lists render Go-like: [a b c])."""
+    if v is None:
+        return "<nil>"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    if isinstance(v, (list, tuple)):
+        return "[" + " ".join(_go_str(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return "map[" + " ".join(f"{k}:{_go_str(x)}" for k, x in sorted(v.items())) + "]"
+    return str(v)
+
+
+def _go_printf(fmt: str, args: list[Any]) -> str:
+    out = []
+    ai = 0
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch != "%":
+            out.append(ch)
+            i += 1
+            continue
+        spec = fmt[i + 1] if i + 1 < len(fmt) else ""
+        if spec == "%":
+            out.append("%")
+        else:
+            if ai >= len(args):
+                raise RenderError(f"printf: missing argument for %{spec}")
+            arg = args[ai]
+            ai += 1
+            if spec == "q":
+                out.append('"' + _go_str(arg).replace("\\", "\\\\").replace('"', '\\"') + '"')
+            elif spec == "d":
+                out.append(str(int(arg)))
+            elif spec in ("v", "s"):
+                out.append(_go_str(arg))
+            else:
+                raise RenderError(f"printf: unsupported verb %{spec}")
+        i += 2
+    return "".join(out)
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _indent(n: int, s: str) -> str:
+    pad = " " * int(n)
+    return "\n".join(pad + line if line else line for line in s.split("\n"))
+
+
+def _kind_of(v: Any) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int64"
+    if isinstance(v, float):
+        return "float64"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, (list, tuple)):
+        return "slice"
+    if isinstance(v, dict):
+        return "map"
+    if v is None:
+        return "invalid"
+    return type(v).__name__
+
+
+def _num(v: Any) -> Any:
+    """Coerce for numeric comparison the way sprig's untyped compares do."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        try:
+            return float(v) if "." in v else int(v)
+        except ValueError:
+            return v
+    return v
+
+
+class _Renderer:
+    def __init__(self, defines: dict[str, list], root: dict):
+        self.defines = defines
+        self.root = root  # the '.' for top-level templates
+
+        self.funcs: dict[str, Callable[..., Any]] = {
+            "default": lambda d, v=None: v if _truthy(v) else d,
+            "quote": lambda *a: " ".join('"' + _go_str(x).replace("\\", "\\\\").replace('"', '\\"') + '"' for x in a),
+            "trunc": lambda n, s: s[: int(n)] if int(n) >= 0 else s[int(n) :],
+            "trimSuffix": lambda suf, s: s[: -len(suf)] if suf and s.endswith(suf) else s,
+            "trimPrefix": lambda pre, s: s[len(pre) :] if pre and s.startswith(pre) else s,
+            "upper": lambda s: s.upper(),
+            "lower": lambda s: s.lower(),
+            "indent": _indent,
+            "nindent": lambda n, s: "\n" + _indent(n, s),
+            "toYaml": _to_yaml,
+            "int": lambda v: int(float(v)) if _truthy(v) or v == "0" or v == 0 else 0,
+            "len": lambda v: len(v),
+            "not": lambda v: not _truthy(v),
+            "and": self._f_and,
+            "or": self._f_or,
+            "eq": lambda a, *rest: any(a == r for r in rest),
+            "ne": lambda a, b: a != b,
+            "lt": lambda a, b: _num(a) < _num(b),
+            "le": lambda a, b: _num(a) <= _num(b),
+            "gt": lambda a, b: _num(a) > _num(b),
+            "ge": lambda a, b: _num(a) >= _num(b),
+            "list": lambda *a: list(a),
+            "dict": self._f_dict,
+            "has": lambda needle, coll: needle in (coll or []),
+            "hasKey": lambda d, k: isinstance(d, dict) and k in d,
+            "kindIs": lambda kind, v: _kind_of(v) == kind,
+            "printf": lambda fmt, *a: _go_printf(fmt, list(a)),
+            "print": lambda *a: "".join(_go_str(x) for x in a),
+            "fail": self._f_fail,
+            "required": self._f_required,
+            "join": lambda sep, coll: sep.join(_go_str(x) for x in coll or []),
+            "split": lambda sep, s: dict((f"_{i}", part) for i, part in enumerate(s.split(sep))),
+            "hasPrefix": lambda pre, s: isinstance(s, str) and s.startswith(pre),
+            "hasSuffix": lambda suf, s: isinstance(s, str) and s.endswith(suf),
+            "contains": lambda sub, s: isinstance(s, str) and sub in s,
+            "regexMatch": lambda pat, s: re.search(pat, s or "") is not None,
+            "replace": lambda old, new, s: s.replace(old, new),
+            "empty": lambda v: not _truthy(v),
+            "coalesce": lambda *a: next((x for x in a if _truthy(x)), None),
+            "ternary": lambda t, f, cond: t if _truthy(cond) else f,
+            "include": self._f_include,
+            "tpl": self._f_tpl,
+            "toString": _go_str,
+            "trim": lambda s: s.strip(),
+            "add": lambda *a: sum(_num(x) for x in a),
+            "sub": lambda a, b: _num(a) - _num(b),
+            "keys": lambda d: sorted(d.keys()),
+            "first": lambda coll: coll[0] if coll else None,
+            "last": lambda coll: coll[-1] if coll else None,
+        }
+
+    # -- function helpers needing renderer state
+    def _f_and(self, *args):
+        result: Any = True
+        for a in args:
+            result = a
+            if not _truthy(a):
+                return a
+        return result
+
+    def _f_or(self, *args):
+        for a in args:
+            if _truthy(a):
+                return a
+        return args[-1] if args else None
+
+    def _f_dict(self, *kv):
+        if len(kv) % 2:
+            raise RenderError("dict: odd number of arguments")
+        return {kv[i]: kv[i + 1] for i in range(0, len(kv), 2)}
+
+    def _f_fail(self, msg):
+        raise ChartFail(_go_str(msg))
+
+    def _f_required(self, msg, v=None):
+        if not _truthy(v):
+            raise ChartFail(_go_str(msg))
+        return v
+
+    def _f_include(self, name, dot):
+        body = self.defines.get(name)
+        if body is None:
+            raise RenderError(f"include of undefined template {name!r}")
+        return self.render_body(body, dot, {"$": self.root})
+
+    def _f_tpl(self, text, dot):
+        nodes = _lex(text)
+        body = _parse_nodes(nodes)
+        return self.render_body(body, dot, {"$": self.root})
+
+    # -- expression evaluation
+    def eval_pipeline(self, p: _Pipeline, dot: Any, vars: dict) -> Any:
+        value: Any = None
+        have_value = False
+        for cmd in p.commands:
+            if have_value:
+                if isinstance(cmd, _Call):
+                    value = self.eval_call(cmd, dot, vars, piped=value)
+                else:
+                    raise RenderError("piped into a non-function operand")
+            else:
+                value = self.eval_operand(cmd, dot, vars)
+                have_value = True
+        return value
+
+    def eval_operand(self, op: Any, dot: Any, vars: dict) -> Any:
+        if isinstance(op, _Lit):
+            return op.value
+        if isinstance(op, _Paren):
+            return self.eval_pipeline(op.pipeline, dot, vars)
+        if isinstance(op, _Dot):
+            return self._walk(dot, op.path)
+        if isinstance(op, _Var):
+            if op.name == "$":
+                base = vars.get("$", self.root)
+            elif op.name in vars:
+                base = vars[op.name]
+            else:
+                raise RenderError(f"undefined variable {op.name}")
+            return self._walk(base, op.path)
+        if isinstance(op, _Call):
+            return self.eval_call(op, dot, vars)
+        raise RenderError(f"cannot evaluate operand {op!r}")
+
+    def eval_call(self, call: _Call, dot: Any, vars: dict, piped: Any = ...) -> Any:
+        fn = self.funcs.get(call.name)
+        if fn is None:
+            raise RenderError(f"unknown function {call.name!r}")
+        args = [self.eval_operand(a, dot, vars) for a in call.args]
+        if piped is not ...:
+            args.append(piped)
+        return fn(*args)
+
+    @staticmethod
+    def _walk(base: Any, path: list[str]) -> Any:
+        cur = base
+        for field in path:
+            if isinstance(cur, dict):
+                cur = cur.get(field)
+            elif cur is None:
+                return None
+            else:
+                raise RenderError(f"cannot access field {field!r} of {type(cur).__name__}")
+        return cur
+
+    # -- node rendering
+    def render_body(self, body: list, dot: Any, vars: dict) -> str:
+        out: list[str] = []
+        # each body shares one variable scope (Go scopes per block; the
+        # chart only ever assigns at file top level, so flat is faithful)
+        for node in body:
+            if isinstance(node, _Text):
+                out.append(node.value)
+            elif isinstance(node, _Output):
+                v = self.eval_pipeline(node.pipeline, dot, vars)
+                if v is not None:
+                    out.append(v if isinstance(v, str) else _go_str(v))
+            elif isinstance(node, _Assign):
+                vars[node.var] = self.eval_pipeline(node.pipeline, dot, vars)
+            elif isinstance(node, _Cond):
+                for cond, branch in node.branches:
+                    if cond is None or _truthy(self.eval_pipeline(cond, dot, vars)):
+                        out.append(self.render_body(branch, dot, dict(vars)))
+                        break
+            elif isinstance(node, _Range):
+                coll = self.eval_pipeline(node.pipeline, dot, vars)
+                items = coll.items() if isinstance(coll, dict) else enumerate(coll or [])
+                for _k, item in items:
+                    inner_vars = dict(vars)
+                    if node.var:
+                        inner_vars[node.var] = item
+                    out.append(self.render_body(node.body, item, inner_vars))
+            elif isinstance(node, _With):
+                v = self.eval_pipeline(node.pipeline, dot, vars)
+                if _truthy(v):
+                    out.append(self.render_body(node.body, v, dict(vars)))
+            elif isinstance(node, _Define):
+                pass  # collected in a pre-pass
+            else:
+                raise RenderError(f"cannot render node {node!r}")
+        return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Chart-level driver.
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _collect_defines(body: list, into: dict[str, list]) -> None:
+    for node in body:
+        if isinstance(node, _Define):
+            into[node.name] = node.body
+
+
+def render_chart(
+    chart_dir: str | pathlib.Path,
+    values_override: dict | None = None,
+    release_name: str = "tpu-dra-driver",
+    namespace: str = "tpu-dra-driver",
+) -> dict[str, str]:
+    """Render every template; returns {template-filename: rendered-text}.
+
+    Raises ChartFail when a template calls ``fail`` (the validation path)
+    and RenderError on malformed/unsupported templates.
+    """
+    chart_dir = pathlib.Path(chart_dir)
+    chart_meta = yaml.safe_load((chart_dir / "Chart.yaml").read_text())
+    values = yaml.safe_load((chart_dir / "values.yaml").read_text()) or {}
+    if values_override:
+        values = _deep_merge(values, values_override)
+
+    root = {
+        "Values": values,
+        "Chart": {
+            "Name": chart_meta.get("name", chart_dir.name),
+            "Version": chart_meta.get("version", "0.0.0"),
+            "AppVersion": str(chart_meta.get("appVersion", "0.0.0")),
+        },
+        "Release": {
+            "Name": release_name,
+            "Namespace": namespace,
+            "Service": "Helm",
+            "IsInstall": True,
+            "IsUpgrade": False,
+        },
+        "Capabilities": {"KubeVersion": {"Version": "v1.32.0", "Major": "1", "Minor": "32"}},
+    }
+
+    template_dir = chart_dir / "templates"
+    parsed: dict[str, list] = {}
+    defines: dict[str, list] = {}
+    for path in sorted(template_dir.iterdir()):
+        if path.suffix not in (".yaml", ".tpl") or path.name.startswith("."):
+            continue
+        body = _parse_nodes(_lex(path.read_text()))
+        parsed[path.name] = body
+        _collect_defines(body, defines)
+
+    renderer = _Renderer(defines, root)
+    rendered: dict[str, str] = {}
+    for name, body in parsed.items():
+        if name.endswith(".tpl"):
+            continue  # helpers: defines only
+        rendered[name] = renderer.render_body(body, root, {"$": root})
+    return rendered
+
+
+def render_chart_docs(
+    chart_dir: str | pathlib.Path, **kwargs: Any
+) -> list[dict]:
+    """Render and YAML-parse; returns the non-empty documents (helm's
+    post-render object stream)."""
+    docs: list[dict] = []
+    for name, text in render_chart(chart_dir, **kwargs).items():
+        try:
+            for doc in yaml.safe_load_all(text):
+                if doc is not None:
+                    if not isinstance(doc, dict):
+                        raise RenderError(f"{name}: rendered a non-mapping document: {doc!r}")
+                    docs.append(doc)
+        except yaml.YAMLError as exc:
+            raise RenderError(f"{name}: rendered invalid YAML: {exc}") from exc
+    return docs
+
+
+def _parse_set(pairs: list[str]) -> dict:
+    """--set a.b=c overrides (string/bool/int literal inference)."""
+    out: dict = {}
+    for pair in pairs:
+        key, _, raw = pair.partition("=")
+        value: Any = raw
+        if raw in ("true", "false"):
+            value = raw == "true"
+        elif re.fullmatch(r"-?\d+", raw):
+            value = int(raw)
+        elif raw.startswith("[") or raw.startswith("{"):
+            value = yaml.safe_load(raw)
+        cur = out
+        parts = key.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = value
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="render the helm chart hermetically")
+    ap.add_argument("chart_dir")
+    ap.add_argument("--set", action="append", default=[], dest="sets", metavar="K=V")
+    ap.add_argument("--release", default="tpu-dra-driver")
+    ap.add_argument("--namespace", default="tpu-dra-driver")
+    args = ap.parse_args(argv)
+    try:
+        rendered = render_chart(
+            args.chart_dir,
+            values_override=_parse_set(args.sets),
+            release_name=args.release,
+            namespace=args.namespace,
+        )
+    except ChartFail as exc:
+        print(f"Error: execution error: {exc}", file=sys.stderr)
+        return 1
+    for name, text in rendered.items():
+        if not text.strip():
+            continue
+        print(f"---\n# Source: templates/{name}")
+        print(text.strip("\n"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
