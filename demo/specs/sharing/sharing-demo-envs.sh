@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Emit env-var assignments for the walkthrough (reference
+# demo/specs/mig+mps/sharing-demo-envs.sh analog): resolves the first demo
+# pod and the CDI device IDs of each shared claim so the README's
+# `kubectl exec` lines can be copy-pasted.
+set -euo pipefail
+
+ns=sharing-demo
+
+pod=$(kubectl get pod -n "$ns" -l job-name=sharing-demo-job \
+      -o jsonpath='{.items[0].metadata.name}')
+echo "export SHARING_POD=${pod}"
+
+for claim in chip-ts-sharing chip-sp-sharing subslice-ts-sharing subslice-exclusive; do
+  uid=$(kubectl get resourceclaim -n "$ns" "$claim" -o jsonpath='{.metadata.uid}')
+  var=$(echo "$claim" | tr '[:lower:]-' '[:upper:]_')
+  echo "export ${var}_CLAIM_UID=${uid}"
+done

@@ -63,23 +63,30 @@ def apply_spec(cluster: Cluster, path: str | Path) -> list[RunningPod]:
         elif kind == "Pod":
             pods.append(doc)
         elif kind == "Deployment":
-            pods.extend(_expand_deployment(doc))
+            pods.extend(_expand_workload(doc, doc["spec"].get("replicas", 1)))
+        elif kind == "Job":
+            # batch Jobs run `parallelism` pods of the same template (the
+            # sharing-demo walkthrough uses one, reference
+            # demo/specs/mig+mps/sharing-demo-job.yaml).
+            pods.extend(_expand_workload(doc, doc["spec"].get("parallelism", 1)))
         else:
             raise SpecError(f"unhandled kind {kind!r} in {path}")
 
     return [_run_pod(cluster, pod, templates) for pod in pods]
 
 
-def _expand_deployment(doc: dict) -> list[dict]:
+def _expand_workload(doc: dict, replicas: int) -> list[dict]:
     ns = doc["metadata"]["namespace"]
     name = doc["metadata"]["name"]
-    replicas = doc["spec"].get("replicas", 1)
     template = doc["spec"]["template"]
     out = []
     for i in range(replicas):
         pod = {
             "kind": "Pod",
-            "metadata": {"namespace": ns, "name": f"{name}-{i}", **template.get("metadata", {})},
+            # template metadata first: the generated per-replica name (and
+            # the workload's namespace) must win over any name the template
+            # carries, or every replica collides on one pod name.
+            "metadata": {**template.get("metadata", {}), "namespace": ns, "name": f"{name}-{i}"},
             "spec": template["spec"],
         }
         out.append(pod)

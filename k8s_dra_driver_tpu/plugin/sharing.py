@@ -53,12 +53,24 @@ class SharingError(RuntimeError):
 
 
 def _require_chips(devices: list[AllocatableDevice], strategy: str) -> None:
-    """Sharing strategies apply to whole chips only — the reference likewise
-    rejects MIG devices for time-slicing (sharing.go:103-107); subslices are
-    already spatial partitions."""
+    """Spatial partitioning applies to whole chips only — a subslice is
+    already a spatial partition (and SubsliceConfig likewise rejects nested
+    SpatialPartition at validation, api/tpuconfig.py)."""
     bad = [d.name for d in devices if d.chip is None]
     if bad:
         raise SharingError(f"{strategy} sharing requires whole-chip devices, got {bad}")
+
+
+def _require_compute(devices: list[AllocatableDevice], strategy: str) -> None:
+    """TimeSlicing needs compute devices (chips OR subslices) — membership
+    seats are wiring, not compute.  The reference restricts time-slicing to
+    full GPUs because nvidia-smi's compute-policy is per-GPU
+    (sharing.go:103-107); our cooperative run-lease is scoped per chip SET
+    (topology_daemon.py), so subslice claims time-slice naturally — their
+    consumers' lease scope is the subslice's TPU_VISIBLE_DEVICES."""
+    bad = [d.name for d in devices if d.chip is None and d.subslice is None]
+    if bad:
+        raise SharingError(f"{strategy} sharing requires compute devices, got {bad}")
 
 
 class TimeSlicingManager:
@@ -68,7 +80,7 @@ class TimeSlicingManager:
     def apply(
         self, devices: list[AllocatableDevice], config: TimeSlicingConfig
     ) -> ContainerEdits:
-        _require_chips(devices, "TimeSlicing")
+        _require_compute(devices, "TimeSlicing")
         interval = config.interval
         level = interval.level() if interval is not None else 0
         return ContainerEdits(
