@@ -88,7 +88,11 @@ def run_data_plane() -> dict:
     import jax
 
     from k8s_dra_driver_tpu.models import burnin
-    from k8s_dra_driver_tpu.ops.collectives import attention_speedup, matmul_tflops
+    from k8s_dra_driver_tpu.ops.collectives import (
+        attention_speedup,
+        dispatch_rtt_seconds,
+        matmul_tflops,
+    )
 
     cfg = burnin.ModelConfig(
         vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048, max_seq=512
@@ -102,11 +106,21 @@ def run_data_plane() -> dict:
     # on tunneled devices (axon) block_until_ready alone does not guarantee
     # remote completion.
     start = time.perf_counter()
-    steps = 5
+    steps = 50
     for _ in range(steps):
         params, opt_state, loss = fns.step(params, opt_state, tokens)
     last_loss = float(loss)
-    step_ms = (time.perf_counter() - start) / steps * 1000
+    total = time.perf_counter() - start
+    # The loop enqueues asynchronously; the closing readback pays ONE tunnel
+    # round trip, which at ~67ms would inflate a 5-step window by >2x.
+    rtt = dispatch_rtt_seconds()
+    if total <= 1.5 * rtt:
+        # Same discipline as matmul_tflops: refuse to fabricate a reading.
+        raise RuntimeError(
+            f"burn-in timing dominated by dispatch RTT "
+            f"({total*1e3:.1f}ms total vs {rtt*1e3:.1f}ms RTT); raise steps"
+        )
+    step_ms = (total - rtt) / steps * 1000
     out = {
         "backend": jax.default_backend(),
         "burnin_step_ms": round(step_ms, 2),

@@ -144,6 +144,26 @@ def run_dryrun(n_devices: int) -> None:
     jax.block_until_ready(moe_loss(wu, wd))
     print(f"dryrun_multichip: mesh expert={n_devices} (top-2 moe grad) ok")
 
+    # Latency-hiding TP: the overlapped collective-matmul ring (Megatron-SP
+    # f/g pair as ppermute-pipelined chunk matmuls) must compile and match
+    # under grad on the same mesh.
+    from k8s_dra_driver_tpu.ops.collective_matmul import sharded_tp_mlp
+
+    cm_mesh = build_mesh(devices, MeshShape(model=n_devices))
+    kx, ki, ko = jax.random.split(jax.random.PRNGKey(3), 3)
+    d_cm, ff_cm, s_cm = 32, 16 * n_devices, 8 * n_devices
+    x_cm = jax.random.normal(kx, (2, s_cm, d_cm))
+    wi = jax.random.normal(ki, (d_cm, ff_cm)) / d_cm**0.5
+    wo = jax.random.normal(ko, (ff_cm, d_cm)) / ff_cm**0.5
+    cm_grad = jax.jit(
+        jax.grad(
+            lambda wi, wo: (sharded_tp_mlp(x_cm, wi, wo, cm_mesh) ** 2).sum(),
+            argnums=(0, 1),
+        )
+    )
+    jax.block_until_ready(cm_grad(wi, wo))
+    print(f"dryrun_multichip: mesh model={n_devices} (overlapped tp-mlp grad) ok")
+
 
 def _pick_devices(n_devices: int):
     """Prefer the forced-CPU virtual platform for dry runs; on hosts where

@@ -1,0 +1,230 @@
+"""Collective matmul: latency-hiding TP collectives (scaling-book recipe).
+
+GSPMD lowers a sequence-parallel Megatron block to `all-gather; matmul` and
+`matmul; reduce-scatter` — the collective SERIALIZES with the compute unless
+the compiler happens to overlap them.  These kernels make the overlap
+structural instead of lucky: the gather/scatter is decomposed into a ring of
+``ppermute`` hops (XLA emits async collective-permute start/done pairs on
+TPU), and each hop's transfer rides under the chunk matmul issued next to it.
+Per step, one chunk computes while the next is in flight on ICI; with the
+bidirectional ring both ICI directions carry half the traffic.
+
+All entry points are pure jax (scan + ppermute + dot) and therefore
+differentiable — they drop straight into a training step under shard_map.
+
+The reference driver has no analog (its data plane is delivered by
+NCCL/cuBLAS inside user pods); this is consumer-side capability the TPU
+framework ships so a claimed mesh trains at ICI speed: the deepest
+"communication backend" item of SURVEY.md §2.11.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def _ring_perms(n: int) -> tuple[list, list]:
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    return fwd, bwd
+
+
+def all_gather_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    axis_name: str,
+    bidirectional: bool | None = None,
+) -> jax.Array:
+    """``all_gather(x) @ w`` with the gather hidden under the matmuls.
+
+    Call inside ``shard_map``.  x: [s_loc, k] (rows sharded over
+    ``axis_name``), w: [k, n_loc] (any per-device shard) -> [s, n_loc] with
+    s = s_loc * axis size — the sequence-parallel Megatron forward
+    (column-parallel linear after a row all-gather).
+
+    Ring schedule: at step t each device matmuls the row chunk it received
+    t hops ago while ppermute ships the chunk onward; n chunk-matmuls total,
+    n-1 of them overlapping a transfer.  ``bidirectional`` splits each chunk
+    in half and runs two counter-rotating rings so both ICI directions carry
+    traffic (default: on for even ring sizes > 2).
+    """
+    n = jax.lax.psum(1, axis_name)
+    if n == 1:
+        return x @ w
+    idx = jax.lax.axis_index(axis_name)
+    s_loc, _k = x.shape
+    n_loc = w.shape[1]
+    fwd, bwd = _ring_perms(n)
+    if bidirectional is None:
+        # auto: only when the shape parity supports it — a caller who never
+        # asked for bidirectional must degrade to the plain ring, not raise.
+        bidirectional = n % 2 == 0 and n > 2 and s_loc % 2 == 0
+
+    out = jnp.zeros((n, s_loc, n_loc), x.dtype)
+
+    if not bidirectional:
+        def body(carry, t):
+            chunk, acc = carry
+            # Issue the transfer BEFORE the matmul so the hop rides under it.
+            nxt = jax.lax.ppermute(chunk, axis_name, fwd)
+            part = chunk @ w
+            src = jax.lax.rem(idx - t + n, n)
+            acc = jax.lax.dynamic_update_slice_in_dim(acc, part[None], src, axis=0)
+            return (nxt, acc), None
+
+        (_, out), _ = jax.lax.scan(body, (x, out), jnp.arange(n))
+        return out.reshape(n * s_loc, n_loc)
+
+    half = s_loc // 2
+    if half * 2 != s_loc:
+        raise ValueError(f"bidirectional ring needs even s_loc, got {s_loc}")
+
+    def body(carry, t):
+        top, bot, acc = carry  # top half rides fwd, bottom rides bwd
+        nxt_top = jax.lax.ppermute(top, axis_name, fwd)
+        nxt_bot = jax.lax.ppermute(bot, axis_name, bwd)
+        part_top = top @ w                      # rows of block (idx - t)
+        part_bot = bot @ w                      # rows of block (idx + t)
+        src_t = jax.lax.rem(idx - t + n, n)
+        src_b = jax.lax.rem(idx + t, n)
+        acc = jax.lax.dynamic_update_slice(acc, part_top[None], (src_t, 0, 0))
+        acc = jax.lax.dynamic_update_slice(acc, part_bot[None], (src_b, half, 0))
+        return (nxt_top, nxt_bot, acc), None
+
+    (_, _, out), _ = jax.lax.scan(body, (x[:half], x[half:], out), jnp.arange(n))
+    return out.reshape(n * s_loc, n_loc)
+
+
+def matmul_reduce_scatter(
+    x: jax.Array,
+    w: jax.Array,
+    axis_name: str,
+    bidirectional: bool | None = None,
+) -> jax.Array:
+    """``reduce_scatter(x @ w, rows)`` with the scatter hidden under matmuls.
+
+    Call inside ``shard_map``.  x: [s, k_loc] (contraction dim sharded),
+    w: [k_loc, n] -> [s_loc, n]: the sequence-parallel Megatron backward
+    half (row-parallel linear whose partial sums reduce-scatter onto the
+    sequence axis).
+
+    A rotating accumulator per destination row-block: at step t each device
+    adds its partial for the block the accumulator will reach after the
+    remaining hops, then passes it on; every hop overlaps the next chunk
+    matmul.  ``bidirectional`` splits columns across two counter-rotating
+    accumulators.
+    """
+    n = jax.lax.psum(1, axis_name)
+    if n == 1:
+        return x @ w
+    idx = jax.lax.axis_index(axis_name)
+    s, _k_loc = x.shape
+    n_out = w.shape[1]
+    s_loc = s // n
+    if s_loc * n != s:
+        raise ValueError(f"rows ({s}) must divide by ring size ({n})")
+    fwd, bwd = _ring_perms(n)
+    if bidirectional is None:
+        bidirectional = n % 2 == 0 and n > 2 and n_out % 2 == 0
+
+    def row_block(b):
+        return jax.lax.dynamic_slice_in_dim(x, b * s_loc, s_loc, axis=0)
+
+    # f32 rotating accumulators: the partials sum across n ring steps, and
+    # accumulating in bf16 would grow O(n) rounding error (the chunk dots
+    # already accumulate f32 on the MXU).
+    if not bidirectional:
+        acc = jnp.zeros((s_loc, n_out), jnp.float32)
+
+        def body(carry, t):
+            acc = carry
+            blk = jax.lax.rem(idx - t + n, n)
+            part = jnp.dot(row_block(blk), w, preferred_element_type=jnp.float32)
+            acc = acc + part
+            # add-then-permute x n: the accumulator seeded for block j at
+            # device j walks the whole ring and lands home with all n
+            # contributions (the final hop closes the loop).
+            return jax.lax.ppermute(acc, axis_name, fwd), None
+
+        acc, _ = jax.lax.scan(body, acc, jnp.arange(n))
+        return acc.astype(x.dtype)
+
+    half = n_out // 2
+    if half * 2 != n_out:
+        raise ValueError(f"bidirectional ring needs even output cols, got {n_out}")
+    acc_l = jnp.zeros((s_loc, half), jnp.float32)
+    acc_r = jnp.zeros((s_loc, n_out - half), jnp.float32)
+
+    def body(carry, t):
+        acc_l, acc_r = carry
+        blk_l = jax.lax.rem(idx - t + n, n)
+        blk_r = jax.lax.rem(idx + t, n)
+        acc_l = acc_l + jnp.dot(
+            row_block(blk_l), w[:, :half], preferred_element_type=jnp.float32
+        )
+        acc_r = acc_r + jnp.dot(
+            row_block(blk_r), w[:, half:], preferred_element_type=jnp.float32
+        )
+        return (
+            jax.lax.ppermute(acc_l, axis_name, fwd),
+            jax.lax.ppermute(acc_r, axis_name, bwd),
+        ), None
+
+    (acc_l, acc_r), _ = jax.lax.scan(body, (acc_l, acc_r), jnp.arange(n))
+    return jnp.concatenate([acc_l, acc_r], axis=1).astype(x.dtype)
+
+
+def tp_mlp(
+    x: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    axis_name: str,
+    bidirectional: bool | None = None,
+) -> jax.Array:
+    """One sequence-parallel Megatron MLP with both collectives overlapped.
+
+    Call inside ``shard_map``.  x: [s_loc, d] (sequence-sharded activations),
+    w_in: [d, ff_loc] (column shard), w_out: [ff_loc, d] (row shard) ->
+    [s_loc, d]: gather-matmul, gelu, matmul-scatter — the f/g pair of
+    Megatron-SP (Korthikanti et al.) with the ICI hops hidden under chunk
+    matmuls at both ends.
+    """
+    h = all_gather_matmul(x, w_in, axis_name, bidirectional=bidirectional)
+    h = jax.nn.gelu(h)
+    return matmul_reduce_scatter(h, w_out, axis_name, bidirectional=bidirectional)
+
+
+def sharded_tp_mlp(
+    x: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    mesh: Mesh,
+    model_axis: str = "model",
+    bidirectional: bool | None = None,
+) -> jax.Array:
+    """Convenience wrapper: x [B, S, D] with S sharded over ``model_axis``.
+
+    In Megatron-SP the sequence shard lives on the TENSOR-parallel axis
+    (activations sit sequence-sharded between the f/g collectives), so one
+    mesh axis carries both roles — the gather/scatter rings run over the TP
+    group."""
+    def two_d(xb, wi, wo):
+        return jax.vmap(
+            lambda xs: tp_mlp(xs, wi, wo, model_axis, bidirectional=bidirectional)
+        )(xb)
+
+    fn = jax.shard_map(
+        two_d,
+        mesh=mesh,
+        in_specs=(
+            P(None, model_axis, None),
+            P(None, model_axis),
+            P(model_axis, None),
+        ),
+        out_specs=P(None, model_axis, None),
+        check_vma=False,
+    )
+    return fn(x, w_in, w_out)

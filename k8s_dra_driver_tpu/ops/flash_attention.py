@@ -310,8 +310,8 @@ def sharded_flash_attention(
     causal: bool = True,
     batch_axis: str = "data",
     head_axis: str = "model",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention on a DP×TP mesh: batch sharded over ``batch_axis``,
@@ -337,21 +337,47 @@ def sharded_flash_attention(
     return fn(q, k, v)
 
 
+def auto_block(s: int, cap: int = 512) -> int:
+    """Largest power-of-two block <= ``cap`` dividing ``s`` (else ``s``
+    itself as a single block).  512 measured fastest on v5e for both the
+    forward sweep (0.742 vs 2.581 ms at 128) and fwd+bwd (1.26 vs 4.58 ms)
+    — bigger blocks mean fewer grid steps and better MXU occupancy until
+    VMEM pressure bites."""
+    b = cap
+    while b >= 128:
+        if s % b == 0:
+            return b
+        b //= 2
+    if s <= cap:
+        return s  # short sequence: one block
+    raise ValueError(
+        f"sequence {s} has no power-of-two block divisor >= 128 and exceeds "
+        f"the {cap} single-block cap — pad S upstream (an S-wide score tile "
+        "would blow VMEM)"
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """q/k/v: [B, S, H, D] -> [B, S, H, D].  Differentiable (custom VJP with
     pallas backward kernels — dq and dk/dv passes over the block grid).
 
     S must be a multiple of the block sizes (pad upstream); D should be a
-    multiple of 128 for MXU efficiency but smaller D works.
+    multiple of 128 for MXU efficiency but smaller D works.  Blocks default
+    to :func:`auto_block` (512-capped) — the on-chip sweep optimum.
     """
+    s = q.shape[1]
+    if block_q is None:
+        block_q = auto_block(s)
+    if block_k is None:
+        block_k = auto_block(s)
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)

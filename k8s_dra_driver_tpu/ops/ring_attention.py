@@ -138,7 +138,7 @@ def _merge_blocks(out_a, lse_a, out_b, lse_b):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_flash_attention_local(
     q, k, v, axis_name: str, causal: bool = True,
-    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+    block_q: int = 512, block_k: int = 512, interpret: bool = False,
 ):
     """Per-shard flash ring attention (call inside shard_map).
 
@@ -279,7 +279,7 @@ ring_flash_attention_local.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)
 def ring_flash_attention(
     q, k, v, mesh: Mesh, axis_name: str = "seq", causal: bool = True,
     batch_axis: str = "data", head_axis: str | None = "model",
-    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+    block_q: int = 512, block_k: int = 512, interpret: bool = False,
 ):
     """Sharded flash ring attention: q/k/v [B,S,H,D] with S on ``axis_name``."""
     spec = P(batch_axis, axis_name, head_axis, None)
@@ -329,7 +329,7 @@ def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True, attn_f
 def ulysses_attention(
     q, k, v, mesh: Mesh, axis_name: str = "seq", causal: bool = True,
     batch_axis: str = "data", use_flash: bool = False,
-    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+    block_q: int | None = None, block_k: int | None = None, interpret: bool = False,
 ):
     attn_fn = None
     if use_flash:

@@ -201,3 +201,19 @@ class TestAttentionSpeedupBench:
         assert set(out["block_sweep_ms"]) == {"32x32", "64x64"}
         assert out["blocks"] in out["block_sweep_ms"]
         assert out["flash_ms"] == min(out["block_sweep_ms"].values())
+
+
+class TestAutoBlock:
+    def test_picks_swept_optimum_and_divisors(self):
+        from k8s_dra_driver_tpu.ops.flash_attention import auto_block
+
+        assert auto_block(2048) == 512
+        assert auto_block(384) == 128
+        assert auto_block(96) == 96  # short: one block
+        assert auto_block(512) == 512
+
+    def test_long_indivisible_sequence_fails_loudly(self):
+        from k8s_dra_driver_tpu.ops.flash_attention import auto_block
+
+        with pytest.raises(ValueError, match="pad S upstream"):
+            auto_block(4160)
