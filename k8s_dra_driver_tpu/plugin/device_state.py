@@ -78,6 +78,8 @@ class DeviceStateConfig:
     # Readiness backoff overrides for tests.
     daemon_backoff_initial: float = 1.0
     daemon_backoff_steps: int = 4
+    # Runtime self-test sweep period (tpuinfo/selftest.py); 0 disables.
+    selftest_interval_s: float = 0.0
 
 
 class DeviceState:
@@ -85,6 +87,8 @@ class DeviceState:
         self._lock = threading.Lock()
         self._server = server
         self.config = config
+        # position -> reason; folded into every refresh() enumeration.
+        self._health_overlay: dict[int, str] = {}
         self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
         self._layout = self._load_layout()
         self.allocatable = AllocatableDevices.from_topology(self.topology, self._layout)
@@ -224,6 +228,21 @@ class DeviceState:
         new_topology = enumerate_topology(env=self.config.topology_env or None)
         new_layout = self._load_layout()
         with self._lock:
+            # Runtime-health overlay (selftest failures): applied after
+            # enumeration so a chip that ENUMERATES fine but fails compute
+            # publishes healthy=false like any statically-dead chip — and
+            # participates in the change comparison, so overlay transitions
+            # republish.
+            if self._health_overlay:
+                import dataclasses
+
+                chips = list(new_topology.chips)
+                for pos, reason in self._health_overlay.items():
+                    if 0 <= pos < len(chips) and chips[pos].healthy:
+                        chips[pos] = dataclasses.replace(
+                            chips[pos], healthy=False, health_reason=reason
+                        )
+                new_topology = dataclasses.replace(new_topology, chips=chips)
             if new_topology == self.topology and new_layout == self._layout:
                 return False
             self.topology = new_topology
@@ -231,6 +250,15 @@ class DeviceState:
             self.allocatable = AllocatableDevices.from_topology(new_topology, new_layout)
             self.cdi.create_base_spec(self.allocatable)
             return True
+
+    def set_health_overlay(self, overlay: dict[int, str]) -> bool:
+        """Replace the runtime-health overlay (chip position -> reason);
+        returns True when it changed.  Takes effect at the next refresh()
+        — the caller (the driver's health sweep) runs one right after."""
+        with self._lock:
+            changed = overlay != self._health_overlay
+            self._health_overlay = dict(overlay)
+        return changed
 
     def _load_layout(self):
         """This host's applied subslice layout; a corrupt state file keeps

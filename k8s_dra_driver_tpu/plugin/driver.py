@@ -70,6 +70,10 @@ class Driver:
         )
         self.state = DeviceState(server, config)
         self._needs_publish = False
+        self._last_selftest = 0.0
+        self._selftest_thread: threading.Thread | None = None
+        self._selftest_report: dict | None = None
+        self._selftest_join_grace_s = 1.0
         REGISTRY.gauge(
             "dra_allocatable_devices", "Devices this node publishes"
         ).set(len(self.state.allocatable), node=config.node_name)
@@ -151,6 +155,7 @@ class Driver:
         retries even though refresh() already committed the new topology —
         otherwise a transient API error would leave stale slices advertised
         forever."""
+        self._maybe_selftest()
         changed = self.state.refresh()
         unhealthy = sum(1 for c in self.state.topology.chips if not c.healthy)
         REGISTRY.gauge(
@@ -165,6 +170,97 @@ class Driver:
             self.publish_resources()  # raising keeps the flag set for retry
             self._needs_publish = False
         return changed
+
+    def _maybe_selftest(self) -> None:
+        """Runtime self-test (tpuinfo/selftest.py) folded into the sweep.
+
+        Static enumeration can't see a chip that mounts fine but corrupts
+        matmuls or hangs the runtime; when ``selftest_interval_s`` is set,
+        the watchdogged on-chip probe runs at that cadence and failures
+        become a ``selftest-failed`` health overlay on the published
+        inventory.
+
+        Three constraints shape the flow:
+        * libtpu is process-exclusive — probing a node whose chips serve
+          prepared claims would both fail spuriously and disturb the
+          workload, so the probe only launches (and init-failure reports
+          only apply) while NO claims are prepared: this is pre-flight
+          health for idle nodes, like any between-jobs hardware checker.
+        * a hung backend must not stall the sweep (static health and orphan
+          cleanup share the thread): the probe runs in a daemon thread,
+          joined briefly; slow results fold into a later sweep.
+        * mapping: jax device order == local chip enumeration order (both
+          follow /dev/accel numbering).  On ANY device/chip count mismatch
+          the whole node is fenced — all-pass over fewer devices than
+          published chips means some chip is invisible to the runtime,
+          the strongest failure signal there is.  A non-TPU probe platform
+          (fake topologies, CPU dev hosts) fences nothing: the probe
+          didn't test the published chips (the gauge still reports its
+          honest ok/failed result)."""
+        interval = self.config.selftest_interval_s
+        if interval <= 0:
+            return
+        with self._lock:
+            report = self._selftest_report
+            self._selftest_report = None
+            busy = bool(self.state.prepared)
+        if report is not None:
+            self._apply_selftest_report(report, busy)
+        now = time.monotonic()
+        due = not self._last_selftest or now - self._last_selftest >= interval
+        thread = self._selftest_thread
+        if not due or busy or (thread is not None and thread.is_alive()):
+            return
+        self._last_selftest = now
+        from k8s_dra_driver_tpu.tpuinfo.selftest import run_selftest
+
+        timeout_s = max(min(interval, 180.0), 30.0)
+
+        def worker():
+            result = run_selftest(timeout_s=timeout_s)
+            with self._lock:
+                self._selftest_report = result
+
+        thread = threading.Thread(target=worker, daemon=True, name="tpu-selftest")
+        self._selftest_thread = thread
+        thread.start()
+        # Brief join: a fast probe (healthy chip, stubbed test) folds into
+        # THIS sweep; a hung one keeps running and folds later.
+        thread.join(timeout=self._selftest_join_grace_s)
+        with self._lock:
+            report = self._selftest_report
+            self._selftest_report = None
+        if report is not None:
+            self._apply_selftest_report(report, busy=False)
+
+    def _apply_selftest_report(self, report: dict, busy: bool) -> None:
+        n_chips = len(self.state.topology.chips)
+        if report.get("error") and busy:
+            # Exclusive access explains init failures on a working node;
+            # discard rather than fence chips that are serving claims.
+            return
+        overlay: dict[int, str] = {}
+        if report.get("error"):
+            overlay = {pos: "selftest-failed" for pos in range(n_chips)}
+        elif report.get("platform") == "tpu":
+            devices = report.get("devices", [])
+            if len(devices) == n_chips:
+                overlay = {
+                    pos: "selftest-failed"
+                    for pos, dev in enumerate(devices)
+                    if not dev.get("ok")
+                }
+            else:
+                overlay = {pos: "selftest-failed" for pos in range(n_chips)}
+        else:
+            REGISTRY.gauge(
+                "dra_selftest_ok", "Last runtime self-test result (1 ok / 0 failed)"
+            ).set(1 if report.get("ok") else 0, node=self.config.node_name)
+            return  # non-TPU probe says nothing about published chips
+        REGISTRY.gauge(
+            "dra_selftest_ok", "Last runtime self-test result (1 ok / 0 failed)"
+        ).set(0 if overlay else 1, node=self.config.node_name)
+        self.state.set_health_overlay(overlay)
 
     # -- orphan cleanup (the reference left this as a TODO, driver.go:156-168)
 

@@ -83,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu-parted applied-layout file; shapes republish live when it "
         "changes (mig-parted analog, plugin/parted.py)",
     )
+    p.add_argument(
+        "--selftest-interval-s", type=float,
+        default=float(env_default("TPU_SELFTEST_INTERVAL_S", "0")),
+        help="on-chip runtime self-test period folded into the health sweep "
+        "(tpuinfo/selftest.py); 0 disables",
+    )
     return p
 
 
@@ -145,6 +151,7 @@ def main(argv: list[str] | None = None) -> int:
             libtpu_path=args.libtpu_path,
             topology_env=topology_env,
             parted_state_path=args.parted_state_path,
+            selftest_interval_s=args.selftest_interval_s,
         ),
     )
     plugin = PluginServer(driver, plugin_dir=args.plugin_path, registry_dir=args.registry_path)

@@ -8,10 +8,14 @@
 // Commands:
 //   tpu-ctl list        one line per visible chip (nvidia-smi -L style)
 //   tpu-ctl topology    full enumeration JSON (libtpuinfo passthrough)
+//   tpu-ctl selftest    on-chip runtime probe (execs the Python runtime —
+//                       the reference's exec-nvidia-smi boundary, inverted)
 //   tpu-ctl version     CLI + library version
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <unistd.h>
 
 #include "tpuinfo.h"
 
@@ -80,6 +84,22 @@ int main(int argc, char** argv) {
     std::printf("tpu-ctl %s (libtpuinfo %s)\n", tpuinfo_version(), tpuinfo_version());
     return 0;
   }
+  if (std::strcmp(cmd, "selftest") == 0) {
+    // Compute health needs the ML runtime, which lives on the Python side;
+    // exec it (pass through extra args, e.g. --json / --timeout).
+    const char* py = std::getenv("TPU_CTL_PYTHON");
+    if (!py || !*py) py = "python3";
+    const char** args = new const char*[argc + 3];
+    int n = 0;
+    args[n++] = py;
+    args[n++] = "-m";
+    args[n++] = "k8s_dra_driver_tpu.tpuinfo.selftest";
+    for (int i = 2; i < argc; i++) args[n++] = argv[i];
+    args[n] = nullptr;
+    execvp(py, const_cast<char* const*>(args));
+    std::fprintf(stderr, "tpu-ctl: cannot exec %s: selftest unavailable\n", py);
+    return 1;
+  }
   char* json = nullptr;
   int rc = tpuinfo_enumerate(&json);
   if (rc != 0) {
@@ -92,7 +112,7 @@ int main(int argc, char** argv) {
   } else if (std::strcmp(cmd, "list") == 0) {
     cmd_list(json);
   } else {
-    std::fprintf(stderr, "usage: tpu-ctl [list|topology|version]\n");
+    std::fprintf(stderr, "usage: tpu-ctl [list|topology|selftest|version]\n");
     tpuinfo_free(json);
     return 2;
   }

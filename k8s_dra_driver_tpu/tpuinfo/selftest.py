@@ -1,0 +1,151 @@
+"""On-chip runtime self-test — the health source enumeration can't see.
+
+The C++ shim's health sources (pci-disabled, aer-fatal, node-unopenable —
+tpuinfo.cc) are *static*: they catch dead device nodes, not a chip that
+enumerates fine and then corrupts matmuls or hangs the runtime.  The
+reference has no analog at all (NVML reports presence, not compute health).
+This module actually RUNS the hardware:
+
+* per visible device, a deterministic MXU probe — an identity matmul in
+  bf16 is exact, so any stuck lane/corrupt accumulation flips the
+  comparison, plus an iota-sum VPU check — with per-device latency;
+* the whole probe executes in a SUBPROCESS behind a watchdog
+  (``run_selftest``), because the failure mode being tested for includes
+  "backend init blocks forever" (the round-1 dead-tunnel postmortem,
+  BASELINE.md) and a health check that can hang the plugin is worse than
+  no health check.
+
+Wire-up: ``tpu-ctl selftest`` execs this module (the reference's
+exec-nvidia-smi boundary, nvlib.go:521-539, inverted: C++ CLI → Python
+runtime), and the plugin's refresh sweep folds failures in as a
+``selftest-failed`` health overlay when ``--selftest-interval`` is set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+DEFAULT_SIZE = 512
+DEFAULT_TIMEOUT_S = 180.0
+
+
+def device_selftest(device, size: int = DEFAULT_SIZE) -> dict:
+    """Run the deterministic probe on one jax device."""
+    import jax
+    import jax.numpy as jnp
+
+    result = {"id": device.id, "platform": device.platform, "ok": False}
+    try:
+        eye = jax.device_put(jnp.eye(size, dtype=jnp.bfloat16), device)
+
+        @jax.jit
+        def probe(m):
+            # MXU: identity x identity is exact in bf16 — any stuck lane or
+            # corrupt accumulation breaks equality, no tolerance needed.
+            mm_exact = jnp.all(m @ m == m)
+            # VPU/iota: closed-form sum.
+            n = m.shape[0]
+            iota_ok = jnp.sum(jax.lax.iota(jnp.float32, n)) == n * (n - 1) / 2
+            return jnp.logical_and(mm_exact, iota_ok)
+
+        bool(probe(eye))  # compile + first run
+        start = time.perf_counter()
+        ok = bool(probe(eye))
+        result["latency_ms"] = round((time.perf_counter() - start) * 1e3, 2)
+        result["ok"] = ok
+        if not ok:
+            result["error"] = "probe mismatch: matmul/iota returned wrong values"
+    except Exception as exc:  # noqa: BLE001 - each device reports, none aborts
+        result["error"] = f"{type(exc).__name__}: {exc}"
+    return result
+
+
+def run_inprocess(size: int = DEFAULT_SIZE) -> dict:
+    """Probe every visible device of the default backend (call in a child
+    process — see ``run_selftest`` for the watchdogged entry)."""
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception as exc:  # noqa: BLE001 - backend init is a probe result
+        return {"ok": False, "platform": None, "devices": [],
+                "error": f"backend init failed: {type(exc).__name__}: {exc}"}
+    results = [device_selftest(d, size=size) for d in devices]
+    return {
+        "ok": all(r["ok"] for r in results),
+        "platform": devices[0].platform if devices else None,
+        "devices": results,
+    }
+
+
+def run_selftest(
+    timeout_s: float = DEFAULT_TIMEOUT_S, size: int = DEFAULT_SIZE
+) -> dict:
+    """Subprocess + watchdog wrapper: the current env (INCLUDING the
+    accelerator plugin — unlike the dry run, the device link is the thing
+    under test) with a hard timeout, so a hung backend init becomes a
+    diagnosable failure instead of a stuck caller."""
+    # --timeout 0 = probe in-process: the child must NOT re-wrap itself in
+    # another subprocess (this function IS the watchdog layer).
+    cmd = [sys.executable, "-m", "k8s_dra_driver_tpu.tpuinfo.selftest",
+           "--json", "--size", str(size), "--timeout", "0"]
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+    )
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, env=env
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "platform": None, "devices": [],
+                "error": f"selftest timed out after {timeout_s:.0f}s (hung device link?)"}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"ok": False, "platform": None, "devices": [],
+            "error": f"selftest rc={proc.returncode}, no JSON "
+                     f"(stderr tail: {proc.stderr[-500:]!r})"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="TPU runtime self-test")
+    ap.add_argument("--json", action="store_true", help="one JSON line to stdout")
+    ap.add_argument("--size", type=int, default=DEFAULT_SIZE)
+    ap.add_argument(
+        "--timeout", type=float, default=DEFAULT_TIMEOUT_S,
+        help="watchdogged-subprocess timeout; the failure under test "
+        "includes 'backend init hangs forever', so the DEFAULT is "
+        "watchdogged (0 = probe in this process, no watchdog)",
+    )
+    args = ap.parse_args(argv)
+    if args.timeout > 0:
+        report = run_selftest(timeout_s=args.timeout, size=args.size)
+    else:
+        report = run_inprocess(size=args.size)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"platform: {report.get('platform')}")
+        for dev in report["devices"]:
+            status = "OK" if dev["ok"] else f"FAIL ({dev.get('error', '?')})"
+            lat = f" {dev['latency_ms']}ms" if "latency_ms" in dev else ""
+            print(f"  device {dev['id']}: {status}{lat}")
+        if report.get("error"):
+            print(f"error: {report['error']}")
+    # rc=2 distinguishes "probe says unhealthy" from argparse/etc failures.
+    return 0 if report["ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -273,3 +273,16 @@ class TestRendererEngine:
         rc = main([str(CHART), "--set", "deviceClasses=[]"])
         assert rc == 1
         assert "at least one class" in capsys.readouterr().err
+
+
+class TestSelftestKnob:
+    def test_selftest_env_rendered_when_enabled(self):
+        docs = render_chart_docs(CHART, values_override={"selftestIntervalS": 300})
+        plugin = _by_kind(docs)["DaemonSet"][0]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in plugin["env"]}
+        assert env["TPU_SELFTEST_INTERVAL_S"] == "300"
+
+    def test_selftest_env_absent_by_default(self, default_docs):
+        plugin = _by_kind(default_docs)["DaemonSet"][0]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in plugin["env"]}
+        assert "TPU_SELFTEST_INTERVAL_S" not in env

@@ -1,0 +1,192 @@
+"""Runtime self-test (tpuinfo/selftest.py) + the driver's health overlay.
+
+The probe itself runs on whatever backend the suite has (forced CPU) — its
+job in tests is contract shape; the compute path is exercised for real by
+`tpu-ctl selftest` on hardware.  The driver integration is fully testable:
+stubbed probe reports become `selftest-failed` health overlays on the
+published inventory, and recovery clears them."""
+
+import pytest
+
+from k8s_dra_driver_tpu.e2e.harness import make_cluster
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.tpuinfo import selftest
+
+
+class TestProbe:
+    def test_inprocess_passes_on_cpu(self):
+        report = selftest.run_inprocess(size=32)
+        assert report["ok"] is True
+        assert report["devices"]
+        for dev in report["devices"]:
+            assert dev["ok"] is True
+            assert dev["latency_ms"] >= 0
+
+    def test_subprocess_roundtrip(self):
+        report = selftest.run_selftest(timeout_s=120, size=32)
+        assert report["ok"] is True
+        assert report["devices"]
+
+    def test_timeout_is_a_result_not_a_hang(self):
+        report = selftest.run_selftest(timeout_s=0.01, size=32)
+        assert report["ok"] is False
+        assert "timed out" in report["error"]
+
+    def test_cli_human_output(self, capsys):
+        rc = selftest.main(["--size", "32"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "device 0: OK" in out
+
+    def test_cli_json_single_line(self, capsys):
+        import json
+
+        rc = selftest.main(["--size", "32", "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip())
+        assert report["ok"] is True
+
+
+def _fake_env():
+    return {"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"}
+
+
+@pytest.fixture
+def rig(tmp_path):
+    cluster = make_cluster(hosts=1, work_dir=str(tmp_path / "w"))
+    driver = Driver(
+        cluster.server,
+        DriverConfig(
+            node_name="tpu-host-0",
+            cdi_root=str(tmp_path / "cdi"),
+            checkpoint_path=str(tmp_path / "cp.json"),
+            topology_env=_fake_env(),
+            selftest_interval_s=0.0001,  # due on every sweep
+        ),
+    )
+    return cluster, driver
+
+
+def _stub_report(monkeypatch, report, calls=None):
+    def fake_run_selftest(timeout_s):
+        if calls is not None:
+            calls.append(timeout_s)
+        return report
+
+    monkeypatch.setattr(selftest, "run_selftest", fake_run_selftest)
+
+
+def _chip_health(cluster):
+    devs = {}
+    for s in cluster.server.list("ResourceSlice"):
+        if s.spec.pool.name != "tpu-host-0":
+            continue
+        for d in s.spec.devices:
+            attrs = d.basic.attributes
+            if attrs["type"].string == "tpu":
+                reason = attrs["healthReason"].value if "healthReason" in attrs else ""
+                devs[d.name] = (attrs["healthy"].value, reason)
+    return devs
+
+
+class TestDriverOverlay:
+    def test_whole_run_failure_fences_the_node(self, rig, monkeypatch):
+        cluster, driver = rig
+        _stub_report(monkeypatch, {"ok": False, "platform": None, "devices": [],
+                                   "error": "selftest timed out after 30s"})
+        assert driver.refresh_inventory() is True
+        health = _chip_health(cluster)
+        assert len(health) == 4
+        assert all(h == (False, "selftest-failed") for h in health.values())
+
+    def test_single_device_failure_fences_one_chip(self, rig, monkeypatch):
+        cluster, driver = rig
+        devices = [{"id": i, "platform": "tpu", "ok": i != 2} for i in range(4)]
+        _stub_report(monkeypatch, {"ok": False, "platform": "tpu", "devices": devices})
+        assert driver.refresh_inventory() is True
+        health = _chip_health(cluster)
+        bad = {name for name, (ok, _) in health.items() if not ok}
+        assert bad == {"tpu-2"}
+        assert health["tpu-2"][1] == "selftest-failed"
+
+    def test_count_mismatch_fences_the_node_not_a_guess(self, rig, monkeypatch):
+        cluster, driver = rig
+        devices = [{"id": 0, "platform": "tpu", "ok": False}]  # 1 device, 4 chips
+        _stub_report(monkeypatch, {"ok": False, "platform": "tpu", "devices": devices})
+        driver.refresh_inventory()
+        health = _chip_health(cluster)
+        assert all(not ok for ok, _ in health.values())
+
+    def test_all_ok_count_mismatch_still_fences(self, rig, monkeypatch):
+        # 3 passing devices against 4 published chips: a chip the runtime
+        # cannot even see is the strongest failure signal — must fence.
+        cluster, driver = rig
+        devices = [{"id": i, "platform": "tpu", "ok": True} for i in range(3)]
+        _stub_report(monkeypatch, {"ok": True, "platform": "tpu", "devices": devices})
+        driver.refresh_inventory()
+        assert all(not ok for ok, _ in _chip_health(cluster).values())
+
+    def test_busy_node_skips_the_probe(self, rig, monkeypatch):
+        # libtpu is process-exclusive: probing under a running workload
+        # would fail spuriously AND disturb it — idle nodes only.
+        cluster, driver = rig
+        calls = []
+        _stub_report(monkeypatch, {"ok": True, "platform": "tpu", "devices": []}, calls)
+        driver.state.prepared["some-claim-uid"] = object()
+        try:
+            driver.refresh_inventory()
+        finally:
+            del driver.state.prepared["some-claim-uid"]
+        assert calls == []
+
+    def test_recovery_clears_the_overlay(self, rig, monkeypatch):
+        cluster, driver = rig
+        _stub_report(monkeypatch, {"ok": False, "platform": None, "devices": [],
+                                   "error": "boom"})
+        driver.refresh_inventory()
+        assert all(not ok for ok, _ in _chip_health(cluster).values())
+        driver._last_selftest = 0.0
+        _stub_report(monkeypatch, {
+            "ok": True, "platform": "tpu",
+            "devices": [{"id": i, "platform": "tpu", "ok": True} for i in range(4)],
+        })
+        assert driver.refresh_inventory() is True
+        assert all(ok for ok, _ in _chip_health(cluster).values())
+
+    def test_non_tpu_platform_says_nothing(self, rig, monkeypatch):
+        cluster, driver = rig
+        _stub_report(monkeypatch, {
+            "ok": True, "platform": "cpu",
+            "devices": [{"id": 0, "platform": "cpu", "ok": True}],
+        })
+        assert driver.refresh_inventory() is False  # no overlay, no change
+        assert all(ok for ok, _ in _chip_health(cluster).values())
+
+    def test_interval_gates_probe_frequency(self, rig, monkeypatch):
+        cluster, driver = rig
+        driver.config.selftest_interval_s = 3600.0
+        calls = []
+        _stub_report(monkeypatch, {
+            "ok": True, "platform": "tpu",
+            "devices": [{"id": i, "platform": "tpu", "ok": True} for i in range(4)],
+        }, calls)
+        driver.refresh_inventory()
+        driver.refresh_inventory()
+        driver.refresh_inventory()
+        assert len(calls) == 1  # once per hour, not per sweep
+
+    def test_disabled_by_default(self, tmp_path, monkeypatch):
+        cluster = make_cluster(hosts=1, work_dir=str(tmp_path / "w2"))
+        calls = []
+        _stub_report(monkeypatch, {"ok": True, "platform": "tpu", "devices": []}, calls)
+        driver = Driver(
+            cluster.server,
+            DriverConfig(
+                node_name="tpu-host-0",
+                cdi_root=str(tmp_path / "cdi2"),
+                checkpoint_path=str(tmp_path / "cp2.json"),
+                topology_env=_fake_env(),
+            ),
+        )
+        driver.refresh_inventory()
+        assert calls == []
