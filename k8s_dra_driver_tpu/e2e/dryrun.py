@@ -104,21 +104,25 @@ def run_dryrun(n_devices: int) -> None:
             )
         else:
             pp_mesh = build_mesh(devices, pp_shape)
-            pp_fns = pp_burnin.build_pp_train_step(cfg, pp_mesh)
-            with pp_mesh:
-                params, opt_state = pp_fns.init(jax.random.PRNGKey(0))
-                tokens = jax.device_put(
-                    burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=64),
-                    jax.sharding.NamedSharding(
-                        pp_mesh, jax.sharding.PartitionSpec("data", None)
-                    ),
+            # Both TP modes: classic megatron (replicated activations, psum)
+            # and megatron-sp (seq-sharded residual + overlapped
+            # collective-matmul rings).
+            for tp_mode in ("megatron", "megatron-sp"):
+                pp_fns = pp_burnin.build_pp_train_step(cfg, pp_mesh, tp_mode=tp_mode)
+                with pp_mesh:
+                    params, opt_state = pp_fns.init(jax.random.PRNGKey(0))
+                    tokens = jax.device_put(
+                        burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=64),
+                        jax.sharding.NamedSharding(
+                            pp_mesh, jax.sharding.PartitionSpec("data", None)
+                        ),
+                    )
+                    params, opt_state, loss = pp_fns.step(params, opt_state, tokens)
+                    jax.block_until_ready(loss)
+                print(
+                    f"dryrun_multichip: mesh pipe={pp_shape.pipe} data={pp_shape.data} "
+                    f"model={pp_shape.model} (pipeline, {tp_mode}) loss={float(loss):.4f}"
                 )
-                params, opt_state, loss = pp_fns.step(params, opt_state, tokens)
-                jax.block_until_ready(loss)
-            print(
-                f"dryrun_multichip: mesh pipe={pp_shape.pipe} data={pp_shape.data} "
-                f"model={pp_shape.model} (pipeline) loss={float(loss):.4f}"
-            )
 
     # Expert parallelism: a top-2 GShard-MoE grad step with all_to_all
     # dispatch over the data/expert axis (k=1 Switch is the same code path

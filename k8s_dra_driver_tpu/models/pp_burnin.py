@@ -68,25 +68,66 @@ _STACKED_SPECS = {
 }
 
 
+def _tp_attention_core(qkv, b: int, s: int, tp: int, cfg: ModelConfig, dtype):
+    """Shared attention math for BOTH TP block variants: head-major qkv
+    [b, s, h_loc*3*hd] -> attention output [b, s, d/tp].  One
+    implementation so the mask/f32-softmax/scaling policy cannot drift
+    between tp modes."""
+    h_loc = cfg.n_heads // tp
+    hd = cfg.head_dim
+    qkv = qkv.reshape(b, s, h_loc, 3, hd)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(dtype)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, cfg.d_model // tp)
+
+
+def _manual_tp_block_sp(x, p, cfg: ModelConfig, tp: int):
+    """Megatron-SP variant of the TP block (Korthikanti et al.): the
+    residual stream stays SEQUENCE-SHARDED over ``model`` between matmuls
+    (activation memory / tp instead of full), the column-parallel
+    projections gather it back with :func:`all_gather_matmul` (the gather
+    rides under the chunk matmuls), and the row-parallel projections
+    REDUCE-SCATTER instead of psum — half the collective bytes of classic
+    Megatron, all of them overlapped.
+
+    x: [b, s/tp, D] seq-sharded (vs the classic block's replicated [b,s,D]).
+    """
+    from k8s_dra_driver_tpu.ops.collective_matmul import (
+        all_gather_matmul,
+        matmul_reduce_scatter,
+    )
+
+    b, s_loc, _d = x.shape
+    s = s_loc * tp
+
+    gather_mm = jax.vmap(lambda y, w: all_gather_matmul(y, w, "model"), (0, None))
+    scatter_mm = jax.vmap(lambda y, w: matmul_reduce_scatter(y, w, "model"), (0, None))
+
+    y = _rms_norm(x, p["ln1"])  # per-token: valid on the seq shard
+    qkv = gather_mm(y, p["qkv"])  # [b, s, h_loc*3*hd] — full sequence
+    attn = _tp_attention_core(qkv, b, s, tp, cfg, x.dtype)
+    x = x + scatter_mm(attn, p["attn_out"])  # [b, s/tp, D]
+
+    y = _rms_norm(x, p["ln2"])
+    h = jax.nn.gelu(gather_mm(y, p["mlp_up"]))
+    x = x + scatter_mm(h, p["mlp_down"])
+    return x
+
+
 def _manual_tp_block(x, p, cfg: ModelConfig, tp: int):
     """One transformer block with weights TP-sliced over `model` (call inside
     shard_map; x is model-replicated [b, s, D])."""
-    b, s, d = x.shape
-    h_loc = cfg.n_heads // tp
-    hd = cfg.head_dim
+    b, s, _d = x.shape
 
     y = _rms_norm(x, p["ln1"])
     # p["qkv"] is head-major (see _headmajor_qkv): each TP shard's columns
     # are whole heads carrying their own q,k,v — a naive [q|k|v]-packed
     # column shard would split k across devices.
     qkv = jnp.einsum("bsd,de->bse", y, p["qkv"])  # [b, s, h_loc*3*hd]
-    qkv = qkv.reshape(b, s, h_loc, 3, hd)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
-    weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, d // tp)
+    attn = _tp_attention_core(qkv, b, s, tp, cfg, x.dtype)
     # Row-parallel out-projection: partial sums reduced over `model`.
     x = x + jax.lax.psum(jnp.einsum("bse,ed->bsd", attn, p["attn_out"]), "model")
 
@@ -97,8 +138,18 @@ def _manual_tp_block(x, p, cfg: ModelConfig, tp: int):
 
 
 def build_pp_train_step(
-    cfg: ModelConfig, mesh: Mesh, lr: float = 3e-4, n_micro: int | None = None
+    cfg: ModelConfig,
+    mesh: Mesh,
+    lr: float = 3e-4,
+    n_micro: int | None = None,
+    tp_mode: str = "megatron",
 ) -> TrainStepFns:
+    """``tp_mode``: 'megatron' (replicated activations, psum reductions) or
+    'megatron-sp' (sequence-sharded residual stream with the overlapped
+    collective-matmul rings from ops/collective_matmul.py — less activation
+    memory, half the collective bytes, transfers hidden under compute)."""
+    if tp_mode not in ("megatron", "megatron-sp"):
+        raise ValueError(f"tp_mode must be 'megatron' or 'megatron-sp', got {tp_mode!r}")
     pp = mesh.shape.get("pipe", 1)
     tp = mesh.shape.get("model", 1)
     if pp < 2:
@@ -130,18 +181,21 @@ def build_pp_train_step(
 
     # Same remat tradeoff as the dense path: recompute block activations in
     # backward instead of keeping every per-tick intermediate live.
-    block_fn = jax.checkpoint(functools.partial(_manual_tp_block, cfg=cfg, tp=tp))
+    block = _manual_tp_block_sp if tp_mode == "megatron-sp" else _manual_tp_block
+    block_fn = jax.checkpoint(functools.partial(block, cfg=cfg, tp=tp))
     stage_fn = functools.partial(stage_scan, block_fn)
     data_axis = mesh.shape.get("data", 1)
+
+    # megatron-sp: the hand-off/residual stream is seq-sharded over `model`
+    # inside the shard_map, so the microbatch spec carries S on that axis.
+    seq_axis = "model" if tp_mode == "megatron-sp" else None
+    mb_spec = P(None, "data", seq_axis, None)  # [n_micro, B, S, D]
 
     pipe_body = jax.shard_map(
         lambda blocks, x_mb: pipeline_apply(stage_fn, blocks, x_mb),
         mesh=mesh,
-        in_specs=(
-            _STACKED_SPECS,
-            P(None, "data", None, None),  # [n_micro, B, S, D]
-        ),
-        out_specs=P(None, "data", None, None),
+        in_specs=(_STACKED_SPECS, mb_spec),
+        out_specs=mb_spec,
         check_vma=False,  # psum-replicated output; collection mask confuses vma
     )
 
@@ -151,6 +205,11 @@ def build_pp_train_step(
             raise ValueError(
                 f"batch {b} must split into {n_micro} microbatches each "
                 f"divisible by the data axis ({data_axis})"
+            )
+        if tp_mode == "megatron-sp" and s % tp:
+            raise ValueError(
+                f"megatron-sp shards the sequence over the model axis: "
+                f"seq {s} must be divisible by {tp}"
             )
         x = params["embed"][tokens] + params["pos_embed"][:s]
         x_mb = x.reshape(n_micro, b // n_micro, s, cfg.d_model)

@@ -237,12 +237,19 @@ class DeviceState:
                 import dataclasses
 
                 chips = list(new_topology.chips)
+                touched = False
                 for pos, reason in self._health_overlay.items():
                     if 0 <= pos < len(chips) and chips[pos].healthy:
                         chips[pos] = dataclasses.replace(
                             chips[pos], healthy=False, health_reason=reason
                         )
-                new_topology = dataclasses.replace(new_topology, chips=chips)
+                        touched = True
+                if touched:
+                    # keep the container type: list-vs-tuple chips would fail
+                    # the equality below and republish identical inventory
+                    new_topology = dataclasses.replace(
+                        new_topology, chips=type(new_topology.chips)(chips)
+                    )
             if new_topology == self.topology and new_layout == self._layout:
                 return False
             self.topology = new_topology

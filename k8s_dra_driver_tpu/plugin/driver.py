@@ -73,6 +73,7 @@ class Driver:
         self._last_selftest = 0.0
         self._selftest_thread: threading.Thread | None = None
         self._selftest_report: dict | None = None
+        self._selftest_run = None  # in-flight SelftestRun, cancellable
         self._selftest_join_grace_s = 1.0
         REGISTRY.gauge(
             "dra_allocatable_devices", "Devices this node publishes"
@@ -111,6 +112,12 @@ class Driver:
     def node_prepare_resources(self, claims: list[ClaimRef]) -> dict[str, ClaimResult]:
         out: dict[str, ClaimResult] = {}
         with self._lock:
+            # A workload is arriving: kill any in-flight self-test probe NOW
+            # (libtpu is process-exclusive; the probe would fail the pod's
+            # runtime init).  Its report comes back cancelled and is
+            # discarded by the sweep.
+            if self._selftest_run is not None:
+                self._selftest_run.cancel()
             for ref in claims:
                 ok = False
                 with TRACER.span(
@@ -200,26 +207,27 @@ class Driver:
         interval = self.config.selftest_interval_s
         if interval <= 0:
             return
-        with self._lock:
-            report = self._selftest_report
-            self._selftest_report = None
-            busy = bool(self.state.prepared)
-        if report is not None:
-            self._apply_selftest_report(report, busy)
+        self._fold_selftest_report()
         now = time.monotonic()
         due = not self._last_selftest or now - self._last_selftest >= interval
         thread = self._selftest_thread
+        with self._lock:
+            busy = bool(self.state.prepared)
         if not due or busy or (thread is not None and thread.is_alive()):
             return
         self._last_selftest = now
-        from k8s_dra_driver_tpu.tpuinfo.selftest import run_selftest
+        from k8s_dra_driver_tpu.tpuinfo.selftest import start_selftest
 
         timeout_s = max(min(interval, 180.0), 30.0)
 
         def worker():
-            result = run_selftest(timeout_s=timeout_s)
+            run = start_selftest(timeout_s=timeout_s)
+            with self._lock:
+                self._selftest_run = run  # visible to prepare for cancel
+            result = run.result()
             with self._lock:
                 self._selftest_report = result
+                self._selftest_run = None
 
         thread = threading.Thread(target=worker, daemon=True, name="tpu-selftest")
         self._selftest_thread = thread
@@ -227,11 +235,21 @@ class Driver:
         # Brief join: a fast probe (healthy chip, stubbed test) folds into
         # THIS sweep; a hung one keeps running and folds later.
         thread.join(timeout=self._selftest_join_grace_s)
+        self._fold_selftest_report()
+
+    def _fold_selftest_report(self) -> None:
+        """Apply the newest completed probe report, if any.  ``busy`` is
+        recomputed HERE (not at launch): a claim prepared while the probe
+        ran means its failure may just be exclusive-access contention —
+        never fence a node that is healthily serving workloads.  Cancelled
+        probes (killed by prepare, see node_prepare_resources) say
+        nothing."""
         with self._lock:
             report = self._selftest_report
             self._selftest_report = None
-        if report is not None:
-            self._apply_selftest_report(report, busy=False)
+            busy = bool(self.state.prepared)
+        if report is not None and not report.get("cancelled"):
+            self._apply_selftest_report(report, busy)
 
     def _apply_selftest_report(self, report: dict, busy: bool) -> None:
         n_chips = len(self.state.topology.chips)

@@ -83,15 +83,61 @@ def run_inprocess(size: int = DEFAULT_SIZE) -> dict:
     }
 
 
-def run_selftest(
+class SelftestRun:
+    """Handle to one in-flight watchdogged probe subprocess.
+
+    Exists so the plugin can CANCEL a probe the moment a claim prepares:
+    libtpu is process-exclusive, and a probe still holding the chips when a
+    fresh workload initializes would fail that workload's startup."""
+
+    def __init__(self, proc: subprocess.Popen, timeout_s: float):
+        self._proc = proc
+        self._timeout_s = timeout_s
+        self.cancelled = False
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def cancel(self) -> None:
+        """Kill the probe (idempotent); its result() becomes cancelled."""
+        self.cancelled = True
+        if self._proc.poll() is None:
+            self._proc.kill()
+
+    def result(self) -> dict:
+        """Block (up to the watchdog timeout) and parse the report."""
+        try:
+            stdout, stderr = self._proc.communicate(timeout=self._timeout_s)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.communicate()
+            return {"ok": False, "platform": None, "devices": [],
+                    "error": f"selftest timed out after {self._timeout_s:.0f}s "
+                             "(hung device link?)"}
+        if self.cancelled:
+            return {"ok": False, "platform": None, "devices": [],
+                    "cancelled": True, "error": "selftest cancelled"}
+        for line in reversed(stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    break
+        return {"ok": False, "platform": None, "devices": [],
+                "error": f"selftest rc={self._proc.returncode}, no JSON "
+                         f"(stderr tail: {stderr[-500:]!r})"}
+
+
+def start_selftest(
     timeout_s: float = DEFAULT_TIMEOUT_S, size: int = DEFAULT_SIZE
-) -> dict:
-    """Subprocess + watchdog wrapper: the current env (INCLUDING the
-    accelerator plugin — unlike the dry run, the device link is the thing
-    under test) with a hard timeout, so a hung backend init becomes a
-    diagnosable failure instead of a stuck caller."""
+) -> SelftestRun:
+    """Launch the watchdogged probe subprocess: the current env (INCLUDING
+    the accelerator plugin — unlike the dry run, the device link is the
+    thing under test); a hung backend init becomes a diagnosable timeout in
+    ``result()`` instead of a stuck caller."""
     # --timeout 0 = probe in-process: the child must NOT re-wrap itself in
-    # another subprocess (this function IS the watchdog layer).
+    # another subprocess (this layer IS the watchdog).
     cmd = [sys.executable, "-m", "k8s_dra_driver_tpu.tpuinfo.selftest",
            "--json", "--size", str(size), "--timeout", "0"]
     env = dict(os.environ)
@@ -99,23 +145,17 @@ def run_selftest(
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo_root, env.get("PYTHONPATH", "")) if p
     )
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s, env=env
-        )
-    except subprocess.TimeoutExpired:
-        return {"ok": False, "platform": None, "devices": [],
-                "error": f"selftest timed out after {timeout_s:.0f}s (hung device link?)"}
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                break
-    return {"ok": False, "platform": None, "devices": [],
-            "error": f"selftest rc={proc.returncode}, no JSON "
-                     f"(stderr tail: {proc.stderr[-500:]!r})"}
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+    )
+    return SelftestRun(proc, timeout_s)
+
+
+def run_selftest(
+    timeout_s: float = DEFAULT_TIMEOUT_S, size: int = DEFAULT_SIZE
+) -> dict:
+    """start_selftest + result in one call (the non-cancellable path)."""
+    return start_selftest(timeout_s=timeout_s, size=size).result()
 
 
 def main(argv: list[str] | None = None) -> int:

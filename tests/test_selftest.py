@@ -67,13 +67,31 @@ def rig(tmp_path):
     return cluster, driver
 
 
+class _FakeRun:
+    def __init__(self, report):
+        self._report = report
+        self.cancelled = False
+
+    def alive(self):
+        return False
+
+    def cancel(self):
+        self.cancelled = True
+
+    def result(self):
+        if self.cancelled:
+            return {"ok": False, "platform": None, "devices": [],
+                    "cancelled": True, "error": "selftest cancelled"}
+        return self._report
+
+
 def _stub_report(monkeypatch, report, calls=None):
-    def fake_run_selftest(timeout_s):
+    def fake_start_selftest(timeout_s):
         if calls is not None:
             calls.append(timeout_s)
-        return report
+        return _FakeRun(report)
 
-    monkeypatch.setattr(selftest, "run_selftest", fake_run_selftest)
+    monkeypatch.setattr(selftest, "start_selftest", fake_start_selftest)
 
 
 def _chip_health(cluster):
@@ -174,6 +192,32 @@ class TestDriverOverlay:
         driver.refresh_inventory()
         driver.refresh_inventory()
         assert len(calls) == 1  # once per hour, not per sweep
+
+    def test_prepare_cancels_inflight_probe(self, rig):
+        # A workload arriving mid-probe must kill the probe (libtpu is
+        # process-exclusive) — and the cancelled report must fence nothing.
+        cluster, driver = rig
+        run = _FakeRun({"ok": False, "platform": None, "devices": [],
+                        "error": "would-have-fenced"})
+        driver._selftest_run = run
+        driver.node_prepare_resources([])  # empty batch still sweeps the cancel
+        assert run.cancelled is True
+        driver._selftest_report = run.result()
+        driver._fold_selftest_report()
+        assert all(ok for ok, _ in _chip_health(cluster).values())
+
+    def test_report_folded_while_busy_discards_init_failures(self, rig, monkeypatch):
+        # busy is recomputed at FOLD time: a claim prepared while the probe
+        # ran explains an init failure (exclusive access), so no fencing.
+        cluster, driver = rig
+        driver._selftest_report = {"ok": False, "platform": None, "devices": [],
+                                   "error": "backend init failed: device busy"}
+        driver.state.prepared["uid"] = object()
+        try:
+            driver._fold_selftest_report()
+        finally:
+            del driver.state.prepared["uid"]
+        assert all(ok for ok, _ in _chip_health(cluster).values())
 
     def test_disabled_by_default(self, tmp_path, monkeypatch):
         cluster = make_cluster(hosts=1, work_dir=str(tmp_path / "w2"))
