@@ -144,6 +144,20 @@ def run_data_plane() -> dict:
             out["decode"] = _decode_throughput(cfg, params)
         except Exception as exc:  # noqa: BLE001
             out["decode"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # Weight-only int8 serving: same decode, half the weight bytes.
+        try:
+            from k8s_dra_driver_tpu.models.quant import quantize_blocks
+
+            out["decode_int8"] = _decode_throughput(cfg, quantize_blocks(params))
+        except Exception as exc:  # noqa: BLE001
+            out["decode_int8"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # int8 MXU ceiling (the quantized-compute headroom over bf16).
+        try:
+            from k8s_dra_driver_tpu.ops.collectives import matmul_int8_tops
+
+            out["matmul_int8_tops"] = round(matmul_int8_tops(size=4096, chain=128), 1)
+        except Exception as exc:  # noqa: BLE001
+            out["matmul_int8_tops"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out
 
 

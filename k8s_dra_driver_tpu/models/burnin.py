@@ -29,6 +29,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from k8s_dra_driver_tpu.models.quant import mat as _mat
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -122,7 +124,7 @@ def qkv_proj(x, p, cfg: ModelConfig):
     b, s, _ = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
     y = _rms_norm(x, p["ln1"])
-    qkv = jnp.einsum("bsd,de->bse", y, p["qkv"])
+    qkv = jnp.einsum("bsd,de->bse", y, _mat(p["qkv"]))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     return (
         q.reshape(b, s, h, hd),
@@ -134,8 +136,8 @@ def qkv_proj(x, p, cfg: ModelConfig):
 def mlp_residual(x, p):
     """ln2 + gelu MLP with residual (shared with decode)."""
     y = _rms_norm(x, p["ln2"])
-    y = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, p["mlp_up"]))
-    return x + jnp.einsum("bsf,fd->bsd", y, p["mlp_down"])
+    y = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, _mat(p["mlp_up"])))
+    return x + jnp.einsum("bsf,fd->bsd", y, _mat(p["mlp_down"]))
 
 
 def tied_logits(x, params):
@@ -148,7 +150,7 @@ def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
     b, s, d = x.shape
     q, k, v = qkv_proj(x, p, cfg)
     attn = attn_fn(q, k, v).reshape(b, s, d)
-    x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
+    x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
     x = _constrain(x, act_spec)
     return _constrain(mlp_residual(x, p), act_spec)
 

@@ -26,6 +26,7 @@ from k8s_dra_driver_tpu.models.burnin import (
     qkv_proj,
     tied_logits,
 )
+from k8s_dra_driver_tpu.models.quant import mat as _mat
 
 
 class KVCache(NamedTuple):
@@ -103,7 +104,7 @@ def decode_step(params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConf
             jax.lax.dynamic_update_slice_in_dim(new_v[li], v.astype(new_v.dtype), pos, axis=1)
         )
         attn = _cached_attention(q, new_k[li], new_v[li], pos).reshape(b, 1, cfg.d_model)
-        x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
+        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
         x = mlp_residual(x, p)
 
     logits = tied_logits(x, params)
@@ -245,7 +246,7 @@ def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,
             jax.lax.dynamic_update_slice_in_dim(new_v[li], v_c, 0, axis=1)
         )
         attn = _prefill_attention(q, k_c, v_c).reshape(b, p_len, cfg.d_model)
-        x = x + jnp.einsum("bsd,de->bse", attn, p["attn_out"])
+        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
         x = mlp_residual(x, p)
 
     logits = tied_logits(x, params)[:, -1]

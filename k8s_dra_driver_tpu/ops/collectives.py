@@ -129,15 +129,31 @@ def dispatch_rtt_seconds(device=None, iters: int = 7) -> float:
     return samples[len(samples) // 2]
 
 
+def _timed_probe_seconds(f, arg, device, what: str) -> float:
+    """The shared measurement discipline for every chained-matmul probe:
+    ONE jit ending in a scalar host readback (async dispatch cannot fake
+    completion), compile+sync warmup, median dispatch RTT subtracted, and a
+    refusal — never a clamp — when dispatch noise buries the compute
+    (clamping would fabricate the impossible readings this method exists to
+    prevent)."""
+    float(f(arg))  # compile + sync
+    start = time.perf_counter()
+    float(f(arg))
+    total = time.perf_counter() - start
+    rtt = dispatch_rtt_seconds(device)
+    if total <= 1.5 * rtt:
+        raise RuntimeError(
+            f"{what} measurement dominated by dispatch RTT "
+            f"({total*1e3:.1f}ms total vs {rtt*1e3:.1f}ms RTT); raise `chain`"
+        )
+    return total - rtt
+
+
 def matmul_tflops(
     device=None, size: int = 4096, dtype=jnp.bfloat16, chain: int = 128
 ) -> float:
-    """Single-device MXU utilization probe.
-
-    ``chain`` matmuls run inside ONE jit (lax.scan) ending in a scalar host
-    readback, so async dispatch cannot fake completion and the per-call
-    round-trip (70ms+ through the axon tunnel) is amortized + subtracted.
-    """
+    """Single-device MXU utilization probe (``chain`` matmuls in one jit,
+    see :func:`_timed_probe_seconds` for the timing discipline)."""
     if device is None:
         device = jax.devices()[0]
     key = jax.random.PRNGKey(0)
@@ -152,19 +168,39 @@ def matmul_tflops(
         y, _ = jax.lax.scan(body, x, None, length=chain)
         return jnp.sum(y).astype(jnp.float32)
 
-    float(f(a))  # compile
-    start = time.perf_counter()
-    float(f(a))
-    total = time.perf_counter() - start
-    rtt = dispatch_rtt_seconds(device)
-    if total <= 1.5 * rtt:
-        # Compute is buried in dispatch noise; clamping would fabricate the
-        # impossible readings this method exists to prevent.
-        raise RuntimeError(
-            f"matmul measurement dominated by dispatch RTT "
-            f"({total*1e3:.1f}ms total vs {rtt*1e3:.1f}ms RTT); raise `chain`"
-        )
-    return chain * 2 * size**3 / (total - rtt) / 1e12
+    secs = _timed_probe_seconds(f, a, device, "matmul")
+    return chain * 2 * size**3 / secs / 1e12
+
+
+def matmul_int8_tops(
+    device=None, size: int = 4096, chain: int = 128
+) -> float:
+    """int8 MXU probe (s8 x s8 -> s32): the quantized-serving ceiling.
+
+    v5e's int8 peak is 2x its bf16 peak (394 vs 197 T-ops/s); timing
+    discipline shared with :func:`matmul_tflops` via
+    :func:`_timed_probe_seconds`.  The carry is shifted and truncated back
+    to int8 between links; the truncation wraps (a 4096-deep s8 dot's
+    carries exceed int8 even after the shift) — deterministic and
+    value-irrelevant here, where only the MXU work is being timed."""
+    if device is None:
+        device = jax.devices()[0]
+    key = jax.random.PRNGKey(0)
+    a = jax.device_put(
+        jax.random.randint(key, (size, size), -127, 128, jnp.int8), device
+    )
+
+    @jax.jit
+    def f(x):
+        def body(y, _):
+            y32 = jax.lax.dot(y, x, preferred_element_type=jnp.int32)
+            return (y32 >> 14).astype(jnp.int8), None
+
+        y, _ = jax.lax.scan(body, x, None, length=chain)
+        return jnp.sum(y.astype(jnp.int32)).astype(jnp.float32)
+
+    secs = _timed_probe_seconds(f, a, device, "int8 matmul")
+    return chain * 2 * size**3 / secs / 1e12
 
 
 def attention_speedup(
