@@ -74,35 +74,45 @@ def _masked_attention(q, k, v, mask):
 
 
 def _cached_attention(q, k_cache, v_cache, pos):
-    """q: [B, 1, H, hd]; caches: [B, S_max, H, hd]; attend over
-    positions <= pos (the rest of the cache is masked, not sliced —
-    static shapes keep the step program reusable)."""
+    """q: [B, 1, H, hd]; caches: [B, S_max, H, hd]; attend over positions
+    <= pos (pos: [B] int32 — per ROW; the rest of the cache is masked, not
+    sliced — static shapes keep the step program reusable)."""
     k_pos = jnp.arange(k_cache.shape[1])
     return _masked_attention(
-        q, k_cache, v_cache, (k_pos <= pos)[None, None, None, :]
+        q, k_cache, v_cache, (k_pos[None, :] <= pos[:, None])[:, None, None, :]
     )
 
 
-def decode_step(params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig):
+def decode_step(
+    params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig, active=None
+):
     """One incremental step.
 
-    token: [B] int32 — the token at ``pos``;  pos: scalar int32.
+    token: [B] int32 — the token at ``pos``;  pos: scalar int32 (whole
+    batch at one depth — the sequential-decode case) or [B] int32 (per-row
+    depth — the continuous-batching case, models/serve.py).  ``active``:
+    optional [B] bool; inactive rows' cache writes become no-ops (their
+    outputs are garbage the caller ignores).  One step implementation for
+    BOTH decode paths so the numerics cannot drift.
+
     Returns (logits [B, V] f32 for position ``pos``, updated cache).
     """
     b = token.shape[0]
-    x = params["embed"][token][:, None, :] + jax.lax.dynamic_slice_in_dim(
-        params["pos_embed"], pos, 1, axis=0
-    )  # [B, 1, D]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    rows = jnp.arange(b)
+    x = params["embed"][token][:, None, :] + params["pos_embed"][pos][:, None, :]
 
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
         q, k, v = qkv_proj(x, p, cfg)  # [B, 1, H, hd] each
-        new_k = new_k.at[li].set(
-            jax.lax.dynamic_update_slice_in_dim(new_k[li], k.astype(new_k.dtype), pos, axis=1)
-        )
-        new_v = new_v.at[li].set(
-            jax.lax.dynamic_update_slice_in_dim(new_v[li], v.astype(new_v.dtype), pos, axis=1)
-        )
+        k_new = k[:, 0].astype(new_k.dtype)
+        v_new = v[:, 0].astype(new_v.dtype)
+        if active is not None:
+            gate = active[:, None, None]
+            k_new = jnp.where(gate, k_new, new_k[li, rows, pos])
+            v_new = jnp.where(gate, v_new, new_v[li, rows, pos])
+        new_k = new_k.at[li, rows, pos].set(k_new)
+        new_v = new_v.at[li, rows, pos].set(v_new)
         attn = _cached_attention(q, new_k[li], new_v[li], pos).reshape(b, 1, cfg.d_model)
         x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
         x = mlp_residual(x, p)

@@ -1,0 +1,236 @@
+"""Continuous-batching serving engine over the KV cache.
+
+Sequential `greedy_decode` serves one batch at a time: every request in the
+batch waits for the longest one, and new requests wait for the whole batch.
+Real serving interleaves — this engine keeps a fixed pool of SLOTS (static
+shapes: the cache is [L, n_slots, max_seq, H, hd] forever, so XLA compiles
+exactly two programs — prefill-into-slot and step) and lets requests join
+and leave per step:
+
+* ``submit`` prefills a free slot with the prompt in ONE parallel forward
+  (`decode.prefill`, padded to a bucket length to bound recompiles) and
+  marks it active;
+* ``step`` advances EVERY active slot by one token in a single fused
+  program — per-slot positions, per-row cache scatter, inactive slots
+  masked out;
+* finished slots (eos or max_tokens) free immediately and the next submit
+  reuses them.
+
+Numerics contract (tested): a request served through the engine produces
+EXACTLY the tokens sequential `greedy_decode` produces for the same prompt
+— continuous batching changes scheduling, never results.
+
+The reference has no serving story at all (its data plane is CUDA inside
+user pods); this is consumer-side capability per SURVEY.md §2.11.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_dra_driver_tpu.models.burnin import ModelConfig
+from k8s_dra_driver_tpu.models.decode import KVCache, init_cache
+
+
+def _step_all_slots(params, cache: KVCache, tokens, pos, active, *, cfg: ModelConfig):
+    """One decode step for every slot at its OWN position: exactly
+    :func:`decode.decode_step` with vector positions and the active gate —
+    one step implementation for both decode paths, so the engine's
+    bit-equality contract cannot drift.  Returns (next_token [B], cache)."""
+    from k8s_dra_driver_tpu.models import decode
+
+    logits, cache = decode.decode_step(
+        params, cache, tokens, pos, cfg=cfg, active=active
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
+def _prefill_into_slot(params, cache: KVCache, prompt, plen, slot, *, cfg):
+    """Fill ONE slot's cache from a padded prompt [1, bucket] in one
+    parallel forward; returns (first generated token, new cache).
+
+    Causality makes padding safe: k/v at position j depend only on
+    positions <= j, so every j < plen is computed from real tokens and the
+    garbage tail (>= plen) is zeroed here and mask-excluded forever after.
+    The padded prefill's OWN last-logits are at position bucket-1 (wrong
+    for padded prompts) and are discarded; the first generated token comes
+    from re-running the per-slot step at pos = plen-1 — bit-identical to
+    what sequential decode computes there, and the k/v re-write at that
+    position is idempotent (same token, same position)."""
+    from k8s_dra_driver_tpu.models import decode
+
+    slot_cache, _ = decode.prefill(
+        params, prompt, cfg, max_seq=cache.k.shape[2], cache_dtype=cache.k.dtype
+    )
+    k = jnp.where(
+        (jnp.arange(cache.k.shape[2]) < plen)[None, :, None, None],
+        slot_cache.k[:, 0],
+        0,
+    )
+    v = jnp.where(
+        (jnp.arange(cache.v.shape[2]) < plen)[None, :, None, None],
+        slot_cache.v[:, 0],
+        0,
+    )
+    new_k = cache.k.at[:, slot].set(k.astype(cache.k.dtype))
+    new_v = cache.v.at[:, slot].set(v.astype(cache.v.dtype))
+    new_cache = KVCache(new_k, new_v)
+
+    # First generated token = argmax at position plen-1, computed with the
+    # per-slot step machinery (exactly what sequential decode does).
+    last_tok = prompt[0, plen - 1]
+    tok, new_cache = _step_all_slots(
+        params,
+        new_cache,
+        jnp.full((cache.k.shape[1],), last_tok, jnp.int32),
+        jnp.full((cache.k.shape[1],), plen - 1, jnp.int32),
+        jnp.arange(cache.k.shape[1]) == slot,
+        cfg=cfg,
+    )
+    return tok[slot], new_cache
+
+
+@dataclass
+class _Slot:
+    request_id: int
+    tokens: list[int]  # prompt + generated so far
+    prompt_len: int
+    max_tokens: int
+
+
+@dataclass
+class Completion:
+    request_id: int
+    tokens: list[int]  # prompt + generated
+    generated: list[int]
+
+
+@dataclass
+class ServeEngine:
+    """Host-side scheduler around the two jitted programs.
+
+    Greedy only (temperature sampling composes the same way `sample_decode`
+    does; the scheduling is the point here).  Not thread-safe — drive it
+    from one loop, like the kubelet drives the plugin.
+    """
+
+    params: dict
+    cfg: ModelConfig
+    n_slots: int = 8
+    prompt_bucket: int = 64
+    cache_dtype: object = jnp.float32
+    eos_id: int | None = None
+
+    _cache: KVCache = field(init=False)
+    _last: jax.Array = field(init=False)
+    _pos: jax.Array = field(init=False)
+    _active: jax.Array = field(init=False)
+    _slots: list = field(init=False)
+    _next_id: int = field(init=False, default=0)
+    _completions: list = field(init=False, default_factory=list)
+
+    def __post_init__(self):
+        cfg = self.cfg
+        if self.prompt_bucket > cfg.max_seq:
+            raise ValueError(
+                f"prompt_bucket ({self.prompt_bucket}) exceeds max_seq ({cfg.max_seq})"
+            )
+        self._cache = init_cache(cfg, self.n_slots, cfg.max_seq, dtype=self.cache_dtype)
+        self._last = jnp.zeros((self.n_slots,), jnp.int32)
+        self._pos = jnp.zeros((self.n_slots,), jnp.int32)
+        self._active = jnp.zeros((self.n_slots,), bool)
+        self._slots = [None] * self.n_slots
+        self._step_fn = jax.jit(functools.partial(_step_all_slots, cfg=cfg))
+        self._prefill_fn = jax.jit(functools.partial(_prefill_into_slot, cfg=cfg))
+
+    # -- public API --------------------------------------------------------
+    def free_slots(self) -> int:
+        return sum(1 for s in self._slots if s is None)
+
+    def submit(self, prompt: list[int], max_tokens: int) -> int:
+        """Prefill `prompt` into a free slot; returns a request id.
+        Raises RuntimeError when no slot is free (callers queue upstream —
+        admission control is theirs, scheduling is ours)."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        if len(prompt) > self.prompt_bucket:
+            raise ValueError(f"prompt {len(prompt)} exceeds bucket {self.prompt_bucket}")
+        if len(prompt) + max_tokens > self.cfg.max_seq:
+            raise ValueError("prompt + max_tokens exceeds max_seq")
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            raise RuntimeError("no free slot") from None
+        padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+        padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+        first_tok, self._cache = self._prefill_fn(
+            self.params, self._cache, padded, len(prompt), slot
+        )
+        request_id = self._next_id
+        self._next_id += 1
+        self._slots[slot] = _Slot(
+            request_id, list(prompt) + [int(first_tok)], len(prompt), max_tokens
+        )
+        self._last = self._last.at[slot].set(first_tok)
+        self._pos = self._pos.at[slot].set(len(prompt))
+        self._active = self._active.at[slot].set(True)
+        self._retire(slot)  # max_tokens=1 or eos on the first token
+        return request_id
+
+    def step(self) -> int:
+        """Advance every active slot one token; returns #active before the
+        step.  Finished requests move to ``completions()``.
+
+        One device->host transfer per step (the token vector): occupancy is
+        host-side bookkeeping, and per-slot device reads would serialize
+        the loop against the device once per slot per token."""
+        n_active = self.n_slots - self.free_slots()
+        if n_active == 0:
+            return 0
+        next_tok, self._cache = self._step_fn(
+            self.params, self._cache, self._last, self._pos, self._active
+        )
+        self._last = jnp.where(self._active, next_tok, self._last)
+        self._pos = jnp.where(self._active, self._pos + 1, self._pos)
+        toks = np.asarray(next_tok).tolist()
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            st.tokens.append(toks[slot])
+            self._retire(slot)
+        return n_active
+
+    def run_until_drained(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if self.step() == 0:
+                return
+        raise RuntimeError("serving loop did not drain")
+
+    def completions(self) -> list:
+        out, self._completions = self._completions, []
+        return out
+
+    # -- internals ---------------------------------------------------------
+    def _retire(self, slot: int) -> None:
+        """Free the slot if its request just finished (eos, max_tokens, or
+        the cache ran out of positions)."""
+        st = self._slots[slot]
+        n_gen = len(st.tokens) - st.prompt_len
+        hit_eos = self.eos_id is not None and st.tokens[-1] == self.eos_id
+        if n_gen >= st.max_tokens or hit_eos or len(st.tokens) >= self.cfg.max_seq:
+            self._completions.append(
+                Completion(
+                    request_id=st.request_id,
+                    tokens=list(st.tokens),
+                    generated=list(st.tokens[st.prompt_len :]),
+                )
+            )
+            self._slots[slot] = None
+            self._active = self._active.at[slot].set(False)

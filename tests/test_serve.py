@@ -1,0 +1,131 @@
+"""Continuous-batching serving engine (models/serve.py).
+
+The load-bearing contract: scheduling requests through slots changes
+WHEN tokens are computed, never WHAT tokens come out — every request must
+match sequential `greedy_decode` exactly."""
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, decode
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+CFG = burnin.ModelConfig(
+    vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=64
+)
+PARAMS = burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _prompt(seed, length):
+    return [
+        int(t)
+        for t in burnin.sample_tokens(jax.random.PRNGKey(seed), CFG, 1, length)[0]
+    ]
+
+
+def _reference(prompt, steps):
+    out = decode.greedy_decode(
+        PARAMS, jax.numpy.asarray([prompt], jax.numpy.int32), steps, cfg=CFG
+    )
+    return [int(t) for t in out[0]]
+
+
+def _engine(**kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(PARAMS, CFG, **kw)
+
+
+class TestExactness:
+    def test_single_request_matches_sequential_decode(self):
+        eng = _engine()
+        prompt = _prompt(1, 8)
+        eng.submit(prompt, max_tokens=12)
+        eng.run_until_drained()
+        (done,) = eng.completions()
+        assert done.tokens == _reference(prompt, 12)
+        assert done.generated == done.tokens[8:]
+
+    def test_concurrent_requests_each_match(self):
+        eng = _engine()
+        prompts = {0: _prompt(2, 6), 1: _prompt(3, 9), 2: _prompt(4, 4)}
+        ids = {eng.submit(p, max_tokens=10): k for k, p in prompts.items()}
+        eng.run_until_drained()
+        done = {c.request_id: c for c in eng.completions()}
+        assert len(done) == 3
+        for rid, key in ids.items():
+            assert done[rid].tokens == _reference(prompts[key], 10), key
+
+    def test_mid_flight_submit_matches(self):
+        # A request joining while others are generating must not perturb
+        # them (active-masked cache writes) nor itself (per-slot positions).
+        eng = _engine()
+        p0 = _prompt(5, 8)
+        r0 = eng.submit(p0, max_tokens=12)
+        for _ in range(5):
+            eng.step()
+        p1 = _prompt(6, 5)
+        r1 = eng.submit(p1, max_tokens=6)
+        eng.run_until_drained()
+        done = {c.request_id: c for c in eng.completions()}
+        assert done[r0].tokens == _reference(p0, 12)
+        assert done[r1].tokens == _reference(p1, 6)
+
+    def test_slot_reuse_after_completion(self):
+        eng = _engine(n_slots=1)
+        p0, p1 = _prompt(7, 4), _prompt(8, 6)
+        r0 = eng.submit(p0, max_tokens=3)
+        with pytest.raises(RuntimeError, match="no free slot"):
+            eng.submit(p1, max_tokens=3)
+        eng.run_until_drained()
+        r1 = eng.submit(p1, max_tokens=5)  # reuses the freed slot
+        eng.run_until_drained()
+        done = {c.request_id: c for c in eng.completions()}
+        assert done[r0].tokens == _reference(p0, 3)
+        assert done[r1].tokens == _reference(p1, 5)
+
+
+class TestScheduling:
+    def test_step_counts_active(self):
+        eng = _engine()
+        assert eng.step() == 0
+        eng.submit(_prompt(9, 4), max_tokens=5)
+        eng.submit(_prompt(10, 4), max_tokens=2)
+        assert eng.step() == 2
+        # second request retires after its 2nd token (1 from prefill + 1)
+        assert eng.step() == 1
+
+    def test_eos_stops_early(self):
+        prompt = _prompt(11, 6)
+        ref = _reference(prompt, 20)
+        eos = ref[8]  # a token the model will emit mid-stream
+        eng = _engine(eos_id=eos)
+        eng.submit(prompt, max_tokens=20)
+        eng.run_until_drained()
+        (done,) = eng.completions()
+        assert done.tokens[-1] == eos
+        assert done.tokens == ref[: len(done.tokens)]  # prefix of the ref
+
+    def test_free_slots_accounting(self):
+        eng = _engine()
+        assert eng.free_slots() == 3
+        eng.submit(_prompt(12, 4), max_tokens=4)
+        assert eng.free_slots() == 2
+        eng.run_until_drained()
+        assert eng.free_slots() == 3
+
+
+class TestValidation:
+    def test_rejects_oversized_prompt(self):
+        eng = _engine(prompt_bucket=8)
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(list(range(9)), max_tokens=1)
+
+    def test_rejects_overflow_of_max_seq(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="max_seq"):
+            eng.submit(_prompt(13, 10), max_tokens=CFG.max_seq)
+
+    def test_rejects_empty_prompt(self):
+        with pytest.raises(ValueError, match="empty"):
+            _engine().submit([], max_tokens=1)
