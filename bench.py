@@ -85,6 +85,19 @@ def run_control_plane() -> list[float]:
 
 
 def run_data_plane() -> dict:
+    # BENCH_PROFILE_DIR: capture a jax.profiler trace of the whole data
+    # plane (XPlane protos viewable in TensorBoard/xprof) — the data-plane
+    # counterpart of the control plane's /debug/traces spans.
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")
+    if profile_dir:
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            return _data_plane_body()
+    return _data_plane_body()
+
+
+def _data_plane_body() -> dict:
     import jax
 
     from k8s_dra_driver_tpu.models import burnin

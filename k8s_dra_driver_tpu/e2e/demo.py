@@ -130,6 +130,29 @@ def main() -> None:
     state.refresh()
     print(f"  after `tpu-parted apply -c whole-host-only`: {shapes()}")
 
+    print("\n== sharing walkthrough: 4 pods x 4 differently-shared claims ==")
+    from k8s_dra_driver_tpu.e2e.spec_runner import apply_spec
+
+    specs = pathlib.Path(__file__).parent.parent.parent / "demo" / "specs"
+    wt = make_cluster(hosts=1, topology="v5e-8")  # 2x4: fits the full claim set
+    apply_spec(wt, specs / "sharing" / "sharing-demo-claims.yaml")
+    pods = apply_spec(wt, specs / "sharing" / "sharing-demo-job.yaml")
+    first = pods[0]
+    print(f"  job expanded to {len(pods)} pods, all sharing: "
+          f"{sorted(d['device_name'] for d in first.devices)}")
+    print(f"  wiring: quantum={first.env.get('TPU_QUEUE_QUANTUM_MS')}ms "
+          f"core-fraction={first.env.get('TPU_CORE_FRACTION')}% "
+          f"hbm={first.env.get('TPU_HBM_LIMIT_MIB')}MiB")
+
+    print("\n== selectors walkthrough: CEL recipes pick devices, not code ==")
+    # fresh host: the sharing walkthrough's long-lived claims still hold
+    # every chip above (that sharing IS the demo)
+    wt = make_cluster(hosts=1, topology="v5e-8")
+    apply_spec(wt, specs / "selectors" / "claims.yaml")
+    for pod in apply_spec(wt, specs / "selectors" / "pods.yaml"):
+        names = sorted(d["device_name"] for d in pod.devices)
+        print(f"  {pod.name:22s} -> {names}")
+
 
 if __name__ == "__main__":
     main()

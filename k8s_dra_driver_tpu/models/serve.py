@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from k8s_dra_driver_tpu.models import decode
 from k8s_dra_driver_tpu.models.burnin import ModelConfig
 from k8s_dra_driver_tpu.models.decode import KVCache, init_cache
 
@@ -42,8 +43,6 @@ def _step_all_slots(params, cache: KVCache, tokens, pos, active, *, cfg: ModelCo
     :func:`decode.decode_step` with vector positions and the active gate —
     one step implementation for both decode paths, so the engine's
     bit-equality contract cannot drift.  Returns (next_token [B], cache)."""
-    from k8s_dra_driver_tpu.models import decode
-
     logits, cache = decode.decode_step(
         params, cache, tokens, pos, cfg=cfg, active=active
     )
@@ -62,8 +61,6 @@ def _prefill_into_slot(params, cache: KVCache, prompt, plen, slot, *, cfg):
     from re-running the per-slot step at pos = plen-1 — bit-identical to
     what sequential decode computes there, and the k/v re-write at that
     position is idempotent (same token, same position)."""
-    from k8s_dra_driver_tpu.models import decode
-
     slot_cache, _ = decode.prefill(
         params, prompt, cfg, max_seq=cache.k.shape[2], cache_dtype=cache.k.dtype
     )
@@ -219,12 +216,14 @@ class ServeEngine:
 
     # -- internals ---------------------------------------------------------
     def _retire(self, slot: int) -> None:
-        """Free the slot if its request just finished (eos, max_tokens, or
-        the cache ran out of positions)."""
+        """Free the slot if its request just finished (eos or max_tokens;
+        submit() guarantees prompt + max_tokens <= max_seq, so the cache
+        can never run out of positions mid-stream)."""
         st = self._slots[slot]
         n_gen = len(st.tokens) - st.prompt_len
+        assert len(st.tokens) <= self.cfg.max_seq, "cache overrun: submit() invariant broken"
         hit_eos = self.eos_id is not None and st.tokens[-1] == self.eos_id
-        if n_gen >= st.max_tokens or hit_eos or len(st.tokens) >= self.cfg.max_seq:
+        if n_gen >= st.max_tokens or hit_eos:
             self._completions.append(
                 Completion(
                     request_id=st.request_id,
