@@ -38,18 +38,38 @@ from k8s_dra_driver_tpu.models.burnin import ModelConfig
 from k8s_dra_driver_tpu.models.decode import KVCache, init_cache
 
 
-def _step_all_slots(params, cache: KVCache, tokens, pos, active, *, cfg: ModelConfig):
+def _step_all_slots(
+    params, cache: KVCache, tokens, pos, active, temps, keys,
+    *, cfg: ModelConfig, top_k: int,
+):
     """One decode step for every slot at its OWN position: exactly
     :func:`decode.decode_step` with vector positions and the active gate —
     one step implementation for both decode paths, so the engine's
-    bit-equality contract cannot drift.  Returns (next_token [B], cache)."""
+    bit-equality contract cannot drift.
+
+    Per-slot sampling: ``temps`` [B] f32 (0 = greedy, the bit-equality
+    case), ``keys`` [B, 2] per-request BASE keys — the step key derives
+    statelessly as fold_in(base, pos), so replaying a request is
+    deterministic without threading RNG state through the host loop;
+    ``top_k`` is engine-wide (lax.top_k needs a static k).
+    Returns (next_token [B], cache)."""
     logits, cache = decode.decode_step(
         params, cache, tokens, pos, cfg=cfg, active=active
     )
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+    sampled = jax.vmap(jax.random.categorical)(step_keys, scaled)
+    tok = jnp.where(temps > 0.0, sampled, greedy)
+    return tok.astype(jnp.int32), cache
 
 
-def _prefill_into_slot(params, cache: KVCache, prompt, plen, slot, *, cfg):
+def _prefill_into_slot(
+    params, cache: KVCache, prompt, plen, slot, temp, key, *, cfg, top_k: int
+):
     """Fill ONE slot's cache from a padded prompt [1, bucket] in one
     parallel forward; returns (first generated token, new cache).
 
@@ -81,13 +101,17 @@ def _prefill_into_slot(params, cache: KVCache, prompt, plen, slot, *, cfg):
     # First generated token = argmax at position plen-1, computed with the
     # per-slot step machinery (exactly what sequential decode does).
     last_tok = prompt[0, plen - 1]
+    n_slots = cache.k.shape[1]
     tok, new_cache = _step_all_slots(
         params,
         new_cache,
-        jnp.full((cache.k.shape[1],), last_tok, jnp.int32),
-        jnp.full((cache.k.shape[1],), plen - 1, jnp.int32),
-        jnp.arange(cache.k.shape[1]) == slot,
+        jnp.full((n_slots,), last_tok, jnp.int32),
+        jnp.full((n_slots,), plen - 1, jnp.int32),
+        jnp.arange(n_slots) == slot,
+        jnp.full((n_slots,), temp, jnp.float32),
+        jnp.broadcast_to(key, (n_slots, *key.shape)),
         cfg=cfg,
+        top_k=top_k,
     )
     return tok[slot], new_cache
 
@@ -111,9 +135,11 @@ class Completion:
 class ServeEngine:
     """Host-side scheduler around the two jitted programs.
 
-    Greedy only (temperature sampling composes the same way `sample_decode`
-    does; the scheduling is the point here).  Not thread-safe — drive it
-    from one loop, like the kubelet drives the plugin.
+    Per-request temperature (0 = greedy, the bit-equality case) with
+    deterministic stateless RNG (step key = fold_in(request seed, pos));
+    ``top_k`` is engine-wide because lax.top_k requires a static k.  Not
+    thread-safe — drive it from one loop, like the kubelet drives the
+    plugin.
     """
 
     params: dict
@@ -122,6 +148,7 @@ class ServeEngine:
     prompt_bucket: int = 64
     cache_dtype: object = jnp.float32
     eos_id: int | None = None
+    top_k: int = 0
 
     _cache: KVCache = field(init=False)
     _last: jax.Array = field(init=False)
@@ -137,19 +164,35 @@ class ServeEngine:
             raise ValueError(
                 f"prompt_bucket ({self.prompt_bucket}) exceeds max_seq ({cfg.max_seq})"
             )
+        if not 0 <= self.top_k <= cfg.vocab_size:
+            raise ValueError(
+                f"top_k ({self.top_k}) must be in [0, vocab_size={cfg.vocab_size}]"
+            )
         self._cache = init_cache(cfg, self.n_slots, cfg.max_seq, dtype=self.cache_dtype)
         self._last = jnp.zeros((self.n_slots,), jnp.int32)
         self._pos = jnp.zeros((self.n_slots,), jnp.int32)
         self._active = jnp.zeros((self.n_slots,), bool)
+        self._temps = jnp.zeros((self.n_slots,), jnp.float32)
+        self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
         self._slots = [None] * self.n_slots
-        self._step_fn = jax.jit(functools.partial(_step_all_slots, cfg=cfg))
-        self._prefill_fn = jax.jit(functools.partial(_prefill_into_slot, cfg=cfg))
+        self._step_fn = jax.jit(
+            functools.partial(_step_all_slots, cfg=cfg, top_k=self.top_k)
+        )
+        self._prefill_fn = jax.jit(
+            functools.partial(_prefill_into_slot, cfg=cfg, top_k=self.top_k)
+        )
 
     # -- public API --------------------------------------------------------
     def free_slots(self) -> int:
         return sum(1 for s in self._slots if s is None)
 
-    def submit(self, prompt: list[int], max_tokens: int) -> int:
+    def submit(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        temperature: float = 0.0,
+        seed: int | None = None,
+    ) -> int:
         """Prefill `prompt` into a free slot; returns a request id.
         Raises RuntimeError when no slot is free (callers queue upstream —
         admission control is theirs, scheduling is ours)."""
@@ -167,10 +210,12 @@ class ServeEngine:
             raise RuntimeError("no free slot") from None
         padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
         padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
-        first_tok, self._cache = self._prefill_fn(
-            self.params, self._cache, padded, len(prompt), slot
-        )
         request_id = self._next_id
+        base_key = jax.random.PRNGKey(request_id if seed is None else seed)
+        first_tok, self._cache = self._prefill_fn(
+            self.params, self._cache, padded, len(prompt), slot,
+            jnp.float32(temperature), base_key,
+        )
         self._next_id += 1
         self._slots[slot] = _Slot(
             request_id, list(prompt) + [int(first_tok)], len(prompt), max_tokens
@@ -178,6 +223,8 @@ class ServeEngine:
         self._last = self._last.at[slot].set(first_tok)
         self._pos = self._pos.at[slot].set(len(prompt))
         self._active = self._active.at[slot].set(True)
+        self._temps = self._temps.at[slot].set(temperature)
+        self._keys = self._keys.at[slot].set(base_key)
         self._retire(slot)  # max_tokens=1 or eos on the first token
         return request_id
 
@@ -192,7 +239,8 @@ class ServeEngine:
         if n_active == 0:
             return 0
         next_tok, self._cache = self._step_fn(
-            self.params, self._cache, self._last, self._pos, self._active
+            self.params, self._cache, self._last, self._pos, self._active,
+            self._temps, self._keys,
         )
         self._last = jnp.where(self._active, next_tok, self._last)
         self._pos = jnp.where(self._active, self._pos + 1, self._pos)

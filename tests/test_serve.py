@@ -129,3 +129,44 @@ class TestValidation:
     def test_rejects_empty_prompt(self):
         with pytest.raises(ValueError, match="empty"):
             _engine().submit([], max_tokens=1)
+
+
+class TestSampling:
+    def test_same_seed_reproduces(self):
+        outs = []
+        for _ in range(2):
+            eng = _engine()
+            eng.submit(_prompt(20, 6), max_tokens=10, temperature=0.8, seed=42)
+            eng.run_until_drained()
+            outs.append(eng.completions()[0].tokens)
+        assert outs[0] == outs[1]
+
+    def test_different_seeds_diverge(self):
+        def run(seed):
+            eng = _engine()
+            eng.submit(_prompt(21, 6), max_tokens=16, temperature=1.5, seed=seed)
+            eng.run_until_drained()
+            return eng.completions()[0].generated
+
+        assert run(1) != run(2)  # 16 draws at temp 1.5: collision ~impossible
+
+    def test_sampled_neighbor_does_not_perturb_greedy_rows(self):
+        prompt = _prompt(22, 6)
+        eng = _engine()
+        r_greedy = eng.submit(prompt, max_tokens=10)  # temperature 0
+        eng.submit(_prompt(23, 6), max_tokens=10, temperature=1.0, seed=7)
+        eng.run_until_drained()
+        done = {c.request_id: c for c in eng.completions()}
+        assert done[r_greedy].tokens == _reference(prompt, 10)
+
+    def test_top_k_filter_stays_in_top_k(self):
+        # With top_k=1, sampling at any temperature IS greedy.
+        eng = _engine(top_k=1)
+        prompt = _prompt(24, 6)
+        eng.submit(prompt, max_tokens=10, temperature=2.0, seed=3)
+        eng.run_until_drained()
+        assert eng.completions()[0].tokens == _reference(prompt, 10)
+
+    def test_rejects_out_of_range_top_k(self):
+        with pytest.raises(ValueError, match="top_k"):
+            _engine(top_k=CFG.vocab_size + 1)
