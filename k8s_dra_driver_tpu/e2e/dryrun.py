@@ -168,6 +168,24 @@ def run_dryrun(n_devices: int) -> None:
     jax.block_until_ready(cm_grad(wi, wo))
     print(f"dryrun_multichip: mesh model={n_devices} (overlapped tp-mlp grad) ok")
 
+    # Distributed inference: the continuous-batching engine with its slot
+    # pool sharded over the mesh (each device owns n_slots/n slots' cache
+    # and step compute).
+    from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+    eng = ServeEngine(
+        burnin.init_params(jax.random.PRNGKey(0), cfg),
+        cfg, n_slots=n_devices, prompt_bucket=16,
+        mesh=ep_mesh, slot_axis="data",
+    )
+    for i in range(n_devices):
+        eng.submit([1 + i, 2, 3], max_tokens=4)
+    eng.run_until_drained()
+    served = eng.completions()
+    assert len(served) == n_devices, f"served {len(served)}/{n_devices}"
+    print(f"dryrun_multichip: mesh data={n_devices} (sharded serving, "
+          f"{sum(len(c.generated) for c in served)} tokens) ok")
+
 
 def _pick_devices(n_devices: int):
     """Prefer the forced-CPU virtual platform for dry runs; on hosts where

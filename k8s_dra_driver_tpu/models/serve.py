@@ -149,6 +149,14 @@ class ServeEngine:
     cache_dtype: object = jnp.float32
     eos_id: int | None = None
     top_k: int = 0
+    # Data-parallel serving: shard the SLOT axis over a mesh axis — each
+    # device owns n_slots/axis_size slots' cache and step compute.  Every
+    # per-slot op is row-independent, so sharding the row axis preserves
+    # numerics exactly (the engine's bit-equality contract extends to the
+    # sharded engine; tested).  Weights are replicated (TP-sharded serving
+    # composes at the params level, orthogonal to slot scheduling).
+    mesh: object | None = None
+    slot_axis: str = "data"
 
     _cache: KVCache = field(init=False)
     _last: jax.Array = field(init=False)
@@ -168,12 +176,58 @@ class ServeEngine:
             raise ValueError(
                 f"top_k ({self.top_k}) must be in [0, vocab_size={cfg.vocab_size}]"
             )
-        self._cache = init_cache(cfg, self.n_slots, cfg.max_seq, dtype=self.cache_dtype)
-        self._last = jnp.zeros((self.n_slots,), jnp.int32)
-        self._pos = jnp.zeros((self.n_slots,), jnp.int32)
-        self._active = jnp.zeros((self.n_slots,), bool)
-        self._temps = jnp.zeros((self.n_slots,), jnp.float32)
-        self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
+        if self.mesh is None:
+            self._cache = init_cache(
+                cfg, self.n_slots, cfg.max_seq, dtype=self.cache_dtype
+            )
+            self._last = jnp.zeros((self.n_slots,), jnp.int32)
+            self._pos = jnp.zeros((self.n_slots,), jnp.int32)
+            self._active = jnp.zeros((self.n_slots,), bool)
+            self._temps = jnp.zeros((self.n_slots,), jnp.float32)
+            self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
+        else:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            if self.slot_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"slot_axis {self.slot_axis!r} is not a mesh axis "
+                    f"(mesh has {list(self.mesh.shape)})"
+                )
+            axis = self.mesh.shape[self.slot_axis]
+            if self.n_slots % axis:
+                raise ValueError(
+                    f"n_slots ({self.n_slots}) must divide over "
+                    f"{self.slot_axis!r} axis size {axis}"
+                )
+
+            def sharding(spec):
+                return NamedSharding(self.mesh, spec)
+
+            # State is CREATED sharded (jit with out_shardings): the full
+            # unsharded cache never materializes on one device — at serving
+            # scale that intermediate is the peak-memory point.
+            slot_dim = P(self.slot_axis)
+            cache_s = sharding(P(None, self.slot_axis))
+            self._cache = jax.jit(
+                lambda: init_cache(cfg, self.n_slots, cfg.max_seq, dtype=self.cache_dtype),
+                out_shardings=KVCache(cache_s, cache_s),
+            )()
+            make = jax.jit(
+                lambda: (
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                    jnp.zeros((self.n_slots,), bool),
+                    jnp.zeros((self.n_slots,), jnp.float32),
+                    jnp.stack([jax.random.PRNGKey(0)] * self.n_slots),
+                ),
+                out_shardings=(
+                    sharding(slot_dim), sharding(slot_dim), sharding(slot_dim),
+                    sharding(slot_dim), sharding(P(self.slot_axis, None)),
+                ),
+            )
+            self._last, self._pos, self._active, self._temps, self._keys = make()
+            self.params = jax.device_put(self.params, sharding(P()))
         self._slots = [None] * self.n_slots
         self._step_fn = jax.jit(
             functools.partial(_step_all_slots, cfg=cfg, top_k=self.top_k)

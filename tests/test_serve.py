@@ -170,3 +170,47 @@ class TestSampling:
     def test_rejects_out_of_range_top_k(self):
         with pytest.raises(ValueError, match="top_k"):
             _engine(top_k=CFG.vocab_size + 1)
+
+
+class TestShardedServing:
+    """DP-sharded engine (slots over a mesh axis) must be bit-identical to
+    the unsharded engine — row-axis sharding cannot change per-row math."""
+
+    def _mesh(self, n=4):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from tests.conftest import cpu_devices
+
+        return Mesh(np.array(cpu_devices(n)), ("data",))
+
+    def test_matches_unsharded_engine_exactly(self):
+        mesh = self._mesh()
+        prompts = [_prompt(30 + i, 4 + i) for i in range(3)]
+        results = []
+        for m in (None, mesh):
+            eng = _engine(n_slots=4, mesh=m)
+            ids = [eng.submit(p, max_tokens=8) for p in prompts]
+            eng.run_until_drained()
+            done = {c.request_id: c.tokens for c in eng.completions()}
+            results.append([done[i] for i in ids])
+        assert results[0] == results[1]
+
+    def test_mid_flight_join_sharded(self):
+        eng = _engine(n_slots=4, mesh=self._mesh())
+        p0, p1 = _prompt(40, 6), _prompt(41, 5)
+        r0 = eng.submit(p0, max_tokens=10)
+        eng.step(); eng.step()
+        r1 = eng.submit(p1, max_tokens=6)
+        eng.run_until_drained()
+        done = {c.request_id: c for c in eng.completions()}
+        assert done[r0].tokens == _reference(p0, 10)
+        assert done[r1].tokens == _reference(p1, 6)
+
+    def test_slot_count_must_divide_axis(self):
+        with pytest.raises(ValueError, match="divide"):
+            _engine(n_slots=3, mesh=self._mesh())
+
+    def test_unknown_slot_axis_is_a_clear_error(self):
+        with pytest.raises(ValueError, match="slot_axis"):
+            _engine(n_slots=4, mesh=self._mesh(), slot_axis="model")
