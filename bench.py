@@ -171,6 +171,14 @@ def _data_plane_body() -> dict:
             out["matmul_int8_tops"] = round(matmul_int8_tops(size=4096, chain=128), 1)
         except Exception as exc:  # noqa: BLE001
             out["matmul_int8_tops"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # Greedy speculative decode, int8 self-draft: exact bf16 output,
+        # several tokens per target pass when the burn-in-trained weights
+        # are confident.  Reported next to "decode" (same batch/steps), so
+        # the artifact carries the speedup AND the acceptance that earned it.
+        try:
+            out["decode_speculative"] = _speculative_throughput(cfg, params)
+        except Exception as exc:  # noqa: BLE001
+            out["decode_speculative"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out
 
 
@@ -219,6 +227,70 @@ def _decode_throughput(cfg, params, batch=16, prompt_len=16, steps=496, chain=4)
         "steps": steps,
         "chain": chain,
         "prompt_len": prompt_len,
+    }
+
+
+def _speculative_throughput(
+    cfg, params, batch=16, prompt_len=16, steps=492, chain=4, gamma=4
+) -> dict:
+    """Greedy speculative tokens/second (int8 self-draft, bf16 cache),
+    measured with the same chained-jit + RTT-subtraction discipline as
+    `_decode_throughput`.  steps=492 (not 496): speculation needs ``gamma``
+    positions of verify-window slack under max_seq."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_tpu.models import burnin, speculative
+    from k8s_dra_driver_tpu.models.quant import quantize_blocks
+    from k8s_dra_driver_tpu.ops.collectives import dispatch_rtt_seconds
+
+    draft = quantize_blocks(params)
+    prompt = burnin.sample_tokens(
+        jax.random.PRNGKey(3), cfg, batch=batch, seq=prompt_len
+    )
+
+    @jax.jit
+    def fn(p, d, t):
+        out = t
+        drafted = accepted = rounds = jnp.zeros((), jnp.int32)
+        for _ in range(chain):
+            full, stats = speculative.speculative_decode(
+                p, d, out, steps, cfg,
+                gamma=gamma, cache_dtype=jnp.bfloat16, return_stats=True,
+            )
+            drafted += stats.drafted
+            accepted += stats.accepted
+            rounds += stats.rounds
+            out = jax.lax.dynamic_slice_in_dim(
+                full, full.shape[1] - prompt_len, prompt_len, axis=1
+            )
+        return full, drafted, accepted, rounds
+
+    int(fn(params, draft, prompt)[0][0, -1])  # compile + sync
+    start = time.perf_counter()
+    full, drafted, accepted, rounds = fn(params, draft, prompt)
+    int(full[0, -1])
+    total = time.perf_counter() - start
+    rtt = dispatch_rtt_seconds()
+    if total <= 1.5 * rtt:
+        raise RuntimeError("speculative timing dominated by dispatch RTT")
+    tok_s = batch * steps * chain / (total - rtt)
+    return {
+        "tokens_per_s": round(tok_s, 1),
+        "acceptance": round(float(accepted) / max(float(drafted), 1), 3),
+        # per-sequence positions advanced per verify round (cap = gamma)
+        "tokens_per_round": round(steps * chain / max(float(rounds), 1), 2),
+        "gamma": gamma,
+        "batch": batch,
+        "steps": steps,
+        "chain": chain,
+        # Crossover honesty: speculation beats plain decode only when the
+        # draft step is much cheaper than the target step.  The bench model
+        # is small enough that its decode step is dispatch/overhead-bound
+        # (see decode vs decode_int8: int8 halves the weight bytes for ~6%),
+        # so this block validates the mechanism (acceptance, tokens/round,
+        # greedy-exact output) rather than claiming a speedup at this scale.
+        "note": "wins when target decode is HBM-bound (large models)",
     }
 
 

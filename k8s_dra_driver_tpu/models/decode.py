@@ -73,52 +73,78 @@ def _masked_attention(q, k, v, mask):
     return out.astype(q.dtype)
 
 
-def _cached_attention(q, k_cache, v_cache, pos):
-    """q: [B, 1, H, hd]; caches: [B, S_max, H, hd]; attend over positions
-    <= pos (pos: [B] int32 — per ROW; the rest of the cache is masked, not
-    sliced — static shapes keep the step program reusable)."""
-    k_pos = jnp.arange(k_cache.shape[1])
-    return _masked_attention(
-        q, k_cache, v_cache, (k_pos[None, :] <= pos[:, None])[:, None, None, :]
-    )
+def decode_chunk(
+    params, cache: KVCache, tokens: jax.Array, pos0, *, cfg: ModelConfig,
+    active=None, k_window: int | None = None,
+):
+    """THE incremental forward: score ``S`` known tokens in one pass.
+
+    tokens: [B, S] int32 — the tokens at positions ``pos0 .. pos0+S-1``
+    (``pos0``: scalar int32 — whole batch at one depth — or [B] int32,
+    per-row depth).  Writes k/v for every chunk position into the cache,
+    then attends each query over cache positions ``<=`` its own absolute
+    position: within-chunk causality and the history mask fall out of one
+    comparison, and the rest of the cache is masked, not sliced — static
+    shapes keep the compiled program reusable.  ``active``: optional [B]
+    bool; inactive rows' cache writes become no-ops (their outputs are
+    garbage the caller ignores).  ``k_window``: optional STATIC upper
+    bound on attended key positions — when the caller knows every query
+    sits below it (prefill: queries 0..S-1 never see keys >= S), slicing
+    the cache view to ``[:k_window]`` avoids paying attention FLOPs over
+    the whole max_seq cache on the admission hot path.
+
+    Returns (logits [B, S, V] f32 — one distribution per chunk position —
+    and the updated cache).  This is the ONLY per-layer cache loop:
+    `decode_step` is the S=1 view, `prefill` the pos0=0 view, and
+    speculative verification (models/speculative.py) the general case — so
+    the numerics across all decode paths cannot drift.
+    """
+    b, s = tokens.shape
+    pos0 = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
+    positions = pos0[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [B, S]
+    rows = jnp.arange(b)
+    x = params["embed"][tokens] + params["pos_embed"][positions]
+
+    k_limit = cache.k.shape[2] if k_window is None else k_window
+    k_pos = jnp.arange(k_limit)
+    # [B, 1(head), S(query), K]: key position <= query's absolute position
+    mask = (k_pos[None, None, :] <= positions[:, :, None])[:, None]
+
+    new_k, new_v = cache.k, cache.v
+    for li, p in enumerate(params["blocks"]):
+        q, k, v = qkv_proj(x, p, cfg)  # [B, S, H, hd]
+        k_new = k.astype(new_k.dtype)
+        v_new = v.astype(new_v.dtype)
+        if active is not None:
+            gate = active[:, None, None, None]
+            k_new = jnp.where(gate, k_new, new_k[li][rows[:, None], positions])
+            v_new = jnp.where(gate, v_new, new_v[li][rows[:, None], positions])
+        new_k = new_k.at[li, rows[:, None], positions].set(k_new)
+        new_v = new_v.at[li, rows[:, None], positions].set(v_new)
+        attn = _masked_attention(
+            q, new_k[li][:, :k_limit], new_v[li][:, :k_limit], mask
+        ).reshape(b, s, cfg.d_model)
+        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
+        x = mlp_residual(x, p)
+
+    return tied_logits(x, params), KVCache(k=new_k, v=new_v)
 
 
 def decode_step(
     params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig, active=None
 ):
-    """One incremental step.
+    """One incremental step — the S=1 view of :func:`decode_chunk`.
 
     token: [B] int32 — the token at ``pos``;  pos: scalar int32 (whole
     batch at one depth — the sequential-decode case) or [B] int32 (per-row
-    depth — the continuous-batching case, models/serve.py).  ``active``:
-    optional [B] bool; inactive rows' cache writes become no-ops (their
-    outputs are garbage the caller ignores).  One step implementation for
-    BOTH decode paths so the numerics cannot drift.
+    depth — the continuous-batching case, models/serve.py).
 
     Returns (logits [B, V] f32 for position ``pos``, updated cache).
     """
-    b = token.shape[0]
-    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    rows = jnp.arange(b)
-    x = params["embed"][token][:, None, :] + params["pos_embed"][pos][:, None, :]
-
-    new_k, new_v = cache.k, cache.v
-    for li, p in enumerate(params["blocks"]):
-        q, k, v = qkv_proj(x, p, cfg)  # [B, 1, H, hd] each
-        k_new = k[:, 0].astype(new_k.dtype)
-        v_new = v[:, 0].astype(new_v.dtype)
-        if active is not None:
-            gate = active[:, None, None]
-            k_new = jnp.where(gate, k_new, new_k[li, rows, pos])
-            v_new = jnp.where(gate, v_new, new_v[li, rows, pos])
-        new_k = new_k.at[li, rows, pos].set(k_new)
-        new_v = new_v.at[li, rows, pos].set(v_new)
-        attn = _cached_attention(q, new_k[li], new_v[li], pos).reshape(b, 1, cfg.d_model)
-        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
-        x = mlp_residual(x, p)
-
-    logits = tied_logits(x, params)
-    return logits[:, 0], KVCache(k=new_k, v=new_v)
+    logits, cache = decode_chunk(
+        params, cache, token[:, None], pos, cfg=cfg, active=active
+    )
+    return logits[:, 0], cache
 
 
 def greedy_decode(
@@ -216,25 +242,17 @@ def sample_decode(
     return tokens
 
 
-def _prefill_attention(q, k, v):
-    """Causal attention over the prompt — the same ``_masked_attention``
-    core as the sequential step, so the two prefill modes see identical
-    numerics by construction."""
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
-    return _masked_attention(q, k, v, mask)
-
-
 def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,
             cache_dtype=jnp.float32):
     """Fill the KV cache for the whole prompt in ONE forward pass.
 
     Sequential per-token prefill wastes the MXU: the prompt is fully known,
-    so each layer can project q/k/v for every position at once and run
-    causal attention over the prompt (the training forward's shape), writing
-    k/v into the cache as it goes — O(1) steps instead of O(prompt).
-    Attention runs over the CACHE-dtype k/v (like the incremental step), so
-    the two prefill modes agree up to accumulation order.
+    so one :func:`decode_chunk` at ``pos0=0`` projects q/k/v for every
+    position at once and runs causal attention over the prompt (the
+    training forward's shape) — O(1) steps instead of O(prompt), and the
+    same per-layer loop as the incremental step, so the two prefill modes
+    agree by construction (attention runs over the CACHE-dtype k/v either
+    way).
 
     Returns (cache, logits[B, V] for the LAST prompt position).
     """
@@ -242,22 +260,7 @@ def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,
     if p_len > max_seq:
         raise ValueError(f"prompt {p_len} exceeds max_seq {max_seq}")
     cache = init_cache(cfg, b, max_seq, dtype=cache_dtype)
-    x = params["embed"][prompt] + params["pos_embed"][:p_len]
-
-    new_k, new_v = cache.k, cache.v
-    for li, p in enumerate(params["blocks"]):
-        q, k, v = qkv_proj(x, p, cfg)  # [B, P, H, hd]
-        k_c = k.astype(new_k.dtype)
-        v_c = v.astype(new_v.dtype)
-        new_k = new_k.at[li].set(
-            jax.lax.dynamic_update_slice_in_dim(new_k[li], k_c, 0, axis=1)
-        )
-        new_v = new_v.at[li].set(
-            jax.lax.dynamic_update_slice_in_dim(new_v[li], v_c, 0, axis=1)
-        )
-        attn = _prefill_attention(q, k_c, v_c).reshape(b, p_len, cfg.d_model)
-        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
-        x = mlp_residual(x, p)
-
-    logits = tied_logits(x, params)[:, -1]
-    return KVCache(k=new_k, v=new_v), logits
+    # k_window=p_len: prompt queries never see keys beyond the prompt, so
+    # attention stays [B,H,P,P] (not [B,H,P,max_seq]) on the admission path.
+    logits, cache = decode_chunk(params, cache, prompt, 0, cfg=cfg, k_window=p_len)
+    return cache, logits[:, -1]

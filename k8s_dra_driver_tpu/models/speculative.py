@@ -1,0 +1,205 @@
+"""Greedy speculative decoding — serve the exact target output faster.
+
+Speculative decoding splits a decode step into a cheap *draft* proposal and
+a batched *verify* pass on the target model: the draft proposes ``gamma``
+tokens sequentially, then the target scores the whole window in ONE chunked
+forward (seq dim gamma+1 instead of 1 — near-free on the MXU, since the
+autoregressive step is HBM-bound and the chunk re-reads the same weights).
+Accepted draft tokens advance the stream several positions per target pass;
+under greedy (temperature 0) verification the output is BIT-IDENTICAL to
+plain greedy decode on the target — speculation changes latency, never
+content.
+
+The TPU-native draft configuration is *int8 self-speculation*: the draft is
+the target's own weight-only int8 quantization (`models/quant.py`). No
+second model to train or ship, the draft shares the target's distribution
+(high acceptance once the model is confident), and the int8 weights halve
+the HBM bytes per draft step — the bandwidth that bounds decode.  Any
+smaller model with the same vocab (e.g. fewer layers) also works as the
+draft.
+
+TPU-idiomatic structure: static shapes everywhere (token buffer sized
+``prompt + steps + gamma``, caches at the same cap, scatter writes with
+``mode="drop"`` for the tail), the accept/advance loop is one
+``lax.while_loop`` whose body does a fixed-shape draft scan + one verify
+chunk, and per-row progress is data (a ``pos`` vector), not control flow.
+
+Reference parity note: the reference driver has no ML data plane (it binds
+devices for CUDA pods — SURVEY.md §2.11); this module is consumer-side
+capability of the TPU framework, exercised on claimed slices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from k8s_dra_driver_tpu.models.burnin import ModelConfig
+from k8s_dra_driver_tpu.models.decode import (
+    KVCache,
+    decode_chunk,
+    decode_step,
+    prefill,
+)
+
+
+class SpecStats(NamedTuple):
+    """Speculation telemetry.  ``drafted``/``accepted``/``emitted`` are
+    summed over the whole batch; ``rounds`` is loop iterations (shared by
+    all rows), so ``tokens_per_round`` is a batch-wide rate."""
+
+    rounds: jax.Array          # while-loop iterations executed
+    drafted: jax.Array         # draft tokens proposed, summed over rows
+    accepted: jax.Array        # draft tokens accepted, summed over rows
+    emitted: jax.Array         # tokens emitted (accepted + corrections), summed
+
+    @property
+    def acceptance(self):
+        return self.accepted / jnp.maximum(self.drafted, 1)
+
+    @property
+    def tokens_per_round(self):
+        return self.emitted / jnp.maximum(self.rounds, 1)
+
+
+def speculative_decode(
+    params,
+    draft_params,
+    prompt: jax.Array,
+    steps: int,
+    cfg: ModelConfig,
+    *,
+    gamma: int = 4,
+    cache_dtype=jnp.float32,
+    return_stats: bool = False,
+):
+    """Greedy continuation via draft-then-verify: prompt [B, P] -> [B, P+steps].
+
+    Guarantee: identical to ``decode.greedy_decode(params, prompt, steps,
+    batch_prefill=True)`` token for token — acceptance only moves the
+    speed.  ``draft_params`` may be any weight set with the same vocab and
+    layer layout (int8 `quant.quantize_blocks(params)` is the self-draft;
+    a shallower model works too — the draft's cache is sized by its own
+    block count).
+
+    Per while-loop round, for every unfinished row: the draft proposes
+    ``gamma`` tokens with sequential int8-cheap steps; the target scores
+    the window ``[last_committed, g_1..g_gamma]`` in one `decode_chunk`;
+    the row advances by (leading agreements) + 1, writing the target's own
+    argmaxes (accepted drafts ARE the target argmaxes, and position n+1
+    gets the correction/bonus token for free).  Rejected-suffix cache
+    entries go stale in place — every consumer masks keys by position and
+    both models re-feed from the committed frontier, so stale slots are
+    always overwritten before they are first attended.
+    """
+    b, p_len = prompt.shape
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    total = p_len + steps
+    cap = total + gamma  # verify-window slack past the last emitted position
+    if cap > cfg.max_seq:
+        raise ValueError(
+            f"prompt {p_len} + steps {steps} + gamma {gamma} = {cap} exceeds "
+            f"max_seq {cfg.max_seq} (speculation needs gamma slack)"
+        )
+
+    n_draft_layers = len(draft_params["blocks"])
+    rows = jnp.arange(b)
+    step_idx = jnp.arange(gamma + 1, dtype=jnp.int32)
+
+    # Prefill both models on the prompt; commit the target's first token.
+    t_cache, t_logits = prefill(params, prompt, cfg, max_seq=cap, cache_dtype=cache_dtype)
+    d_cache, _ = prefill(draft_params, prompt, cfg, max_seq=cap, cache_dtype=cache_dtype)
+    d_cache = KVCache(k=d_cache.k[:n_draft_layers], v=d_cache.v[:n_draft_layers])
+    first = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)
+    tokens = jnp.zeros((b, cap), prompt.dtype)
+    tokens = tokens.at[:, :p_len].set(prompt).at[:, p_len].set(first)
+    # Invariant at loop top: tokens[:, :pos[r]+1] committed for row r; both
+    # caches filled through pos[r]-1; tokens[pos[r]] not yet fed to either.
+    pos0 = jnp.full((b,), p_len, jnp.int32)
+
+    draft_step = functools.partial(decode_step, cfg=cfg)
+
+    def draft_round(d_cache, tokens, pos, active):
+        """gamma sequential draft steps from each row's frontier."""
+
+        def body(carry, i):
+            cache, toks = carry
+            p = pos + i  # [B] absolute position of the token being fed
+            tok_in = toks[rows, jnp.minimum(p, cap - 1)]
+            logits, cache = draft_step(
+                draft_params, cache, tok_in, jnp.minimum(p, cap - 1), active=active
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+            toks = toks.at[rows, jnp.minimum(p + 1, cap - 1)].set(
+                jnp.where(active, nxt, toks[rows, jnp.minimum(p + 1, cap - 1)])
+            )
+            return (cache, toks), nxt
+
+        (cache, toks), proposed = jax.lax.scan(
+            body, (d_cache, tokens), jnp.arange(gamma, dtype=jnp.int32)
+        )
+        return cache, toks, proposed.T  # proposed: [B, gamma]
+
+    def cond(carry):
+        _, _, _, pos, _ = carry
+        return jnp.any(pos < total)
+
+    def body(carry):
+        t_cache, d_cache, tokens, pos, stats = carry
+        active = pos < total
+        d_cache, tokens, proposed = draft_round(d_cache, tokens, pos, active)
+
+        # Target verify: one chunk over [committed frontier, g_1..g_gamma].
+        window_pos = jnp.minimum(pos[:, None] + step_idx[None, :], cap - 1)
+        window = tokens[rows[:, None], window_pos]
+        logits, t_cache = decode_chunk(
+            params,
+            t_cache,
+            window,
+            jnp.minimum(pos, cap - 1 - gamma),
+            cfg=cfg,
+            active=active,
+        )
+        target = jnp.argmax(logits, axis=-1).astype(tokens.dtype)  # [B, gamma+1]
+
+        matches = (proposed == target[:, :gamma]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # leading agreements
+        # Advance caps at gamma (not gamma+1): the draft cache is filled only
+        # through pos+gamma-1 (it fed positions pos..pos+gamma-1), so
+        # committing the bonus token on full acceptance would leave the next
+        # draft step attending a never-written key slot.  On partial
+        # acceptance the +1 is the correction token, whose key the next
+        # round's sequential re-feed rewrites before any query sees it.
+        advance = jnp.where(active, jnp.minimum(n_acc + 1, gamma), 0)
+
+        # Commit: positions pos+1 .. pos+gamma+1 get the target argmaxes
+        # (prefix = accepted drafts, then the correction token; the rest is
+        # scratch that later rounds overwrite before reading).
+        write_pos = jnp.where(
+            active[:, None], pos[:, None] + 1 + step_idx[None, :], cap
+        )
+        tokens = tokens.at[rows[:, None], write_pos].set(
+            target, mode="drop"
+        )
+        new_pos = jnp.minimum(pos + advance, total)
+        stats = SpecStats(
+            rounds=stats.rounds + 1,
+            drafted=stats.drafted + jnp.sum(jnp.where(active, gamma, 0)),
+            accepted=stats.accepted + jnp.sum(jnp.where(active, n_acc, 0)),
+            emitted=stats.emitted + jnp.sum(new_pos - pos),
+        )
+        return t_cache, d_cache, tokens, new_pos, stats
+
+    zero = jnp.zeros((), jnp.int32)
+    stats0 = SpecStats(rounds=zero, drafted=zero, accepted=zero, emitted=zero)
+    _, _, tokens, _, stats = jax.lax.while_loop(
+        cond, body, (t_cache, d_cache, tokens, pos0, stats0)
+    )
+    out = tokens[:, :total]
+    return (out, stats) if return_stats else out

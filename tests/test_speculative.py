@@ -1,0 +1,188 @@
+"""Speculative decoding: greedy-exact output, chunk/step parity, stats.
+
+The load-bearing contract: `speculative_decode` returns BIT-IDENTICAL
+tokens to plain greedy decode on the target, for any draft — acceptance
+rate moves latency, never content (models/speculative.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, decode, speculative
+from k8s_dra_driver_tpu.models.quant import quantize_blocks
+
+CFG = burnin.ModelConfig(
+    vocab_size=96, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, CFG.vocab_size)
+
+
+class TestDecodeChunk:
+    def test_matches_sequential_steps(self, params, prompt):
+        """Scoring S tokens in one chunk == S single-token decode_steps."""
+        b, p_len = prompt.shape
+        cache_c = decode.init_cache(CFG, b, 16)
+        cache_s = decode.init_cache(CFG, b, 16)
+        logits_c, cache_c = decode.decode_chunk(
+            params, cache_c, prompt, 0, cfg=CFG
+        )
+        step_logits = []
+        for i in range(p_len):
+            lg, cache_s = decode.decode_step(
+                params, cache_s, prompt[:, i], jnp.int32(i), cfg=CFG
+            )
+            step_logits.append(lg)
+        np.testing.assert_allclose(
+            np.asarray(logits_c),
+            np.stack([np.asarray(x) for x in step_logits], axis=1),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache_c.k), np.asarray(cache_s.k), rtol=1e-5, atol=1e-6
+        )
+
+    def test_per_row_positions(self, params, prompt):
+        """Rows at different depths score/cache at their own offsets."""
+        b = prompt.shape[0]
+        pos0 = jnp.array([0, 3], jnp.int32)
+        cache = decode.init_cache(CFG, b, 16)
+        _, cache = decode.decode_chunk(params, cache, prompt, pos0, cfg=CFG)
+        k = np.asarray(cache.k)
+        # row 0 wrote positions 0..4; row 1 wrote 3..7
+        assert np.any(k[0, 0, 0] != 0) and np.all(k[0, 0, 7] == 0)
+        assert np.all(k[0, 1, 0] == 0) and np.any(k[0, 1, 7] != 0)
+
+    def test_inactive_rows_do_not_write(self, params, prompt):
+        b = prompt.shape[0]
+        cache = decode.init_cache(CFG, b, 16)
+        active = jnp.array([True, False])
+        _, cache = decode.decode_chunk(
+            params, cache, prompt, 0, cfg=CFG, active=active
+        )
+        k = np.asarray(cache.k)
+        assert np.any(k[:, 0] != 0)
+        assert np.all(k[:, 1] == 0)
+
+
+class TestSpeculativeDecode:
+    def _greedy(self, params, prompt, steps):
+        return np.asarray(
+            decode.greedy_decode(
+                params, prompt, steps, cfg=CFG, batch_prefill=True
+            )
+        )
+
+    def test_self_draft_is_greedy_exact(self, params, prompt):
+        """Draft == target: full acceptance, still byte-identical output."""
+        out = speculative.speculative_decode(
+            params, params, prompt, 20, CFG, gamma=4
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), self._greedy(params, prompt, 20)
+        )
+
+    def test_int8_self_draft_is_greedy_exact(self, params, prompt):
+        """The serving configuration: int8 draft, bf16-exact target output."""
+        out, stats = speculative.speculative_decode(
+            params,
+            quantize_blocks(params),
+            prompt,
+            20,
+            CFG,
+            gamma=4,
+            return_stats=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), self._greedy(params, prompt, 20)
+        )
+        assert int(stats.emitted) == 20 * prompt.shape[0]
+
+    def test_shallow_draft_is_greedy_exact(self, params, prompt):
+        """A 1-layer draft of a 2-layer target: low acceptance, same output."""
+        draft = dict(params)
+        draft["blocks"] = params["blocks"][:1]
+        out = speculative.speculative_decode(params, draft, prompt, 16, CFG, gamma=3)
+        np.testing.assert_array_equal(
+            np.asarray(out), self._greedy(params, prompt, 16)
+        )
+
+    def test_adversarial_draft_is_greedy_exact(self, params, prompt):
+        """A draft with permuted weights (near-zero acceptance) cannot
+        corrupt the output — verification owns content."""
+        rng = jax.random.PRNGKey(7)
+        draft = jax.tree.map(
+            lambda x: jax.random.permutation(rng, x.ravel()).reshape(x.shape),
+            params,
+        )
+        out = speculative.speculative_decode(params, draft, prompt, 12, CFG, gamma=4)
+        np.testing.assert_array_equal(
+            np.asarray(out), self._greedy(params, prompt, 12)
+        )
+
+    @pytest.mark.parametrize("gamma", [1, 2, 5])
+    def test_gamma_sweep(self, params, prompt, gamma):
+        out = speculative.speculative_decode(
+            params, quantize_blocks(params), prompt, 10, CFG, gamma=gamma
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), self._greedy(params, prompt, 10)
+        )
+
+    def test_full_acceptance_stats(self, params, prompt):
+        """Self-draft: every proposal accepted; rounds ~= steps/gamma."""
+        steps, gamma = 20, 4
+        _, stats = speculative.speculative_decode(
+            params, params, prompt, steps, CFG, gamma=gamma, return_stats=True
+        )
+        assert float(stats.acceptance) == pytest.approx(1.0)
+        # advance caps at gamma per round -> ceil(steps/gamma) rounds
+        assert int(stats.rounds) == -(-steps // gamma)
+
+    def test_bf16_cache(self, params, prompt):
+        """Reduced-precision cache path compiles and emits every token
+        (greedy equality is only guaranteed within one cache dtype)."""
+        out, stats = speculative.speculative_decode(
+            params,
+            quantize_blocks(params),
+            prompt,
+            8,
+            CFG,
+            gamma=3,
+            cache_dtype=jnp.bfloat16,
+            return_stats=True,
+        )
+        assert out.shape == (prompt.shape[0], prompt.shape[1] + 8)
+        assert int(stats.emitted) == 8 * prompt.shape[0]
+
+    def test_jit_compatible(self, params, prompt):
+        fn = jax.jit(
+            lambda p, d, t: speculative.speculative_decode(p, d, t, 8, CFG, gamma=3)
+        )
+        out = fn(params, quantize_blocks(params), prompt)
+        np.testing.assert_array_equal(
+            np.asarray(out), self._greedy(params, prompt, 8)
+        )
+
+    def test_rejects_overflow(self, params, prompt):
+        with pytest.raises(ValueError, match="exceeds"):
+            speculative.speculative_decode(
+                params, params, prompt, CFG.max_seq, CFG, gamma=4
+            )
+
+    def test_rejects_bad_args(self, params, prompt):
+        with pytest.raises(ValueError, match="steps"):
+            speculative.speculative_decode(params, params, prompt, 0, CFG)
+        with pytest.raises(ValueError, match="gamma"):
+            speculative.speculative_decode(params, params, prompt, 4, CFG, gamma=0)
