@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=os.environ.get("LEADER_ELECT", "") == "true",
         help="coordinate multiple controller replicas via a coordination.k8s.io Lease",
     )
+    p.add_argument(
+        "--extender-port", type=int,
+        default=int(os.environ.get("EXTENDER_PORT", "-1")),
+        help="kube-scheduler extender webhook port (/filter,/prioritize,/bind); "
+        "-1 disables, 0 = ephemeral",
+    )
     return p
 
 
@@ -101,6 +107,16 @@ def main(argv: list[str] | None = None) -> int:
             manager.start()
             log.info("slice manager watching node slice-domain labels")
 
+    extender = None
+    if args.extender_port >= 0:
+        from k8s_dra_driver_tpu.scheduler.extender import SchedulerExtender
+
+        extender = SchedulerExtender(
+            server, port=args.extender_port, bind_host="0.0.0.0"
+        )
+        extender.start()
+        log.info("scheduler extender on http://0.0.0.0:%d/filter", extender.port)
+
     diagnostics = None
     if args.http_port >= 0:
         from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
@@ -121,6 +137,8 @@ def main(argv: list[str] | None = None) -> int:
     while not stop.wait(timeout=1.0):
         if manager is not None:
             manager.retry_pending()
+    if extender is not None:
+        extender.stop()
     if diagnostics is not None:
         diagnostics.stop()
     if elector_thread is not None:

@@ -126,6 +126,44 @@ def _qualified_attr(c: _Candidate, qualified_name: str):
     return None if attr is None else attr.value
 
 
+@dataclass(frozen=True)
+class Plan:
+    """A committed-to-nothing allocation: what `Allocator.plan` chose.
+
+    chosen: ``[(request_name, candidate)]`` for consuming requests;
+    admin_results: observer (adminAccess) results placed outside the search;
+    free: the node's unallocated candidates at plan time (for scoring);
+    classes: DeviceClass index (reused by `allocate` for config gathering).
+    """
+
+    chosen: list
+    admin_results: list
+    free: list
+    classes: dict
+    used_markers: frozenset
+
+    def tightness(self) -> float:
+        """Bin-packing score in [0, 1]: fraction of the node's AVAILABLE
+        chip markers this plan consumes (available = markers of free
+        devices minus markers other allocations already hold — an
+        overlapping subslice device keeps its blocked chips out of the
+        denominator).  Higher = tighter fit — a MostAllocated-style signal
+        that steers small claims onto already-fragmented nodes so intact
+        geometry survives for whole-subslice claims (the same policy
+        `_search` applies WITHIN a node, lifted to cross-node choice for
+        the extender's prioritize)."""
+        available: set = set()
+        for c in self.free:
+            available.update(c.markers)  # (pool, marker) pairs
+        available -= self.used_markers
+        used: set = set()
+        for _, c in self.chosen:
+            used.update(c.markers)
+        if not available:
+            return 0.0
+        return len(used & available) / len(available)
+
+
 class Allocator:
     """Allocates pending ResourceClaims against published ResourceSlices."""
 
@@ -148,11 +186,61 @@ class Allocator:
         """
         if claim.status.allocation is not None:
             return claim  # already allocated (idempotent)
+        p = self.plan(claim, node_name, node_labels)
+        results = [
+            DeviceRequestAllocationResult(
+                request=req_name, driver=c.driver, pool=c.pool, device=c.device.name
+            )
+            for req_name, c in p.chosen
+        ] + p.admin_results
+        config = self._gather_config(claim, claim.spec.devices.requests, p.classes)
+        claim.status.allocation = AllocationResult(
+            devices=DeviceAllocationResult(results=results, config=config),
+            node_selector=NodeSelector(
+                node_selector_terms=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key="kubernetes.io/hostname", values=[node_name]
+                            )
+                        ]
+                    )
+                ]
+            )
+            if node_name
+            else None,
+        )
+        return self._server.update(claim)
+
+    def plan(
+        self,
+        claim: ResourceClaim,
+        node_name: str = "",
+        node_labels: Optional[dict[str, str]] = None,
+        exclude_devices: frozenset = frozenset(),
+        extra_markers: frozenset = frozenset(),
+    ) -> "Plan":
+        """Dry-run feasibility: the FULL allocation search for ``claim`` on
+        ``node_name`` — selectors, markers, constraints, backtracking —
+        with no write-back.  Raises AllocationError when unsatisfiable.
+
+        ``exclude_devices``/``extra_markers`` thread the chosen devices and
+        markers of EARLIER plans into this search, so a multi-claim pod is
+        planned jointly (claims planned in isolation would each grab the
+        same last chip and pass a node the pod can never bind to).
+
+        This is the scheduler-extender primitive (SURVEY.md §3.5: geometry
+        must be CEL/capacity-expressible *unless we also ship a scheduler
+        extender*): `filter` calls it per node, `prioritize` scores its
+        result, `allocate` commits it.
+        """
         node_labels = dict(node_labels or {})
         node_labels.setdefault("kubernetes.io/hostname", node_name)
 
         candidates = self._visible_devices(node_name, node_labels)
         in_use, used_markers = self._consumed()
+        in_use |= set(exclude_devices)
+        used_markers |= set(extra_markers)
 
         free = [c for c in candidates if c.key not in in_use]
 
@@ -226,31 +314,13 @@ class Allocator:
                 f"claim {claim.metadata.name!r}: cannot satisfy "
                 f"{[(name, count) for name, count, _ in per_request]} on node {node_name!r}"
             )
-
-        results = [
-            DeviceRequestAllocationResult(
-                request=req_name, driver=c.driver, pool=c.pool, device=c.device.name
-            )
-            for req_name, c in chosen
-        ] + admin_results
-        config = self._gather_config(claim, requests, classes)
-        claim.status.allocation = AllocationResult(
-            devices=DeviceAllocationResult(results=results, config=config),
-            node_selector=NodeSelector(
-                node_selector_terms=[
-                    NodeSelectorTerm(
-                        match_expressions=[
-                            NodeSelectorRequirement(
-                                key="kubernetes.io/hostname", values=[node_name]
-                            )
-                        ]
-                    )
-                ]
-            )
-            if node_name
-            else None,
+        return Plan(
+            chosen=chosen,
+            admin_results=admin_results,
+            free=free,
+            classes=classes,
+            used_markers=frozenset(used_markers),
         )
-        return self._server.update(claim)
 
     def deallocate(self, claim: ResourceClaim) -> ResourceClaim:
         if claim.status.reserved_for:

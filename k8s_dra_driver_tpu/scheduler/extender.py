@@ -1,0 +1,310 @@
+"""kube-scheduler extender: topology-aware DRA filtering over HTTP.
+
+SURVEY.md §3.5 names the boundary this service exists for: the upstream
+scheduler allocates per-claim via CEL + capacity markers, so any TPU
+geometry policy must be expressible as published device attributes —
+*"unless we also ship a scheduler extender."*  This is that extender.  It
+wires the repo's structured allocator (`scheduler/allocator.py` — the full
+backtracking search with subslice overlap markers and matchAttribute
+constraints) behind the upstream scheduler-extender webhook protocol, so a
+cluster whose geometry outgrows CEL (multi-claim bin packing, cross-node
+tightness policy) can delegate:
+
+* ``POST /filter`` — for each candidate node, dry-run every one of the
+  pod's ResourceClaims (`Allocator.plan`, no write); nodes where any claim
+  is unsatisfiable land in ``failedNodes`` with the allocator's reason.
+* ``POST /prioritize`` — score feasible nodes 0..10 by plan *tightness*
+  (fraction of the node's free chip markers consumed — MostAllocated-style
+  packing, so small claims densify broken regions and intact blocks
+  survive for whole-subslice claims).
+* ``POST /bind`` — commit: allocate all claims, reserve them for the pod,
+  then bind the pod to the node; every step is compensated on failure
+  (deallocate/unreserve in reverse) so a lost race leaves no partial state.
+
+Wire format: the upstream ``k8s.io/kube-scheduler/extender/v1`` JSON
+shapes re-authored field-for-field (ExtenderArgs ``pod``/``nodes``/
+``nodenames``; ExtenderFilterResult ``nodenames``/``failedNodes``/
+``error``; HostPriority ``host``/``score``; ExtenderBindingArgs
+``podName``/``podNamespace``/``podUID``/``node``) — the compatibility
+surface a real kube-scheduler policy config dials
+(``urlPrefix`` + ``filterVerb``/``prioritizeVerb``/``bindVerb``).
+
+The backing API client needs only get/list/update — both the in-memory
+fake (`kube/fakeserver.py`, tests/demo) and the real REST client
+(`kube/restclient.py`) satisfy it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k8s_dra_driver_tpu.e2e.harness import claim_name_for_ref
+from k8s_dra_driver_tpu.kube.objects import Node, Pod, ResourceClaim
+from k8s_dra_driver_tpu.scheduler.allocator import AllocationError, Allocator
+
+MAX_PRIORITY = 10  # upstream extender/v1 MaxExtenderPriority
+
+
+class SchedulerExtender:
+    """HTTP scheduler-extender service over an `Allocator`."""
+
+    def __init__(self, server, allocator: Allocator | None = None,
+                 port: int = 0, bind_host: str = "127.0.0.1"):
+        self._server = server
+        self._allocator = allocator or Allocator(server)
+        self._lock = threading.Lock()  # one verb at a time: plan vs bind races
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    args = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError as exc:
+                    self._reply(400, {"error": f"bad JSON: {exc}"})
+                    return
+                try:
+                    if self.path == "/filter":
+                        body = outer.filter(args)
+                    elif self.path == "/prioritize":
+                        body = outer.prioritize(args)
+                    elif self.path == "/bind":
+                        body = outer.bind(args)
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as exc:  # noqa: BLE001 - webhook must answer
+                    # /prioritize's wire type is a JSON array; an error
+                    # object would fail the scheduler-side unmarshal.
+                    body = (
+                        []
+                        if self.path == "/prioritize"
+                        else {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+                self._reply(200, body)
+
+            def _reply(self, code: int, body) -> None:
+                payload = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- verbs (also callable directly, e.g. from tests) -------------------
+
+    def filter(self, args: dict) -> dict:
+        """ExtenderArgs -> ExtenderFilterResult.  The reply mirrors the
+        request's shape: a caller that sent full ``nodes`` (a scheduler
+        without nodeCacheCapable) reads ``result.Nodes``, one that sent
+        ``nodenames`` reads ``result.NodeNames`` — upstream HTTPExtender
+        consults exactly one of the two."""
+        pod = args.get("pod") or {}
+        nodes = self._candidate_nodes(args)
+        with self._lock:
+            claims = self._claims_from_pod_dict(pod)
+            passed, failed = [], {}
+            for name, labels in nodes:
+                reason = self._node_feasible(claims, name, labels)
+                if reason is None:
+                    passed.append(name)
+                else:
+                    failed[name] = reason
+        out = {"nodenames": passed, "failedNodes": failed, "error": ""}
+        sent_nodes = args.get("nodes")
+        if sent_nodes and sent_nodes.get("items"):
+            keep = set(passed)
+            out["nodes"] = {
+                "items": [
+                    n for n in sent_nodes["items"]
+                    if (n.get("metadata") or {}).get("name") in keep
+                ]
+            }
+        return out
+
+    def prioritize(self, args: dict) -> list[dict]:
+        """ExtenderArgs -> HostPriorityList (a JSON *array* — the wire
+        contract holds even on errors: any failure scores the node 0,
+        because upstream HTTPExtender.Prioritize unmarshals the body into
+        a HostPriorityList and would choke on an error object)."""
+        pod = args.get("pod") or {}
+        nodes = self._candidate_nodes(args)
+        out = []
+        with self._lock:
+            try:
+                claims = self._claims_from_pod_dict(pod)
+            except Exception:  # noqa: BLE001 - e.g. claim not created yet
+                return [{"host": name, "score": 0} for name, _ in nodes]
+            for name, labels in nodes:
+                score = 0.0
+                try:
+                    plans = self._joint_plans(claims, name, labels)
+                    if plans:
+                        score = max(p.tightness() for p in plans)
+                except AllocationError:
+                    score = 0.0
+                out.append({"host": name, "score": round(MAX_PRIORITY * score)})
+        return out
+
+    def bind(self, args: dict) -> dict:
+        """ExtenderBindingArgs -> ExtenderBindingResult.  Allocates +
+        reserves every pod claim, then binds the pod — compensating in
+        reverse on any failure (the Prepare-path rollback discipline,
+        device_state.py, applied at the scheduling boundary)."""
+        name = args.get("podName", "")
+        namespace = args.get("podNamespace", "") or "default"
+        uid = args.get("podUID", "")
+        node = args.get("node", "")
+        with self._lock:
+            try:
+                pod = self._server.get(Pod.KIND, name, namespace)
+            except Exception as exc:  # noqa: BLE001
+                return {"error": f"pod {namespace}/{name}: {exc}"}
+            labels = self._node_labels(node)
+            done: list = []  # (claim, was_unallocated) for compensation
+            try:
+                claims = self._pod_claims(name, namespace, pod.spec or {})
+                # A shared claim allocated since filter ran pins the pod:
+                # binding here would strand it away from its devices
+                # (allocate's idempotent early-return can't catch this).
+                pinned = self._allocation_pins_elsewhere(claims, node, labels)
+                if pinned is not None:
+                    return {"error": pinned}
+                for claim in claims:
+                    was_unallocated = claim.status.allocation is None
+                    claim = self._allocator.allocate(
+                        claim, node_name=node, node_labels=labels
+                    )
+                    claim = self._allocator.reserve(claim, pod_name=name, pod_uid=uid)
+                    done.append((claim, was_unallocated))
+                pod.metadata.labels["_scheduled_node"] = node
+                if isinstance(pod.spec, dict):
+                    pod.spec["nodeName"] = node
+                self._server.update(pod)
+            except Exception as exc:  # noqa: BLE001
+                for claim, was_unallocated in reversed(done):
+                    try:
+                        current = self._server.get(
+                            ResourceClaim.KIND,
+                            claim.metadata.name,
+                            claim.metadata.namespace,
+                        )
+                        current = self._allocator.unreserve(current, uid)
+                        if was_unallocated and not current.status.reserved_for:
+                            self._allocator.deallocate(current)
+                    except Exception:  # noqa: BLE001 - best-effort unwind
+                        pass
+                return {"error": f"{type(exc).__name__}: {exc}"}
+        return {"error": ""}
+
+    # -- helpers -----------------------------------------------------------
+
+    def _candidate_nodes(self, args: dict) -> list[tuple[str, dict]]:
+        """(name, labels) per candidate from ExtenderArgs: full ``nodes``
+        (NodeList) carry their labels; bare ``nodenames`` resolve labels
+        from the API server."""
+        nodes = args.get("nodes")
+        if nodes and nodes.get("items"):
+            return [
+                (
+                    (n.get("metadata") or {}).get("name", ""),
+                    (n.get("metadata") or {}).get("labels") or {},
+                )
+                for n in nodes["items"]
+            ]
+        return [(n, self._node_labels(n)) for n in args.get("nodenames") or []]
+
+    def _node_labels(self, name: str) -> dict:
+        try:
+            return dict(self._server.get(Node.KIND, name).metadata.labels)
+        except Exception:  # noqa: BLE001 - unknown node: hostname label only
+            return {}
+
+    def _claims_from_pod_dict(self, pod: dict) -> list:
+        meta = pod.get("metadata") or {}
+        return self._pod_claims(
+            meta.get("name", ""),
+            meta.get("namespace") or "default",
+            pod.get("spec") or {},
+        )
+
+    def _pod_claims(self, name: str, namespace: str, spec: dict) -> list:
+        """Resolve the pod's resourceClaims entries to ResourceClaim objects
+        (template instances follow THE naming rule, harness.claim_name_for_ref)."""
+        return [
+            self._server.get(
+                ResourceClaim.KIND, claim_name_for_ref(name, ref), namespace
+            )
+            for ref in spec.get("resourceClaims", [])
+        ]
+
+    def _node_feasible(self, claims: list, node: str, labels: dict) -> str | None:
+        """None when every claim fits on ``node`` JOINTLY; else the first
+        reason.  Already-allocated claims pass iff their allocation's node
+        selector admits this node (gpu-test3 pattern: a shared claim pins
+        pod 2 to pod 1's node)."""
+        reason = self._allocation_pins_elsewhere(claims, node, labels)
+        if reason is not None:
+            return reason
+        try:
+            self._joint_plans(claims, node, labels)
+        except AllocationError as exc:
+            return str(exc)
+        return None
+
+    def _joint_plans(self, claims: list, node: str, labels: dict) -> list:
+        """Plan the pod's unallocated claims as ONE placement: each plan's
+        chosen devices and markers are excluded from the next search, so
+        two 1-chip claims cannot both pass a node with one free chip (they
+        would in isolation, and the pod would livelock at bind)."""
+        plans = []
+        taken_keys: set = set()
+        taken_markers: set = set()
+        for claim in claims:
+            if claim.status.allocation is not None:
+                continue
+            plan = self._allocator.plan(
+                claim,
+                node_name=node,
+                node_labels=labels,
+                exclude_devices=frozenset(taken_keys),
+                extra_markers=frozenset(taken_markers),
+            )
+            for _, c in plan.chosen:
+                taken_keys.add(c.key)
+                taken_markers.update(c.markers)
+            plans.append(plan)
+        return plans
+
+    @staticmethod
+    def _allocation_pins_elsewhere(claims: list, node: str, labels: dict) -> str | None:
+        """Reason string when any already-allocated claim's node selector
+        rejects ``node`` — shared by filter (exclude the node) and bind
+        (refuse: the pod would land away from its devices)."""
+        for claim in claims:
+            if claim.status.allocation is None:
+                continue
+            sel = claim.status.allocation.node_selector
+            if sel is not None and not sel.matches(
+                {"kubernetes.io/hostname": node, **labels}
+            ):
+                return f"claim {claim.metadata.name!r} already allocated elsewhere"
+        return None

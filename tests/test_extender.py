@@ -1,0 +1,322 @@
+"""Scheduler extender: filter/prioritize/bind over the real HTTP wire.
+
+The service is the SURVEY §3.5 escape hatch — upstream-scheduler semantics
+(CEL + markers) delegated to the structured allocator via the
+kube-scheduler extender webhook protocol.  Tests drive it end-to-end with
+urllib against a multi-host fake cluster.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+from k8s_dra_driver_tpu.kube.objects import ObjectMeta, Pod, ResourceClaim
+from k8s_dra_driver_tpu.scheduler.extender import SchedulerExtender
+
+
+def _post(port: int, verb: str, body: dict):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{verb}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _pod(server, name: str, claim_refs: list[dict]) -> dict:
+    """Create the Pod object and return its extender-wire dict."""
+    server.create(
+        Pod(
+            metadata=ObjectMeta(name=name, namespace="default", uid=f"uid-{name}"),
+            spec={"resourceClaims": claim_refs},
+        )
+    )
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": f"uid-{name}"},
+        "spec": {"resourceClaims": claim_refs},
+    }
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    return make_cluster(hosts=2, topology="v5e-16", work_dir=str(tmp_path))
+
+
+@pytest.fixture
+def extender(cluster):
+    ext = SchedulerExtender(cluster.server)
+    ext.start()
+    yield ext
+    ext.stop()
+
+
+NODES = ["tpu-host-0", "tpu-host-1"]
+
+
+class TestFilter:
+    def test_all_nodes_feasible(self, cluster, extender):
+        cluster.server.create(simple_claim("c1"))
+        pod = _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert out["nodenames"] == NODES
+        assert out["failedNodes"] == {}
+        assert out["error"] == ""
+
+    def test_exhausted_node_fails_with_reason(self, cluster, extender):
+        # consume ALL of host-0's chips (4 chips per fake host)
+        blocker = cluster.server.create(simple_claim("blocker", count=4))
+        cluster.allocator.allocate(
+            blocker, node_name="tpu-host-0",
+            node_labels=cluster.node_labels("tpu-host-0"),
+        )
+        cluster.server.create(simple_claim("c1", count=4))
+        pod = _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert out["nodenames"] == ["tpu-host-1"]
+        assert "cannot satisfy" in out["failedNodes"]["tpu-host-0"]
+
+    def test_allocated_shared_claim_pins_node(self, cluster, extender):
+        """gpu-test3 pattern: pod 2 of a shared claim only fits where the
+        claim already landed."""
+        shared = cluster.server.create(simple_claim("shared"))
+        cluster.allocator.allocate(
+            shared, node_name="tpu-host-1",
+            node_labels=cluster.node_labels("tpu-host-1"),
+        )
+        pod = _pod(cluster.server, "p2", [{"name": "tpu", "resourceClaimName": "shared"}])
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert out["nodenames"] == ["tpu-host-1"]
+        assert "already allocated" in out["failedNodes"]["tpu-host-0"]
+
+    def test_full_node_objects_carry_labels(self, cluster, extender):
+        cluster.server.create(simple_claim("c1"))
+        pod = _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        nodes = {
+            "items": [
+                {"metadata": {"name": n, "labels": {"kubernetes.io/hostname": n}}}
+                for n in NODES
+            ]
+        }
+        out = _post(extender.port, "filter", {"pod": pod, "nodes": nodes})
+        assert out["nodenames"] == NODES
+
+    def test_podless_claimless_pod_passes_everywhere(self, cluster, extender):
+        pod = _pod(cluster.server, "p1", [])
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert out["nodenames"] == NODES
+
+    def test_template_claim_naming(self, cluster, extender):
+        """A template ref resolves to <pod>-<ref-name> (THE naming rule)."""
+        cluster.server.create(simple_claim("p1-tpu"))
+        pod = _pod(
+            cluster.server, "p1", [{"name": "tpu", "resourceClaimTemplateName": "t"}]
+        )
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert out["nodenames"] == NODES
+
+    def test_full_nodes_request_gets_nodes_reply(self, cluster, extender):
+        """A scheduler without nodeCacheCapable reads result.Nodes — the
+        reply must echo a filtered NodeList, not just nodenames."""
+        blocker = cluster.server.create(simple_claim("blocker", count=4))
+        cluster.allocator.allocate(
+            blocker, node_name="tpu-host-0",
+            node_labels=cluster.node_labels("tpu-host-0"),
+        )
+        cluster.server.create(simple_claim("c1", count=4))
+        pod = _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        nodes = {
+            "items": [
+                {"metadata": {"name": n, "labels": {"kubernetes.io/hostname": n}}}
+                for n in NODES
+            ]
+        }
+        out = _post(extender.port, "filter", {"pod": pod, "nodes": nodes})
+        kept = [n["metadata"]["name"] for n in out["nodes"]["items"]]
+        assert kept == ["tpu-host-1"]
+
+    def test_jointly_infeasible_multi_claim_pod_fails_filter(self, cluster, extender):
+        """Two claims that each fit alone but not together must fail the
+        node at FILTER time, not livelock at bind (claims planned jointly:
+        later searches exclude earlier plans' devices)."""
+        cluster.server.create(simple_claim("a", count=3))
+        cluster.server.create(simple_claim("b", count=3))
+        pod = _pod(
+            cluster.server,
+            "p1",
+            [
+                {"name": "x", "resourceClaimName": "a"},
+                {"name": "y", "resourceClaimName": "b"},
+            ],
+        )
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert out["nodenames"] == []  # 3+3 > 4 chips on every host
+        assert set(out["failedNodes"]) == set(NODES)
+
+
+class TestPrioritize:
+    def test_most_allocated_wins(self, cluster, extender):
+        """The fuller node scores higher: small claims densify broken
+        geometry instead of fragmenting a pristine host."""
+        warm = cluster.server.create(simple_claim("warm", count=3))
+        cluster.allocator.allocate(
+            warm, node_name="tpu-host-0",
+            node_labels=cluster.node_labels("tpu-host-0"),
+        )
+        cluster.server.create(simple_claim("c1"))
+        pod = _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        out = _post(extender.port, "prioritize", {"pod": pod, "nodenames": NODES})
+        scores = {e["host"]: e["score"] for e in out}
+        assert scores["tpu-host-0"] > scores["tpu-host-1"]
+
+    def test_missing_claim_still_returns_a_list(self, cluster, extender):
+        """HostPriorityList is the wire type even on errors: a pod whose
+        template claim isn't instantiated yet scores 0 everywhere instead
+        of breaking the scheduler-side unmarshal with an error object."""
+        pod = _pod(cluster.server, "p1", [{"name": "t", "resourceClaimName": "nope"}])
+        out = _post(extender.port, "prioritize", {"pod": pod, "nodenames": NODES})
+        assert isinstance(out, list)
+        assert [e["score"] for e in out] == [0, 0]
+
+    def test_infeasible_scores_zero(self, cluster, extender):
+        blocker = cluster.server.create(simple_claim("blocker", count=4))
+        cluster.allocator.allocate(
+            blocker, node_name="tpu-host-0",
+            node_labels=cluster.node_labels("tpu-host-0"),
+        )
+        cluster.server.create(simple_claim("c1", count=2))
+        pod = _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        out = _post(extender.port, "prioritize", {"pod": pod, "nodenames": NODES})
+        scores = {e["host"]: e["score"] for e in out}
+        assert scores["tpu-host-0"] == 0
+        assert scores["tpu-host-1"] > 0
+
+
+class TestBind:
+    def test_bind_allocates_reserves_and_pins(self, cluster, extender):
+        cluster.server.create(simple_claim("c1"))
+        _pod(cluster.server, "p1", [{"name": "tpu", "resourceClaimName": "c1"}])
+        out = _post(
+            extender.port,
+            "bind",
+            {"podName": "p1", "podNamespace": "default", "podUID": "uid-p1",
+             "node": "tpu-host-0"},
+        )
+        assert out["error"] == ""
+        claim = cluster.server.get(ResourceClaim.KIND, "c1", "default")
+        assert claim.status.allocation is not None
+        assert [r.uid for r in claim.status.reserved_for] == ["uid-p1"]
+        pod = cluster.server.get(Pod.KIND, "p1", "default")
+        assert pod.metadata.labels["_scheduled_node"] == "tpu-host-0"
+        assert pod.spec["nodeName"] == "tpu-host-0"
+        # bound pod tears down through the standard lifecycle
+        cluster.delete_pod("p1")
+        claim = cluster.server.get(ResourceClaim.KIND, "c1", "default")
+        assert claim.status.allocation is None
+
+    def test_bind_failure_compensates(self, cluster, extender):
+        """Two claims, second unsatisfiable: the first must be rolled back
+        (unreserved AND deallocated) — no partial scheduling state."""
+        cluster.server.create(simple_claim("ok-claim"))
+        cluster.server.create(simple_claim("too-big", count=8))
+        _pod(
+            cluster.server,
+            "p1",
+            [
+                {"name": "a", "resourceClaimName": "ok-claim"},
+                {"name": "b", "resourceClaimName": "too-big"},
+            ],
+        )
+        out = _post(
+            extender.port,
+            "bind",
+            {"podName": "p1", "podNamespace": "default", "podUID": "uid-p1",
+             "node": "tpu-host-0"},
+        )
+        assert "cannot satisfy" in out["error"]
+        claim = cluster.server.get(ResourceClaim.KIND, "ok-claim", "default")
+        assert claim.status.allocation is None
+        assert not claim.status.reserved_for
+
+    def test_bind_refuses_node_away_from_shared_allocation(self, cluster, extender):
+        """Race: both pods of a shared claim pass filter while it is
+        unallocated; pod 1 binds on host-0 (allocating there).  Pod 2's
+        bind to host-1 must REFUSE — allocate's idempotent early-return
+        would otherwise strand pod 2 away from the claim's devices."""
+        cluster.server.create(simple_claim("shared"))
+        _pod(cluster.server, "p1", [{"name": "t", "resourceClaimName": "shared"}])
+        _pod(cluster.server, "p2", [{"name": "t", "resourceClaimName": "shared"}])
+        out = _post(
+            extender.port, "bind",
+            {"podName": "p1", "podNamespace": "default", "podUID": "uid-p1",
+             "node": "tpu-host-0"},
+        )
+        assert out["error"] == ""
+        out = _post(
+            extender.port, "bind",
+            {"podName": "p2", "podNamespace": "default", "podUID": "uid-p2",
+             "node": "tpu-host-1"},
+        )
+        assert "already allocated" in out["error"]
+        claim = cluster.server.get(ResourceClaim.KIND, "shared", "default")
+        assert [r.uid for r in claim.status.reserved_for] == ["uid-p1"]  # no p2 residue
+
+    def test_bind_unknown_pod_errors(self, cluster, extender):
+        out = _post(
+            extender.port,
+            "bind",
+            {"podName": "ghost", "podNamespace": "default", "podUID": "u",
+             "node": "tpu-host-0"},
+        )
+        assert "ghost" in out["error"]
+
+    def test_bind_shared_claim_second_pod(self, cluster, extender):
+        """Second consumer of an allocated claim: reserve only, claim
+        survives the first pod's teardown until the last consumer goes."""
+        cluster.server.create(simple_claim("shared"))
+        _pod(cluster.server, "p1", [{"name": "t", "resourceClaimName": "shared"}])
+        _pod(cluster.server, "p2", [{"name": "t", "resourceClaimName": "shared"}])
+        for pod_name in ("p1", "p2"):
+            out = _post(
+                extender.port,
+                "bind",
+                {"podName": pod_name, "podNamespace": "default",
+                 "podUID": f"uid-{pod_name}", "node": "tpu-host-0"},
+            )
+            assert out["error"] == ""
+        claim = cluster.server.get(ResourceClaim.KIND, "shared", "default")
+        assert len(claim.status.reserved_for) == 2
+        cluster.delete_pod("p1")
+        claim = cluster.server.get(ResourceClaim.KIND, "shared", "default")
+        assert claim.status.allocation is not None  # p2 still consuming
+        cluster.delete_pod("p2")
+        claim = cluster.server.get(ResourceClaim.KIND, "shared", "default")
+        assert claim.status.allocation is None
+
+
+class TestWire:
+    def test_bad_json_is_400(self, extender):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{extender.port}/filter",
+            data=b"{not json",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 400
+
+    def test_unknown_verb_is_404(self, extender):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{extender.port}/preempt", data=b"{}", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+
+    def test_missing_claim_reports_error_body(self, cluster, extender):
+        pod = _pod(cluster.server, "p1", [{"name": "t", "resourceClaimName": "nope"}])
+        out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
+        assert "error" in out and out["error"] != ""
