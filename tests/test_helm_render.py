@@ -126,6 +126,36 @@ class TestDefaultRender:
 
 
 class TestVariants:
+    def test_extender_disabled_by_default(self, default_docs):
+        kinds = _by_kind(default_docs)
+        assert "Service" not in kinds
+        controller = next(
+            d for d in kinds["Deployment"]
+            if d["metadata"]["name"].endswith("-controller")
+        )
+        env = controller["spec"]["template"]["spec"]["containers"][0]["env"]
+        assert all(e["name"] != "EXTENDER_PORT" for e in env)
+
+    def test_extender_port_renders_service_and_env(self):
+        docs = render_chart_docs(CHART, values_override={"extenderPort": 8090})
+        kinds = _by_kind(docs)
+        svc = next(
+            d for d in kinds["Service"]
+            if d["metadata"]["name"].endswith("-extender")
+        )
+        assert svc["spec"]["ports"][0]["port"] == 8090
+        # the Service must select the controller pods that serve the webhook
+        assert svc["spec"]["selector"]["app.kubernetes.io/component"] == "controller"
+        controller = next(
+            d for d in kinds["Deployment"]
+            if d["metadata"]["name"].endswith("-controller")
+        )
+        env = {
+            e["name"]: e["value"]
+            for e in controller["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["EXTENDER_PORT"] == "8090"
+
     def test_membership_disabled_drops_controller(self):
         docs = render_chart_docs(
             CHART, values_override={"deviceClasses": ["tpu", "subslice"]}

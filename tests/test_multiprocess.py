@@ -95,15 +95,19 @@ def test_two_process_membership_claim_runs_cross_process_collective(tmp_path):
                 )
             )
         outs = []
-        for child in children:
-            try:
+        try:
+            for child in children:
                 out, err = child.communicate(timeout=180)
-            except subprocess.TimeoutExpired:
-                for c in children:
+                assert child.returncode == 0, f"worker failed:\n{err[-2000:]}"
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            # one worker failing must not orphan its sibling: the survivor
+            # would block in jax.distributed.initialize for its full init
+            # timeout waiting on a coordinator that will never answer
+            for c in children:
+                if c.poll() is None:
                     c.kill()
-                raise
-            assert child.returncode == 0, f"worker failed:\n{err[-2000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
+                    c.wait()
 
         workers = sorted(o["worker"] for o in outs)
         assert workers == [0, 1]  # distinct driver-assigned identities
