@@ -16,10 +16,12 @@ native:
 test: native
 	$(PYTHON) -m pytest tests/ -q
 
-# Native shim under ASAN/UBSAN (SURVEY.md §5: we add sanitizers the
-# reference's all-Go tree never needed).
+# Native shim + daemon under ASAN/UBSAN (SURVEY.md §5: we add sanitizers
+# the reference's all-Go tree never needed).  The sanitized daemon serves
+# one full protocol round trip so leaks/UB in the hot path surface here.
 asan-test:
-	$(MAKE) -C $(CPP_DIR) libtpuinfo_asan.so
+	$(MAKE) -C $(CPP_DIR) libtpuinfo_asan.so tpu_topology_daemon_asan
+	$(PYTHON) tools/asan_daemon_check.py
 
 # Headline benchmark (claim-to-running p50 + live data-plane proof).
 bench:

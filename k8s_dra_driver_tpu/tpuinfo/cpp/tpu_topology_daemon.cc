@@ -1,0 +1,734 @@
+// tpu-topology-daemon (native): the per-host TPU topology daemon.
+//
+// The reference's counterpart daemon is a NATIVE binary
+// (nvidia-cuda-mps-control, rendered into the Deployment at
+// templates/mps-control-daemon.tmpl.yaml:26-42 and started from
+// cmd/nvidia-dra-plugin/sharing.go:185-287); this is the TPU build's native
+// implementation, wire-compatible with the Python module
+// (k8s_dra_driver_tpu/plugin/topology_daemon.py) — same CLI, same env
+// contract, same newline-delimited-JSON unix-socket protocol, so the
+// Python client and the whole test suite drive both interchangeably
+// (tests/test_topology_daemon.py parametrizes over the two servers).
+//
+// Modes (exactly one):
+//   --claim-uid <uid>  per-claim partition-table server (SpatialPartition)
+//   --host-mode        per-host cooperative run-lease arbiter (TimeSlicing)
+//
+// Protocol: requests {"op": "info"|"register"|"acquire"|"release", ...},
+// one JSON object per line; every response carries "ok".
+
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <chrono>
+#include <condition_variable>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON DOM — just enough for this protocol (objects, arrays,
+// strings, integers/doubles, booleans, null).  Parse errors throw.
+// ---------------------------------------------------------------------------
+
+struct Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+struct Json {
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+  Type type = Type::Null;
+  bool b = false;
+  long long i = 0;
+  double d = 0;
+  std::string s;
+  std::vector<JsonPtr> arr;
+  // insertion-ordered object (vector of pairs): stable, deterministic output
+  std::vector<std::pair<std::string, JsonPtr>> obj;
+
+  static JsonPtr null() { return std::make_shared<Json>(); }
+  static JsonPtr boolean(bool v) {
+    auto j = std::make_shared<Json>();
+    j->type = Type::Bool;
+    j->b = v;
+    return j;
+  }
+  static JsonPtr number(long long v) {
+    auto j = std::make_shared<Json>();
+    j->type = Type::Int;
+    j->i = v;
+    return j;
+  }
+  static JsonPtr str(const std::string& v) {
+    auto j = std::make_shared<Json>();
+    j->type = Type::String;
+    j->s = v;
+    return j;
+  }
+  static JsonPtr array() {
+    auto j = std::make_shared<Json>();
+    j->type = Type::Array;
+    return j;
+  }
+  static JsonPtr object() {
+    auto j = std::make_shared<Json>();
+    j->type = Type::Object;
+    return j;
+  }
+
+  JsonPtr get(const std::string& key) const {
+    for (const auto& kv : obj)
+      if (kv.first == key) return kv.second;
+    return nullptr;
+  }
+  void set(const std::string& key, JsonPtr v) {
+    for (auto& kv : obj)
+      if (kv.first == key) {
+        kv.second = std::move(v);
+        return;
+      }
+    obj.emplace_back(key, std::move(v));
+  }
+  bool truthy() const {
+    switch (type) {
+      case Type::Null: return false;
+      case Type::Bool: return b;
+      case Type::Int: return i != 0;
+      case Type::Double: return d != 0;
+      case Type::String: return !s.empty();
+      case Type::Array: return !arr.empty();
+      case Type::Object: return !obj.empty();
+    }
+    return false;
+  }
+  long long as_int(long long fallback) const {
+    if (type == Type::Int) return i;
+    if (type == Type::Double) return static_cast<long long>(d);
+    if (type == Type::String && !s.empty()) {
+      try {
+        return std::stoll(s);
+      } catch (...) {
+      }
+    }
+    return fallback;
+  }
+  std::string as_str() const { return type == Type::String ? s : ""; }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+
+  explicit JsonParser(const std::string& text)
+      : p(text.data()), end(text.data() + text.size()) {}
+
+  [[noreturn]] void fail(const std::string& what) {
+    throw std::runtime_error("json: " + what);
+  }
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+  char peek() {
+    skip_ws();
+    if (p >= end) fail("unexpected end");
+    return *p;
+  }
+  void expect(char c) {
+    if (peek() != c) fail(std::string("expected '") + c + "'");
+    p++;
+  }
+
+  JsonPtr parse() {
+    JsonPtr v = parse_value();
+    skip_ws();
+    if (p != end) fail("trailing data");
+    return v;
+  }
+
+  JsonPtr parse_value() {
+    switch (peek()) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Json::str(parse_string());
+      case 't':
+        literal("true");
+        return Json::boolean(true);
+      case 'f':
+        literal("false");
+        return Json::boolean(false);
+      case 'n':
+        literal("null");
+        return Json::null();
+      default: return parse_number();
+    }
+  }
+
+  void literal(const char* lit) {
+    size_t n = std::strlen(lit);
+    skip_ws();
+    if (static_cast<size_t>(end - p) < n || std::strncmp(p, lit, n) != 0)
+      fail(std::string("bad literal, wanted ") + lit);
+    p += n;
+  }
+
+  JsonPtr parse_object() {
+    expect('{');
+    auto j = Json::object();
+    if (peek() == '}') {
+      p++;
+      return j;
+    }
+    while (true) {
+      std::string key = parse_string();
+      expect(':');
+      j->set(key, parse_value());
+      char c = peek();
+      if (c == ',') {
+        p++;
+        continue;
+      }
+      expect('}');
+      return j;
+    }
+  }
+
+  JsonPtr parse_array() {
+    expect('[');
+    auto j = Json::array();
+    if (peek() == ']') {
+      p++;
+      return j;
+    }
+    while (true) {
+      j->arr.push_back(parse_value());
+      char c = peek();
+      if (c == ',') {
+        p++;
+        continue;
+      }
+      expect(']');
+      return j;
+    }
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c != '\\') {
+        out += c;
+        continue;
+      }
+      if (p >= end) fail("bad escape");
+      char e = *p++;
+      switch (e) {
+        case '"': out += '"'; break;
+        case '\\': out += '\\'; break;
+        case '/': out += '/'; break;
+        case 'b': out += '\b'; break;
+        case 'f': out += '\f'; break;
+        case 'n': out += '\n'; break;
+        case 'r': out += '\r'; break;
+        case 't': out += '\t'; break;
+        case 'u': {
+          if (end - p < 4) fail("bad \\u escape");
+          unsigned code = 0;
+          for (int k = 0; k < 4; k++) {
+            char h = *p++;
+            code <<= 4;
+            if (h >= '0' && h <= '9') code |= h - '0';
+            else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+            else fail("bad \\u escape");
+          }
+          // UTF-8 encode (BMP only; protocol strings are ASCII in practice)
+          if (code < 0x80) {
+            out += static_cast<char>(code);
+          } else if (code < 0x800) {
+            out += static_cast<char>(0xC0 | (code >> 6));
+            out += static_cast<char>(0x80 | (code & 0x3F));
+          } else {
+            out += static_cast<char>(0xE0 | (code >> 12));
+            out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+            out += static_cast<char>(0x80 | (code & 0x3F));
+          }
+          break;
+        }
+        default: fail("bad escape");
+      }
+    }
+    if (p >= end) fail("unterminated string");
+    p++;  // closing quote
+    return out;
+  }
+
+  JsonPtr parse_number() {
+    skip_ws();
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) p++;
+    bool is_double = false;
+    while (p < end && (std::isdigit(static_cast<unsigned char>(*p)) || *p == '.' ||
+                       *p == 'e' || *p == 'E' || *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+      p++;
+    }
+    std::string text(start, p - start);
+    if (text.empty()) fail("bad number");
+    auto j = std::make_shared<Json>();
+    if (is_double) {
+      j->type = Json::Type::Double;
+      j->d = std::strtod(text.c_str(), nullptr);
+    } else {
+      j->type = Json::Type::Int;
+      j->i = std::stoll(text);
+    }
+    return j;
+  }
+};
+
+void dump_string(const std::string& s, std::string& out) {
+  out += '"';
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  out += '"';
+}
+
+void dump(const JsonPtr& j, std::string& out) {
+  if (!j) {
+    out += "null";
+    return;
+  }
+  switch (j->type) {
+    case Json::Type::Null: out += "null"; break;
+    case Json::Type::Bool: out += j->b ? "true" : "false"; break;
+    case Json::Type::Int: out += std::to_string(j->i); break;
+    case Json::Type::Double: {
+      char buf[32];
+      std::snprintf(buf, sizeof buf, "%.17g", j->d);
+      out += buf;
+      break;
+    }
+    case Json::Type::String: dump_string(j->s, out); break;
+    case Json::Type::Array: {
+      out += '[';
+      for (size_t k = 0; k < j->arr.size(); k++) {
+        if (k) out += ", ";
+        dump(j->arr[k], out);
+      }
+      out += ']';
+      break;
+    }
+    case Json::Type::Object: {
+      out += '{';
+      bool first = true;
+      for (const auto& kv : j->obj) {
+        if (!first) out += ", ";
+        first = false;
+        dump_string(kv.first, out);
+        out += ": ";
+        dump(kv.second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+std::string dumps(const JsonPtr& j) {
+  std::string out;
+  dump(j, out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Daemon state + protocol (mirror of TopologyDaemonServer semantics)
+// ---------------------------------------------------------------------------
+
+constexpr int kLeaseGraceQuanta = 4;  // topology_daemon.py LEASE_GRACE_QUANTA
+constexpr int kDefaultQuantumMs = 5;
+
+using Clock = std::chrono::steady_clock;
+
+struct Lease {
+  std::string consumer;
+  long long quantum_ms = 0;
+  Clock::time_point granted_at;
+
+  Clock::time_point expiry() const {
+    return granted_at + std::chrono::milliseconds(quantum_ms * kLeaseGraceQuanta);
+  }
+};
+
+class Daemon {
+ public:
+  Daemon(std::string claim_uid, std::string partition_spec, JsonPtr partitions,
+         JsonPtr hbm_limits, long long quantum_ms)
+      : claim_uid_(std::move(claim_uid)),
+        partition_spec_(std::move(partition_spec)),
+        partitions_(partitions ? partitions : Json::array()),
+        hbm_limits_(hbm_limits ? hbm_limits : Json::object()),
+        quantum_ms_(quantum_ms) {}
+
+  JsonPtr handle(const JsonPtr& req) {
+    std::string op = req->get("op") ? req->get("op")->as_str() : "";
+    if (op == "info") return info();
+    if (op == "register") return do_register(req);
+    if (op == "acquire") return acquire(req);
+    if (op == "release") return release(req);
+    return error("unknown op '" + op + "'");
+  }
+
+ private:
+  static JsonPtr error(const std::string& msg) {
+    auto j = Json::object();
+    j->set("ok", Json::boolean(false));
+    j->set("error", Json::str(msg));
+    return j;
+  }
+
+  JsonPtr info() {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto j = Json::object();
+    j->set("ok", Json::boolean(true));
+    j->set("claim_uid", Json::str(claim_uid_));
+    j->set("partition_spec", Json::str(partition_spec_));
+    j->set("partitions", partitions_);
+    j->set("hbm_limits", hbm_limits_);
+    j->set("quantum_ms", Json::number(quantum_ms_));
+    auto consumers = Json::array();
+    for (const auto& name : std::set<std::string>(consumers_.begin(), consumers_.end()))
+      consumers->arr.push_back(Json::str(name));
+    j->set("consumers", consumers);
+    auto holders = Json::object();
+    for (const auto& kv : leases_) holders->set(kv.first, Json::str(kv.second.consumer));
+    j->set("lease_holders", holders);
+    return j;
+  }
+
+  JsonPtr do_register(const JsonPtr& req) {
+    std::string consumer = req->get("consumer") ? req->get("consumer")->as_str() : "";
+    if (consumer.empty()) return error("register requires 'consumer'");
+    JsonPtr index = req->get("partition");
+    std::lock_guard<std::mutex> lock(mu_);
+    JsonPtr partition = Json::null();
+    if (index && index->type != Json::Type::Null) {
+      for (const auto& part : partitions_->arr) {
+        JsonPtr pi = part->get("index");
+        if (pi && pi->as_int(-1) == index->as_int(-2)) {
+          partition = part;
+          break;
+        }
+      }
+      if (partition->type == Json::Type::Null) {
+        std::string have = "[";
+        for (size_t k = 0; k < partitions_->arr.size(); k++) {
+          if (k) have += ", ";
+          JsonPtr pi = partitions_->arr[k]->get("index");
+          have += pi ? std::to_string(pi->as_int(-1)) : "null";
+        }
+        have += "]";
+        return error("no partition " + std::to_string(index->as_int(-1)) +
+                     " (have " + have + ")");
+      }
+    }
+    consumers_.insert(consumer);
+    auto j = Json::object();
+    j->set("ok", Json::boolean(true));
+    j->set("partition", partition);
+    j->set("quantum_ms", Json::number(quantum_ms_));
+    j->set("hbm_limits", hbm_limits_);
+    return j;
+  }
+
+  JsonPtr acquire(const JsonPtr& req) {
+    std::string consumer = req->get("consumer") ? req->get("consumer")->as_str() : "";
+    if (consumer.empty()) return error("acquire requires 'consumer'");
+    std::string scope = req->get("scope") ? req->get("scope")->as_str() : "";
+    if (scope.empty()) scope = "*";
+    long long quantum =
+        req->get("quantum_ms") ? req->get("quantum_ms")->as_int(quantum_ms_) : quantum_ms_;
+    long long timeout_ms =
+        req->get("timeout_ms") ? req->get("timeout_ms")->as_int(5000) : 5000;
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+
+    std::unique_lock<std::mutex> lock(mu_);
+    while (true) {
+      auto now = Clock::now();
+      auto it = leases_.find(scope);
+      if (it != leases_.end() && now >= it->second.expiry()) {
+        leases_.erase(it);  // reclaim from the dead
+        it = leases_.end();
+      }
+      if (it == leases_.end() || it->second.consumer == consumer) {
+        leases_[scope] = Lease{consumer, quantum, now};
+        cond_.notify_all();
+        auto j = Json::object();
+        j->set("ok", Json::boolean(true));
+        j->set("lease_ms", Json::number(quantum));
+        j->set("scope", Json::str(scope));
+        return j;
+      }
+      if (now >= deadline) {
+        auto j = error("timeout");
+        j->set("holder", Json::str(it->second.consumer));
+        return j;
+      }
+      // Wake on release OR when the current lease would expire.
+      auto wake = std::min(deadline, it->second.expiry());
+      cond_.wait_until(lock, wake);
+    }
+  }
+
+  JsonPtr release(const JsonPtr& req) {
+    std::string consumer = req->get("consumer") ? req->get("consumer")->as_str() : "";
+    std::string scope = req->get("scope") ? req->get("scope")->as_str() : "";
+    if (scope.empty()) scope = "*";
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = leases_.find(scope);
+    auto j = Json::object();
+    j->set("ok", Json::boolean(true));
+    if (it != leases_.end() && it->second.consumer == consumer) {
+      leases_.erase(it);
+      cond_.notify_all();
+    } else {
+      j->set("noop", Json::boolean(true));
+    }
+    return j;
+  }
+
+  std::string claim_uid_;
+  std::string partition_spec_;
+  JsonPtr partitions_;
+  JsonPtr hbm_limits_;
+  long long quantum_ms_;
+  std::set<std::string> consumers_;
+  std::map<std::string, Lease> leases_;
+  std::mutex mu_;
+  std::condition_variable cond_;
+};
+
+// ---------------------------------------------------------------------------
+// Socket server: thread per connection, newline-delimited JSON
+// ---------------------------------------------------------------------------
+
+void serve_connection(Daemon* daemon, int fd) {
+  std::string buffer;
+  char chunk[4096];
+  while (true) {
+    ssize_t n = read(fd, chunk, sizeof chunk);
+    if (n <= 0) break;
+    buffer.append(chunk, n);
+    size_t nl;
+    while ((nl = buffer.find('\n')) != std::string::npos) {
+      std::string line = buffer.substr(0, nl);
+      buffer.erase(0, nl + 1);
+      while (!line.empty() && (line.back() == '\r' || line.back() == ' '))
+        line.pop_back();
+      if (line.empty()) continue;
+      JsonPtr resp;
+      try {
+        JsonPtr req = JsonParser(line).parse();
+        if (req->type != Json::Type::Object) throw std::runtime_error("not an object");
+        resp = daemon->handle(req);
+      } catch (const std::exception& exc) {
+        // malformed input must not kill the daemon
+        resp = Json::object();
+        resp->set("ok", Json::boolean(false));
+        resp->set("error", Json::str(std::string("Error: ") + exc.what()));
+      }
+      std::string out = dumps(resp) + "\n";
+      size_t off = 0;
+      while (off < out.size()) {
+        ssize_t w = write(fd, out.data() + off, out.size() - off);
+        if (w <= 0) {
+          close(fd);
+          return;
+        }
+        off += w;
+      }
+    }
+  }
+  close(fd);
+}
+
+std::string getenv_str(const char* name) {
+  const char* v = std::getenv(name);
+  return v ? v : "";
+}
+
+// SIGTERM closes the listener so accept() fails and run() returns
+// normally — a NORMAL exit, which is what lets LeakSanitizer produce its
+// end-of-process report under the sanitized build (a default-action
+// SIGTERM death would skip it, silently voiding `make asan-test`'s leak
+// coverage).  close() is async-signal-safe.
+volatile int g_listener_fd = -1;
+
+void handle_term(int) {
+  int fd = g_listener_fd;
+  if (fd >= 0) close(fd);
+}
+
+int run(const std::string& socket_path, Daemon* daemon, const std::string& mode) {
+  unlink(socket_path.c_str());
+  int listener = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listener < 0) {
+    perror("socket");
+    return 1;
+  }
+  g_listener_fd = listener;
+  signal(SIGTERM, handle_term);
+  signal(SIGINT, handle_term);
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (socket_path.size() >= sizeof(addr.sun_path)) {
+    std::fprintf(stderr, "socket path too long: %s\n", socket_path.c_str());
+    return 1;
+  }
+  std::strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(listener, 64) != 0) {
+    perror("listen");
+    return 1;
+  }
+  // Same startup line as the Python program: the plugin's readiness poll
+  // and the tests look for it.
+  std::printf("tpu-topology-daemon: serving %s on %s\n", mode.c_str(),
+              socket_path.c_str());
+  std::fflush(stdout);
+  while (true) {
+    int fd = accept(listener, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;  // listener closed by handle_term: clean shutdown
+    }
+    std::thread(serve_connection, daemon, fd).detach();
+  }
+  g_listener_fd = -1;
+  unlink(socket_path.c_str());
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string claim_uid;
+  bool host_mode = false;
+  std::string socket_dir = "/run/tpu-topology";
+  // Both argparse forms: "--flag value" and "--flag=value" — the
+  // deployment templates use the '=' form (topology-daemon.tmpl.yaml,
+  // kubeletplugin.yaml), tests and humans often the spaced one.
+  auto value_of = [&](const std::string& arg, const std::string& flag,
+                      int* k, std::string* out) -> bool {
+    if (arg == flag) {
+      if (*k + 1 >= argc) return false;
+      *out = argv[++*k];
+      return true;
+    }
+    if (arg.rfind(flag + "=", 0) == 0) {
+      *out = arg.substr(flag.size() + 1);
+      return true;
+    }
+    return false;
+  };
+  for (int k = 1; k < argc; k++) {
+    std::string arg = argv[k];
+    if (arg == "--host-mode") {
+      host_mode = true;
+    } else if (value_of(arg, "--claim-uid", &k, &claim_uid) ||
+               value_of(arg, "--socket-dir", &k, &socket_dir)) {
+      continue;
+    } else {
+      std::fprintf(stderr,
+                   "usage: tpu-topology-daemon (--claim-uid UID | --host-mode) "
+                   "[--socket-dir DIR]\n");
+      return 2;
+    }
+  }
+  if (claim_uid.empty() == !host_mode) {
+    std::fprintf(stderr,
+                 "exactly one of --claim-uid or --host-mode is required\n");
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);  // a vanished consumer must not kill the daemon
+
+  // Env contract shared with the Python program / the Deployment template.
+  JsonPtr partitions = Json::array();
+  std::string raw = getenv_str("TPU_PARTITIONS");
+  if (!raw.empty()) {
+    try {
+      partitions = JsonParser(raw).parse();
+    } catch (const std::exception& exc) {
+      std::fprintf(stderr, "bad TPU_PARTITIONS: %s\n", exc.what());
+      return 2;
+    }
+  }
+  JsonPtr hbm_limits = Json::object();
+  raw = getenv_str("TPU_HBM_LIMITS");
+  if (!raw.empty()) {
+    std::stringstream ss(raw);
+    std::string kv;
+    while (std::getline(ss, kv, ',')) {
+      size_t eq = kv.find('=');
+      if (eq != std::string::npos)
+        hbm_limits->set(kv.substr(0, eq), Json::str(kv.substr(eq + 1)));
+    }
+  }
+  long long quantum_ms = kDefaultQuantumMs;
+  raw = getenv_str("TPU_QUEUE_QUANTUM_MS");
+  if (!raw.empty()) quantum_ms = std::strtoll(raw.c_str(), nullptr, 10);
+
+  std::string socket_path =
+      host_mode ? socket_dir + "/host.sock" : socket_dir + "/" + claim_uid + ".sock";
+  // mkdir -p for the socket dir (one level is enough in practice; walk anyway)
+  std::string path_acc;
+  std::stringstream dirss(socket_dir);
+  std::string part;
+  while (std::getline(dirss, part, '/')) {
+    if (part.empty()) {
+      path_acc += "/";
+      continue;
+    }
+    path_acc += part;
+    mkdir(path_acc.c_str(), 0755);
+    path_acc += "/";
+  }
+
+  Daemon daemon(claim_uid, getenv_str("TPU_PARTITION_SPEC"), partitions,
+                hbm_limits, quantum_ms);
+  std::string mode = host_mode ? "host" : "claim " + claim_uid;
+  return run(socket_path, &daemon, mode);
+}

@@ -1,0 +1,90 @@
+"""Drive the ASAN/UBSAN build of the native topology daemon through one
+full protocol round trip (info/register/acquire/contend/release), so
+memory errors or UB in the request path fail `make asan-test` loudly.
+
+The sanitized binary aborts on any finding; a clean exit after real
+socket traffic is the pass signal.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BINARY = REPO / "k8s_dra_driver_tpu/tpuinfo/cpp/tpu_topology_daemon_asan"
+
+sys.path.insert(0, str(REPO))
+
+from k8s_dra_driver_tpu.plugin.topology_daemon import (  # noqa: E402
+    TopologyDaemonClient,
+    claim_socket_path,
+)
+
+PARTITIONS = [
+    {"index": 0, "visible_devices": "0", "hbm_limit_mib": 4096},
+    {"index": 1, "visible_devices": "1", "hbm_limit_mib": None},
+]
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="asan-daemon-") as tmp:
+        proc = subprocess.Popen(
+            [str(BINARY), "--claim-uid", "asan", "--socket-dir", tmp],
+            env={
+                "PATH": "/usr/bin:/bin",
+                "TPU_PARTITIONS": json.dumps(PARTITIONS),
+                "TPU_PARTITION_SPEC": "2,1,1",
+                "TPU_HBM_LIMITS": "u0=4096Mi",
+                "TPU_QUEUE_QUANTUM_MS": "10",
+            },
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            sock = claim_socket_path(tmp, "asan")
+            deadline = time.time() + 10
+            while time.time() < deadline and not pathlib.Path(sock).exists():
+                if proc.poll() is not None:
+                    print(proc.stdout.read().decode(), file=sys.stderr)
+                    return 1
+                time.sleep(0.02)
+            a = TopologyDaemonClient(sock, "a")
+            b = TopologyDaemonClient(sock, "b")
+            assert a.info()["ok"]
+            assert a.register(partition=0)["ok"]
+            assert not a.register(partition=9)["ok"]  # error path
+            assert a.acquire(quantum_ms=10, scope="0")["ok"]
+            assert not b.acquire(quantum_ms=10, scope="0", timeout_ms=30)["ok"]
+            assert a.release(scope="0")["ok"]
+            assert b.acquire(quantum_ms=10, scope="0", timeout_ms=500)["ok"]
+            # malformed line must be answered, not crash the daemon
+            import socket as socketlib
+
+            s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+            s.connect(sock)
+            s.sendall(b"{broken\n")
+            assert not json.loads(s.makefile("rb").readline())["ok"]
+            s.close()
+            a.close(), b.close()
+        finally:
+            proc.terminate()
+            rc = proc.wait(timeout=10)
+        out = proc.stdout.read().decode()
+        # The daemon handles SIGTERM by closing its listener and returning
+        # from main NORMALLY, so LeakSanitizer's end-of-process report runs
+        # — rc must be 0 and no sanitizer may have spoken.
+        bad = ("ERROR: AddressSanitizer", "ERROR: LeakSanitizer", "runtime error")
+        if rc != 0 or any(m in out for m in bad):
+            print(f"rc={rc}\n{out}", file=sys.stderr)
+            return 1
+        print("asan daemon check: ok (clean exit, no sanitizer findings)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
