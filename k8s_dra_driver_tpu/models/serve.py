@@ -18,7 +18,13 @@ and leave per step:
 
 Numerics contract (tested): a request served through the engine produces
 EXACTLY the tokens sequential `greedy_decode` produces for the same prompt
-— continuous batching changes scheduling, never results.
+— continuous batching changes scheduling, never results.  Caveat on the
+"exactly": the engine admits via the PARALLEL prefill, whose k/v agree
+with the sequential scan's to float tolerance, not necessarily bit-for-bit
+(tests/test_decode.py pins the prefill-mode parity at atol 2e-5); on a
+degenerate model whose argmax sits on a near-tie, that low-bit difference
+can pick the other tied token.  Real checkpoints don't generate off
+coin-flip logits; the bit-equality tests pin the shipped configs.
 
 The reference has no serving story at all (its data plane is CUDA inside
 user pods); this is consumer-side capability per SURVEY.md §2.11.
@@ -67,39 +73,26 @@ def _step_all_slots(
     return tok.astype(jnp.int32), cache
 
 
-def _prefill_into_slot(
-    params, cache: KVCache, prompt, plen, slot, temp, key, *, cfg, top_k: int
+def _commit_row_and_first_token(
+    params, cache: KVCache, row_k, row_v, prompt, plen, slot, temp, key,
+    *, cfg, top_k: int,
 ):
-    """Fill ONE slot's cache from a padded prompt [1, bucket] in one
-    parallel forward; returns (first generated token, new cache).
+    """Shared admission tail for BOTH prefill paths (full and prefix-hit):
+    zero the row's garbage tail (>= plen), scatter it into the slot, and
+    compute the first generated token by re-running the per-slot step at
+    pos = plen-1 — bit-identical to what sequential decode computes there
+    (the k/v re-write at that position is idempotent: same token, same
+    position).  ONE implementation so hit- and miss-path streams cannot
+    drift.
 
     Causality makes padding safe: k/v at position j depend only on
-    positions <= j, so every j < plen is computed from real tokens and the
-    garbage tail (>= plen) is zeroed here and mask-excluded forever after.
-    The padded prefill's OWN last-logits are at position bucket-1 (wrong
-    for padded prompts) and are discarded; the first generated token comes
-    from re-running the per-slot step at pos = plen-1 — bit-identical to
-    what sequential decode computes there, and the k/v re-write at that
-    position is idempotent (same token, same position)."""
-    slot_cache, _ = decode.prefill(
-        params, prompt, cfg, max_seq=cache.k.shape[2], cache_dtype=cache.k.dtype
+    positions <= j, so every j < plen came from real tokens and the
+    garbage tail is zeroed here and mask-excluded forever after."""
+    keep = (jnp.arange(cache.k.shape[2]) < plen)[None, :, None, None]
+    new_cache = KVCache(
+        cache.k.at[:, slot].set(jnp.where(keep, row_k, 0).astype(cache.k.dtype)),
+        cache.v.at[:, slot].set(jnp.where(keep, row_v, 0).astype(cache.v.dtype)),
     )
-    k = jnp.where(
-        (jnp.arange(cache.k.shape[2]) < plen)[None, :, None, None],
-        slot_cache.k[:, 0],
-        0,
-    )
-    v = jnp.where(
-        (jnp.arange(cache.v.shape[2]) < plen)[None, :, None, None],
-        slot_cache.v[:, 0],
-        0,
-    )
-    new_k = cache.k.at[:, slot].set(k.astype(cache.k.dtype))
-    new_v = cache.v.at[:, slot].set(v.astype(cache.v.dtype))
-    new_cache = KVCache(new_k, new_v)
-
-    # First generated token = argmax at position plen-1, computed with the
-    # per-slot step machinery (exactly what sequential decode does).
     last_tok = prompt[0, plen - 1]
     n_slots = cache.k.shape[1]
     tok, new_cache = _step_all_slots(
@@ -114,6 +107,61 @@ def _prefill_into_slot(
         top_k=top_k,
     )
     return tok[slot], new_cache
+
+
+def _prefill_into_slot(
+    params, cache: KVCache, prompt, plen, slot, temp, key, *, cfg, top_k: int
+):
+    """Fill ONE slot's cache from a padded prompt [1, bucket] in one
+    parallel forward; returns (first generated token, new cache).  The
+    padded prefill's OWN last-logits are at position bucket-1 (wrong for
+    padded prompts) and are discarded; `_commit_row_and_first_token` owns
+    the admission tail."""
+    slot_cache, _ = decode.prefill(
+        params, prompt, cfg, max_seq=cache.k.shape[2], cache_dtype=cache.k.dtype
+    )
+    return _commit_row_and_first_token(
+        params, cache, slot_cache.k[:, 0], slot_cache.v[:, 0],
+        prompt, plen, slot, temp, key, cfg=cfg, top_k=top_k,
+    )
+
+
+def _prefill_suffix_into_slot(
+    params, cache: KVCache, prefix_k, prefix_v, prompt, plen, slot, temp, key,
+    *, cfg, top_k: int, prefix_bucket: int,
+):
+    """Prefix-cache hit path: write the stored prefix k/v (positions
+    ``< prefix_bucket``) and compute ONLY the suffix's k/v with one
+    `decode_chunk` at ``pos0=prefix_bucket`` — the shared-system-prompt
+    admission saving.
+
+    Bit-equality with the full prefill holds by construction: (a) the
+    stored prefix bytes came out of this engine's own full-prefill program,
+    whose k/v at positions ``< prefix_bucket`` depend only on the prefix
+    tokens (causality) — same program, same inputs, same bits; (b) the
+    suffix chunk contracts attention over the same ``k_window`` (the
+    prompt bucket) the full prefill uses, so its reductions match shape
+    for shape.  Returns (first generated token, new cache)."""
+    bucket = prompt.shape[1]
+    max_seq = cache.k.shape[2]
+    row = init_cache(cfg, 1, max_seq, dtype=cache.k.dtype)
+    row = KVCache(
+        row.k.at[:, 0, :prefix_bucket].set(prefix_k.astype(row.k.dtype)),
+        row.v.at[:, 0, :prefix_bucket].set(prefix_v.astype(row.v.dtype)),
+    )
+    suffix = prompt[:, prefix_bucket:]
+    _, row = decode.decode_chunk(
+        params, row, suffix, prefix_bucket, cfg=cfg, k_window=bucket
+    )
+    return _commit_row_and_first_token(
+        params, cache, row.k[:, 0], row.v[:, 0],
+        prompt, plen, slot, temp, key, cfg=cfg, top_k=top_k,
+    )
+
+
+def _extract_prefix(cache: KVCache, slot, *, prefix_bucket: int):
+    """The slot's k/v for positions < prefix_bucket (store entry)."""
+    return cache.k[:, slot, :prefix_bucket], cache.v[:, slot, :prefix_bucket]
 
 
 @dataclass
@@ -157,6 +205,13 @@ class ServeEngine:
     # composes at the params level, orthogonal to slot scheduling).
     mesh: object | None = None
     slot_axis: str = "data"
+    # Prefix caching: with ``prefix_bucket`` set (< prompt_bucket), the k/v
+    # of each distinct ``prompt[:prefix_bucket]`` is stored once (LRU over
+    # ``prefix_cache_entries``); later prompts sharing it skip the prefix's
+    # prefill compute — the shared-system-prompt serving optimization.
+    # Token streams are bit-identical with caching on or off (tested).
+    prefix_bucket: int | None = None
+    prefix_cache_entries: int = 8
 
     _cache: KVCache = field(init=False)
     _last: jax.Array = field(init=False)
@@ -235,6 +290,29 @@ class ServeEngine:
         self._prefill_fn = jax.jit(
             functools.partial(_prefill_into_slot, cfg=cfg, top_k=self.top_k)
         )
+        from collections import OrderedDict
+
+        self._prefix_store: OrderedDict = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self._suffix_fn = self._extract_fn = None  # fail fast when disabled
+        if self.prefix_bucket is not None:
+            if not 0 < self.prefix_bucket < self.prompt_bucket:
+                raise ValueError(
+                    f"prefix_bucket ({self.prefix_bucket}) must be in "
+                    f"(0, prompt_bucket={self.prompt_bucket})"
+                )
+            if self.prefix_cache_entries < 1:
+                raise ValueError("prefix_cache_entries must be >= 1")
+            self._suffix_fn = jax.jit(
+                functools.partial(
+                    _prefill_suffix_into_slot, cfg=cfg, top_k=self.top_k,
+                    prefix_bucket=self.prefix_bucket,
+                )
+            )
+            self._extract_fn = jax.jit(
+                functools.partial(_extract_prefix, prefix_bucket=self.prefix_bucket)
+            )
 
     # -- public API --------------------------------------------------------
     def free_slots(self) -> int:
@@ -266,10 +344,29 @@ class ServeEngine:
         padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
         request_id = self._next_id
         base_key = jax.random.PRNGKey(request_id if seed is None else seed)
-        first_tok, self._cache = self._prefill_fn(
-            self.params, self._cache, padded, len(prompt), slot,
-            jnp.float32(temperature), base_key,
+        prefix_key = (
+            tuple(prompt[: self.prefix_bucket])
+            if self.prefix_bucket is not None and len(prompt) > self.prefix_bucket
+            else None
         )
+        if prefix_key is not None and prefix_key in self._prefix_store:
+            self._prefix_store.move_to_end(prefix_key)  # LRU touch
+            pk, pv = self._prefix_store[prefix_key]
+            self.prefix_hits += 1
+            first_tok, self._cache = self._suffix_fn(
+                self.params, self._cache, pk, pv, padded, len(prompt), slot,
+                jnp.float32(temperature), base_key,
+            )
+        else:
+            first_tok, self._cache = self._prefill_fn(
+                self.params, self._cache, padded, len(prompt), slot,
+                jnp.float32(temperature), base_key,
+            )
+            if prefix_key is not None:
+                self.prefix_misses += 1
+                self._prefix_store[prefix_key] = self._extract_fn(self._cache, slot)
+                while len(self._prefix_store) > self.prefix_cache_entries:
+                    self._prefix_store.popitem(last=False)
         self._next_id += 1
         self._slots[slot] = _Slot(
             request_id, list(prompt) + [int(first_tok)], len(prompt), max_tokens

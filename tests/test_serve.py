@@ -214,3 +214,91 @@ class TestShardedServing:
     def test_unknown_slot_axis_is_a_clear_error(self):
         with pytest.raises(ValueError, match="slot_axis"):
             _engine(n_slots=4, mesh=self._mesh(), slot_axis="model")
+
+
+class TestPrefixCaching:
+    """Shared-prefix admission: the prefix's prefill compute is paid once;
+    token streams are BIT-IDENTICAL with caching on or off."""
+
+    SYS = _prompt(40, 6)  # the shared "system prompt" (prefix_bucket=6)
+
+    def _drain(self, eng, prompts, max_tokens=8):
+        ids = {eng.submit(p, max_tokens=max_tokens): p for p in prompts}
+        eng.run_until_drained()
+        return {c.request_id: c.tokens for c in eng.completions()}, ids
+
+    def test_hit_is_bit_identical_to_reference(self):
+        eng = _engine(prefix_bucket=6)
+        prompts = [self.SYS + _prompt(s, 4) for s in (41, 42, 43)]
+        done, ids = self._drain(eng, prompts)
+        assert eng.prefix_misses == 1 and eng.prefix_hits == 2
+        for rid, prompt in ids.items():
+            assert done[rid] == _reference(prompt, 8)
+
+    def test_on_off_streams_identical(self):
+        prompts = [self.SYS + _prompt(s, 4) for s in (44, 45)]
+        on, ids_on = self._drain(_engine(prefix_bucket=6), prompts)
+        off, ids_off = self._drain(_engine(), prompts)
+        assert [on[r] for r in sorted(on)] == [off[r] for r in sorted(off)]
+
+    def test_short_prompt_bypasses_store(self):
+        eng = _engine(prefix_bucket=6)
+        eng.submit(_prompt(46, 5), max_tokens=4)  # shorter than the prefix
+        eng.run_until_drained()
+        assert eng.prefix_hits == 0 and eng.prefix_misses == 0
+        assert len(eng._prefix_store) == 0
+
+    def test_lru_eviction(self):
+        eng = _engine(prefix_bucket=6, prefix_cache_entries=2)
+        a, b, c = (_prompt(s, 6) for s in (47, 48, 49))
+
+        def serve(pre):
+            eng.submit(pre + _prompt(50, 3), max_tokens=2)
+            eng.run_until_drained()
+
+        serve(a), serve(b)              # store: {a, b} (2 misses)
+        serve(a)                        # HIT a -> LRU order b, a
+        serve(c)                        # cap 2: evicts b (oldest)
+        assert len(eng._prefix_store) == 2
+        assert eng.prefix_misses == 3 and eng.prefix_hits == 1
+        serve(a)                        # a survived the eviction: hit
+        assert eng.prefix_hits == 2
+        serve(b)                        # b did not: miss again
+        assert eng.prefix_misses == 4
+
+    def test_hit_with_sampling_matches_unsuffixed_engine(self):
+        """Sampled requests through the hit path reproduce the no-cache
+        engine exactly (same stateless step keys)."""
+        prompts = [self.SYS + _prompt(s, 4) for s in (53, 54)]
+
+        def run(eng):
+            ids = [eng.submit(p, max_tokens=6, temperature=0.8, seed=7) for p in prompts]
+            eng.run_until_drained()
+            return {c.request_id: c.tokens for c in eng.completions()}
+
+        assert run(_engine(prefix_bucket=6)) == run(_engine())
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError, match="prefix_bucket"):
+            _engine(prefix_bucket=16)  # == prompt_bucket
+        with pytest.raises(ValueError, match="prefix_cache_entries"):
+            _engine(prefix_bucket=4, prefix_cache_entries=0)
+
+
+    def test_sharded_engine_prefix_hits_match_unsharded(self):
+        """Prefix caching on the DP-sharded engine: hit-path streams equal
+        the unsharded engine's, and the stored prefix entries replicate
+        cleanly across the mesh (the hit path mixes sharded cache rows with
+        replicated prefix arrays)."""
+        mesh = TestShardedServing._mesh(TestShardedServing(), 4)
+        sys_p = _prompt(60, 6)
+        prompts = [sys_p + _prompt(61 + i, 3) for i in range(3)]
+        results = []
+        for m in (None, mesh):
+            eng = _engine(n_slots=4, mesh=m, prefix_bucket=6)
+            ids = [eng.submit(p, max_tokens=6) for p in prompts]
+            eng.run_until_drained()
+            done = {c.request_id: c.tokens for c in eng.completions()}
+            results.append([done[i] for i in ids])
+            assert eng.prefix_misses == 1 and eng.prefix_hits == 2
+        assert results[0] == results[1]
