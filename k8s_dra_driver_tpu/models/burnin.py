@@ -55,9 +55,23 @@ FLAGSHIP = ModelConfig(
 TINY = ModelConfig()
 
 
+def block_matrix_shapes(cfg: ModelConfig) -> dict:
+    """THE shapes of a transformer block's matmul weights — single source
+    of truth shared by `init_params` and adapter construction
+    (models/lora.py), so a layout change (e.g. GQA shrinking qkv) breaks
+    loudly at one definition instead of deep in a jitted merge."""
+    return {
+        "qkv": (cfg.d_model, 3 * cfg.d_model),
+        "attn_out": (cfg.d_model, cfg.d_model),
+        "mlp_up": (cfg.d_model, cfg.d_ff),
+        "mlp_down": (cfg.d_ff, cfg.d_model),
+    }
+
+
 def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
     keys = iter(jax.random.split(key, 4 + 4 * cfg.n_layers))
     scale = cfg.d_model**-0.5
+    shapes = block_matrix_shapes(cfg)
 
     def dense(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
@@ -72,11 +86,11 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
         params["blocks"].append(
             {
                 "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
-                "qkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
-                "attn_out": dense(next(keys), (cfg.d_model, cfg.d_model)),
+                "qkv": dense(next(keys), shapes["qkv"]),
+                "attn_out": dense(next(keys), shapes["attn_out"]),
                 "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
-                "mlp_up": dense(next(keys), (cfg.d_model, cfg.d_ff)),
-                "mlp_down": dense(next(keys), (cfg.d_ff, cfg.d_model)),
+                "mlp_up": dense(next(keys), shapes["mlp_up"]),
+                "mlp_down": dense(next(keys), shapes["mlp_down"]),
             }
         )
     return params

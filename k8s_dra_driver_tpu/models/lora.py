@@ -1,0 +1,160 @@
+"""LoRA: low-rank adapter fine-tuning for the burn-in transformer.
+
+Fine-tuning a full model re-writes every weight; LoRA freezes the base
+and learns a rank-``r`` update per targeted matrix — ``W' = W +
+(alpha/r) * A @ B`` with ``A: [in, r]``, ``B: [r, out]`` — cutting
+trainable state (and optimizer memory, the real HBM cost: adam carries
+2x params) by orders of magnitude.  TPU-idiomatic shape: the merge is a
+pair of small matmuls fused into the step, the train step is the same
+``value_and_grad`` + optax wiring as full training (`burnin.make_sgd_step`
+pattern) but differentiates ONLY the adapters, and serving pays ZERO
+overhead because adapters merge back into plain weight matrices
+(`merge`) that every downstream path — decode, serving engine, int8
+quantization, speculative drafting — consumes unchanged.
+
+Exactness contracts (tested):
+* fresh adapters (``B = 0``) merge to the base weights BIT-identically,
+  so enabling LoRA cannot change a model before training does;
+* a train step moves adapters only — the base pytree is untouched bits;
+* decode on ``merge(base, adapters)`` equals the adapted model's forward.
+
+Reference parity note: the reference driver has no training stack (its
+data plane is CUDA inside user pods — SURVEY.md §2.11); this is
+consumer-side capability of the TPU framework.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_dra_driver_tpu.models import burnin
+from k8s_dra_driver_tpu.models.burnin import ModelConfig, TrainStepFns
+
+# The transformer-block matmuls (the bulk of the parameters — the same
+# set weight-only quantization targets, models/quant.py).
+DEFAULT_TARGETS = ("qkv", "attn_out", "mlp_up", "mlp_down")
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple = DEFAULT_TARGETS
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    def validate(self, cfg: ModelConfig) -> None:
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        bad = [t for t in self.targets if t not in DEFAULT_TARGETS]
+        if bad:
+            raise ValueError(f"unknown LoRA targets {bad}; valid: {DEFAULT_TARGETS}")
+        if not self.targets:
+            raise ValueError("LoRA needs at least one target matrix")
+        if self.rank >= cfg.d_model:
+            raise ValueError(
+                f"rank {self.rank} >= d_model {cfg.d_model}: low-rank in name only"
+            )
+
+
+def init_adapters(key: jax.Array, cfg: ModelConfig, lora: LoraConfig) -> dict:
+    """A gaussian with std 1/r (f32 — tiny, and adam moments want the
+    precision), B = 0: the merged model starts EXACTLY at the base
+    (A @ 0 = 0), and the A scale only sets the early learning signal's
+    magnitude through dB.  Shapes come from `burnin.block_matrix_shapes`
+    — the one layout definition."""
+    lora.validate(cfg)
+    dims = burnin.block_matrix_shapes(cfg)
+    blocks = []
+    keys = jax.random.split(key, cfg.n_layers * len(lora.targets))
+    ki = iter(keys)
+    for _ in range(cfg.n_layers):
+        blk = {}
+        for name in lora.targets:
+            d_in, d_out = dims[name]
+            blk[name] = {
+                "a": jax.random.normal(next(ki), (d_in, lora.rank), jnp.float32)
+                * (1.0 / lora.rank),
+                "b": jnp.zeros((lora.rank, d_out), jnp.float32),
+            }
+        blocks.append(blk)
+    return {"blocks": blocks}
+
+
+def merge(params: dict, adapters: dict, lora: LoraConfig) -> dict:
+    """Plain params with ``W + scale * A @ B`` folded in (compute in f32,
+    cast back to the weight dtype) — what decode/serving/quantization
+    consume.  With B = 0 this is bit-identity: A @ 0 = 0 exactly, and the
+    f32 round trip of a bf16 weight is exact."""
+    out = dict(params)
+    out["blocks"] = [
+        {
+            name: (
+                w.astype(jnp.float32)
+                + lora.scale * (ad[name]["a"] @ ad[name]["b"])
+            ).astype(w.dtype)
+            if name in ad
+            else w
+            for name, w in blk.items()
+        }
+        for blk, ad in zip(params["blocks"], adapters["blocks"])
+    ]
+    return out
+
+
+def adapter_param_count(adapters: dict) -> int:
+    return sum(x.size for x in jax.tree.leaves(adapters))
+
+
+def build_lora_train_step(
+    cfg: ModelConfig,
+    lora: LoraConfig = LoraConfig(),
+    lr: float = 1e-3,
+    attention: str = "dense",
+) -> TrainStepFns:
+    """(init, step) for adapter-only fine-tuning.
+
+    ``init(key) -> (adapters, opt_state)``;
+    ``step(adapters, opt_state, base_params, tokens) -> (adapters,
+    opt_state, loss)``.  The base is an ARGUMENT, not a closure: closed-over
+    arrays bake into the compiled program as constants (compile-time bloat
+    and a re-trace per base), while an argument donates/shards like any
+    other input.  Gradients flow through the merge into A/B only — the
+    base is never differentiated, and adam state exists only for adapters
+    (the memory win that makes LoRA LoRA).
+    """
+    lora.validate(cfg)
+    if attention not in ("dense", "flash"):
+        raise ValueError(f"attention must be 'dense' or 'flash', got {attention!r}")
+    flash_fn = None
+    if attention == "flash":
+        from k8s_dra_driver_tpu.ops.flash_attention import flash_attention
+
+        interpret = jax.devices()[0].platform != "tpu"
+        flash_fn = functools.partial(flash_attention, interpret=interpret)
+    opt = optax.adam(lr)
+
+    def init(key):
+        adapters = init_adapters(key, cfg, lora)
+        return adapters, opt.init(adapters)
+
+    def loss(adapters, base_params, tokens):
+        merged = merge(base_params, adapters, lora)
+        return burnin.loss_fn(merged, tokens, cfg, None, flash_fn)
+
+    def step(adapters, opt_state, base_params, tokens):
+        val, grads = jax.value_and_grad(loss)(adapters, base_params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return adapters, opt_state, val
+
+    return TrainStepFns(
+        init=jax.jit(init), step=jax.jit(step, donate_argnums=(0, 1))
+    )

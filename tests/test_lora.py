@@ -1,0 +1,136 @@
+"""LoRA adapter fine-tuning (models/lora.py).
+
+Contracts: B=0 merges bit-identically to the base; training moves
+adapters only; merged weights serve through every downstream path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, decode, lora
+from k8s_dra_driver_tpu.models.quant import quantize_blocks
+
+CFG = burnin.ModelConfig(
+    vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32
+)
+LORA = lora.LoraConfig(rank=4, alpha=8.0)
+
+
+@pytest.fixture(scope="module")
+def base():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return burnin.sample_tokens(jax.random.PRNGKey(1), CFG, batch=4, seq=16)
+
+
+def _tree_equal(a, b) -> bool:
+    return all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+class TestInitAndMerge:
+    def test_fresh_adapters_merge_to_base_bits(self, base):
+        ad = lora.init_adapters(jax.random.PRNGKey(2), CFG, LORA)
+        assert _tree_equal(lora.merge(base, ad, LORA), base)
+
+    def test_fresh_adapters_do_not_change_forward(self, base, tokens):
+        ad = lora.init_adapters(jax.random.PRNGKey(2), CFG, LORA)
+        want = burnin.forward(base, tokens, cfg=CFG)
+        got = burnin.forward(lora.merge(base, ad, LORA), tokens, cfg=CFG)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_nonzero_b_changes_targeted_weights_only(self, base):
+        ad = lora.init_adapters(jax.random.PRNGKey(2), CFG, LORA)
+        ad["blocks"][0]["qkv"]["b"] = jnp.ones_like(ad["blocks"][0]["qkv"]["b"])
+        merged = lora.merge(base, ad, LORA)
+        assert not bool(
+            jnp.array_equal(merged["blocks"][0]["qkv"], base["blocks"][0]["qkv"])
+        )
+        assert bool(
+            jnp.array_equal(merged["blocks"][1]["qkv"], base["blocks"][1]["qkv"])
+        )
+        assert bool(jnp.array_equal(merged["embed"], base["embed"]))
+
+    def test_subset_targets(self, base):
+        cfg_sub = lora.LoraConfig(rank=4, targets=("qkv",))
+        ad = lora.init_adapters(jax.random.PRNGKey(2), CFG, cfg_sub)
+        assert set(ad["blocks"][0]) == {"qkv"}
+        assert _tree_equal(lora.merge(base, ad, cfg_sub), base)
+
+    def test_adapter_count_is_small(self, base):
+        ad = lora.init_adapters(jax.random.PRNGKey(2), CFG, LORA)
+        n_base = sum(x.size for x in jax.tree.leaves(base))
+        assert lora.adapter_param_count(ad) < n_base / 4
+
+    def test_bad_configs_rejected(self):
+        with pytest.raises(ValueError, match="rank"):
+            lora.LoraConfig(rank=0).validate(CFG)
+        with pytest.raises(ValueError, match="unknown"):
+            lora.LoraConfig(targets=("embed",)).validate(CFG)
+        with pytest.raises(ValueError, match="low-rank"):
+            lora.LoraConfig(rank=CFG.d_model).validate(CFG)
+        with pytest.raises(ValueError, match="at least one"):
+            lora.LoraConfig(targets=()).validate(CFG)
+
+
+class TestTraining:
+    def test_loss_decreases_and_base_untouched(self, base, tokens):
+        fns = lora.build_lora_train_step(CFG, LORA, lr=5e-2)
+        adapters, opt_state = fns.init(jax.random.PRNGKey(3))
+        base_before = jax.tree.map(lambda x: np.asarray(x).copy(), base)
+        losses = []
+        for _ in range(15):
+            adapters, opt_state, loss = fns.step(adapters, opt_state, base, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.9, losses
+        assert _tree_equal(base, base_before)  # frozen means frozen
+
+    def test_gradients_hit_every_adapter(self, base, tokens):
+        fns = lora.build_lora_train_step(CFG, LORA, lr=5e-2)
+        adapters, opt_state = fns.init(jax.random.PRNGKey(3))
+        before = jax.tree.map(lambda x: np.asarray(x).copy(), adapters)
+        for _ in range(2):  # step 1 trains only B (A@dB); step 2 reaches A
+            adapters, opt_state, _ = fns.step(adapters, opt_state, base, tokens)
+        moved = [
+            not np.array_equal(x, y)
+            for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(adapters))
+        ]
+        assert all(moved), "every A and B must receive updates"
+
+    def test_trained_adapters_transfer_through_merge(self, base, tokens):
+        """The served model (merged) computes what training computed."""
+        fns = lora.build_lora_train_step(CFG, LORA, lr=5e-2)
+        adapters, opt_state = fns.init(jax.random.PRNGKey(3))
+        for _ in range(5):
+            adapters, opt_state, loss = fns.step(adapters, opt_state, base, tokens)
+        merged = lora.merge(base, adapters, LORA)
+        served_loss = float(burnin.loss_fn(merged, tokens, CFG))
+        # the NEXT step's reported loss is computed from the same adapters
+        _, _, train_loss = fns.step(adapters, opt_state, base, tokens)
+        assert served_loss == pytest.approx(float(train_loss), rel=1e-3)  # bf16 cross-program fusion noise
+
+
+class TestDownstreamPaths:
+    def test_merged_model_decodes(self, base, tokens):
+        ad = lora.init_adapters(jax.random.PRNGKey(4), CFG, LORA)
+        ad["blocks"][0]["qkv"]["b"] = (
+            jnp.ones_like(ad["blocks"][0]["qkv"]["b"]) * 0.01
+        )
+        merged = lora.merge(base, ad, LORA)
+        prompt = tokens[:2, :6]
+        out = decode.greedy_decode(merged, prompt, 8, cfg=CFG, batch_prefill=True)
+        want = decode.greedy_decode(merged, prompt, 8, cfg=CFG)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+    def test_merged_model_quantizes(self, base):
+        ad = lora.init_adapters(jax.random.PRNGKey(4), CFG, LORA)
+        q = quantize_blocks(lora.merge(base, ad, LORA))
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        out = decode.greedy_decode(q, prompt, 4, cfg=CFG)
+        assert out.shape == (1, 8)

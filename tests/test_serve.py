@@ -237,8 +237,8 @@ class TestPrefixCaching:
 
     def test_on_off_streams_identical(self):
         prompts = [self.SYS + _prompt(s, 4) for s in (44, 45)]
-        on, ids_on = self._drain(_engine(prefix_bucket=6), prompts)
-        off, ids_off = self._drain(_engine(), prompts)
+        on, _ = self._drain(_engine(prefix_bucket=6), prompts)
+        off, _ = self._drain(_engine(), prompts)
         assert [on[r] for r in sorted(on)] == [off[r] for r in sorted(off)]
 
     def test_short_prompt_bypasses_store(self):
