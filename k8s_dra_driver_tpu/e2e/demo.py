@@ -130,6 +130,58 @@ def main() -> None:
     state.refresh()
     print(f"  after `tpu-parted apply -c whole-host-only`: {shapes()}")
 
+    print("\n== scheduler extender: filter -> prioritize -> bind over real HTTP ==")
+    import urllib.request
+
+    from k8s_dra_driver_tpu.kube.objects import ObjectMeta, Pod
+    from k8s_dra_driver_tpu.scheduler.extender import SchedulerExtender
+
+    ext_cluster = make_cluster(hosts=2, topology="v5e-16")
+    ext = SchedulerExtender(ext_cluster.server)
+    ext.start()
+
+    def post(verb, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ext.port}/{verb}",
+            data=json.dumps(body).encode(), method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    # Pre-warm host-0 so the MostAllocated policy has something to prefer.
+    warm = ext_cluster.server.create(simple_claim("warm", count=3))
+    ext_cluster.allocator.allocate(
+        warm, node_name="tpu-host-0",
+        node_labels=ext_cluster.node_labels("tpu-host-0"),
+    )
+    ext_cluster.server.create(simple_claim("ext-claim"))
+    ext_cluster.server.create(Pod(
+        metadata=ObjectMeta(name="ext-pod", namespace="default", uid="uid-ext"),
+        spec={"resourceClaims": [{"name": "t", "resourceClaimName": "ext-claim"}]},
+    ))
+    pod_doc = {
+        "metadata": {"name": "ext-pod", "namespace": "default", "uid": "uid-ext"},
+        "spec": {"resourceClaims": [{"name": "t", "resourceClaimName": "ext-claim"}]},
+    }
+    nodes = ["tpu-host-0", "tpu-host-1"]
+    f = post("filter", {"pod": pod_doc, "nodenames": nodes})
+    print(f"  /filter: feasible={f['nodenames']} failed={f['failedNodes']}")
+    scores = post("prioritize", {"pod": pod_doc, "nodenames": f["nodenames"]})
+    print(f"  /prioritize (MostAllocated): "
+          f"{ {e['host']: e['score'] for e in scores} }")
+    best = max(scores, key=lambda e: e["score"])["host"]
+    if best != "tpu-host-0":
+        raise SystemExit("BUG: packing must prefer the pre-warmed host")
+    b = post("bind", {"podName": "ext-pod", "podNamespace": "default",
+                      "podUID": "uid-ext", "node": best})
+    if b["error"]:
+        raise SystemExit(f"BUG: bind failed: {b['error']}")
+    bound = ext_cluster.server.get("ResourceClaim", "ext-claim", "default")
+    devices = ext_cluster.nodes[best].state.prepare(bound)
+    print(f"  /bind -> {best}; kubelet prepares: "
+          f"{[d['device_name'] for d in devices]}")
+    ext.stop()
+
     print("\n== sharing walkthrough: 4 pods x 4 differently-shared claims ==")
     from k8s_dra_driver_tpu.e2e.spec_runner import apply_spec
 

@@ -41,10 +41,33 @@ class ModelConfig:
     d_ff: int = 512
     max_seq: int = 256
     dtype: jnp.dtype = jnp.bfloat16
+    # Grouped-query attention: K/V project to this many heads (queries keep
+    # n_heads; each KV head serves n_heads/n_kv_heads query heads).  None =
+    # multi-head attention (every path identical to before).  The win is
+    # the KV CACHE: serving memory shrinks by n_heads/n_kv_heads, which is
+    # what bounds slot count x context length (models/serve.py).
+    n_kv_heads: int | None = None
+
+    def __post_init__(self):
+        if self.n_kv_heads is not None and (
+            self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads
+        ):
+            raise ValueError(
+                f"n_kv_heads ({self.n_kv_heads}) must divide n_heads ({self.n_heads})"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def kv_groups(self) -> int:
+        """Query heads per KV head (1 = plain MHA)."""
+        return self.n_heads // self.kv_heads
 
 
 # Flagship default: big enough that the MXU (not dispatch overhead) dominates
@@ -61,7 +84,8 @@ def block_matrix_shapes(cfg: ModelConfig) -> dict:
     (models/lora.py), so a layout change (e.g. GQA shrinking qkv) breaks
     loudly at one definition instead of deep in a jitted merge."""
     return {
-        "qkv": (cfg.d_model, 3 * cfg.d_model),
+        # fused [q | k | v]: q keeps n_heads, k/v shrink to kv_heads (GQA)
+        "qkv": (cfg.d_model, (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim),
         "attn_out": (cfg.d_model, cfg.d_model),
         "mlp_up": (cfg.d_model, cfg.d_ff),
         "mlp_down": (cfg.d_ff, cfg.d_model),
@@ -133,18 +157,30 @@ def _full_attention(q, k, v):
 
 
 def qkv_proj(x, p, cfg: ModelConfig):
-    """ln1 + fused QKV projection -> q/k/v [B, S, H, hd].  Shared with the
-    incremental decode path (models/decode.py) so the two can't drift."""
+    """ln1 + fused QKV projection -> q [B, S, H, hd], k/v [B, S, Hkv, hd].
+    Shared with the incremental decode path (models/decode.py) so the two
+    can't drift.  With GQA (kv_heads < n_heads) k/v carry fewer heads —
+    the cache-facing shape; training paths widen them via `repeat_kv`."""
     b, s, _ = x.shape
-    h, hd = cfg.n_heads, cfg.head_dim
+    h, hkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     y = _rms_norm(x, p["ln1"])
     qkv = jnp.einsum("bsd,de->bse", y, _mat(p["qkv"]))
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [h * hd, (h + hkv) * hd], axis=-1)
     return (
         q.reshape(b, s, h, hd),
-        k.reshape(b, s, h, hd),
-        v.reshape(b, s, h, hd),
+        k.reshape(b, s, hkv, hd),
+        v.reshape(b, s, hkv, hd),
     )
+
+
+def repeat_kv(kv, cfg: ModelConfig):
+    """Widen [B, S, Hkv, hd] -> [B, S, H, hd] for attention paths that want
+    one KV head per query head (training: dense/flash/ring — GQA saves no
+    FLOPs there, only cache bytes; decode keeps the narrow shape and uses
+    the grouped einsum instead, decode._masked_attention)."""
+    if cfg.kv_groups == 1:
+        return kv
+    return jnp.repeat(kv, cfg.kv_groups, axis=2)
 
 
 def mlp_residual(x, p):
@@ -163,7 +199,9 @@ def tied_logits(x, params):
 def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
     b, s, d = x.shape
     q, k, v = qkv_proj(x, p, cfg)
-    attn = attn_fn(q, k, v).reshape(b, s, d)
+    # Training widens GQA k/v to one head per query head: every attention
+    # backend (dense/flash/ring/ulysses) then sees the MHA shape it knows.
+    attn = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
     x = _constrain(x, act_spec)
     return _constrain(mlp_residual(x, p), act_spec)

@@ -30,7 +30,9 @@ from k8s_dra_driver_tpu.models.quant import mat as _mat
 
 
 class KVCache(NamedTuple):
-    """Per-layer stacked K/V: [L, B, max_seq, H, head_dim]."""
+    """Per-layer stacked K/V: [L, B, max_seq, Hkv, head_dim].  With GQA
+    the head dim is ``cfg.kv_heads`` — the cache is the thing GQA shrinks
+    (serving memory = slots x max_seq x Hkv x hd per layer)."""
 
     k: jax.Array
     v: jax.Array
@@ -39,7 +41,7 @@ class KVCache(NamedTuple):
 def init_cache(
     cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.float32
 ) -> KVCache:
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -50,27 +52,77 @@ def _masked_attention(q, k, v, mask):
     bandwidth it exists to save.  One implementation so the numerics parity
     between batched prefill and sequential decode cannot drift.
 
-    mask: broadcastable to [B, H, Q, K]; masked-out scores get -1e30."""
+    GQA: when q carries G = Hq/Hkv times more heads than k/v, the grouped
+    einsum contracts each KV head against its G query heads directly — the
+    narrow cache is never materialized wide (no jnp.repeat of [B,K,Hq,hd]
+    on the bandwidth-bound decode path).
+
+    mask: broadcastable to [B, H, Q, K] (the head axis broadcasts across
+    grouped heads too); masked-out scores get -1e30."""
     d = q.shape[-1]
+    hq, hkv = q.shape[2], k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    if hq == hkv:
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q.astype(k.dtype),
+                k,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            probs.astype(v.dtype),
+            v,
+            preferred_element_type=jnp.float32,
+        )
+        return out.astype(q.dtype)
+    groups = hq // hkv
+    b, s_q = q.shape[0], q.shape[1]
+    qg = q.reshape(b, s_q, hkv, groups, d)
     scores = (
         jnp.einsum(
-            "bqhd,bkhd->bhqk",
-            q.astype(k.dtype),
+            "bqhgd,bkhd->bhgqk",
+            qg.astype(k.dtype),
             k,
             preferred_element_type=jnp.float32,
         )
         * scale
     )
-    scores = jnp.where(mask, scores, -1e30)
+    # Align the mask's head axis with the grouped [B, Hkv, G, Q, K] scores:
+    # a broadcast head axis stays broadcast; a FULL per-query-head axis
+    # (ALiBi-style) splits into its (kv-head, group) factors.  Anything
+    # else is ambiguous — fail loudly rather than silently reinterpret a
+    # per-KV-head mask as per-query-head.
+    if mask.ndim == 4:
+        if mask.shape[1] == 1:
+            gmask = mask[:, :, None]
+        elif mask.shape[1] == hq:
+            gmask = mask.reshape(mask.shape[0], hkv, groups, *mask.shape[2:])
+        else:
+            raise ValueError(
+                f"GQA mask head axis must be 1 or n_heads ({hq}), got {mask.shape[1]}"
+            )
+    elif mask.ndim == 3 and mask.shape[0] != 1:
+        raise ValueError(
+            f"ambiguous 3-d GQA mask with leading axis {mask.shape[0]}: "
+            "pass [B, H, Q, K] (H = 1 or n_heads) or [Q, K]/[K]"
+        )
+    else:
+        gmask = mask  # trailing [Q, K]/[K] axes broadcast against the scores
+    scores = jnp.where(gmask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd",
+        "bhgqk,bkhd->bqhgd",
         probs.astype(v.dtype),
         v,
         preferred_element_type=jnp.float32,
     )
-    return out.astype(q.dtype)
+    return out.reshape(b, s_q, hq, d).astype(q.dtype)
 
 
 def decode_chunk(
@@ -112,7 +164,7 @@ def decode_chunk(
 
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
-        q, k, v = qkv_proj(x, p, cfg)  # [B, S, H, hd]
+        q, k, v = qkv_proj(x, p, cfg)  # q: [B, S, H, hd]; k/v: [B, S, Hkv, hd]
         k_new = k.astype(new_k.dtype)
         v_new = v.astype(new_v.dtype)
         if active is not None:

@@ -35,6 +35,14 @@ from k8s_dra_driver_tpu.ops.pipeline import pipeline_apply, stack_blocks, stage_
 def _headmajor_qkv(w, cfg: ModelConfig):
     """[D, q|k|v packed] -> [D, head-major (h, 3, hd)] so TP column shards
     hold whole heads."""
+    if cfg.kv_heads != cfg.n_heads:
+        # GQA packs [q(Hq) | k(Hkv) | v(Hkv)] — the 3-equal-chunk head-major
+        # repack below would scramble it.  Shard-whole-(q-head + its kv
+        # group) repacking is a follow-up; fail loudly, not numerically.
+        raise NotImplementedError(
+            "pipeline TP variant supports MHA only (n_kv_heads == n_heads); "
+            f"got n_heads={cfg.n_heads} n_kv_heads={cfg.kv_heads}"
+        )
     d = cfg.d_model
     return (
         w.reshape(d, 3, cfg.n_heads, cfg.head_dim)

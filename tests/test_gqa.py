@@ -1,0 +1,163 @@
+"""Grouped-query attention (ModelConfig.n_kv_heads).
+
+Contracts: MHA (n_kv_heads=None) is byte-for-byte the old behavior; GQA
+shrinks the KV cache by n_heads/n_kv_heads; every decode path (chunk,
+step, prefill, serving engine, speculative) agrees with the training
+forward on the narrow cache."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, decode, lora, speculative
+from k8s_dra_driver_tpu.models.quant import quantize_blocks
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+GQA = burnin.ModelConfig(
+    vocab_size=96, d_model=64, n_heads=8, n_kv_heads=2, n_layers=2,
+    d_ff=96, max_seq=64,
+)
+MHA = burnin.ModelConfig(
+    vocab_size=96, d_model=64, n_heads=8, n_layers=2, d_ff=96, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), GQA)
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, GQA.vocab_size)
+
+
+class TestConfig:
+    def test_rejects_non_divisible(self):
+        with pytest.raises(ValueError, match="divide"):
+            burnin.ModelConfig(n_heads=8, n_kv_heads=3)
+        with pytest.raises(ValueError, match="divide"):
+            burnin.ModelConfig(n_heads=8, n_kv_heads=0)
+
+    def test_mha_defaults_unchanged(self):
+        assert MHA.kv_heads == MHA.n_heads and MHA.kv_groups == 1
+        assert burnin.block_matrix_shapes(MHA)["qkv"] == (64, 3 * 64)
+
+    def test_gqa_shrinks_qkv_and_cache(self):
+        # q: 8 heads, k/v: 2 heads -> (8 + 2*2) * hd columns
+        assert burnin.block_matrix_shapes(GQA)["qkv"] == (64, 12 * 8)
+        cache = decode.init_cache(GQA, batch=2, max_seq=16)
+        assert cache.k.shape == (2, 2, 16, 2, 8)  # Hkv=2, 4x smaller
+        wide = decode.init_cache(MHA, batch=2, max_seq=16)
+        assert wide.k.size == 4 * cache.k.size
+
+
+class TestGroupedAttention:
+    def test_grouped_equals_explicit_repeat(self):
+        """The grouped einsum is exactly repeat-then-MHA (same contraction
+        per element — the narrow cache is a layout choice, not math)."""
+        key = jax.random.PRNGKey(2)
+        b, sq, k_len, hkv, g, hd = 2, 3, 10, 2, 4, 8
+        q = jax.random.normal(key, (b, sq, hkv * g, hd))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, k_len, hkv, hd))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, k_len, hkv, hd))
+        mask = (jnp.arange(k_len) < 7)[None, None, None, :]
+        got = decode._masked_attention(q, k, v, mask)
+        # reference: widen kv so each query head gets its group's kv head
+        k_w = jnp.repeat(k, g, axis=2)
+        v_w = jnp.repeat(v, g, axis=2)
+        want = decode._masked_attention(q, k_w, v_w, mask)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+
+
+class TestDecodePaths:
+    def test_teacher_forced_chunk_matches_forward(self, params, prompt):
+        logits_fwd = burnin.forward(params, prompt, cfg=GQA)
+        cache = decode.init_cache(GQA, prompt.shape[0], 16)
+        logits_chunk, _ = decode.decode_chunk(params, cache, prompt, 0, cfg=GQA)
+        np.testing.assert_allclose(
+            np.asarray(logits_chunk), np.asarray(logits_fwd), rtol=5e-2, atol=5e-2
+        )
+
+    def test_prefill_modes_agree(self, params, prompt):
+        a = decode.greedy_decode(params, prompt, 10, cfg=GQA, batch_prefill=True)
+        b = decode.greedy_decode(params, prompt, 10, cfg=GQA, batch_prefill=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_serving_engine_matches_greedy(self, params, prompt):
+        eng = ServeEngine(params, GQA, n_slots=2, prompt_bucket=16)
+        p = [int(t) for t in prompt[0]]
+        rid = eng.submit(p, max_tokens=8)
+        eng.run_until_drained()
+        got = [c for c in eng.completions() if c.request_id == rid][0].tokens
+        want = decode.greedy_decode(
+            params, prompt[:1], 8, cfg=GQA, batch_prefill=True
+        )
+        assert got == [int(t) for t in want[0]]
+
+    def test_speculative_greedy_exact(self, params, prompt):
+        out = speculative.speculative_decode(
+            params, quantize_blocks(params), prompt, 12, GQA, gamma=3
+        )
+        want = decode.greedy_decode(params, prompt, 12, cfg=GQA, batch_prefill=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+class TestTraining:
+    def test_train_step_learns(self, params):
+        fns = burnin.build_train_step(GQA, lr=5e-2)
+        p, opt = fns.init(jax.random.PRNGKey(3))
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(4), GQA, batch=4, seq=16)
+        first = last = None
+        for i in range(10):
+            p, opt, loss = fns.step(p, opt, tokens)
+            first = float(loss) if i == 0 else first
+            last = float(loss)
+        assert last < first
+
+    def test_lora_composes(self, params):
+        lc = lora.LoraConfig(rank=4)
+        ad = lora.init_adapters(jax.random.PRNGKey(5), GQA, lc)
+        assert ad["blocks"][0]["qkv"]["b"].shape == (4, 12 * 8)  # GQA columns
+        merged = lora.merge(params, ad, lc)
+        assert all(
+            bool(jnp.array_equal(a, b))
+            for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(params))
+        )
+
+    def test_pipeline_tp_rejects_gqa_loudly(self, params):
+        from k8s_dra_driver_tpu.models import pp_burnin
+
+        with pytest.raises(NotImplementedError, match="MHA only"):
+            pp_burnin.pp_params_from_dense(params, GQA)
+
+    def test_full_head_mask_splits_into_groups(self):
+        """ALiBi-style per-query-head masks work on the grouped path."""
+        key = jax.random.PRNGKey(6)
+        b, sq, k_len, hkv, g, hd = 1, 2, 8, 2, 4, 8
+        hq = hkv * g
+        q = jax.random.normal(key, (b, sq, hq, hd))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, k_len, hkv, hd))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, k_len, hkv, hd))
+        # distinct per-query-head key windows
+        heads = jnp.arange(hq)[None, :, None, None]
+        mask = jnp.arange(k_len)[None, None, None, :] < (heads % k_len) + 1
+        got = decode._masked_attention(q, k, v, mask)
+        want = decode._masked_attention(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), mask
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+
+    def test_ambiguous_masks_rejected(self):
+        key = jax.random.PRNGKey(7)
+        q = jax.random.normal(key, (1, 2, 8, 8))
+        k = jax.random.normal(key, (1, 4, 2, 8))
+        with pytest.raises(ValueError, match="head axis"):
+            decode._masked_attention(q, k, k, jnp.ones((1, 2, 2, 4), bool))
+        with pytest.raises(ValueError, match="ambiguous"):
+            decode._masked_attention(q, k, k, jnp.ones((8, 2, 4), bool))
