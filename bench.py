@@ -171,6 +171,21 @@ def _data_plane_body() -> dict:
             out["matmul_int8_tops"] = round(matmul_int8_tops(size=4096, chain=128), 1)
         except Exception as exc:  # noqa: BLE001
             out["matmul_int8_tops"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # GQA serving: same model geometry with n_kv_heads=2 — the KV
+        # cache (and its per-step read traffic) shrinks 4x.  Weights are
+        # fresh-init: decode THROUGHPUT is value-independent, and the
+        # point is the cache-bandwidth delta vs the "decode" block.
+        try:
+            import dataclasses
+
+            gqa_cfg = dataclasses.replace(cfg, n_kv_heads=2)
+            gqa_params = burnin.init_params(jax.random.PRNGKey(5), gqa_cfg)
+            out["decode_gqa"] = {
+                **_decode_throughput(gqa_cfg, gqa_params),
+                "kv_heads": 2,
+            }
+        except Exception as exc:  # noqa: BLE001
+            out["decode_gqa"] = {"error": f"{type(exc).__name__}: {exc}"}
         # Greedy speculative decode, int8 self-draft: exact bf16 output,
         # several tokens per target pass when the burn-in-trained weights
         # are confident.  Reported next to "decode" (same batch/steps), so

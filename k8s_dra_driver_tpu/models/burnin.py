@@ -47,6 +47,13 @@ class ModelConfig:
     # the KV CACHE: serving memory shrinks by n_heads/n_kv_heads, which is
     # what bounds slot count x context length (models/serve.py).
     n_kv_heads: int | None = None
+    # Rotary position embeddings: q/k rotate by absolute position inside
+    # the projection (replacing the learned pos_embed table), so relative
+    # offsets fall out of dot products and the context length is not tied
+    # to a table size.  Rotated keys land in the KV cache, so decode needs
+    # no re-rotation.  False = learned absolute embeddings (unchanged).
+    rope: bool = False
+    rope_base: float = 10000.0
 
     def __post_init__(self):
         if self.n_kv_heads is not None and (
@@ -54,6 +61,11 @@ class ModelConfig:
         ):
             raise ValueError(
                 f"n_kv_heads ({self.n_kv_heads}) must divide n_heads ({self.n_heads})"
+            )
+        if self.rope and self.head_dim % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {self.head_dim} "
+                f"(d_model {self.d_model} / n_heads {self.n_heads})"
             )
 
     @property
@@ -102,7 +114,13 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
 
     params = {
         "embed": dense(next(keys), (cfg.vocab_size, cfg.d_model)),
-        "pos_embed": dense(next(keys), (cfg.max_seq, cfg.d_model)),
+        # RoPE replaces the learned position table entirely (positions are
+        # encoded in the q/k rotation, qkv_proj) — no dead parameter.
+        **(
+            {}
+            if cfg.rope
+            else {"pos_embed": dense(next(keys), (cfg.max_seq, cfg.d_model))}
+        ),
         "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
         "blocks": [],
     }
@@ -130,12 +148,14 @@ def param_pspecs(cfg: ModelConfig) -> dict:
         "mlp_up": P(None, "model"),
         "mlp_down": P("model", None),
     }
-    return {
+    out = {
         "embed": P("model", None),  # vocab-sharded embedding
-        "pos_embed": P(),
         "ln_f": P(),
         "blocks": [dict(block) for _ in range(cfg.n_layers)],
     }
+    if not cfg.rope:  # the table exists only without RoPE; specs must match
+        out["pos_embed"] = P()
+    return out
 
 
 def _rms_norm(x, gamma):
@@ -156,21 +176,53 @@ def _full_attention(q, k, v):
     return reference_attention(q, k, v, causal=True)
 
 
-def qkv_proj(x, p, cfg: ModelConfig):
+def rope_rotate(x, positions, cfg: ModelConfig):
+    """Rotary embedding: rotate [..., S, H, hd] by ``positions`` ([S] or
+    [B, S]) in HALF-SPLIT pairs — feature i rotates with feature i+hd/2
+    (the GPT-NeoX / "rotate_half" convention, NOT the interleaved
+    even/odd one; checkpoints trained under the other convention need a
+    feature permutation on import).  Angles in f32 (bf16 loses position
+    resolution fast), output back in x's dtype."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = cfg.rope_base ** (
+        -jnp.arange(0, half, dtype=jnp.float32) * 2.0 / hd
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., S, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x.astype(jnp.float32)[..., :half]
+    x2 = x.astype(jnp.float32)[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def qkv_proj(x, p, cfg: ModelConfig, positions=None):
     """ln1 + fused QKV projection -> q [B, S, H, hd], k/v [B, S, Hkv, hd].
     Shared with the incremental decode path (models/decode.py) so the two
     can't drift.  With GQA (kv_heads < n_heads) k/v carry fewer heads —
-    the cache-facing shape; training paths widen them via `repeat_kv`."""
+    the cache-facing shape; training paths widen them via `repeat_kv`.
+
+    With ``cfg.rope``, q and k rotate by absolute position HERE — before
+    any attention backend and before the cache write — so every consumer
+    (dense/flash/ring/ulysses, chunked decode, speculation) inherits RoPE
+    without knowing it exists.  ``positions``: [S] or [B, S]; defaults to
+    ``arange(S)`` (the training forward's implicit positions)."""
     b, s, _ = x.shape
     h, hkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     y = _rms_norm(x, p["ln1"])
     qkv = jnp.einsum("bsd,de->bse", y, _mat(p["qkv"]))
     q, k, v = jnp.split(qkv, [h * hd, (h + hkv) * hd], axis=-1)
-    return (
-        q.reshape(b, s, h, hd),
-        k.reshape(b, s, hkv, hd),
-        v.reshape(b, s, hkv, hd),
-    )
+    q = q.reshape(b, s, h, hd)
+    k = k.reshape(b, s, hkv, hd)
+    v = v.reshape(b, s, hkv, hd)
+    if cfg.rope:
+        if positions is None:
+            positions = jnp.arange(s, dtype=jnp.int32)
+        q = rope_rotate(q, positions, cfg)
+        k = rope_rotate(k, positions, cfg)
+    return q, k, v
 
 
 def repeat_kv(kv, cfg: ModelConfig):
@@ -212,7 +264,9 @@ def forward(
 ) -> jax.Array:
     """tokens [B,S] int32 -> logits [B,S,V] (f32)."""
     s = tokens.shape[1]
-    x = params["embed"][tokens] + params["pos_embed"][:s]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos_embed"][:s]
     x = _constrain(x, act_spec)
     block = functools.partial(
         _block, cfg=cfg, act_spec=act_spec, attn_fn=attn_fn or _full_attention

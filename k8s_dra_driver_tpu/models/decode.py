@@ -155,7 +155,9 @@ def decode_chunk(
     pos0 = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
     positions = pos0[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [B, S]
     rows = jnp.arange(b)
-    x = params["embed"][tokens] + params["pos_embed"][positions]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos_embed"][positions]
 
     k_limit = cache.k.shape[2] if k_window is None else k_window
     k_pos = jnp.arange(k_limit)
@@ -164,7 +166,10 @@ def decode_chunk(
 
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
-        q, k, v = qkv_proj(x, p, cfg)  # q: [B, S, H, hd]; k/v: [B, S, Hkv, hd]
+        # q: [B, S, H, hd]; k/v: [B, S, Hkv, hd].  positions flow in so
+        # RoPE rotates by ABSOLUTE position mid-stream (cache holds
+        # rotated keys; history needs no re-rotation).
+        q, k, v = qkv_proj(x, p, cfg, positions=positions)
         k_new = k.astype(new_k.dtype)
         v_new = v.astype(new_v.dtype)
         if active is not None:

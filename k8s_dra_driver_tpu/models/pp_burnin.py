@@ -35,6 +35,11 @@ from k8s_dra_driver_tpu.ops.pipeline import pipeline_apply, stack_blocks, stage_
 def _headmajor_qkv(w, cfg: ModelConfig):
     """[D, q|k|v packed] -> [D, head-major (h, 3, hd)] so TP column shards
     hold whole heads."""
+    if cfg.rope:
+        raise NotImplementedError(
+            "pipeline TP variant supports learned positions only (rope=False); "
+            "RoPE plumbing through the stage scan is a follow-up"
+        )
     if cfg.kv_heads != cfg.n_heads:
         # GQA packs [q(Hq) | k(Hkv) | v(Hkv)] — the 3-equal-chunk head-major
         # repack below would scramble it.  Shard-whole-(q-head + its kv

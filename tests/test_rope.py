@@ -1,0 +1,151 @@
+"""Rotary position embeddings (ModelConfig.rope).
+
+Contracts: rotation happens once in qkv_proj so every attention backend
+and decode path inherits it; rotated keys live in the cache (no
+re-rotation at decode); rope=False remains the byte-identical default."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, decode, speculative
+from k8s_dra_driver_tpu.models.quant import quantize_blocks
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+ROPE = burnin.ModelConfig(
+    vocab_size=96, d_model=64, n_heads=8, n_layers=2, d_ff=96, max_seq=64,
+    rope=True,
+)
+ROPE_GQA = burnin.ModelConfig(
+    vocab_size=96, d_model=64, n_heads=8, n_kv_heads=2, n_layers=2, d_ff=96,
+    max_seq=64, rope=True,
+)
+
+
+@pytest.fixture(scope="module", params=[ROPE, ROPE_GQA], ids=["mha", "gqa"])
+def cfg(request):
+    return request.param
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return burnin.init_params(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def prompt(cfg):
+    return jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab_size)
+
+
+class TestRotation:
+    def test_preserves_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 5, 4, 16))
+        rot = burnin.rope_rotate(x, jnp.arange(5), ROPE)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(rot), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_scores_depend_on_relative_offset_only(self):
+        """dot(rot(q, i), rot(k, j)) is a function of i - j — the property
+        that makes RoPE a RELATIVE encoding."""
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, 16))
+        k = jax.random.normal(jax.random.PRNGKey(4), (1, 1, 1, 16))
+
+        def score(i, j):
+            qi = burnin.rope_rotate(q, jnp.array([i]), ROPE)
+            kj = burnin.rope_rotate(k, jnp.array([j]), ROPE)
+            return float(jnp.sum(qi * kj))
+
+        assert score(7, 3) == pytest.approx(score(17, 13), rel=1e-5)
+        assert score(7, 3) != pytest.approx(score(7, 5), rel=1e-3)
+
+    def test_per_row_positions(self):
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 3, 2, 8))
+        per_row = jnp.array([[0, 1, 2], [10, 11, 12]])
+        got = burnin.rope_rotate(x, per_row, ROPE)
+        row1 = burnin.rope_rotate(x[1:], jnp.arange(10, 13), ROPE)
+        np.testing.assert_allclose(np.asarray(got[1:]), np.asarray(row1), rtol=1e-6)
+
+
+class TestConfigAndParams:
+    def test_odd_head_dim_rejected(self):
+        with pytest.raises(ValueError, match="even head_dim"):
+            burnin.ModelConfig(d_model=36, n_heads=4, rope=True)
+
+    def test_no_pos_embed_param(self, params):
+        assert "pos_embed" not in params
+        assert "pos_embed" not in burnin.param_pspecs(ROPE)
+
+    def test_default_still_has_pos_embed(self):
+        p = burnin.init_params(jax.random.PRNGKey(0), burnin.TINY)
+        assert "pos_embed" in p
+
+
+class TestDecodePaths:
+    def test_teacher_forced_chunk_matches_forward(self, cfg, params, prompt):
+        logits_fwd = burnin.forward(params, prompt, cfg=cfg)
+        cache = decode.init_cache(cfg, prompt.shape[0], 16)
+        logits_chunk, _ = decode.decode_chunk(params, cache, prompt, 0, cfg=cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits_chunk), np.asarray(logits_fwd), rtol=5e-2, atol=5e-2
+        )
+
+    def test_prefill_modes_agree(self, cfg, params, prompt):
+        a = decode.greedy_decode(params, prompt, 10, cfg=cfg, batch_prefill=True)
+        b = decode.greedy_decode(params, prompt, 10, cfg=cfg, batch_prefill=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_serving_engine_matches_greedy(self, cfg, params, prompt):
+        eng = ServeEngine(params, cfg, n_slots=2, prompt_bucket=16)
+        p = [int(t) for t in prompt[0]]
+        rid = eng.submit(p, max_tokens=8)
+        eng.run_until_drained()
+        got = [c for c in eng.completions() if c.request_id == rid][0].tokens
+        want = decode.greedy_decode(params, prompt[:1], 8, cfg=cfg, batch_prefill=True)
+        assert got == [int(t) for t in want[0]]
+
+    def test_speculative_greedy_exact(self, cfg, params, prompt):
+        out = speculative.speculative_decode(
+            params, quantize_blocks(params), prompt, 12, cfg, gamma=3
+        )
+        want = decode.greedy_decode(params, prompt, 12, cfg=cfg, batch_prefill=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+class TestTraining:
+    def test_train_step_learns(self, cfg):
+        fns = burnin.build_train_step(cfg, lr=5e-2)
+        p, opt = fns.init(jax.random.PRNGKey(3))
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(4), cfg, batch=4, seq=16)
+        first = last = None
+        for i in range(10):
+            p, opt, loss = fns.step(p, opt, tokens)
+            first = float(loss) if i == 0 else first
+            last = float(loss)
+        assert last < first
+
+    def test_mesh_train_step_compiles_and_runs(self):
+        """param_pspecs without pos_embed must match the rope param tree
+        under a real DP/TP mesh."""
+        import numpy as np_
+
+        from jax.sharding import Mesh
+
+        from tests.conftest import cpu_devices
+
+        mesh = Mesh(np_.array(cpu_devices(4)).reshape(2, 1, 2), ("data", "seq", "model"))
+        fns = burnin.build_train_step(ROPE, mesh=mesh)
+        p, opt = fns.init(jax.random.PRNGKey(5))
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(6), ROPE, batch=4, seq=16)
+        _, _, loss = fns.step(p, opt, tokens)
+        assert np.isfinite(float(loss))
+
+    def test_pipeline_tp_rejects_rope_loudly(self):
+        from k8s_dra_driver_tpu.models import pp_burnin
+
+        params = burnin.init_params(jax.random.PRNGKey(0), ROPE)
+        with pytest.raises(NotImplementedError, match="learned positions"):
+            pp_burnin.pp_params_from_dense(params, ROPE)
