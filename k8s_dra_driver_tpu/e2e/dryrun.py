@@ -93,6 +93,27 @@ def run_dryrun(n_devices: int) -> None:
         f"loss={float(loss):.4f}"
     )
 
+    # Modern attention family on the same mesh: GQA (narrow KV heads) +
+    # RoPE (no position table in the param tree — pspecs must agree) with
+    # DP/SP/TP shardings; the multi-chip artifact covers the serving-era
+    # config, not just the classic one.
+    import dataclasses
+
+    modern = dataclasses.replace(cfg, n_kv_heads=cfg.n_heads // 4, rope=True)
+    fns_m = burnin.build_train_step(modern, mesh=mesh)
+    with mesh:
+        params_m, opt_m = fns_m.init(jax.random.PRNGKey(0))
+        tokens_m = jax.device_put(
+            burnin.sample_tokens(jax.random.PRNGKey(1), modern, batch=4 * shape.data, seq=64),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data", None)),
+        )
+        params_m, opt_m, loss_m = fns_m.step(params_m, opt_m, tokens_m)
+        jax.block_until_ready(loss_m)
+    print(
+        f"dryrun_multichip: mesh data={shape.data} seq={shape.seq} model={shape.model} "
+        f"(gqa kv={modern.kv_heads} + rope) loss={float(loss_m):.4f}"
+    )
+
     if n_devices >= 4 and n_devices % 4 == 0:
         from k8s_dra_driver_tpu.models import pp_burnin
 

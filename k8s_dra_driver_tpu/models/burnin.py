@@ -87,6 +87,12 @@ class ModelConfig:
 FLAGSHIP = ModelConfig(
     vocab_size=32768, d_model=1024, n_heads=16, n_layers=8, d_ff=4096, max_seq=1024
 )
+# The serving-era variant: 4x-narrower KV cache + rotary positions — what
+# the single-chip compile check exercises (__graft_entry__.entry).
+FLAGSHIP_MODERN = ModelConfig(
+    vocab_size=32768, d_model=1024, n_heads=16, n_kv_heads=4, n_layers=8,
+    d_ff=4096, max_seq=1024, rope=True,
+)
 TINY = ModelConfig()
 
 
