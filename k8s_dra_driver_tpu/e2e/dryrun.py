@@ -76,43 +76,37 @@ def run_dryrun(n_devices: int) -> None:
     shape = auto_mesh_shape(n_devices, want_seq=True)
     mesh = build_mesh(devices, shape)
     cfg = burnin.TINY
-    # attention="flash" on a seq-sharded mesh = flash RING attention (pallas
-    # kernel per k/v block, lse merge over the ring) — the flagship
-    # long-context path must be what the multi-chip artifact proves.
-    fns = burnin.build_train_step(cfg, mesh=mesh, attention="flash")
-    with mesh:
-        params, opt_state = fns.init(jax.random.PRNGKey(0))
-        tokens = jax.device_put(
-            burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4 * shape.data, seq=64),
-            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data", None)),
-        )
-        params, opt_state, loss = fns.step(params, opt_state, tokens)
-        jax.block_until_ready(loss)
-    print(
-        f"dryrun_multichip: mesh data={shape.data} seq={shape.seq} model={shape.model} "
-        f"loss={float(loss):.4f}"
-    )
-
-    # Modern attention family on the same mesh: GQA (narrow KV heads) +
-    # RoPE (no position table in the param tree — pspecs must agree) with
-    # DP/SP/TP shardings; the multi-chip artifact covers the serving-era
-    # config, not just the classic one.
+    # Two attention families over the SAME DP/SP/TP mesh:
+    # * classic (learned positions, MHA) with attention="flash" — on a
+    #   seq-sharded mesh that is flash RING attention (pallas kernel per
+    #   k/v block, lse merge over the ring), the long-context path the
+    #   multi-chip artifact must prove;
+    # * modern (GQA narrow KV + RoPE — no position table in the param
+    #   tree, so pspecs must agree), the serving-era config.
     import dataclasses
 
     modern = dataclasses.replace(cfg, n_kv_heads=cfg.n_heads // 4, rope=True)
-    fns_m = burnin.build_train_step(modern, mesh=mesh)
-    with mesh:
-        params_m, opt_m = fns_m.init(jax.random.PRNGKey(0))
-        tokens_m = jax.device_put(
-            burnin.sample_tokens(jax.random.PRNGKey(1), modern, batch=4 * shape.data, seq=64),
-            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data", None)),
+    for leg_cfg, kwargs, tag in (
+        (cfg, {"attention": "flash"}, ""),
+        (modern, {}, f"(gqa kv={modern.kv_heads} + rope) "),
+    ):
+        fns = burnin.build_train_step(leg_cfg, mesh=mesh, **kwargs)
+        with mesh:
+            params, opt_state = fns.init(jax.random.PRNGKey(0))
+            tokens = jax.device_put(
+                burnin.sample_tokens(
+                    jax.random.PRNGKey(1), leg_cfg, batch=4 * shape.data, seq=64
+                ),
+                jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec("data", None)
+                ),
+            )
+            params, opt_state, loss = fns.step(params, opt_state, tokens)
+            jax.block_until_ready(loss)
+        print(
+            f"dryrun_multichip: mesh data={shape.data} seq={shape.seq} "
+            f"model={shape.model} {tag}loss={float(loss):.4f}"
         )
-        params_m, opt_m, loss_m = fns_m.step(params_m, opt_m, tokens_m)
-        jax.block_until_ready(loss_m)
-    print(
-        f"dryrun_multichip: mesh data={shape.data} seq={shape.seq} model={shape.model} "
-        f"(gqa kv={modern.kv_heads} + rope) loss={float(loss_m):.4f}"
-    )
 
     if n_devices >= 4 and n_devices % 4 == 0:
         from k8s_dra_driver_tpu.models import pp_burnin

@@ -302,3 +302,36 @@ class TestPrefixCaching:
             results.append([done[i] for i in ids])
             assert eng.prefix_misses == 1 and eng.prefix_hits == 2
         assert results[0] == results[1]
+
+
+class TestServingMetrics:
+    def test_engine_activity_lands_in_registry(self):
+        """Serving counters surface through the shared /metrics registry
+        (utils/diagnostics.py scrape path) — the data-plane counterpart of
+        the driver's claim-latency histogram."""
+        from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+        def sample():
+            out = {}
+            for line in REGISTRY.render().splitlines():
+                if line.startswith("tpu_serve_") and " " in line:
+                    name, val = line.rsplit(" ", 1)
+                    out[name] = float(val)
+            return out
+
+        before = sample()
+        eng = _engine(prefix_bucket=6)
+        sys_p = _prompt(70, 6)
+        eng.submit(sys_p + _prompt(71, 3), max_tokens=4)
+        eng.submit(sys_p + _prompt(72, 3), max_tokens=4)
+        eng.run_until_drained()
+        after = sample()
+
+        def delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        assert delta("tpu_serve_requests_total") == 2
+        assert delta("tpu_serve_completions_total") == 2
+        assert delta("tpu_serve_tokens_total") == 8  # 4 generated each
+        assert delta('tpu_serve_prefix_cache_total{outcome="hit"}') == 1
+        assert delta('tpu_serve_prefix_cache_total{outcome="miss"}') == 1
