@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="kube-scheduler extender webhook port (/filter,/prioritize,/bind); "
         "-1 disables, 0 = ephemeral",
     )
+    p.add_argument(
+        "--extender-tls-cert", default=os.environ.get("EXTENDER_TLS_CERT", ""),
+        help="PEM certificate for the extender webhook; with --extender-tls-key, "
+        "serves HTTPS (scheduler policy side: enableHTTPS: true)",
+    )
+    p.add_argument(
+        "--extender-tls-key", default=os.environ.get("EXTENDER_TLS_KEY", ""),
+        help="PEM private key for the extender webhook",
+    )
     return p
 
 
@@ -111,11 +120,26 @@ def main(argv: list[str] | None = None) -> int:
     if args.extender_port >= 0:
         from k8s_dra_driver_tpu.scheduler.extender import SchedulerExtender
 
-        extender = SchedulerExtender(
-            server, port=args.extender_port, bind_host="0.0.0.0"
-        )
+        try:
+            extender = SchedulerExtender(
+                server, port=args.extender_port, bind_host="0.0.0.0",
+                tls_cert=args.extender_tls_cert or None,
+                tls_key=args.extender_tls_key or None,
+            )
+        except ValueError as exc:  # half-specified TLS: fail fast, not open
+            log.error("%s", exc)
+            return 2
         extender.start()
-        log.info("scheduler extender on http://0.0.0.0:%d/filter", extender.port)
+        log.info(
+            "scheduler extender on %s://0.0.0.0:%d/filter",
+            extender.scheme, extender.port,
+        )
+        if extender.scheme == "http":
+            log.warning(
+                "extender is serving PLAIN HTTP and /bind mutates cluster "
+                "state; restrict the Service to the control plane "
+                "(extenderAllowedCIDRs) or provide EXTENDER_TLS_CERT/KEY"
+            )
 
     diagnostics = None
     if args.http_port >= 0:

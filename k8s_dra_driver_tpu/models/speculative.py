@@ -86,13 +86,15 @@ def speculative_decode(
     block count).
 
     Per while-loop round, for every unfinished row: the draft proposes
-    ``gamma`` tokens with sequential int8-cheap steps; the target scores
-    the window ``[last_committed, g_1..g_gamma]`` in one `decode_chunk`;
-    the row advances by (leading agreements) + 1, writing the target's own
-    argmaxes (accepted drafts ARE the target argmaxes, and position n+1
-    gets the correction/bonus token for free).  Rejected-suffix cache
-    entries go stale in place — every consumer masks keys by position and
-    both models re-feed from the committed frontier, so stale slots are
+    ``gamma`` tokens with sequential int8-cheap steps (plus one cache-priming
+    step on the last proposal, so the draft cache covers the bonus position);
+    the target scores the window ``[last_committed, g_1..g_gamma]`` in one
+    `decode_chunk`; the row advances by (leading agreements) + 1 — up to
+    ``gamma + 1`` on full acceptance, the standard bonus token — writing the
+    target's own argmaxes (accepted drafts ARE the target argmaxes, and the
+    final position gets the correction/bonus token for free).  Rejected-suffix
+    cache entries go stale in place — every consumer masks keys by position
+    and both models re-feed from the committed frontier, so stale slots are
     always overwritten before they are first attended.
     """
     b, p_len = prompt.shape
@@ -126,7 +128,11 @@ def speculative_decode(
     draft_step = functools.partial(decode_step, cfg=cfg)
 
     def draft_round(d_cache, tokens, pos, active):
-        """gamma sequential draft steps from each row's frontier."""
+        """gamma sequential draft steps from each row's frontier, plus one
+        cache-priming step: iteration ``gamma`` feeds the last proposal
+        (position pos+gamma) so its draft-cache key exists and full
+        acceptance can commit the gamma+1 bonus token — without it the next
+        round's draft would attend a never-written key slot."""
 
         def body(carry, i):
             cache, toks = carry
@@ -142,9 +148,9 @@ def speculative_decode(
             return (cache, toks), nxt
 
         (cache, toks), proposed = jax.lax.scan(
-            body, (d_cache, tokens), jnp.arange(gamma, dtype=jnp.int32)
+            body, (d_cache, tokens), jnp.arange(gamma + 1, dtype=jnp.int32)
         )
-        return cache, toks, proposed.T  # proposed: [B, gamma]
+        return cache, toks, proposed.T[:, :gamma]  # proposed: [B, gamma]
 
     def cond(carry):
         _, _, _, pos, _ = carry
@@ -170,13 +176,12 @@ def speculative_decode(
 
         matches = (proposed == target[:, :gamma]).astype(jnp.int32)
         n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # leading agreements
-        # Advance caps at gamma (not gamma+1): the draft cache is filled only
-        # through pos+gamma-1 (it fed positions pos..pos+gamma-1), so
-        # committing the bonus token on full acceptance would leave the next
-        # draft step attending a never-written key slot.  On partial
-        # acceptance the +1 is the correction token, whose key the next
-        # round's sequential re-feed rewrites before any query sees it.
-        advance = jnp.where(active, jnp.minimum(n_acc + 1, gamma), 0)
+        # Full acceptance commits n_acc + 1 = gamma + 1 (the standard bonus
+        # token): the priming step in draft_round fed position pos+gamma, so
+        # the draft cache covers every position below the new frontier.  On
+        # partial acceptance the +1 is the correction token, whose key the
+        # next round's sequential re-feed rewrites before any query sees it.
+        advance = jnp.where(active, n_acc + 1, 0)
 
         # Commit: positions pos+1 .. pos+gamma+1 get the target argmaxes
         # (prefix = accepted drafts, then the correction token; the rest is

@@ -48,10 +48,21 @@ MAX_PRIORITY = 10  # upstream extender/v1 MaxExtenderPriority
 
 
 class SchedulerExtender:
-    """HTTP scheduler-extender service over an `Allocator`."""
+    """HTTP(S) scheduler-extender service over an `Allocator`.
+
+    Exposure note: ``/bind`` mutates cluster state (allocates claims,
+    reserves them, writes ``pod.spec.nodeName``) with the controller's
+    credentials, so anything that can reach the Service can drive
+    allocations.  Serve TLS by passing ``tls_cert``/``tls_key`` (the
+    scheduler policy then sets ``enableHTTPS: true``), and restrict the
+    Service to the control plane with a NetworkPolicy — see
+    demo/specs/scheduler/README.md and the helm values
+    ``extenderTLSSecret`` / ``extenderAllowedCIDRs``.
+    """
 
     def __init__(self, server, allocator: Allocator | None = None,
-                 port: int = 0, bind_host: str = "127.0.0.1"):
+                 port: int = 0, bind_host: str = "127.0.0.1",
+                 tls_cert: str | None = None, tls_key: str | None = None):
         self._server = server
         self._allocator = allocator or Allocator(server)
         self._lock = threading.Lock()  # one verb at a time: plan vs bind races
@@ -96,7 +107,38 @@ class SchedulerExtender:
             def log_message(self, *args):  # silence per-request logging
                 pass
 
+            # Bounds a stalled or malicious client: the socket timeout covers
+            # the deferred TLS handshake and request reads, both of which run
+            # in THIS connection's thread (see do_handshake_on_connect below).
+            timeout = 30
+            # Keep-alive (every reply carries Content-Length): the scheduler
+            # issues /filter+/prioritize+/bind per pod per cycle, and under
+            # TLS a close-per-request HTTP/1.0 server would redo the
+            # handshake for each — scheduling-latency for nothing.
+            protocol_version = "HTTP/1.1"
+
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError(
+                "extender TLS requires BOTH tls_cert and tls_key — refusing "
+                "to fail open to plain HTTP on a half-specified config"
+            )
         self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        self.scheme = "http"
+        if tls_cert and tls_key:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls_cert, keyfile=tls_key)
+            # do_handshake_on_connect=False: with a wrapped LISTENING socket
+            # the handshake would otherwise run inside accept() on the
+            # serve_forever thread, so one client that connects and sends
+            # nothing wedges every scheduler webhook call.  Deferred, it runs
+            # on the per-connection handler thread under Handler.timeout.
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
+            self.scheme = "https"
         self.port = self._httpd.server_port
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
 

@@ -18,6 +18,7 @@
 // one JSON object per line; every response carries "ok".
 
 #include <arpa/inet.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/un.h>
@@ -279,24 +280,53 @@ struct JsonParser {
   }
 
   JsonPtr parse_number() {
+    // Strict JSON number grammar (mirrors the Python json module, which the
+    // wire-compatible Python daemon uses): -?(0|[1-9][0-9]*)(.[0-9]+)?
+    // ([eE][+-]?[0-9]+)?.  Signs are legal only in the leading position and
+    // directly after e/E, and nothing past the grammar is consumed — so
+    // malformed input like {"quantum_ms": 12-3} fails at the residue instead
+    // of being silently read as 12.
     skip_ws();
     const char* start = p;
-    if (p < end && (*p == '-' || *p == '+')) p++;
-    bool is_double = false;
-    while (p < end && (std::isdigit(static_cast<unsigned char>(*p)) || *p == '.' ||
-                       *p == 'e' || *p == 'E' || *p == '-' || *p == '+')) {
-      if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+    auto digit = [&]() {
+      return p < end && std::isdigit(static_cast<unsigned char>(*p));
+    };
+    if (p < end && *p == '-') p++;
+    if (!digit()) fail("bad number");
+    if (*p == '0') {
       p++;
+    } else {
+      while (digit()) p++;
+    }
+    bool is_double = false;
+    if (p < end && *p == '.') {
+      is_double = true;
+      p++;
+      if (!digit()) fail("bad number");
+      while (digit()) p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      is_double = true;
+      p++;
+      if (p < end && (*p == '-' || *p == '+')) p++;
+      if (!digit()) fail("bad number");
+      while (digit()) p++;
     }
     std::string text(start, p - start);
-    if (text.empty()) fail("bad number");
     auto j = std::make_shared<Json>();
     if (is_double) {
       j->type = Json::Type::Double;
       j->d = std::strtod(text.c_str(), nullptr);
     } else {
-      j->type = Json::Type::Int;
-      j->i = std::stoll(text);
+      try {
+        j->type = Json::Type::Int;
+        j->i = std::stoll(text);
+      } catch (const std::out_of_range&) {
+        // Beyond int64: degrade to double rather than erroring, matching the
+        // Python daemon's acceptance of arbitrary-precision integers.
+        j->type = Json::Type::Double;
+        j->d = std::strtod(text.c_str(), nullptr);
+      }
     }
     return j;
   }
@@ -409,6 +439,14 @@ class Daemon {
     return error("unknown op '" + op + "'");
   }
 
+  // Wakes every acquire() waiter so in-flight requests drain promptly at
+  // shutdown instead of sleeping out their timeout while run() joins them.
+  void stop() {
+    std::lock_guard<std::mutex> lock(mu_);
+    stopping_ = true;
+    cond_.notify_all();
+  }
+
  private:
   static JsonPtr error(const std::string& msg) {
     auto j = Json::object();
@@ -499,12 +537,13 @@ class Daemon {
         j->set("scope", Json::str(scope));
         return j;
       }
+      if (stopping_) return error("daemon shutting down");
       if (now >= deadline) {
         auto j = error("timeout");
         j->set("holder", Json::str(it->second.consumer));
         return j;
       }
-      // Wake on release OR when the current lease would expire.
+      // Wake on release, shutdown, OR when the current lease would expire.
       auto wake = std::min(deadline, it->second.expiry());
       cond_.wait_until(lock, wake);
     }
@@ -536,13 +575,29 @@ class Daemon {
   std::map<std::string, Lease> leases_;
   std::mutex mu_;
   std::condition_variable cond_;
+  bool stopping_ = false;
 };
 
 // ---------------------------------------------------------------------------
 // Socket server: thread per connection, newline-delimited JSON
 // ---------------------------------------------------------------------------
 
-void serve_connection(Daemon* daemon, int fd) {
+// Live-connection registry: run() owns every worker thread it spawns and
+// joins them all before returning, so the Daemon (which lives on main's
+// stack) outlives every thread that can touch it.  Workers deregister their
+// fd when they finish; at shutdown run() shutdown()s the fds still present
+// to unblock their read() loops.
+struct ConnRegistry {
+  std::mutex mu;
+  std::map<long long, int> fds;        // conn id -> fd, while the conn lives
+  std::map<long long, std::thread> threads;
+  std::vector<long long> finished;     // ids whose thread is about to return
+  long long next_id = 0;
+};
+
+void serve_connection(Daemon* daemon, int fd, ConnRegistry* reg, long long id);
+
+void serve_connection_body(Daemon* daemon, int fd) {
   std::string buffer;
   char chunk[4096];
   while (true) {
@@ -571,13 +626,21 @@ void serve_connection(Daemon* daemon, int fd) {
       size_t off = 0;
       while (off < out.size()) {
         ssize_t w = write(fd, out.data() + off, out.size() - off);
-        if (w <= 0) {
-          close(fd);
-          return;
-        }
+        if (w <= 0) return;
         off += w;
       }
     }
+  }
+}
+
+void serve_connection(Daemon* daemon, int fd, ConnRegistry* reg, long long id) {
+  serve_connection_body(daemon, fd);
+  // Deregister BEFORE close: once the fd leaves the map the acceptor can no
+  // longer shutdown() it, so the close below can't race a reused fd number.
+  {
+    std::lock_guard<std::mutex> lock(reg->mu);
+    reg->fds.erase(id);
+    reg->finished.push_back(id);
   }
   close(fd);
 }
@@ -629,14 +692,65 @@ int run(const std::string& socket_path, Daemon* daemon, const std::string& mode)
   std::printf("tpu-topology-daemon: serving %s on %s\n", mode.c_str(),
               socket_path.c_str());
   std::fflush(stdout);
+  ConnRegistry reg;
+  auto reap_finished = [&]() {
+    // Joins threads whose connection loop has ended.  Join happens outside
+    // reg.mu (the worker's deregistration step needs the lock to finish).
+    std::vector<std::thread> done;
+    {
+      std::lock_guard<std::mutex> lock(reg.mu);
+      for (long long id : reg.finished) {
+        auto it = reg.threads.find(id);
+        if (it != reg.threads.end()) {
+          done.push_back(std::move(it->second));
+          reg.threads.erase(it);
+        }
+      }
+      reg.finished.clear();
+    }
+    for (auto& t : done) t.join();
+  };
   while (true) {
+    // Poll with a timeout instead of a bare blocking accept: the periodic
+    // wakeup joins finished workers even while the daemon sits idle, so a
+    // burst of short-lived connections doesn't pin N exited thread stacks
+    // until the next client happens to connect.
+    struct pollfd pfd{};
+    pfd.fd = listener;
+    pfd.events = POLLIN;
+    int pr = poll(&pfd, 1, 1000);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    reap_finished();
+    if (pr == 0) continue;  // timeout tick: reap only
     int fd = accept(listener, nullptr, nullptr);
     if (fd < 0) {
       if (errno == EINTR) continue;
       break;  // listener closed by handle_term: clean shutdown
     }
-    std::thread(serve_connection, daemon, fd).detach();
+    std::lock_guard<std::mutex> lock(reg.mu);
+    long long id = reg.next_id++;
+    reg.fds[id] = fd;
+    reg.threads.emplace(id, std::thread(serve_connection, daemon, fd, &reg, id));
   }
+  // Shutdown: wake acquire() waiters, unblock reads on live connections,
+  // then join every worker so the Daemon outlives all references to it
+  // (detached threads here were a shutdown use-after-free).
+  daemon->stop();
+  {
+    std::lock_guard<std::mutex> lock(reg.mu);
+    for (const auto& kv : reg.fds) shutdown(kv.second, SHUT_RDWR);
+  }
+  std::vector<std::thread> rest;
+  {
+    std::lock_guard<std::mutex> lock(reg.mu);
+    for (auto& kv : reg.threads) rest.push_back(std::move(kv.second));
+    reg.threads.clear();
+    reg.finished.clear();
+  }
+  for (auto& t : rest) t.join();
   g_listener_fd = -1;
   unlink(socket_path.c_str());
   return 0;

@@ -320,3 +320,68 @@ class TestWire:
         pod = _pod(cluster.server, "p1", [{"name": "t", "resourceClaimName": "nope"}])
         out = _post(extender.port, "filter", {"pod": pod, "nodenames": NODES})
         assert "error" in out and out["error"] != ""
+
+    def test_tls_serves_https(self, cluster, tmp_path):
+        """extenderTLSSecret path: with a cert/key pair the webhook serves
+        HTTPS (scheduler policy enableHTTPS: true) — the advisor's mitigation
+        for /bind mutating cluster state over plaintext."""
+        import ssl
+        import subprocess
+
+        cert, key = tmp_path / "tls.crt", tmp_path / "tls.key"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", str(key), "-out", str(cert), "-days", "1",
+                "-subj", "/CN=127.0.0.1",
+            ],
+            check=True, capture_output=True, timeout=60,
+        )
+        ext = SchedulerExtender(
+            cluster.server, tls_cert=str(cert), tls_key=str(key)
+        )
+        assert ext.scheme == "https"
+        ext.start()
+        try:
+            ctx = ssl.create_default_context(cafile=str(cert))
+            ctx.check_hostname = False
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{ext.port}/filter",
+                data=json.dumps(
+                    {"pod": {"metadata": {"name": "p", "namespace": "default"},
+                             "spec": {}},
+                     "nodenames": NODES}
+                ).encode(),
+                method="POST",
+            )
+            out = json.loads(
+                urllib.request.urlopen(req, timeout=10, context=ctx).read()
+            )
+            assert out["nodenames"] == NODES
+
+            # A bare TCP client that connects and sends nothing must NOT
+            # wedge the accept loop (handshake is deferred to the handler
+            # thread): a real TLS request issued while the silent client is
+            # still connected has to succeed.
+            import socket as socketlib
+
+            silent = socketlib.create_connection(("127.0.0.1", ext.port))
+            try:
+                out2 = json.loads(
+                    urllib.request.urlopen(req, timeout=10, context=ctx).read()
+                )
+                assert out2["nodenames"] == NODES
+            finally:
+                silent.close()
+        finally:
+            ext.stop()
+
+    def test_half_specified_tls_fails_closed(self, cluster, tmp_path):
+        """Cert without key (or vice versa) must raise — never silently
+        serve the mutating /bind verb over plain HTTP."""
+        cert = tmp_path / "tls.crt"
+        cert.write_text("not-even-read")
+        with pytest.raises(ValueError, match="BOTH"):
+            SchedulerExtender(cluster.server, tls_cert=str(cert))
+        with pytest.raises(ValueError, match="BOTH"):
+            SchedulerExtender(cluster.server, tls_key=str(cert))

@@ -156,6 +156,74 @@ class TestVariants:
         }
         assert env["EXTENDER_PORT"] == "8090"
 
+    def test_extender_tls_secret_mounts_and_env(self):
+        docs = render_chart_docs(
+            CHART,
+            values_override={"extenderPort": 8090, "extenderTLSSecret": "ext-tls"},
+        )
+        kinds = _by_kind(docs)
+        controller = next(
+            d for d in kinds["Deployment"]
+            if d["metadata"]["name"].endswith("-controller")
+        )
+        spec = controller["spec"]["template"]["spec"]
+        env = {e["name"]: e["value"] for e in spec["containers"][0]["env"]}
+        assert env["EXTENDER_TLS_CERT"] == "/etc/tpu-dra-extender-tls/tls.crt"
+        assert env["EXTENDER_TLS_KEY"] == "/etc/tpu-dra-extender-tls/tls.key"
+        mounts = spec["containers"][0]["volumeMounts"]
+        assert any(
+            m["mountPath"] == "/etc/tpu-dra-extender-tls" and m["readOnly"]
+            for m in mounts
+        )
+        assert any(
+            v.get("secret", {}).get("secretName") == "ext-tls"
+            for v in spec["volumes"]
+        )
+
+    def test_tls_secret_inert_while_extender_disabled(self):
+        """extenderTLSSecret with extenderPort=-1 must not mount the secret:
+        a missing secret would wedge the pod for a feature that is off."""
+        docs = render_chart_docs(
+            CHART, values_override={"extenderTLSSecret": "ext-tls"}
+        )
+        controller = next(
+            d for d in _by_kind(docs)["Deployment"]
+            if d["metadata"]["name"].endswith("-controller")
+        )
+        spec = controller["spec"]["template"]["spec"]
+        assert "volumes" not in spec
+        assert "volumeMounts" not in spec["containers"][0]
+
+    def test_extender_cidrs_render_networkpolicy(self):
+        docs = render_chart_docs(
+            CHART,
+            values_override={
+                "extenderPort": 8090,
+                "extenderAllowedCIDRs": ["10.0.0.0/28", "10.0.1.0/28"],
+            },
+        )
+        kinds = _by_kind(docs)
+        np = next(
+            d for d in kinds["NetworkPolicy"]
+            if d["metadata"]["name"].endswith("-extender")
+        )
+        assert np["spec"]["podSelector"]["matchLabels"][
+            "app.kubernetes.io/component"
+        ] == "controller"
+        rule = np["spec"]["ingress"][0]
+        assert [p["ipBlock"]["cidr"] for p in rule["from"]] == [
+            "10.0.0.0/28", "10.0.1.0/28",
+        ]
+        assert rule["ports"][0]["port"] == 8090
+        # selecting the pod default-denies everything else, so the policy
+        # must carry a second rule keeping the diagnostics port scrapeable
+        diag = np["spec"]["ingress"][1]
+        assert "from" not in diag
+        assert diag["ports"][0]["port"] == 8080
+
+    def test_no_networkpolicy_without_cidrs(self, default_docs):
+        assert "NetworkPolicy" not in _by_kind(default_docs)
+
     def test_membership_disabled_drops_controller(self):
         docs = render_chart_docs(
             CHART, values_override={"deviceClasses": ["tpu", "subslice"]}

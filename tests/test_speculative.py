@@ -147,8 +147,13 @@ class TestSpeculativeDecode:
             params, params, prompt, steps, CFG, gamma=gamma, return_stats=True
         )
         assert float(stats.acceptance) == pytest.approx(1.0)
-        # advance caps at gamma per round -> ceil(steps/gamma) rounds
-        assert int(stats.rounds) == -(-steps // gamma)
+        # full acceptance commits gamma+1 per round (bonus token) ->
+        # ceil(steps/(gamma+1)) rounds
+        assert int(stats.rounds) == -(-steps // (gamma + 1))
+        # stats are batch-summed, so the per-round rate carries a factor of B
+        assert float(stats.tokens_per_round) == pytest.approx(
+            prompt.shape[0] * steps / int(stats.rounds)
+        )
 
     def test_bf16_cache(self, params, prompt):
         """Reduced-precision cache path compiles and emits every token

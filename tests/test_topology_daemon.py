@@ -138,6 +138,40 @@ class TestPerClaimProtocol:
         assert client.info()["ok"]
         client.close()
 
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            b'{"op": "info", "x": 12-3}',   # interior sign / residue
+            b'{"op": "info", "x": +1}',     # leading plus
+            b'{"op": "info", "x": 01}',     # leading zero
+            b'{"op": "info", "x": 1.}',     # bare decimal point
+        ],
+    )
+    def test_malformed_numbers_rejected(self, daemon, payload):
+        """Strict JSON number grammar on BOTH implementations: the native
+        parser must not silently misread `12-3` as 12 (round-2 advisor
+        finding) — it must error exactly like Python's json module."""
+        import socket as socketlib
+
+        s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+        s.connect(daemon.socket_path)
+        s.sendall(payload + b"\n")
+        resp = json.loads(s.makefile("rb").readline())
+        assert not resp["ok"]
+        s.close()
+
+    def test_huge_integer_accepted(self, daemon):
+        """Python parses arbitrary-precision ints; the native daemon must not
+        error on them either (it degrades >int64 to double)."""
+        import socket as socketlib
+
+        s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+        s.connect(daemon.socket_path)
+        s.sendall(b'{"op": "info", "x": 123456789012345678901234567890}\n')
+        resp = json.loads(s.makefile("rb").readline())
+        assert resp["ok"]
+        s.close()
+
 
 class TestLeaseArbitration:
     def test_second_consumer_blocks_until_release(self, daemon):
@@ -273,6 +307,48 @@ class TestProgram:
                 capture_output=True, timeout=10,
             )
             assert proc.returncode == 2, args
+
+    def test_native_sigterm_with_inflight_acquire_exits_clean(
+        self, native_daemon_bin, tmp_path
+    ):
+        """SIGTERM while a worker thread is parked in acquire()'s cond-wait:
+        the daemon must stop(), unblock, JOIN the worker and exit 0 promptly
+        — not leave a detached thread racing Daemon destruction (the round-2
+        advisor's shutdown use-after-free)."""
+        import socket as socketlib
+
+        proc = subprocess.Popen(
+            [str(native_daemon_bin), "--host-mode", "--socket-dir", str(tmp_path)],
+            env={"PATH": "/usr/bin:/bin", "TPU_QUEUE_QUANTUM_MS": "10"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        try:
+            sock = str(tmp_path / "host.sock")
+            deadline = time.time() + 10
+            while time.time() < deadline and not Path(sock).exists():
+                time.sleep(0.02)
+            holder = TopologyDaemonClient(sock, "holder")
+            assert holder.acquire(quantum_ms=60000, scope="z")["ok"]
+            waiter = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+            waiter.connect(sock)
+            waiter.sendall(
+                json.dumps(
+                    {"op": "acquire", "consumer": "w", "scope": "z",
+                     "timeout_ms": 30000}
+                ).encode() + b"\n"
+            )
+            time.sleep(0.3)  # park the worker in the cond-wait
+            start = time.time()
+            proc.terminate()
+            rc = proc.wait(timeout=10)
+            # prompt (stop() wakes the waiter; no 30s timeout drain), clean
+            assert rc == 0
+            assert time.time() - start < 5
+            waiter.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
 
     def test_native_program_serves_host_mode(self, native_daemon_bin, tmp_path):
         """The C++ binary's host mode: lease arbitration over the host

@@ -69,8 +69,27 @@ def main() -> int:
             s.connect(sock)
             s.sendall(b"{broken\n")
             assert not json.loads(s.makefile("rb").readline())["ok"]
+            # interior-sign residue: strict grammar must error, not read 12
+            s.sendall(b'{"op": "info", "x": 12-3}\n')
+            assert not json.loads(s.makefile("rb").readline())["ok"]
             s.close()
             a.close(), b.close()
+            # Shutdown with an in-flight blocked acquire — the round-2
+            # advisor's use-after-free: a worker thread parked in acquire()'s
+            # cond-wait while main destroys the Daemon.  run() now stop()s
+            # the daemon and JOINS every worker, so this must exit clean.
+            holder = TopologyDaemonClient(sock, "holder")
+            assert holder.acquire(quantum_ms=60000, scope="z")["ok"]
+            waiter = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+            waiter.connect(sock)
+            waiter.sendall(
+                json.dumps(
+                    {"op": "acquire", "consumer": "w", "scope": "z",
+                     "timeout_ms": 30000}
+                ).encode() + b"\n"
+            )
+            time.sleep(0.3)  # let the worker park in cond_.wait_until
+            # leave holder + waiter connections open across SIGTERM
         finally:
             proc.terminate()
             rc = proc.wait(timeout=10)
