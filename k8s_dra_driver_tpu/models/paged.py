@@ -1,0 +1,540 @@
+"""Paged KV cache: block pool + allocator + decode over the block table.
+
+The dense serving cache (models/serve.py) reserves ``n_slots x max_seq``
+keys per layer forever — worst-case sized, mostly empty under ragged real
+traffic.  This module stores KV in a shared pool of fixed-size blocks and
+gives each sequence a *block table* (ops/paged_attention.py documents the
+attention side).  What that buys, concretely:
+
+* capacity is ``sum(ceil(len_i/bs))`` blocks, not ``n_slots x max_seq`` —
+  a 32k-context request and thirty short chats share one pool;
+* blocks allocate ON DEMAND as a sequence crosses a block boundary and
+  free the moment it retires — admission control over a counter, not a
+  worst-case reservation;
+* per-step attention traffic follows actual lengths (the pallas kernel
+  skips unused blocks' DMA), where the dense path reads max_seq per slot.
+
+TPU-idiomatic split of labor: the ALLOCATOR is host-side numpy (a free
+list is pointer-chasing — the wrong shape for XLA), while everything
+per-token is jitted with static shapes — the pool, the table, and the
+scatter of new k/v through ``table[row, pos//bs]`` never change shape.
+Pool block 0 is reserved as the NULL block: inactive rows' writes land
+there, so a freed-and-reassigned block can never be clobbered by a stale
+inactive row (write-after-free via duplicate scatter indices is otherwise
+silent corruption under XLA's unordered scatter).
+
+Numerics contract (tested): paged greedy decode reproduces the dense
+path's tokens exactly — paging changes residency, never results.
+
+Reference parity note: the reference driver has no ML data plane
+(SURVEY.md §2.11); consumer-side capability of the TPU framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_dra_driver_tpu.models import decode
+from k8s_dra_driver_tpu.models.burnin import (
+    ModelConfig,
+    mlp_residual,
+    qkv_proj,
+    tied_logits,
+)
+from k8s_dra_driver_tpu.models.quant import mat as _mat
+from k8s_dra_driver_tpu.ops import paged_attention
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+NULL_BLOCK = 0  # reserved: inactive rows scatter here; never allocated
+
+# Pool observability (the serving counters live in models/serve.py and are
+# shared by both engine backends; this gauge is paged-specific).
+_M_POOL_FREE = REGISTRY.gauge(
+    "tpu_serve_kv_pool_free_blocks", "free KV pool blocks right now"
+)
+
+
+class PagedKVCache(NamedTuple):
+    """Per-layer stacked block pools: [L, n_blocks, Hkv, block_size, hd]
+    (head-major — the pallas kernel's DMA tile must be [bs, hd]-trailing,
+    see ops/paged_attention.paged_decode_attention)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+
+def init_paged_cache(
+    cfg: ModelConfig, n_blocks: int, block_size: int, dtype=jnp.float32
+) -> PagedKVCache:
+    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+class OutOfBlocks(RuntimeError):
+    """Pool exhausted — admission control should have said no."""
+
+
+class BlockAllocator:
+    """Host-side free list over pool blocks 1..n_blocks-1 (0 is reserved).
+
+    LIFO reuse on purpose: the hottest blocks (just freed, still resident
+    in whatever cache hierarchy) are handed out first, and tests get
+    deterministic tables.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (one is the null block), got {n_blocks}")
+        self._free = list(range(n_blocks - 1, 0, -1))  # pop() -> lowest id first
+        self.n_blocks = n_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1) -> list[int]:
+        if n > len(self._free):
+            raise OutOfBlocks(
+                f"requested {n} blocks, {len(self._free)} free of {self.n_blocks - 1}"
+            )
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids) -> None:
+        for i in ids:
+            if not 0 < i < self.n_blocks:
+                raise ValueError(f"block id {i} out of range (null block is 0)")
+            if i in self._free:
+                raise ValueError(f"double free of block {i}")
+            self._free.append(int(i))
+
+
+def blocks_needed(tokens: int, block_size: int) -> int:
+    return -(-tokens // block_size)
+
+
+def _attend(q, cache, li, block_table, lengths, attn_impl, interpret):
+    if attn_impl == "kernel":
+        return paged_attention.paged_decode_attention(
+            q, cache.k[li], cache.v[li], block_table, lengths,
+            interpret=interpret,
+        )
+    return paged_attention.paged_attention_xla(
+        q, cache.k[li], cache.v[li], block_table, lengths
+    )
+
+
+def default_attn_impl() -> str:
+    """Pallas kernel on real TPU, gather-XLA elsewhere (CPU tests exercise
+    the kernel explicitly via interpret=True)."""
+    return "kernel" if jax.default_backend() == "tpu" else "xla"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "attn_impl", "interpret")
+)
+def paged_decode_step(
+    params,
+    cache: PagedKVCache,
+    block_table: jax.Array,  # [B, max_blocks] i32
+    token: jax.Array,        # [B] i32 — the token at ``pos``
+    pos: jax.Array,          # [B] i32 per-row depth
+    *,
+    cfg: ModelConfig,
+    active=None,             # [B] bool; inactive rows write the null block
+    attn_impl: str = "xla",
+    interpret: bool = False,
+):
+    """One incremental step over the paged cache — the paged mirror of
+    :func:`decode.decode_step` (same qkv/mlp/logits helpers, so numerics
+    cannot drift).  Returns (logits [B, V] f32, updated cache)."""
+    b = token.shape[0]
+    bs = cache.block_size
+    rows = jnp.arange(b)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+
+    x = params["embed"][token][:, None]  # [B, 1, D]
+    if not cfg.rope:
+        x = x + params["pos_embed"][pos[:, None]]
+
+    block_ids = block_table[rows, pos // bs]
+    offs = pos % bs
+    if active is not None:
+        # stale tables on inactive rows may point at REASSIGNED blocks —
+        # divert their writes to the null block instead of gating values
+        # (a duplicate-index scatter against the new owner is unordered)
+        block_ids = jnp.where(active, block_ids, NULL_BLOCK)
+    lengths = pos + 1
+
+    new_k, new_v = cache.k, cache.v
+    for li, p in enumerate(params["blocks"]):
+        q, k, v = qkv_proj(x, p, cfg, positions=pos[:, None])
+        # pool is [L, N, Hkv, bs, hd]: row r writes [Hkv, hd] at
+        # (block_ids[r], :, offs[r]) — the advanced indices bracket the
+        # head slice, so the result subspace leads with the batch axis.
+        new_k = new_k.at[li, block_ids, :, offs].set(k[:, 0].astype(new_k.dtype))
+        new_v = new_v.at[li, block_ids, :, offs].set(v[:, 0].astype(new_v.dtype))
+        cache = PagedKVCache(k=new_k, v=new_v)
+        attn = _attend(
+            q[:, 0], cache, li, block_table, lengths, attn_impl, interpret
+        ).reshape(b, 1, cfg.d_model)
+        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
+        x = mlp_residual(x, p)
+
+    return tied_logits(x, params)[:, 0], cache
+
+
+def paged_prefill(
+    params,
+    prompt: jax.Array,  # [B, P]
+    cache: PagedKVCache,
+    block_table: jax.Array,  # [B, >= ceil(P/bs)] i32 — disjoint, owned rows
+    *,
+    cfg: ModelConfig,
+):
+    """Fill pool blocks for the whole prompt in ONE parallel forward.
+
+    Runs the dense :func:`decode.prefill` over a prompt-sized scratch cache
+    (P padded to whole blocks), then scatters each block stripe into the
+    rows' pool blocks — admission pays one [B, P] pass, exactly like the
+    dense engine, and the scratch is freed by XLA after the scatter.
+    Returns (cache, logits [B, V] of the last prompt position).
+    """
+    b, p_len = prompt.shape
+    bs = cache.block_size
+    nb = blocks_needed(p_len, bs)
+    p_pad = nb * bs
+    dense, last_logits = decode.prefill(
+        params, prompt, cfg, max_seq=p_pad, cache_dtype=cache.k.dtype
+    )
+    # [L, B, p_pad, Hkv, hd] -> blocks, then head-major to match the pool:
+    # [L, B, nb, Hkv, bs, hd]
+    l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+    kb = dense.k.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
+    vb = dense.v.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
+    ids = block_table[:, :nb]
+    return (
+        PagedKVCache(k=cache.k.at[:, ids].set(kb), v=cache.v.at[:, ids].set(vb)),
+        last_logits,
+    )
+
+
+def _paged_step_all(
+    params, cache, table, tokens, pos, active, temps, keys,
+    *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
+):
+    """One paged decode step for every slot at its own position + the
+    shared sampling tail (serve.sample_next — ONE sampling implementation
+    across backends, so the engines' bit-equality contract cannot drift)."""
+    from k8s_dra_driver_tpu.models import serve
+
+    logits, cache = paged_decode_step(
+        params, cache, table, tokens, pos, cfg=cfg, active=active,
+        attn_impl=attn_impl, interpret=interpret,
+    )
+    return serve.sample_next(logits, pos, temps, keys, top_k=top_k), cache
+
+
+def _paged_first_token(
+    params, cache, table, prompt, plen, slot, temp, key,
+    *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
+):
+    """Admission tail: re-run the per-slot step at ``plen - 1`` over the
+    freshly scattered prefill blocks (idempotent rewrite — same token, same
+    position) and sample the first generated token, mirroring the dense
+    engine's `_commit_row_and_first_token` so the streams agree."""
+    n_slots = table.shape[0]
+    last_tok = prompt[0, plen - 1]
+    pos = jnp.full((n_slots,), plen - 1, jnp.int32)
+    tok, cache = _paged_step_all(
+        params, cache, table,
+        jnp.full((n_slots,), last_tok, jnp.int32),
+        pos,
+        jnp.arange(n_slots) == slot,
+        jnp.full((n_slots,), temp, jnp.float32),
+        jnp.broadcast_to(key, (n_slots, *key.shape)),
+        cfg=cfg, top_k=top_k, attn_impl=attn_impl, interpret=interpret,
+    )
+    return tok[slot], cache
+
+
+@dataclasses.dataclass
+class PagedServeEngine:
+    """Continuous batching over the paged pool — the capacity-first engine.
+
+    Same scheduling contract as `serve.ServeEngine` (submit/step/
+    completions, per-request temperature, eos/max_tokens retirement, token
+    streams bit-identical to the dense engine — tested) with the dense
+    per-slot ``max_seq`` reservation replaced by pool accounting:
+
+    * ``submit`` admits when a slot AND the prompt's blocks are free —
+      capacity is ``n_blocks``, shared across ragged requests, not
+      ``n_slots x max_seq``;
+    * ``step`` allocates a block on demand when a slot's next write
+      crosses a block boundary; if the pool is momentarily empty the slot
+      STALLS for the step (stays resident, generates nothing) and resumes
+      when a retirement frees blocks — backpressure instead of overrun;
+    * retirement frees the slot's blocks immediately (table row reset to
+      the null block).
+
+    Not thread-safe; drive from one loop, like the dense engine.
+    """
+
+    params: dict
+    cfg: ModelConfig
+    n_slots: int = 8
+    n_blocks: int = 65       # pool size incl. the reserved null block
+    block_size: int = 16
+    prompt_bucket: int = 64
+    cache_dtype: object = jnp.float32
+    eos_id: int | None = None
+    top_k: int = 0
+    attn_impl: str | None = None  # None = kernel on TPU, xla elsewhere
+    interpret: bool = False
+
+    def __post_init__(self):
+        cfg = self.cfg
+        if self.prompt_bucket > cfg.max_seq:
+            raise ValueError(
+                f"prompt_bucket ({self.prompt_bucket}) exceeds max_seq ({cfg.max_seq})"
+            )
+        if self.attn_impl is None:
+            self.attn_impl = default_attn_impl()
+        bs = self.block_size
+        self._mb = blocks_needed(cfg.max_seq, bs)        # table width
+        self._mbp = blocks_needed(self.prompt_bucket, bs)  # prefill width
+        self._alloc = BlockAllocator(self.n_blocks)
+        self._cache = init_paged_cache(cfg, self.n_blocks, bs, dtype=self.cache_dtype)
+        self._table_np = np.full((self.n_slots, self._mb), NULL_BLOCK, np.int32)
+        self._table = jnp.asarray(self._table_np)
+        self._owned: list[list[int]] = [[] for _ in range(self.n_slots)]
+        self._last = jnp.zeros((self.n_slots,), jnp.int32)
+        self._pos = jnp.zeros((self.n_slots,), jnp.int32)
+        self._temps = jnp.zeros((self.n_slots,), jnp.float32)
+        self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
+        self._slots: list = [None] * self.n_slots
+        self._next_id = 0
+        self._completions: list = []
+        self.stalled_steps = 0  # slot-steps skipped waiting for a block
+        kw = dict(
+            cfg=cfg, top_k=self.top_k,
+            attn_impl=self.attn_impl, interpret=self.interpret,
+        )
+        self._step_fn = jax.jit(functools.partial(_paged_step_all, **kw))
+        self._first_fn = jax.jit(functools.partial(_paged_first_token, **kw))
+        self._prefill_fn = jax.jit(functools.partial(paged_prefill, cfg=cfg))
+
+    # -- public API --------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return self._alloc.free_blocks
+
+    def free_slots(self) -> int:
+        return sum(1 for s in self._slots if s is None)
+
+    def submit(
+        self,
+        prompt: list[int],
+        max_tokens: int,
+        temperature: float = 0.0,
+        seed: int | None = None,
+    ) -> int:
+        """Admit when a slot AND the prompt's blocks are available; raises
+        RuntimeError otherwise (admission control is the caller's)."""
+        from k8s_dra_driver_tpu.models import serve
+        from k8s_dra_driver_tpu.models.serve import _Slot
+
+        serve.check_submit(prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq)
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            raise RuntimeError("no free slot") from None
+        # blocks for the prompt AND the first generated token's position
+        need = blocks_needed(len(prompt) + 1, self.block_size)
+        try:
+            ids = self._alloc.alloc(need)
+        except OutOfBlocks:
+            raise RuntimeError(
+                f"no free blocks ({need} needed, {self._alloc.free_blocks} free)"
+            ) from None
+        self._owned[slot] = ids
+        self._table_np[slot, :] = NULL_BLOCK
+        self._table_np[slot, :need] = ids
+        self._table = jnp.asarray(self._table_np)
+
+        padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+        padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+        # Prefill writes ceil(bucket/bs) block stripes; entries past the
+        # row's owned blocks are the null block (a scratch sink — those
+        # positions are beyond plen+1 and re-written before ever attended).
+        prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+        self._cache, _ = self._prefill_fn(self.params, padded, self._cache, prefill_row)
+
+        request_id = self._next_id
+        base_key = jax.random.PRNGKey(request_id if seed is None else seed)
+        first_tok, self._cache = self._first_fn(
+            self.params, self._cache, self._table, padded, len(prompt), slot,
+            jnp.float32(temperature), base_key,
+        )
+        self._next_id += 1
+        self._slots[slot] = _Slot(
+            request_id, list(prompt) + [int(first_tok)], len(prompt), max_tokens
+        )
+        self._last = self._last.at[slot].set(first_tok)
+        self._pos = self._pos.at[slot].set(len(prompt))
+        self._temps = self._temps.at[slot].set(temperature)
+        self._keys = self._keys.at[slot].set(base_key)
+        serve._M_REQUESTS.inc()
+        serve._M_TOKENS.inc()  # the admission step's first generated token
+        self._retire(slot)  # max_tokens=1 or eos on the first token
+        self._update_gauges()
+        return request_id
+
+    def step(self) -> int:
+        """Advance every active, non-stalled slot one token; returns the
+        number of slots stepped."""
+        active = np.zeros((self.n_slots,), bool)
+        table_dirty = False
+        pos_np = np.asarray(self._pos)
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            blk = int(pos_np[slot]) // self.block_size
+            if blk >= len(self._owned[slot]):
+                try:
+                    (new_id,) = self._alloc.alloc(1)
+                except OutOfBlocks:
+                    self.stalled_steps += 1  # resumes after a retirement
+                    continue
+                self._owned[slot].append(new_id)
+                self._table_np[slot, blk] = new_id
+                table_dirty = True
+            active[slot] = True
+        if not active.any():
+            return 0
+        if table_dirty:
+            self._table = jnp.asarray(self._table_np)
+        active_j = jnp.asarray(active)
+        next_tok, self._cache = self._step_fn(
+            self.params, self._cache, self._table, self._last, self._pos,
+            active_j, self._temps, self._keys,
+        )
+        self._last = jnp.where(active_j, next_tok, self._last)
+        self._pos = jnp.where(active_j, self._pos + 1, self._pos)
+        toks = np.asarray(next_tok).tolist()
+        from k8s_dra_driver_tpu.models import serve
+
+        serve._M_TOKENS.inc(int(active.sum()))
+        for slot, st in enumerate(self._slots):
+            if st is None or not active[slot]:
+                continue
+            st.tokens.append(toks[slot])
+            self._retire(slot)
+        self._update_gauges()
+        return int(active.sum())
+
+    def run_until_drained(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if self.step() == 0:
+                if self.free_slots() == self.n_slots:
+                    return
+                # every resident slot stalled and nothing can retire to
+                # free a block: the pool is too small for this resident set
+                raise RuntimeError("engine wedged: resident slots, no progress")
+        raise RuntimeError("serving loop did not drain")
+
+    def completions(self) -> list:
+        out, self._completions = self._completions, []
+        return out
+
+    # -- internals ---------------------------------------------------------
+    def _retire(self, slot: int) -> None:
+        from k8s_dra_driver_tpu.models import serve
+
+        done = serve.completion_if_done(
+            self._slots[slot], self.eos_id, self.cfg.max_seq
+        )
+        if done is not None:
+            self._completions.append(done)
+            self._slots[slot] = None
+            self._alloc.free(self._owned[slot])
+            self._owned[slot] = []
+            self._table_np[slot, :] = NULL_BLOCK
+            self._table = jnp.asarray(self._table_np)
+
+    def _update_gauges(self) -> None:
+        from k8s_dra_driver_tpu.models import serve
+
+        serve._M_OCCUPANCY.set(self.n_slots - self.free_slots())
+        _M_POOL_FREE.set(self._alloc.free_blocks)
+
+
+def paged_greedy_decode(
+    params,
+    prompt: jax.Array,
+    steps: int,
+    cfg: ModelConfig,
+    *,
+    block_size: int,
+    n_blocks: int | None = None,
+    cache_dtype=jnp.float32,
+    attn_impl: str = "xla",
+    interpret: bool = False,
+):
+    """Greedy continuation over a paged cache: [B, P] -> [B, P+steps].
+
+    The correctness harness (and the bench's paged path): allocates each
+    row's blocks up front (static table → one compiled scan), prefills,
+    then scans :func:`paged_decode_step`.  Token-exact vs
+    ``decode.greedy_decode(..., batch_prefill=True)`` — tests pin it.
+    """
+    b, p_len = prompt.shape
+    total = p_len + steps
+    mb = blocks_needed(total, block_size)
+    if n_blocks is None:
+        n_blocks = b * mb + 1  # + the null block
+    alloc = BlockAllocator(n_blocks)
+    table = np.zeros((b, mb), np.int32)
+    for r in range(b):
+        table[r] = alloc.alloc(mb)
+    table = jnp.asarray(table)
+
+    cache = init_paged_cache(cfg, n_blocks, block_size, dtype=cache_dtype)
+    cache, last_logits = paged_prefill(params, prompt, cache, table, cfg=cfg)
+    first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
+
+    step = functools.partial(
+        paged_decode_step, cfg=cfg, attn_impl=attn_impl, interpret=interpret
+    )
+
+    def body(carry, pos):
+        cache, tokens = carry
+        token_in = jax.lax.dynamic_slice_in_dim(tokens, pos, 1, axis=1)[:, 0]
+        logits, cache = step(params, cache, table, token_in, pos)
+        nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, nxt[:, None], pos + 1, axis=1
+        )
+        return (cache, tokens), None
+
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, steps), prompt.dtype)], axis=1
+    )
+    tokens = tokens.at[:, p_len].set(first)
+    if steps > 1:
+        positions = jnp.arange(p_len, total - 1, dtype=jnp.int32)
+        (cache, tokens), _ = jax.lax.scan(body, (cache, tokens), positions)
+    return tokens

@@ -1,0 +1,192 @@
+"""Ragged paged attention — decode over a block-pooled KV cache.
+
+Dense serving caches reserve ``n_slots x max_seq`` keys forever, so slot
+count times context length is bounded by the WORST CASE sequence, and every
+decode step's attention reads the whole ``max_seq`` stripe per slot.  Paged
+attention breaks that coupling the vLLM way, designed TPU-first here:
+
+* the KV cache is a POOL of fixed-size blocks ``[n_blocks, block_size,
+  Hkv, hd]`` shared by all slots; a per-slot *block table* lists which pool
+  blocks hold its keys, in order;
+* capacity is bounded by TOTAL tokens across slots (sum of lengths), not
+  ``n_slots x max_seq`` — ragged batches pack; long-context slots coexist
+  with short ones (the long-context first-class mandate, SURVEY.md §5);
+* the decode kernel walks only the blocks a slot actually uses: grid
+  ``(batch, block)`` with the block axis innermost, the block table
+  SCALAR-PREFETCHED so each step's ``BlockSpec`` index map picks the
+  right pool block to DMA (every KV head rides one fetch — maximal DMA
+  granularity), and online-softmax state in VMEM scratch across the
+  block walk (same structure as ops/flash_attention.py).  Steps past a
+  slot's last used block are predicated off with ``pl.when`` AND their
+  index map repeats the previous block id, so Mosaic skips the re-fetch —
+  a slot at length 300 with 128-token blocks reads 3 blocks, not
+  ``max_blocks``: per-step HBM traffic follows the RAGGED lengths.
+
+GQA falls out of the layout: queries arrive grouped ``[B, Hkv, G, hd]`` and
+each grid step contracts one KV head's block against its G query heads —
+the narrow cache is never widened (same contract as the dense grouped
+einsum in models/decode._masked_attention).
+
+``paged_attention_xla`` is the gather-based XLA reference implementation:
+same semantics via ``pool[table]`` + masked dense attention — the
+cross-check oracle for the kernel and the fallback for backends without
+pallas.
+
+Reference parity note: the reference driver has no ML data plane (SURVEY.md
+§2.11); this is consumer-side capability of the TPU framework, exercised on
+claimed slices (the MIG-analog geometry work is what makes the big HBM
+pools allocatable in the first place).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(
+    table_ref, lens_ref,  # scalar-prefetch: [B, max_blocks] i32, [B] i32
+    q_ref, k_ref, v_ref,  # [1,Hkv,G,d], [1,Hkv,bs,d], [1,Hkv,bs,d]
+    out_ref,              # [1,Hkv,G,d]
+    m_ref, l_ref, acc_ref,  # [Hkv*G,128], [Hkv*G,128], [Hkv*G,d]
+    *, block_size: int, num_blocks: int, scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lens_ref[b]
+
+    # Blocks at or past the slot's frontier hold no attended keys: no FLOPs
+    # (and no fresh DMA — their index map repeats the last valid block).
+    @pl.when(i * block_size < length)
+    def _compute():
+        q = q_ref[0]             # [Hkv, G, d] — every head in one step
+        k = k_ref[0]             # [Hkv, bs, d]
+        v = v_ref[0]
+        hkv, g, _ = q.shape
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                # [Hkv, G, bs]
+        k_pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(k_pos < length, s, _NEG_INF)
+
+        s2 = s.reshape(hkv * g, block_size)  # head-major rows, online state
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, s2.max(axis=-1, keepdims=True))
+        p = jnp.exp(s2 - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_prev * correction + p.sum(axis=-1, keepdims=True), l_ref.shape
+        )
+        pv = jax.lax.dot_general(
+            p.reshape(hkv, g, block_size).astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                        # [Hkv, G, d]
+        acc_ref[:] = acc_ref[:] * correction + pv.reshape(hkv * g, -1)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(i == num_blocks - 1)
+    def _finalize():
+        out_ref[0] = (
+            (acc_ref[:] / l_ref[:, 0:1])
+            .reshape(out_ref.shape[1:])
+            .astype(out_ref.dtype)
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(
+    q: jax.Array,            # [B, Hq, d] — ONE query per slot (decode)
+    k_pool: jax.Array,       # [n_blocks, Hkv, block_size, d]
+    v_pool: jax.Array,
+    block_table: jax.Array,  # [B, max_blocks] i32 pool-block ids
+    lengths: jax.Array,      # [B] i32 — keys attended per slot (>= 1)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged paged decode attention; returns [B, Hq, d] in q's dtype.
+
+    Pool layout is head-MAJOR (``[n_blocks, Hkv, bs, d]``): the TPU
+    lowering requires a block's last two dims to tile (8, 128), so the
+    per-grid-step slice must be ``[bs, d]``-shaped — the head axis cannot
+    sit between them.
+    """
+    b, hq, d = q.shape
+    n_pool, hkv, block_size, _ = k_pool.shape
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} must be a multiple of kv heads {hkv}")
+    groups = hq // hkv
+    max_blocks = block_table.shape[1]
+    qg = q.reshape(b, hkv, groups, d)  # heads are contiguous per kv group
+
+    def k_index(bi, i, table, lens):
+        # Past-frontier steps REPEAT the last used block id: identical
+        # consecutive indices make the pipeline skip the DMA, so HBM reads
+        # track the ragged lengths, not max_blocks.
+        last = jnp.maximum((lens[bi] - 1) // block_size, 0)
+        return (table[bi, jnp.minimum(i, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, hkv, groups, d), lambda bi, i, t, ln: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, block_size, d), k_index),
+            pl.BlockSpec((1, hkv, block_size, d), k_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hkv, groups, d), lambda bi, i, t, ln: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv * groups, 128), jnp.float32),  # m
+            pltpu.VMEM((hkv * groups, 128), jnp.float32),  # l
+            pltpu.VMEM((hkv * groups, d), jnp.float32),    # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel,
+            block_size=block_size,
+            num_blocks=max_blocks,
+            scale=1.0 / (d ** 0.5),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    return out.reshape(b, hq, d)
+
+
+def paged_attention_xla(q, k_pool, v_pool, block_table, lengths):
+    """Gather-based reference: identical semantics, plain XLA.
+
+    ``pool[table]`` materializes the slot-major view ``[B, max_blocks*bs,
+    Hkv, d]`` and runs the dense grouped attention with a position mask —
+    the oracle the kernel is tested against, and the path for backends
+    without pallas support.
+    """
+    from k8s_dra_driver_tpu.models.decode import _masked_attention
+
+    b = q.shape[0]
+    n_pool, hkv, block_size, d = k_pool.shape
+    # [B, mb, Hkv, bs, d] -> sequence-major [B, mb*bs, Hkv, d]
+    k = k_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
+    v = v_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
+    k_pos = jnp.arange(k.shape[1])
+    mask = (k_pos[None, :] < lengths[:, None])[:, None, None]  # [B,1,1,K]
+    return _masked_attention(q[:, None], k, v, mask)[:, 0]

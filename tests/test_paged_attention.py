@@ -1,0 +1,216 @@
+"""Paged KV cache: kernel/gather numerics, allocator invariants, decode
+parity (pallas kernel in interpret mode on CPU — same policy as
+test_flash_attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, decode, paged
+from k8s_dra_driver_tpu.models.decode import _masked_attention
+from k8s_dra_driver_tpu.ops import paged_attention
+
+CFG = burnin.ModelConfig(
+    vocab_size=89, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=128
+)
+CFG_GQA = burnin.ModelConfig(
+    vocab_size=89, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+    d_ff=128, max_seq=128, rope=True,
+)
+
+
+def _random_pool(rng, *, b, hq, hkv, d, bs, max_blocks, dtype=jnp.float32):
+    """Pool + a disjoint ragged layout; returns q, pools, table, lengths."""
+    n_pool = 1 + b * max_blocks
+    ks = jax.random.split(rng, 4)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (n_pool, hkv, bs, d), jnp.float32).astype(dtype)
+    v_pool = jax.random.normal(ks[2], (n_pool, hkv, bs, d), jnp.float32).astype(dtype)
+    table = 1 + np.arange(b * max_blocks, dtype=np.int32).reshape(b, max_blocks)
+    lengths = jax.random.randint(ks[3], (b,), 1, bs * max_blocks + 1)
+    return q, k_pool, v_pool, jnp.asarray(table), lengths
+
+
+def _dense_oracle(q, k_pool, v_pool, table, lengths):
+    """Gathered dense attention straight from decode._masked_attention."""
+    b = q.shape[0]
+    _, hkv, bs, d = k_pool.shape
+    k = k_pool[table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
+    v = v_pool[table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
+    mask = (jnp.arange(k.shape[1])[None, :] < lengths[:, None])[:, None, None]
+    return _masked_attention(q[:, None], k, v, mask)[:, 0]
+
+
+class TestKernelNumerics:
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 1)])
+    def test_kernel_matches_dense(self, hq, hkv):
+        q, kp, vp, table, lengths = _random_pool(
+            jax.random.PRNGKey(0), b=3, hq=hq, hkv=hkv, d=64, bs=16, max_blocks=4
+        )
+        want = _dense_oracle(q, kp, vp, table, lengths)
+        got = paged_attention.paged_decode_attention(
+            q, kp, vp, table, lengths, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_xla_gather_matches_dense(self):
+        q, kp, vp, table, lengths = _random_pool(
+            jax.random.PRNGKey(1), b=4, hq=4, hkv=2, d=32, bs=8, max_blocks=3
+        )
+        got = paged_attention.paged_attention_xla(q, kp, vp, table, lengths)
+        want = _dense_oracle(q, kp, vp, table, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_bf16_pool(self):
+        q, kp, vp, table, lengths = _random_pool(
+            jax.random.PRNGKey(2), b=2, hq=4, hkv=2, d=64, bs=16, max_blocks=2,
+            dtype=jnp.bfloat16,
+        )
+        want = _dense_oracle(q, kp, vp, table, lengths)
+        got = paged_attention.paged_decode_attention(
+            q, kp, vp, table, lengths, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+        )
+
+    def test_single_key(self):
+        """length=1: only the first key of the first block attends."""
+        q, kp, vp, table, _ = _random_pool(
+            jax.random.PRNGKey(3), b=2, hq=2, hkv=2, d=32, bs=8, max_blocks=2
+        )
+        lengths = jnp.ones((2,), jnp.int32)
+        got = paged_attention.paged_decode_attention(
+            q, kp, vp, table, lengths, interpret=True
+        )
+        want = _dense_oracle(q, kp, vp, table, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_scrambled_table(self):
+        """Block ids in arbitrary pool order — the table, not pool layout,
+        defines key order."""
+        rng = jax.random.PRNGKey(4)
+        q, kp, vp, table, lengths = _random_pool(
+            rng, b=2, hq=4, hkv=4, d=32, bs=8, max_blocks=4
+        )
+        perm = np.asarray(jax.random.permutation(rng, np.asarray(table).ravel()))
+        table = jnp.asarray(perm.reshape(table.shape))
+        got = paged_attention.paged_decode_attention(
+            q, kp, vp, table, lengths, interpret=True
+        )
+        want = _dense_oracle(q, kp, vp, table, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_bad_head_ratio_raises(self):
+        q = jnp.zeros((1, 3, 8))
+        kp = vp = jnp.zeros((2, 2, 4, 8))
+        with pytest.raises(ValueError, match="multiple of kv heads"):
+            paged_attention.paged_decode_attention(
+                q, kp, vp, jnp.zeros((1, 1), jnp.int32), jnp.ones((1,), jnp.int32),
+                interpret=True,
+            )
+
+
+class TestAllocator:
+    def test_lifo_and_exhaustion(self):
+        a = paged.BlockAllocator(5)  # usable: 1..4
+        assert a.alloc(2) == [1, 2]
+        assert a.free_blocks == 2
+        with pytest.raises(paged.OutOfBlocks):
+            a.alloc(3)
+        a.free([1])
+        assert a.alloc(1) == [1]  # hottest block reused first
+
+    def test_null_block_never_allocated(self):
+        a = paged.BlockAllocator(4)
+        assert paged.NULL_BLOCK not in a.alloc(3)
+
+    def test_double_free_and_range(self):
+        a = paged.BlockAllocator(4)
+        ids = a.alloc(1)
+        a.free(ids)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(ids)
+        with pytest.raises(ValueError, match="out of range"):
+            a.free([0])
+
+    def test_blocks_needed(self):
+        assert paged.blocks_needed(1, 16) == 1
+        assert paged.blocks_needed(16, 16) == 1
+        assert paged.blocks_needed(17, 16) == 2
+
+
+class TestPagedDecode:
+    @pytest.mark.parametrize("cfg", [CFG, CFG_GQA], ids=["mha", "gqa+rope"])
+    def test_greedy_parity_with_dense(self, cfg):
+        """Token-exact vs the dense batched-prefill greedy decode."""
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 7), 0, cfg.vocab_size)
+        want = decode.greedy_decode(params, prompt, 20, cfg, batch_prefill=True)
+        got = paged.paged_greedy_decode(
+            params, prompt, 20, cfg, block_size=8, attn_impl="xla"
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_greedy_parity_kernel(self):
+        """Same contract through the pallas kernel (interpret mode)."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG_GQA)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, CFG_GQA.vocab_size)
+        want = decode.greedy_decode(params, prompt, 8, CFG_GQA, batch_prefill=True)
+        got = paged.paged_greedy_decode(
+            params, prompt, 8, CFG_GQA, block_size=8,
+            attn_impl="kernel", interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_block_boundary_crossing(self):
+        """Generation crosses several block boundaries (bs=4, 18 tokens)."""
+        params = burnin.init_params(jax.random.PRNGKey(2), CFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 3), 0, CFG.vocab_size)
+        want = decode.greedy_decode(params, prompt, 15, CFG, batch_prefill=True)
+        got = paged.paged_greedy_decode(
+            params, prompt, 15, CFG, block_size=4, attn_impl="xla"
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_inactive_rows_write_null_block(self):
+        """A retired slot whose stale table points at a REASSIGNED block
+        must not clobber the new owner's keys (write-after-free guard)."""
+        cfg = CFG
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        cache = paged.init_paged_cache(cfg, n_blocks=3, block_size=4)
+        # both rows' tables point at the SAME block 1: row 1 is inactive
+        # (its slot was freed; block 1 reassigned to row 0)
+        table = jnp.asarray([[1, 2], [1, 2]], jnp.int32)
+        token = jnp.asarray([5, 9], jnp.int32)
+        pos = jnp.asarray([0, 0], jnp.int32)
+        active = jnp.asarray([True, False])
+        _, cache2 = paged.paged_decode_step(
+            params, cache, table, token, pos, cfg=cfg, active=active
+        )
+        # row 0's write must be exactly what a solo active write produces
+        _, solo = paged.paged_decode_step(
+            params, cache, table[:1], token[:1], pos[:1], cfg=cfg,
+            active=jnp.asarray([True]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache2.k[:, 1]), np.asarray(solo.k[:, 1]), atol=0
+        )
+        # the inactive row's key landed in the null block, nowhere else
+        assert np.any(np.asarray(cache2.k[:, paged.NULL_BLOCK]) != 0)
+        np.testing.assert_array_equal(np.asarray(cache2.k[:, 2]), 0)
+
+    def test_prefill_fills_only_owned_blocks(self):
+        cfg = CFG
+        params = burnin.init_params(jax.random.PRNGKey(1), cfg)
+        cache = paged.init_paged_cache(cfg, n_blocks=6, block_size=4)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0, cfg.vocab_size)
+        table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        cache2, logits = paged.paged_prefill(params, prompt, cache, table, cfg=cfg)
+        assert logits.shape == (2, cfg.vocab_size)
+        # blocks 1..4 written, block 5 and the null block untouched
+        for blk in (1, 2, 3, 4):
+            assert np.any(np.asarray(cache2.k[:, blk]) != 0)
+        np.testing.assert_array_equal(np.asarray(cache2.k[:, 5]), 0)
+        np.testing.assert_array_equal(np.asarray(cache2.k[:, 0]), 0)

@@ -1,0 +1,191 @@
+"""PagedServeEngine: stream parity with the dense engine, pool accounting,
+stall/backpressure, wedge detection."""
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, paged
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+# max_seq a multiple of block_size so both engines mask the same key width
+CFG = burnin.ModelConfig(
+    vocab_size=89, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=128
+)
+BS = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _prompts(n, rng=7):
+    r = np.random.RandomState(rng)
+    return [r.randint(0, CFG.vocab_size, size=r.randint(3, 12)).tolist() for _ in range(n)]
+
+
+def _streams(engine, reqs, max_steps=10_000):
+    """FIFO queue in front of the engine: submit as capacity frees.
+    Request ids are assigned in submit order (FIFO in both engines), so
+    stream dicts are comparable across engines by id."""
+    pending = list(reqs)
+    out = {}
+    for _ in range(max_steps):
+        while pending:
+            prompt, max_tokens, temp, seed = pending[0]
+            try:
+                engine.submit(prompt, max_tokens, temperature=temp, seed=seed)
+                pending.pop(0)
+            except RuntimeError:
+                break
+        stepped = engine.step()
+        for c in engine.completions():
+            out[c.request_id] = c.generated
+        if not pending and stepped == 0 and engine.free_slots() == engine.n_slots:
+            return out
+    raise RuntimeError("queue did not drain")
+
+
+class TestParityWithDense:
+    def test_greedy_streams_identical(self, params):
+        reqs = [(p, 12, 0.0, i) for i, p in enumerate(_prompts(5))]
+        dense = ServeEngine(params=params, cfg=CFG, n_slots=3, prompt_bucket=16)
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=3, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        assert _streams(dense, reqs) == _streams(pag, reqs)
+
+    def test_sampled_streams_identical(self, params):
+        reqs = [(p, 8, 0.8, 100 + i) for i, p in enumerate(_prompts(4, rng=11))]
+        dense = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=16)
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        assert _streams(dense, reqs) == _streams(pag, reqs)
+
+    def test_eos_retires_early(self, params):
+        # find the greedy continuation's 3rd token and use it as eos
+        dense = ServeEngine(params=params, cfg=CFG, n_slots=1, prompt_bucket=16)
+        prompt = _prompts(1)[0]
+        dense.submit(prompt, 10)
+        dense.run_until_drained()
+        stream = dense.completions()[0].generated
+        eos = stream[2]
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla", eos_id=eos,
+        )
+        pag.submit(prompt, 10)
+        pag.run_until_drained()
+        want = stream[: stream.index(eos) + 1]  # first eos occurrence wins
+        assert pag.completions()[0].generated == want
+
+
+class TestPoolAccounting:
+    def test_blocks_freed_on_retirement(self, params):
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=20, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        before = pag.free_blocks
+        for p in _prompts(4):
+            pag.submit(p, 6)
+            pag.run_until_drained()
+        assert pag.free_blocks == before
+        assert np.all(np.asarray(pag._table) == paged.NULL_BLOCK)
+
+    def test_capacity_is_tokens_not_slots(self, params):
+        """Pool of 9 usable blocks (144 tokens) serves 4 requests whose
+        dense reservation would be 4 x 128 = 512 token rows."""
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=4, n_blocks=10, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        reqs = [(p, 10, 0.0, i) for i, p in enumerate(_prompts(4))]
+        dense = ServeEngine(params=params, cfg=CFG, n_slots=4, prompt_bucket=16)
+        assert _streams(pag, reqs) == _streams(dense, reqs)
+
+    def test_admission_rejects_on_empty_pool(self, params):
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=4, n_blocks=3, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        pag.submit(list(range(10)), 30)  # holds 1 block, grows later
+        with pytest.raises(RuntimeError, match="no free blocks"):
+            pag.submit(list(range(16)), 4)  # needs 2 blocks, 1 free
+
+    def test_stall_and_resume(self, params):
+        """When the pool momentarily empties, growing slots stall (not
+        overrun) and resume after a retirement frees blocks — streams still
+        exactly match the dense engine's."""
+        # 3 usable blocks.  A (10+20 toks, 2 blocks) grabs the third block
+        # at its step 6; B (5+40 toks, 3 blocks) hits its first boundary at
+        # step 11 with the pool empty -> stalls until A retires at step 19.
+        reqs = [
+            (list(np.arange(10) % CFG.vocab_size), 20, 0.0, 0),
+            (list((np.arange(5) + 17) % CFG.vocab_size), 40, 0.0, 1),
+        ]
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=4, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        dense = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=16)
+        assert _streams(pag, reqs) == _streams(dense, reqs)
+        assert pag.stalled_steps > 0
+
+    def test_wedge_detected(self, params):
+        """A single resident request that outgrows the whole pool cannot
+        make progress — the engine says so instead of spinning."""
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=2, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        pag.submit(list(range(10)), 60)  # needs 5 blocks eventually, has 1
+        with pytest.raises(RuntimeError, match="wedged"):
+            pag.run_until_drained()
+
+    def test_metrics_land_in_registry(self, params):
+        """The paged backend feeds the SAME serving counters as the dense
+        engine (observability parity) plus the pool-free gauge."""
+        from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+        def sample():
+            out = {}
+            for line in REGISTRY.render().splitlines():
+                if line.startswith("tpu_serve_") and " " in line:
+                    name, val = line.rsplit(" ", 1)
+                    out[name] = float(val)
+            return out
+
+        before = sample()
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=20, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        for p in _prompts(2):
+            pag.submit(p, 4)
+        pag.run_until_drained()
+        after = sample()
+
+        def delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        assert delta("tpu_serve_requests_total") == 2
+        assert delta("tpu_serve_completions_total") == 2
+        assert delta("tpu_serve_tokens_total") == 8
+        assert after["tpu_serve_kv_pool_free_blocks"] == 19  # all returned
+
+    def test_validation(self, params):
+        pag = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=20, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        with pytest.raises(ValueError, match="empty"):
+            pag.submit([], 4)
+        with pytest.raises(ValueError, match="exceeds bucket"):
+            pag.submit(list(range(17)), 4)
+        with pytest.raises(ValueError, match="max_seq"):
+            pag.submit(list(range(10)), 1000)

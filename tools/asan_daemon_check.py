@@ -92,7 +92,15 @@ def main() -> int:
             # leave holder + waiter connections open across SIGTERM
         finally:
             proc.terminate()
-            rc = proc.wait(timeout=10)
+            try:
+                rc = proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # shutdown-hang regression (the very bug this check guards):
+                # reap the daemon and fail with the diagnostic, don't leak
+                # it and die on an unhandled traceback
+                proc.kill()
+                proc.wait(timeout=10)
+                rc = -9
         out = proc.stdout.read().decode()
         # The daemon handles SIGTERM by closing its listener and returning
         # from main NORMALLY, so LeakSanitizer's end-of-process report runs
