@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# nvkind-analog variant: SPLIT ONE HOST'S CHIPS among several kind workers.
+#
+# The reference's nvkind flow gives each kind worker a distinct subset of
+# the box's real GPUs via params masking (values.yaml:41-48,
+# kubeletplugin.yaml:58-67).  Here the same per-worker-subset property is a
+# node label: every worker impersonates THE SAME fake host
+# (fake-host-id=0) but carries a disjoint tpu.google.com/visible-chips
+# mask, so its plugin publishes only its share — disjoint uuids, disjoint
+# chip markers, no double-booking (tests/test_visible_chips.py).
+#
+#   NUM_SPLITS=2 FAKE_TOPOLOGY=v5e-8 demo/clusters/kind/create-split-host-cluster.sh
+#   -> worker 0 publishes chips {0,1}, worker 1 publishes chips {2,3}
+#
+# Label values cannot carry commas; the mask label uses '.' ("0.1").
+source "$(dirname "${BASH_SOURCE[0]}")/scripts/common.sh"
+
+: "${NUM_SPLITS:=2}"
+# chips per host for the chosen fake topology (v5e-16: 4, v5e-8: 4, v5e-32: 4)
+: "${CHIPS_PER_HOST:=4}"
+
+if (( CHIPS_PER_HOST % NUM_SPLITS != 0 )); then
+  echo "NUM_SPLITS (${NUM_SPLITS}) must divide CHIPS_PER_HOST (${CHIPS_PER_HOST})" >&2
+  exit 2
+fi
+share=$(( CHIPS_PER_HOST / NUM_SPLITS ))
+
+workers() {
+  for ((i = 0; i < NUM_SPLITS; i++)); do
+    mask=""
+    for ((c = i * share; c < (i + 1) * share; c++)); do
+      mask="${mask:+${mask}.}${c}"
+    done
+    cat <<EOF
+  - role: worker
+    labels:
+      tpu.google.com/fake-topology: "${FAKE_TOPOLOGY}"
+      tpu.google.com/fake-host-id: "0"
+      tpu.google.com/visible-chips: "${mask}"
+EOF
+  done
+}
+
+cat <<EOF | kind create cluster --name "${CLUSTER_NAME}" --config=-
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+featureGates:
+  DynamicResourceAllocation: true
+containerdConfigPatches:
+  - |-
+    [plugins."io.containerd.grpc.v1.cri"]
+      enable_cdi = true
+nodes:
+  - role: control-plane
+    kubeadmConfigPatches:
+      - |
+        kind: ClusterConfiguration
+        apiServer:
+          extraArgs:
+            runtime-config: "resource.k8s.io/v1beta1=true"
+$(workers)
+EOF
+
+echo "cluster ${CLUSTER_NAME} ready (${NUM_SPLITS} workers sharing one ${FAKE_TOPOLOGY} host, ${share} chips each)."
+echo "next: the same build/load/install steps as create-cluster.sh, then:"
+echo "  kubectl get resourceslices   # disjoint tpu-N inventories per worker"

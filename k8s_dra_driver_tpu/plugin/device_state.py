@@ -62,6 +62,32 @@ class PrepareError(RuntimeError):
     pass
 
 
+def _parse_visible_chips(spec: str, n_chips: int):
+    """"0,2" -> {0, 2}; "" -> None (all).  '.' also separates ("0.2") —
+    node-label values cannot carry commas.  Loud on malformed/out-of-range
+    input — a typo'd mask silently publishing the wrong chips is exactly
+    the double-booking the masking exists to prevent."""
+    if not spec:
+        return None
+    try:
+        positions = {
+            int(p) for p in spec.replace(".", ",").split(",") if p.strip() != ""
+        }
+    except ValueError as exc:
+        raise ValueError(f"invalid visible-chips spec {spec!r}: {exc}") from None
+    if not positions:
+        # a non-empty spec that names NO chips (e.g. "." or ",") is a
+        # templating bug — treating it as "all" would double-book the very
+        # chips the mask was supposed to fence off
+        raise ValueError(f"visible-chips spec {spec!r} names no chip positions")
+    bad = sorted(p for p in positions if not 0 <= p < n_chips)
+    if bad:
+        raise ValueError(
+            f"visible-chips positions {bad} out of range (host has {n_chips} chips)"
+        )
+    return frozenset(positions)
+
+
 @dataclass
 class DeviceStateConfig:
     node_name: str = ""
@@ -75,6 +101,10 @@ class DeviceStateConfig:
     # tpu-parted applied-state file (out-of-band subslice-layout
     # partitioning, plugin/parted.py); empty = publish all shapes.
     parted_state_path: str = ""
+    # Comma-separated LOCAL chip positions this plugin may publish; "" =
+    # all.  The nvkind params-masking analog: several kind workers on one
+    # host each own a disjoint share (label tpu.google.com/visible-chips).
+    visible_chips: str = ""
     # Readiness backoff overrides for tests.
     daemon_backoff_initial: float = 1.0
     daemon_backoff_steps: int = 4
@@ -91,7 +121,12 @@ class DeviceState:
         self._health_overlay: dict[int, str] = {}
         self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
         self._layout = self._load_layout()
-        self.allocatable = AllocatableDevices.from_topology(self.topology, self._layout)
+        self._visible = _parse_visible_chips(
+            config.visible_chips, len(self.topology.chips)
+        )
+        self.allocatable = AllocatableDevices.from_topology(
+            self.topology, self._layout, self._visible
+        )
         # Resolve libtpu under the chroot-like driver root when one is
         # mounted (root.go:25-109 pattern); fall back to the configured path.
         libtpu_path = config.libtpu_path
@@ -254,7 +289,9 @@ class DeviceState:
                 return False
             self.topology = new_topology
             self._layout = new_layout
-            self.allocatable = AllocatableDevices.from_topology(new_topology, new_layout)
+            self.allocatable = AllocatableDevices.from_topology(
+                new_topology, new_layout, self._visible
+            )
             self.cdi.create_base_spec(self.allocatable)
             return True
 

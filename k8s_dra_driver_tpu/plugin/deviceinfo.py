@@ -232,18 +232,33 @@ class AllocatableDevices:
     devices: dict[str, AllocatableDevice] = field(default_factory=dict)
 
     @staticmethod
-    def from_topology(topology: TopologyInfo, layout=None) -> "AllocatableDevices":
+    def from_topology(
+        topology: TopologyInfo, layout=None, visible=None
+    ) -> "AllocatableDevices":
         """``layout`` (plugin.parted.SubsliceLayout) restricts which subslice
         shapes publish — the out-of-band tpu-parted partitioning; chips
-        always publish."""
+        always publish.
+
+        ``visible`` (set of LOCAL chip positions, or None = all) masks the
+        published inventory to a subset of the host's chips — the nvkind
+        params-masking analog (reference values.yaml:41-48 /
+        kubeletplugin.yaml:58-67), so several kind workers on one host can
+        each own a disjoint share.  Positions keep their true local index
+        (chip markers and CDI paths must stay aligned with the hardware),
+        and a subslice publishes only when EVERY member chip is visible.
+        """
         from k8s_dra_driver_tpu.plugin.geometry import enumerate_subslices
 
         out: dict[str, AllocatableDevice] = {}
         for pos, chip in enumerate(topology.chips):
+            if visible is not None and pos not in visible:
+                continue
             info = TpuChipInfo(chip, topology, local_pos=pos)
             out[info.name] = AllocatableDevice(chip=info)
         for sub in enumerate_subslices(topology):
             if layout is not None and not layout.allows(sub.shape_name(topology.ndims)):
+                continue
+            if visible is not None and not set(sub.chip_indices) <= visible:
                 continue
             info = TpuSubsliceInfo(sub, topology)
             out[info.name] = AllocatableDevice(subslice=info)

@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="on-chip runtime self-test period folded into the health sweep "
         "(tpuinfo/selftest.py); 0 disables",
     )
+    p.add_argument(
+        "--visible-chips", default=env_default("VISIBLE_CHIPS", ""),
+        help="comma-separated LOCAL chip positions this plugin publishes "
+        "(nvkind params-masking analog); empty falls back to this node's "
+        "tpu.google.com/visible-chips label, then all chips",
+    )
     return p
 
 
@@ -102,14 +108,19 @@ def _node_labels(server, node_name: str) -> dict[str, str]:
         return {}
 
 
-def resolve_topology_env(server, node_name, fake_topology, fake_host_id) -> dict[str, str]:
+def resolve_topology_env(
+    server, node_name, fake_topology, fake_host_id, labels=None
+) -> dict[str, str]:
     """Fake-backend knobs: flag/env first, then this node's labels — so a
     single DaemonSet drives a multi-node fake cluster where every kind
     worker carries its own topology/host-id labels (the reference needs
     nvkind + params masking for per-node device subsets, values.yaml:41-48;
-    our fake backend makes it declarative).  {} = real hardware mode."""
+    our fake backend makes it declarative).  {} = real hardware mode.
+    ``labels``: pre-fetched node labels (None = fetch here) so callers with
+    several label-driven knobs pay ONE Node GET."""
     if not fake_topology or not fake_host_id:
-        labels = _node_labels(server, node_name)
+        if labels is None:
+            labels = _node_labels(server, node_name)
         fake_topology = fake_topology or labels.get("tpu.google.com/fake-topology", "")
         fake_host_id = fake_host_id or labels.get("tpu.google.com/fake-host-id", "0")
     if not fake_topology:
@@ -137,8 +148,18 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as exc:
             log.error("cannot reach an API server (%s); use --fake-cluster for demos", exc)
             return 2
+    labels = None
+    if (
+        not (args.fake_topology and args.fake_host_id)
+        or not args.visible_chips
+    ):
+        labels = _node_labels(server, args.node_name)
     topology_env = resolve_topology_env(
-        server, args.node_name, args.fake_topology, args.fake_host_id
+        server, args.node_name, args.fake_topology, args.fake_host_id,
+        labels=labels,
+    )
+    visible_chips = args.visible_chips or (labels or {}).get(
+        "tpu.google.com/visible-chips", ""
     )
     driver = Driver(
         server,
@@ -152,6 +173,7 @@ def main(argv: list[str] | None = None) -> int:
             topology_env=topology_env,
             parted_state_path=args.parted_state_path,
             selftest_interval_s=args.selftest_interval_s,
+            visible_chips=visible_chips,
         ),
     )
     plugin = PluginServer(driver, plugin_dir=args.plugin_path, registry_dir=args.registry_path)

@@ -1,0 +1,127 @@
+"""Visible-chips masking — the nvkind params-masking analog (reference
+values.yaml:41-48 / kubeletplugin.yaml:58-67): several kind workers on one
+host each publish a disjoint share of its chips."""
+
+import pytest
+
+from k8s_dra_driver_tpu.plugin.device_state import (
+    DeviceState,
+    DeviceStateConfig,
+    _parse_visible_chips,
+)
+from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+
+V5E16_HOST = {"TPUINFO_FAKE_TOPOLOGY": "v5e-16", "TPUINFO_FAKE_HOST_ID": "0"}
+
+
+class TestParse:
+    def test_empty_means_all(self):
+        assert _parse_visible_chips("", 4) is None
+
+    def test_comma_and_dot_forms(self):
+        # '.' is the node-label form (label values cannot carry commas)
+        assert _parse_visible_chips("0,2", 4) == {0, 2}
+        assert _parse_visible_chips("0.2", 4) == {0, 2}
+
+    def test_out_of_range_is_loud(self):
+        with pytest.raises(ValueError, match="out of range"):
+            _parse_visible_chips("0,7", 4)
+
+    def test_garbage_is_loud(self):
+        with pytest.raises(ValueError, match="invalid visible-chips"):
+            _parse_visible_chips("0,x", 4)
+
+    @pytest.mark.parametrize("spec", [".", ",", " ,"])
+    def test_nonempty_spec_naming_no_chips_is_loud(self, spec):
+        """A templating bug like '.' must not silently mean 'publish ALL'
+        — that re-creates the double-booking the mask prevents."""
+        with pytest.raises(ValueError, match="names no chip positions"):
+            _parse_visible_chips(spec, 4)
+
+
+class TestInventoryMasking:
+    def topology(self):
+        return enumerate_topology(env=V5E16_HOST)  # 4 local chips (2x2)
+
+    def test_masked_chips_not_published(self):
+        inv = AllocatableDevices.from_topology(self.topology(), visible={0, 1})
+        chip_names = [d.chip.name for d in inv if d.chip is not None]
+        assert sorted(chip_names) == ["tpu-0", "tpu-1"]
+
+    def test_local_positions_preserved(self):
+        """Masking must not renumber: chip markers / CDI paths follow the
+        TRUE local index."""
+        inv = AllocatableDevices.from_topology(self.topology(), visible={2, 3})
+        names = sorted(d.chip.name for d in inv if d.chip is not None)
+        assert names == ["tpu-2", "tpu-3"]
+
+    def test_subslice_needs_every_member_visible(self):
+        topo = self.topology()
+        full = AllocatableDevices.from_topology(topo)
+        sub_names = {d.subslice.name for d in full if d.subslice is not None}
+        assert sub_names  # the host block publishes subslices at all
+        # half the host visible: the 2x2 (whole-host) subslice must vanish;
+        # a 2x1/1x2 shape fully inside {0,1} may survive
+        masked = AllocatableDevices.from_topology(topo, visible={0, 1})
+        for d in masked:
+            if d.subslice is not None:
+                assert set(d.subslice.subslice.chip_indices) <= {0, 1}
+
+    def test_disjoint_shares_have_disjoint_uuids(self):
+        """Two plugins on one (fake) host with complementary masks publish
+        disjoint devices — the nvkind per-worker-subset property."""
+        topo = self.topology()
+        a = AllocatableDevices.from_topology(topo, visible={0, 1})
+        b = AllocatableDevices.from_topology(topo, visible={2, 3})
+        ua = {u for d in a for u in d.uuids()}
+        ub = {u for d in b for u in d.uuids()}
+        assert ua and ub and not (ua & ub)
+
+
+class TestDeviceStateWiring:
+    def test_state_publishes_masked_inventory(self, api_server, tmp_path):
+        state = DeviceState(
+            api_server,
+            DeviceStateConfig(
+                node_name="host0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "checkpoint.json"),
+                topology_env=dict(V5E16_HOST),
+                visible_chips="0,1",
+            ),
+        )
+        names = sorted(state.allocatable.devices)
+        assert "tpu-0" in names and "tpu-1" in names
+        assert "tpu-2" not in names and "tpu-3" not in names
+
+    def test_mask_survives_refresh(self, api_server, tmp_path):
+        state = DeviceState(
+            api_server,
+            DeviceStateConfig(
+                node_name="host0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "checkpoint.json"),
+                topology_env=dict(V5E16_HOST),
+                visible_chips="0.1",
+            ),
+        )
+        # force a re-enumeration: the overlay makes the topology differ so
+        # refresh() rebuilds allocatable — the mask must be re-applied
+        state._health_overlay[0] = "test"
+        assert state.refresh()
+        names = sorted(state.allocatable.devices)
+        assert "tpu-2" not in names and "tpu-3" not in names
+
+    def test_bad_mask_fails_startup(self, api_server, tmp_path):
+        with pytest.raises(ValueError, match="out of range"):
+            DeviceState(
+                api_server,
+                DeviceStateConfig(
+                    node_name="host0",
+                    cdi_root=str(tmp_path / "cdi"),
+                    checkpoint_path=str(tmp_path / "checkpoint.json"),
+                    topology_env=dict(V5E16_HOST),
+                    visible_chips="0,9",
+                ),
+            )
