@@ -14,8 +14,16 @@ from pathlib import Path
 from typing import Any
 
 from k8s_dra_driver_tpu.utils.fileio import write_json_atomic
+from k8s_dra_driver_tpu.version import __version__
 
-SCHEMA_VERSION = "v1"
+SCHEMA_VERSION = "v2"
+# Versions this build can still read.  v1 (round 1/2 deployments) carried
+# only {version, checksum, preparedClaims}; v2 adds writerVersion so a
+# restore after an upgrade can log WHICH driver build wrote the state —
+# the checkpointmanager-style migration path (reference checkpoint.go
+# pins a named CheckpointV1 schema for exactly this reason).  Reading a
+# v1 file works transparently; the next write() upgrades it in place.
+_READABLE_VERSIONS = ("v1", "v2")
 
 
 class CorruptCheckpoint(RuntimeError):
@@ -31,16 +39,24 @@ class CheckpointFile:
 
     def __init__(self, path: str | Path):
         self.path = Path(path)
+        #: driver version that wrote the file last read, for upgrade-path
+        #: logging ("" before the first read / for v1 files, which predate
+        #: the field).
+        self.writer_version = ""
 
     def read(self) -> dict[str, Any]:
         if not self.path.exists():
             return {}
         doc = json.loads(self.path.read_text())
-        if doc.get("version") != SCHEMA_VERSION:
-            raise CorruptCheckpoint(f"unknown checkpoint version {doc.get('version')!r}")
+        version = doc.get("version")
+        if version not in _READABLE_VERSIONS:
+            # A FUTURE schema is not guessable: downgrades must fail loudly
+            # rather than silently drop fields a newer build depends on.
+            raise CorruptCheckpoint(f"unknown checkpoint version {version!r}")
         payload = json.dumps(doc.get("preparedClaims", {}), sort_keys=True)
         if _checksum(payload) != doc.get("checksum"):
             raise CorruptCheckpoint(f"checksum mismatch in {self.path}")
+        self.writer_version = doc.get("writerVersion", "")
         return doc["preparedClaims"]
 
     def write(self, prepared_claims: dict[str, Any]) -> None:
@@ -49,5 +65,6 @@ class CheckpointFile:
             "version": SCHEMA_VERSION,
             "checksum": _checksum(payload),
             "preparedClaims": prepared_claims,
+            "writerVersion": __version__,
         }
         write_json_atomic(self.path, doc, indent=1)

@@ -470,3 +470,60 @@ class TestCheckpointIntegrity:
         (tmp_path / "checkpoint.json").write_text(raw)
         with pytest.raises(CorruptCheckpoint, match="checksum"):
             cp.read()
+
+    def test_v1_checkpoint_migrates_on_write(self, tmp_path):
+        """Upgrade path: a round-1/2 (v1) file reads transparently — same
+        claims, checksum still enforced — and the next write upgrades the
+        schema in place, stamping the writer version."""
+        import hashlib
+        import json
+
+        from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile
+        from k8s_dra_driver_tpu.version import __version__
+
+        claims = {"uid1": {"uid": "uid1"}}
+        payload = json.dumps(claims, sort_keys=True)
+        v1 = {
+            "version": "v1",
+            "checksum": hashlib.sha256(payload.encode()).hexdigest(),
+            "preparedClaims": claims,
+        }
+        path = tmp_path / "checkpoint.json"
+        path.write_text(json.dumps(v1))
+        cp = CheckpointFile(path)
+        assert cp.read() == claims
+        assert cp.writer_version == ""  # v1 predates the field
+        cp.write(claims)
+        doc = json.loads(path.read_text())
+        assert doc["version"] == "v2"
+        assert doc["writerVersion"] == __version__
+        assert cp.read() == claims
+        assert cp.writer_version == __version__
+
+    def test_v1_checksum_still_enforced(self, tmp_path):
+        import hashlib
+        import json
+
+        from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile, CorruptCheckpoint
+
+        v1 = {
+            "version": "v1",
+            "checksum": hashlib.sha256(b"{}").hexdigest(),
+            "preparedClaims": {"uid9": {}},  # does not match the checksum
+        }
+        path = tmp_path / "checkpoint.json"
+        path.write_text(json.dumps(v1))
+        with pytest.raises(CorruptCheckpoint, match="checksum"):
+            CheckpointFile(path).read()
+
+    def test_future_version_fails_loudly(self, tmp_path):
+        """Downgrade safety: a v3 file written by a newer build must refuse
+        to load, not silently drop fields the newer schema depends on."""
+        import json
+
+        from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile, CorruptCheckpoint
+
+        path = tmp_path / "checkpoint.json"
+        path.write_text(json.dumps({"version": "v3", "preparedClaims": {}}))
+        with pytest.raises(CorruptCheckpoint, match="unknown checkpoint version 'v3'"):
+            CheckpointFile(path).read()
