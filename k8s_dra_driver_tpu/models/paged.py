@@ -333,7 +333,19 @@ class PagedServeEngine:
             cfg=cfg, top_k=self.top_k,
             attn_impl=self.attn_impl, interpret=self.interpret,
         )
-        self._step_fn = jax.jit(functools.partial(_paged_step_all, **kw))
+        # The per-token step DONATES the cache: the engine always reassigns
+        # self._cache from the result, and without aliasing every step
+        # would copy the whole pool — doubling peak HBM on the very
+        # structure this engine sizes to fill it.  The ADMISSION fns do
+        # NOT donate on purpose: a donated buffer is consumed at dispatch,
+        # so a runtime failure (device OOM — most likely exactly at
+        # admission) would leave self._cache deleted and wedge every
+        # resident request; submit()'s block-recovery path relies on the
+        # old cache surviving a failed call.  One pool copy per admission,
+        # amortized over the request's whole token stream, buys that.
+        self._step_fn = jax.jit(
+            functools.partial(_paged_step_all, **kw), donate_argnums=(1,)
+        )
         self._first_fn = jax.jit(functools.partial(_paged_first_token, **kw))
         self._prefill_fn = jax.jit(functools.partial(paged_prefill, cfg=cfg))
 
@@ -375,20 +387,31 @@ class PagedServeEngine:
         self._table_np[slot, :need] = ids
         self._table = jnp.asarray(self._table_np)
 
-        padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
-        padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
-        # Prefill writes ceil(bucket/bs) block stripes; entries past the
-        # row's owned blocks are the null block (a scratch sink — those
-        # positions are beyond plen+1 and re-written before ever attended).
-        prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
-        self._cache, _ = self._prefill_fn(self.params, padded, self._cache, prefill_row)
+        try:
+            padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+            padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+            # Prefill writes ceil(bucket/bs) block stripes; entries past the
+            # row's owned blocks are the null block (a scratch sink — those
+            # positions are beyond plen+1 and re-written before ever attended).
+            prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+            self._cache, _ = self._prefill_fn(
+                self.params, padded, self._cache, prefill_row
+            )
 
-        request_id = self._next_id
-        base_key = jax.random.PRNGKey(request_id if seed is None else seed)
-        first_tok, self._cache = self._first_fn(
-            self.params, self._cache, self._table, padded, len(prompt), slot,
-            jnp.float32(temperature), base_key,
-        )
+            request_id = self._next_id
+            base_key = jax.random.PRNGKey(request_id if seed is None else seed)
+            first_tok, self._cache = self._first_fn(
+                self.params, self._cache, self._table, padded, len(prompt), slot,
+                jnp.float32(temperature), base_key,
+            )
+        except BaseException:
+            # a failed admission (device OOM, interrupt) must return its
+            # blocks — the slot was never occupied, so nothing else will
+            self._alloc.free(self._owned[slot])
+            self._owned[slot] = []
+            self._table_np[slot, :] = NULL_BLOCK
+            self._table = jnp.asarray(self._table_np)
+            raise
         self._next_id += 1
         self._slots[slot] = _Slot(
             request_id, list(prompt) + [int(first_tok)], len(prompt), max_tokens
@@ -482,40 +505,24 @@ class PagedServeEngine:
         _M_POOL_FREE.set(self._alloc.free_blocks)
 
 
-def paged_greedy_decode(
-    params,
-    prompt: jax.Array,
-    steps: int,
-    cfg: ModelConfig,
-    *,
-    block_size: int,
-    n_blocks: int | None = None,
-    cache_dtype=jnp.float32,
-    attn_impl: str = "xla",
-    interpret: bool = False,
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "steps", "cfg", "block_size", "n_blocks", "cache_dtype",
+        "attn_impl", "interpret", "chain",
+    ),
+)
+def _paged_greedy_jit(
+    params, prompt, table, *, steps, cfg, block_size, n_blocks,
+    cache_dtype, attn_impl, interpret, chain,
 ):
-    """Greedy continuation over a paged cache: [B, P] -> [B, P+steps].
-
-    The correctness harness (and the bench's paged path): allocates each
-    row's blocks up front (static table → one compiled scan), prefills,
-    then scans :func:`paged_decode_step`.  Token-exact vs
-    ``decode.greedy_decode(..., batch_prefill=True)`` — tests pin it.
-    """
+    """Whole paged greedy pass (cache init + prefill scatter + decode scan)
+    as ONE compiled program — on tunneled devices the eager prefill's
+    per-op dispatches would otherwise dominate.  ``chain > 1`` re-seeds the
+    next pass from the tail of the previous one (the bench's RTT
+    amortization discipline); the same table is re-prefilled in place."""
     b, p_len = prompt.shape
     total = p_len + steps
-    mb = blocks_needed(total, block_size)
-    if n_blocks is None:
-        n_blocks = b * mb + 1  # + the null block
-    alloc = BlockAllocator(n_blocks)
-    table = np.zeros((b, mb), np.int32)
-    for r in range(b):
-        table[r] = alloc.alloc(mb)
-    table = jnp.asarray(table)
-
-    cache = init_paged_cache(cfg, n_blocks, block_size, dtype=cache_dtype)
-    cache, last_logits = paged_prefill(params, prompt, cache, table, cfg=cfg)
-    first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
-
     step = functools.partial(
         paged_decode_step, cfg=cfg, attn_impl=attn_impl, interpret=interpret
     )
@@ -530,11 +537,56 @@ def paged_greedy_decode(
         )
         return (cache, tokens), None
 
-    tokens = jnp.concatenate(
-        [prompt, jnp.zeros((b, steps), prompt.dtype)], axis=1
+    out = prompt
+    for _ in range(chain):
+        cache = init_paged_cache(cfg, n_blocks, block_size, dtype=cache_dtype)
+        cache, last_logits = paged_prefill(params, out, cache, table, cfg=cfg)
+        first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
+        tokens = jnp.concatenate(
+            [out, jnp.zeros((b, steps), prompt.dtype)], axis=1
+        )
+        tokens = tokens.at[:, p_len].set(first)
+        if steps > 1:
+            positions = jnp.arange(p_len, total - 1, dtype=jnp.int32)
+            (cache, tokens), _ = jax.lax.scan(body, (cache, tokens), positions)
+        full = tokens
+        out = jax.lax.dynamic_slice_in_dim(full, total - p_len, p_len, axis=1)
+    return full
+
+
+def paged_greedy_decode(
+    params,
+    prompt: jax.Array,
+    steps: int,
+    cfg: ModelConfig,
+    *,
+    block_size: int,
+    n_blocks: int | None = None,
+    cache_dtype=jnp.float32,
+    attn_impl: str = "xla",
+    interpret: bool = False,
+    chain: int = 1,
+):
+    """Greedy continuation over a paged cache: [B, P] -> [B, P+steps]
+    (of the LAST chained pass; chain > 1 is the bench's RTT amortization).
+
+    The correctness harness (and the bench's paged path): allocates each
+    row's blocks up front (static table -> one compiled program), prefills,
+    then scans :func:`paged_decode_step`.  Token-exact vs
+    ``decode.greedy_decode(..., batch_prefill=True)`` -- tests pin it.
+    """
+    b, p_len = prompt.shape
+    total = p_len + steps
+    mb = blocks_needed(total, block_size)
+    if n_blocks is None:
+        n_blocks = b * mb + 1  # + the null block
+    alloc = BlockAllocator(n_blocks)
+    table = np.zeros((b, mb), np.int32)
+    for r in range(b):
+        table[r] = alloc.alloc(mb)
+    return _paged_greedy_jit(
+        params, prompt, jnp.asarray(table), steps=steps, cfg=cfg,
+        block_size=block_size, n_blocks=n_blocks,
+        cache_dtype=jnp.dtype(cache_dtype), attn_impl=attn_impl,
+        interpret=interpret, chain=chain,
     )
-    tokens = tokens.at[:, p_len].set(first)
-    if steps > 1:
-        positions = jnp.arange(p_len, total - 1, dtype=jnp.int32)
-        (cache, tokens), _ = jax.lax.scan(body, (cache, tokens), positions)
-    return tokens

@@ -167,6 +167,11 @@ def paged_decode_attention(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
+        # batch rows are independent walks (scratch re-inits at i == 0), so
+        # the row axis may reorder/pipeline; the block walk is sequential.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
     return out.reshape(b, hq, d)

@@ -20,87 +20,107 @@ CFG_GQA = burnin.ModelConfig(
 )
 
 
-def _random_pool(rng, *, b, hq, hkv, d, bs, max_blocks, dtype=jnp.float32):
-    """Pool + a disjoint ragged layout; returns q, pools, table, lengths."""
-    n_pool = 1 + b * max_blocks
-    ks = jax.random.split(rng, 4)
-    q = jax.random.normal(ks[0], (b, hq, d), jnp.float32)
-    k_pool = jax.random.normal(ks[1], (n_pool, hkv, bs, d), jnp.float32).astype(dtype)
-    v_pool = jax.random.normal(ks[2], (n_pool, hkv, bs, d), jnp.float32).astype(dtype)
-    table = 1 + np.arange(b * max_blocks, dtype=np.int32).reshape(b, max_blocks)
-    lengths = jax.random.randint(ks[3], (b,), 1, bs * max_blocks + 1)
-    return q, k_pool, v_pool, jnp.asarray(table), lengths
+class _Case:
+    """Sequence-major k/v FIRST, pool built FROM them: the oracle attends
+    the original contiguous arrays with an independent code path, so a bug
+    replicated in the gather implementation cannot cancel out (a previous
+    oracle copied paged_attention_xla line for line and was vacuous)."""
 
+    def __init__(self, rng, *, b, hq, hkv, d, bs, max_blocks, dtype=jnp.float32,
+                 table_perm=None):
+        ks = jax.random.split(rng, 4)
+        self.q = jax.random.normal(ks[0], (b, hq, d), jnp.float32)
+        seq = bs * max_blocks
+        self.k_seq = jax.random.normal(ks[1], (b, seq, hkv, d), jnp.float32).astype(dtype)
+        self.v_seq = jax.random.normal(ks[2], (b, seq, hkv, d), jnp.float32).astype(dtype)
+        self.lengths = jax.random.randint(ks[3], (b,), 1, seq + 1)
+        # table: row r's i-th logical block lives at pool id table[r, i]
+        table = 1 + np.arange(b * max_blocks, dtype=np.int32).reshape(b, max_blocks)
+        if table_perm is not None:
+            table = table_perm(table)
+        self.table = jnp.asarray(table)
+        n_pool = 1 + b * max_blocks
+        k_pool = np.zeros((n_pool, hkv, bs, d), np.float32)
+        v_pool = np.zeros((n_pool, hkv, bs, d), np.float32)
+        for r in range(b):
+            for i in range(max_blocks):
+                blk = int(table[r, i])
+                # [bs, hkv, d] -> head-major [hkv, bs, d]
+                k_pool[blk] = np.asarray(
+                    self.k_seq[r, i * bs : (i + 1) * bs], np.float32
+                ).transpose(1, 0, 2)
+                v_pool[blk] = np.asarray(
+                    self.v_seq[r, i * bs : (i + 1) * bs], np.float32
+                ).transpose(1, 0, 2)
+        self.k_pool = jnp.asarray(k_pool).astype(dtype)
+        self.v_pool = jnp.asarray(v_pool).astype(dtype)
 
-def _dense_oracle(q, k_pool, v_pool, table, lengths):
-    """Gathered dense attention straight from decode._masked_attention."""
-    b = q.shape[0]
-    _, hkv, bs, d = k_pool.shape
-    k = k_pool[table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
-    v = v_pool[table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
-    mask = (jnp.arange(k.shape[1])[None, :] < lengths[:, None])[:, None, None]
-    return _masked_attention(q[:, None], k, v, mask)[:, 0]
+    def oracle(self):
+        """Dense attention over the ORIGINAL sequence-major arrays."""
+        mask = (
+            jnp.arange(self.k_seq.shape[1])[None, :] < self.lengths[:, None]
+        )[:, None, None]
+        return _masked_attention(self.q[:, None], self.k_seq, self.v_seq, mask)[:, 0]
 
 
 class TestKernelNumerics:
     @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 1)])
     def test_kernel_matches_dense(self, hq, hkv):
-        q, kp, vp, table, lengths = _random_pool(
+        c = _Case(
             jax.random.PRNGKey(0), b=3, hq=hq, hkv=hkv, d=64, bs=16, max_blocks=4
         )
-        want = _dense_oracle(q, kp, vp, table, lengths)
         got = paged_attention.paged_decode_attention(
-            q, kp, vp, table, lengths, interpret=True
+            c.q, c.k_pool, c.v_pool, c.table, c.lengths, interpret=True
         )
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(c.oracle()), atol=2e-5)
 
     def test_xla_gather_matches_dense(self):
-        q, kp, vp, table, lengths = _random_pool(
+        c = _Case(
             jax.random.PRNGKey(1), b=4, hq=4, hkv=2, d=32, bs=8, max_blocks=3
         )
-        got = paged_attention.paged_attention_xla(q, kp, vp, table, lengths)
-        want = _dense_oracle(q, kp, vp, table, lengths)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+        got = paged_attention.paged_attention_xla(
+            c.q, c.k_pool, c.v_pool, c.table, c.lengths
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(c.oracle()), atol=2e-5)
 
     def test_bf16_pool(self):
-        q, kp, vp, table, lengths = _random_pool(
+        c = _Case(
             jax.random.PRNGKey(2), b=2, hq=4, hkv=2, d=64, bs=16, max_blocks=2,
             dtype=jnp.bfloat16,
         )
-        want = _dense_oracle(q, kp, vp, table, lengths)
         got = paged_attention.paged_decode_attention(
-            q, kp, vp, table, lengths, interpret=True
+            c.q, c.k_pool, c.v_pool, c.table, c.lengths, interpret=True
         )
         np.testing.assert_allclose(
-            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+            np.asarray(got, np.float32), np.asarray(c.oracle(), np.float32),
+            atol=3e-2,
         )
 
     def test_single_key(self):
         """length=1: only the first key of the first block attends."""
-        q, kp, vp, table, _ = _random_pool(
-            jax.random.PRNGKey(3), b=2, hq=2, hkv=2, d=32, bs=8, max_blocks=2
-        )
-        lengths = jnp.ones((2,), jnp.int32)
+        c = _Case(jax.random.PRNGKey(3), b=2, hq=2, hkv=2, d=32, bs=8, max_blocks=2)
+        c.lengths = jnp.ones((2,), jnp.int32)
         got = paged_attention.paged_decode_attention(
-            q, kp, vp, table, lengths, interpret=True
+            c.q, c.k_pool, c.v_pool, c.table, c.lengths, interpret=True
         )
-        want = _dense_oracle(q, kp, vp, table, lengths)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(c.oracle()), atol=2e-5)
 
     def test_scrambled_table(self):
         """Block ids in arbitrary pool order — the table, not pool layout,
         defines key order."""
         rng = jax.random.PRNGKey(4)
-        q, kp, vp, table, lengths = _random_pool(
-            rng, b=2, hq=4, hkv=4, d=32, bs=8, max_blocks=4
+
+        def scramble(table):
+            perm = np.asarray(jax.random.permutation(rng, table.ravel()))
+            return perm.reshape(table.shape)
+
+        c = _Case(
+            rng, b=2, hq=4, hkv=4, d=32, bs=8, max_blocks=4, table_perm=scramble
         )
-        perm = np.asarray(jax.random.permutation(rng, np.asarray(table).ravel()))
-        table = jnp.asarray(perm.reshape(table.shape))
         got = paged_attention.paged_decode_attention(
-            q, kp, vp, table, lengths, interpret=True
+            c.q, c.k_pool, c.v_pool, c.table, c.lengths, interpret=True
         )
-        want = _dense_oracle(q, kp, vp, table, lengths)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(c.oracle()), atol=2e-5)
 
     def test_bad_head_ratio_raises(self):
         q = jnp.zeros((1, 3, 8))
