@@ -89,37 +89,64 @@ class OutOfBlocks(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side free list over pool blocks 1..n_blocks-1 (0 is reserved).
+    """Host-side refcounted free list over pool blocks 1..n_blocks-1 (0 is
+    reserved).
 
     LIFO reuse on purpose: the hottest blocks (just freed, still resident
     in whatever cache hierarchy) are handed out first, and tests get
     deterministic tables.
+
+    Refcounts are what make block-level PREFIX SHARING safe: a block holding
+    a common prompt prefix is referenced by every slot using it (plus the
+    prefix store); ``free`` drops one reference and the block returns to the
+    pool only when the last holder lets go.
     """
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
             raise ValueError(f"need >= 2 blocks (one is the null block), got {n_blocks}")
         self._free = list(range(n_blocks - 1, 0, -1))  # pop() -> lowest id first
+        self._refs: dict[int, int] = {}
         self.n_blocks = n_blocks
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def refcount(self, block_id: int) -> int:
+        return self._refs.get(block_id, 0)
+
     def alloc(self, n: int = 1) -> list[int]:
         if n > len(self._free):
             raise OutOfBlocks(
                 f"requested {n} blocks, {len(self._free)} free of {self.n_blocks - 1}"
             )
-        return [self._free.pop() for _ in range(n)]
+        ids = [self._free.pop() for _ in range(n)]
+        for i in ids:
+            self._refs[i] = 1
+        return ids
+
+    def share(self, block_id: int) -> int:
+        """Add a reference to a live block (prefix sharing)."""
+        if self._refs.get(block_id, 0) < 1:
+            raise ValueError(f"cannot share free block {block_id}")
+        self._refs[block_id] += 1
+        return block_id
 
     def free(self, ids) -> None:
+        """Drop one reference per id; a block returns to the pool when its
+        last reference drops."""
         for i in ids:
             if not 0 < i < self.n_blocks:
                 raise ValueError(f"block id {i} out of range (null block is 0)")
-            if i in self._free:
+            refs = self._refs.get(int(i), 0)
+            if refs < 1:
                 raise ValueError(f"double free of block {i}")
-            self._free.append(int(i))
+            if refs == 1:
+                del self._refs[int(i)]
+                self._free.append(int(i))
+            else:
+                self._refs[int(i)] = refs - 1
 
 
 def blocks_needed(tokens: int, block_size: int) -> int:
@@ -232,6 +259,79 @@ def paged_prefill(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "done_blocks", "chunk_len"))
+def paged_prefill_chunk(
+    params,
+    prompt: jax.Array,       # [1, bucket] padded prompt
+    cache: PagedKVCache,
+    block_table_row: jax.Array,  # [1, >= ceil(bucket/bs)] — done ids first
+    *,
+    cfg: ModelConfig,
+    done_blocks: int,        # leading FULL blocks already in the pool
+    chunk_len: int,          # tokens to prefill this call
+):
+    """Incremental admission: gather the already-pooled leading blocks'
+    k/v into a dense scratch row (only as wide as this chunk needs), run
+    ONE `decode_chunk` over positions ``[done, done + chunk_len)``
+    (``pos0`` re-derives positions, RoPE included), and scatter only the
+    chunk's blocks back into the pool.  The done blocks are never
+    re-written — whether they came from THIS request's earlier chunks
+    (chunked prefill) or from the SHARED prefix store (block-level prefix
+    cache): either way the attended bytes are the ones a full prefill
+    produces, the dense engine's prefix-cache bit-equality argument
+    (serve._prefill_suffix_into_slot).  Intermediate chunks must be
+    block-aligned; the final chunk may end anywhere in the bucket.
+    Returns the updated cache."""
+    b, bucket = prompt.shape
+    bs = cache.block_size
+    done_len = done_blocks * bs
+    end = done_len + chunk_len
+    if end > bucket:
+        raise ValueError(f"chunk [{done_len}, {end}) exceeds bucket {bucket}")
+    end_blocks = blocks_needed(end, bs)
+    p_pad = end_blocks * bs
+    l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+
+    row = decode.init_cache(cfg, 1, p_pad, dtype=cache.k.dtype)
+    if done_blocks:
+        ids = block_table_row[0, :done_blocks]
+        # pool [L, N, Hkv, bs, hd] -> [L, done, Hkv, bs, hd] -> seq-major
+        pre_k = cache.k[:, ids].transpose(0, 1, 3, 2, 4).reshape(
+            l, 1, done_len, hkv, hd
+        )
+        pre_v = cache.v[:, ids].transpose(0, 1, 3, 2, 4).reshape(
+            l, 1, done_len, hkv, hd
+        )
+        row = decode.KVCache(
+            k=row.k.at[:, :, :done_len].set(pre_k),
+            v=row.v.at[:, :, :done_len].set(pre_v),
+        )
+    chunk = prompt[:, done_len:end]
+    _, row = decode.decode_chunk(
+        params, row, chunk, done_len, cfg=cfg, k_window=end
+    )
+    # scatter ONLY the chunk's blocks (done ones are pooled already)
+    kb = row.k.reshape(l, b, end_blocks, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
+    vb = row.v.reshape(l, b, end_blocks, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
+    ids = block_table_row[:, done_blocks:end_blocks]
+    return PagedKVCache(
+        k=cache.k.at[:, ids].set(kb[:, :, done_blocks:]),
+        v=cache.v.at[:, ids].set(vb[:, :, done_blocks:]),
+    )
+
+
+def paged_prefill_suffix(
+    params, prompt, cache, block_table_row, *, cfg, cached_blocks
+):
+    """Prefix-hit admission = one chunk covering everything after the
+    shared prefix."""
+    return paged_prefill_chunk(
+        params, prompt, cache, block_table_row, cfg=cfg,
+        done_blocks=cached_blocks,
+        chunk_len=prompt.shape[1] - cached_blocks * cache.block_size,
+    )
+
+
 def _paged_step_all(
     params, cache, table, tokens, pos, active, temps, keys,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
@@ -304,6 +404,20 @@ class PagedServeEngine:
     top_k: int = 0
     attn_impl: str | None = None  # None = kernel on TPU, xla elsewhere
     interpret: bool = False
+    # Block-level prefix caching: > 0 keeps up to this many FULL prompt
+    # blocks in an LRU store and SHARES them (refcounted) across requests
+    # whose prompts start with the same tokens — admission skips both the
+    # blocks' memory and their prefill compute.  Paging generalizes the
+    # dense engine's fixed-bucket prefix cache to any whole-block prefix.
+    # Token streams are identical with caching on or off (tested).
+    prefix_cache_blocks: int = 0
+    # Chunked prefill (Sarathi-style): > 0 admits prompts incrementally,
+    # at most this many BLOCKS of prefill per engine step, interleaved
+    # with the decode batch — a long prompt no longer head-of-line blocks
+    # every resident request's next token.  0 = whole-prompt admission in
+    # submit().  Composes with the prefix store (shared blocks count as
+    # already-done chunks).  Streams identical either way (tested).
+    prefill_chunk_blocks: int = 0
 
     def __post_init__(self):
         cfg = self.cfg
@@ -348,6 +462,15 @@ class PagedServeEngine:
         )
         self._first_fn = jax.jit(functools.partial(_paged_first_token, **kw))
         self._prefill_fn = jax.jit(functools.partial(paged_prefill, cfg=cfg))
+        from collections import OrderedDict
+
+        # prefix store: tokens[0:(i+1)*bs] -> pool block id (holds one ref)
+        self._prefix_store: OrderedDict = OrderedDict()
+        self.prefix_hits = 0     # blocks reused across submits
+        self.prefix_misses = 0   # storable blocks computed fresh
+        # chunked-admission queue: FIFO of dicts, head advances one chunk
+        # per step() (see prefill_chunk_blocks)
+        self._admitting: list[dict] = []
 
     # -- public API --------------------------------------------------------
     @property
@@ -374,32 +497,87 @@ class PagedServeEngine:
             slot = self._slots.index(None)
         except ValueError:
             raise RuntimeError("no free slot") from None
-        # blocks for the prompt AND the first generated token's position
-        need = blocks_needed(len(prompt) + 1, self.block_size)
+        # padded prompt first: it is pure (no pool state), so a failure
+        # here can never strand allocated blocks
+        padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+        padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+        request_id = self._next_id
+        base_key = jax.random.PRNGKey(request_id if seed is None else seed)
+
+        # Prefix-store hit walk: the longest run of leading FULL blocks
+        # whose token content is already pooled.  Two caps: (plen-1)//bs
+        # keeps the block holding position plen-1 out of the store — the
+        # admission tail rewrites that position through the STEP program,
+        # whose bytes are not guaranteed bit-identical to the prefill's,
+        # and a shared block must never be written at all (the dense
+        # engine's strict `len(prompt) > prefix_bucket` for the same
+        # reason); (bucket-1)//bs keeps the suffix chunk's width real.
+        bs = self.block_size
+        storable = min((len(prompt) - 1) // bs, (self.prompt_bucket - 1) // bs)
+        cached_ids: list[int] = []
+        if self.prefix_cache_blocks > 0:
+            for i in range(storable):
+                key = tuple(prompt[: (i + 1) * bs])
+                if key not in self._prefix_store:
+                    break
+                self._prefix_store.move_to_end(key)  # LRU touch
+                cached_ids.append(self._alloc.share(self._prefix_store[key]))
+        cached = len(cached_ids)
+        self.prefix_hits += cached
+        if self.prefix_cache_blocks > 0 and storable > 0:
+            serve._M_PREFIX.inc(outcome="hit" if cached else "miss")
+        # blocks for the prompt AND the first generated token's position;
+        # shared prefix blocks satisfy the first `cached` entries
+        need = blocks_needed(len(prompt) + 1, bs)
         try:
-            ids = self._alloc.alloc(need)
+            ids = cached_ids + self._alloc.alloc(need - cached)
         except OutOfBlocks:
+            self._alloc.free(cached_ids)  # drop the hit refs we just took
             raise RuntimeError(
-                f"no free blocks ({need} needed, {self._alloc.free_blocks} free)"
+                f"no free blocks ({need - cached} needed, "
+                f"{self._alloc.free_blocks} free)"
             ) from None
         self._owned[slot] = ids
         self._table_np[slot, :] = NULL_BLOCK
         self._table_np[slot, :need] = ids
         self._table = jnp.asarray(self._table_np)
 
+        if self.prefill_chunk_blocks > 0:
+            # Chunked admission: reserve the slot now, prefill at most
+            # prefill_chunk_blocks per step() so resident requests keep
+            # generating while this prompt admits (shared prefix blocks
+            # count as already-done chunks).
+            self._next_id += 1
+            self._slots[slot] = _Slot(
+                request_id, list(prompt), len(prompt), max_tokens
+            )
+            self._admitting.append(
+                dict(
+                    slot=slot, prompt=list(prompt), padded=padded,
+                    plen=len(prompt), done=cached, storable=storable,
+                    cached=cached, temp=temperature, key=base_key,
+                )
+            )
+            # _M_REQUESTS counts at ACTIVATION (matching the non-chunked
+            # path, which only counts successful admissions)
+            self._update_gauges()
+            return request_id
+
         try:
-            padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
-            padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
             # Prefill writes ceil(bucket/bs) block stripes; entries past the
             # row's owned blocks are the null block (a scratch sink — those
             # positions are beyond plen+1 and re-written before ever attended).
             prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
-            self._cache, _ = self._prefill_fn(
-                self.params, padded, self._cache, prefill_row
-            )
-
-            request_id = self._next_id
-            base_key = jax.random.PRNGKey(request_id if seed is None else seed)
+            if cached:
+                self._cache = paged_prefill_suffix(
+                    self.params, padded, self._cache, prefill_row,
+                    cfg=self.cfg, cached_blocks=cached,
+                )
+            else:
+                self._cache, _ = self._prefill_fn(
+                    self.params, padded, self._cache, prefill_row
+                )
+            self._store_prefix_blocks(prompt, slot, storable, cached)
             first_tok, self._cache = self._first_fn(
                 self.params, self._cache, self._table, padded, len(prompt), slot,
                 jnp.float32(temperature), base_key,
@@ -426,14 +604,87 @@ class PagedServeEngine:
         self._update_gauges()
         return request_id
 
+    def _advance_admission(self) -> None:
+        """Run at most ONE prefill chunk for the admission-queue head; on
+        the final chunk, activate the slot (first token, sampler state)."""
+        from k8s_dra_driver_tpu.models import serve
+
+        if not self._admitting:
+            return
+        adm = self._admitting[0]
+        slot = adm["slot"]
+        bs = self.block_size
+        # walk only the PROMPT's blocks (rounded up to a boundary, capped
+        # at the bucket): padding past the prompt is never attended, so
+        # prefilling it would only delay activation — first-token latency
+        # must scale with the prompt, not the bucket
+        real_end = min(blocks_needed(adm["plen"], bs) * bs, self.prompt_bucket)
+        prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+        try:
+            if real_end - adm["done"] * bs > self.prefill_chunk_blocks * bs:
+                self._cache = paged_prefill_chunk(
+                    self.params, adm["padded"], self._cache, prefill_row,
+                    cfg=self.cfg, done_blocks=adm["done"],
+                    chunk_len=self.prefill_chunk_blocks * bs,
+                )
+                adm["done"] += self.prefill_chunk_blocks
+                return
+            # final chunk (may be narrower than a whole number of blocks),
+            # then activation
+            chunk_len = real_end - adm["done"] * bs
+            if chunk_len > 0:
+                self._cache = paged_prefill_chunk(
+                    self.params, adm["padded"], self._cache, prefill_row,
+                    cfg=self.cfg, done_blocks=adm["done"], chunk_len=chunk_len,
+                )
+            first_tok, self._cache = self._first_fn(
+                self.params, self._cache, self._table, adm["padded"],
+                adm["plen"], slot, jnp.float32(adm["temp"]), adm["key"],
+            )
+        except BaseException as exc:
+            # failed mid-admission: release the reservation entirely AND
+            # surface an errored Completion — the caller already holds the
+            # request id, and without it a failed request is
+            # indistinguishable from one still streaming
+            self._admitting.pop(0)
+            st = self._slots[slot]
+            self._slots[slot] = None
+            self._alloc.free(self._owned[slot])
+            self._owned[slot] = []
+            self._table_np[slot, :] = NULL_BLOCK
+            self._table = jnp.asarray(self._table_np)
+            self._completions.append(
+                serve.Completion(
+                    request_id=st.request_id, tokens=list(st.tokens),
+                    generated=[], error=f"{type(exc).__name__}: {exc}",
+                )
+            )
+            raise
+        self._admitting.pop(0)
+        serve._M_REQUESTS.inc()  # successful admission, like the sync path
+        self._store_prefix_blocks(
+            adm["prompt"], slot, adm["storable"], adm["cached"]
+        )
+        self._slots[slot].tokens.append(int(first_tok))
+        self._last = self._last.at[slot].set(first_tok)
+        self._pos = self._pos.at[slot].set(adm["plen"])
+        self._temps = self._temps.at[slot].set(adm["temp"])
+        self._keys = self._keys.at[slot].set(adm["key"])
+        serve._M_TOKENS.inc()
+        self._retire(slot)
+        self._update_gauges()
+
     def step(self) -> int:
-        """Advance every active, non-stalled slot one token; returns the
-        number of slots stepped."""
+        """Advance every active, non-stalled slot one token (and the
+        admission-queue head by one prefill chunk); returns the number of
+        slots stepped."""
+        self._advance_admission()
+        admitting = {a["slot"] for a in self._admitting}
         active = np.zeros((self.n_slots,), bool)
         table_dirty = False
         pos_np = np.asarray(self._pos)
         for slot, st in enumerate(self._slots):
-            if st is None:
+            if st is None or slot in admitting:
                 continue
             blk = int(pos_np[slot]) // self.block_size
             if blk >= len(self._owned[slot]):
@@ -471,7 +722,8 @@ class PagedServeEngine:
 
     def run_until_drained(self, max_steps: int = 10_000) -> None:
         for _ in range(max_steps):
-            if self.step() == 0:
+            admitting = bool(self._admitting)  # a chunk advancing IS progress
+            if self.step() == 0 and not admitting:
                 if self.free_slots() == self.n_slots:
                     return
                 # every resident slot stalled and nothing can retire to
@@ -484,6 +736,27 @@ class PagedServeEngine:
         return out
 
     # -- internals ---------------------------------------------------------
+    def _store_prefix_blocks(
+        self, prompt: list[int], slot: int, storable: int, cached: int
+    ) -> None:
+        """Insert this admission's freshly computed full prompt blocks into
+        the LRU prefix store (each entry holds one reference, so stored
+        blocks outlive the request that computed them)."""
+        if self.prefix_cache_blocks <= 0:
+            return
+        self.prefix_misses += max(storable - cached, 0)
+        for i in range(cached, storable):
+            key = tuple(prompt[: (i + 1) * self.block_size])
+            if key in self._prefix_store:
+                self._prefix_store.move_to_end(key)
+                continue
+            self._prefix_store[key] = self._alloc.share(
+                int(self._table_np[slot, i])
+            )
+        while len(self._prefix_store) > self.prefix_cache_blocks:
+            _, old = self._prefix_store.popitem(last=False)  # LRU evict
+            self._alloc.free([old])
+
     def _retire(self, slot: int) -> None:
         from k8s_dra_driver_tpu.models import serve
 

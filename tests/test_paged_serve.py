@@ -147,6 +147,122 @@ class TestPoolAccounting:
         with pytest.raises(RuntimeError, match="wedged"):
             pag.run_until_drained()
 
+    def test_prefix_cache_streams_identical(self, params):
+        """Block-level prefix sharing changes residency and admission
+        compute, never tokens: same streams with caching on and off."""
+        sys_prefix = list(np.arange(32) % CFG.vocab_size)  # 2 full blocks
+        reqs = [
+            (sys_prefix + [5, 7, 9], 10, 0.0, 0),
+            (sys_prefix + [11, 2], 10, 0.0, 1),
+            (sys_prefix + [3], 8, 0.9, 2),
+        ]
+        off = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=30, block_size=BS,
+            prompt_bucket=48, attn_impl="xla",
+        )
+        on = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=30, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefix_cache_blocks=4,
+        )
+        assert _streams(off, reqs) == _streams(on, reqs)
+        assert on.prefix_hits > 0 and on.prefix_misses > 0
+
+    def test_prefix_blocks_shared_not_recomputed(self, params):
+        """Two live requests with a common 2-block prefix consume the
+        prefix blocks ONCE; the store keeps them after both retire."""
+        sys_prefix = list(np.arange(32) % CFG.vocab_size)
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=30, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefix_cache_blocks=4,
+        )
+        before = eng.free_blocks
+        eng.submit(sys_prefix + [1, 2, 3], 40)
+        used_first = before - eng.free_blocks
+        eng.submit(sys_prefix + [4, 5], 40)
+        used_second = (before - eng.free_blocks) - used_first
+        # second request shares the 2 prefix blocks: only its own suffix +
+        # growth blocks are newly drawn
+        assert used_second == used_first - 2
+        shared_id = eng._prefix_store[tuple(sys_prefix[:BS])]
+        assert eng._alloc.refcount(shared_id) == 3  # store + both slots
+        eng.run_until_drained()
+        # slots retired: store still holds one ref per cached block
+        assert eng._alloc.refcount(shared_id) == 1
+        assert eng.free_blocks == before - len(eng._prefix_store)
+
+    def test_prefix_store_lru_eviction_frees_blocks(self, params):
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=30, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefix_cache_blocks=2,
+        )
+        baseline = eng.free_blocks
+        for seed in range(4):  # distinct full-block prefixes (plen > bs:
+            # the block holding plen-1 is never stored, so a storable
+            # block needs at least bs+1 prompt tokens)
+            prompt = list((np.arange(20) + 7 * seed) % CFG.vocab_size)
+            eng.submit(prompt, 2)
+            eng.run_until_drained()
+        assert len(eng._prefix_store) == 2  # LRU capped
+        assert eng.free_blocks == baseline - 2  # evicted entries freed
+
+    def test_chunked_prefill_streams_identical(self, params):
+        """Chunked admission changes WHEN prefill compute runs, never the
+        tokens: same streams with chunking on and off (greedy + sampled)."""
+        reqs = [(p, 10, t, i) for i, (p, t) in enumerate(
+            zip(_prompts(5, rng=13), [0.0, 0.8, 0.0, 1.1, 0.0])
+        )]
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=48, attn_impl="xla",
+        )
+        chunked = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefill_chunk_blocks=1,
+        )
+        assert _streams(plain, reqs) == _streams(chunked, reqs)
+
+    def test_decode_interleaves_with_admission(self, params):
+        """The Sarathi property: resident requests keep generating while a
+        prompt admits chunk by chunk — no head-of-line blocking."""
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefill_chunk_blocks=1,
+        )
+        eng.submit(_prompts(1)[0], 30)
+        while eng._admitting:  # admit A fully
+            eng.step()
+        a_slot = next(i for i, s in enumerate(eng._slots) if s is not None)
+        eng.submit(list(np.arange(40) % CFG.vocab_size), 5)  # 3-chunk admission
+        assert eng._admitting
+        before = len(eng._slots[a_slot].tokens)
+        eng.step()
+        assert eng._admitting  # B still admitting...
+        assert len(eng._slots[a_slot].tokens) == before + 1  # ...A advanced
+
+    def test_chunked_composes_with_prefix_cache(self, params):
+        """Shared prefix blocks count as already-done chunks: the second
+        admission needs fewer steps AND produces identical tokens."""
+        sys_prefix = list(np.arange(32) % CFG.vocab_size)  # 2 full blocks
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=40, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefill_chunk_blocks=1,
+            prefix_cache_blocks=4,
+        )
+
+        def admit_steps(prompt):
+            eng.submit(prompt, 6)
+            n = 0
+            while eng._admitting:
+                eng.step()
+                n += 1
+            eng.run_until_drained()
+            return n, eng.completions()[0].generated
+
+        n1, gen1 = admit_steps(sys_prefix + [5, 7])
+        n2, gen2 = admit_steps(sys_prefix + [5, 7])
+        assert n2 < n1  # 2 of 3 chunks came from the store
+        assert gen1 == gen2
+
     def test_metrics_land_in_registry(self, params):
         """The paged backend feeds the SAME serving counters as the dense
         engine (observability parity) plus the pool-free gauge."""

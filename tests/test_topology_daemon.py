@@ -360,9 +360,14 @@ class TestProgram:
         )
         try:
             sock = str(tmp_path / "host.sock")
-            deadline = time.time() + 10
+            # generous deadline: the suite may share the box with a bench
+            # run, and a slow fork is not a daemon bug (observed flake)
+            deadline = time.time() + 30
             while time.time() < deadline and not Path(sock).exists():
+                if proc.poll() is not None:
+                    raise RuntimeError(f"daemon died: {proc.stdout.read()!r}")
                 time.sleep(0.02)
+            assert Path(sock).exists(), "daemon socket never appeared"
             a = TopologyDaemonClient(sock, "a")
             b = TopologyDaemonClient(sock, "b")
             assert a.acquire(quantum_ms=60000, scope="0")["ok"]
