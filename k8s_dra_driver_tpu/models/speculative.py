@@ -46,6 +46,19 @@ from k8s_dra_driver_tpu.models.decode import (
 )
 
 
+def accept_advance(proposed, target, active):
+    """THE speculative acceptance rule, shared by `speculative_decode` and
+    the serving engine (`serve._spec_round`) — one implementation or their
+    bit-equality contracts drift.  ``proposed`` [B, gamma] draft tokens,
+    ``target`` [B, >= gamma] verifier argmaxes, ``active`` [B] bool.
+    Returns (n_acc leading agreements, advance = n_acc + 1 per active row
+    — full acceptance commits the gamma+1 bonus token)."""
+    gamma = proposed.shape[1]
+    matches = (proposed == target[:, :gamma]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+    return n_acc, jnp.where(active, n_acc + 1, 0)
+
+
 class SpecStats(NamedTuple):
     """Speculation telemetry.  ``drafted``/``accepted``/``emitted`` are
     summed over the whole batch; ``rounds`` is loop iterations (shared by
@@ -174,14 +187,12 @@ def speculative_decode(
         )
         target = jnp.argmax(logits, axis=-1).astype(tokens.dtype)  # [B, gamma+1]
 
-        matches = (proposed == target[:, :gamma]).astype(jnp.int32)
-        n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # leading agreements
         # Full acceptance commits n_acc + 1 = gamma + 1 (the standard bonus
         # token): the priming step in draft_round fed position pos+gamma, so
         # the draft cache covers every position below the new frontier.  On
         # partial acceptance the +1 is the correction token, whose key the
         # next round's sequential re-feed rewrites before any query sees it.
-        advance = jnp.where(active, n_acc + 1, 0)
+        n_acc, advance = accept_advance(proposed, target, active)
 
         # Commit: positions pos+1 .. pos+gamma+1 get the target argmaxes
         # (prefix = accepted drafts, then the correction token; the rest is

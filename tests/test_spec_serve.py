@@ -1,0 +1,146 @@
+"""Speculative serving: continuous batching where every greedy slot
+advances up to gamma+1 tokens per round — streams bit-equal the plain
+engine's."""
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin
+from k8s_dra_driver_tpu.models.quant import quantize_blocks
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+CFG = burnin.ModelConfig(
+    vocab_size=89, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=128
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _prompts(n, rng=7):
+    r = np.random.RandomState(rng)
+    return [r.randint(0, CFG.vocab_size, size=r.randint(3, 12)).tolist() for _ in range(n)]
+
+
+def _streams(engine, reqs):
+    pending = list(reqs)
+    out = {}
+    for _ in range(5000):
+        while pending:
+            prompt, max_tokens = pending[0]
+            try:
+                engine.submit(prompt, max_tokens)
+                pending.pop(0)
+            except RuntimeError:
+                break
+        stepped = engine.step()
+        for c in engine.completions():
+            out[c.request_id] = c.generated
+        if not pending and stepped == 0 and engine.free_slots() == engine.n_slots:
+            return out
+    raise RuntimeError("queue did not drain")
+
+
+class TestSpecServe:
+    def test_streams_identical_to_plain_engine(self, params):
+        """int8 self-draft through the engine: same tokens as the plain
+        engine, requests joining and leaving mid-flight."""
+        reqs = [(p, 14) for p in _prompts(5)]
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=16)
+        spec = ServeEngine(
+            params=params, cfg=CFG, n_slots=2, prompt_bucket=16, spec_gamma=3
+        )
+        assert _streams(plain, reqs) == _streams(spec, reqs)
+
+    def test_full_acceptance_round_count(self, params):
+        """Self-draft with the TARGET weights accepts everything: a
+        request commits gamma+1 tokens per round."""
+        gamma, steps = 3, 20
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+            spec_gamma=gamma, draft_params=params,
+        )
+        eng.submit(_prompts(1)[0], steps)
+        rounds = 0
+        while eng.free_slots() < eng.n_slots:
+            eng.step()
+            rounds += 1
+        # 1 token at admission, then gamma+1 per round
+        assert rounds == -(-(steps - 1) // (gamma + 1))
+        gen = eng.completions()[0].generated
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=1, prompt_bucket=16)
+        plain.submit(_prompts(1)[0], steps)
+        plain.run_until_drained()
+        assert gen == plain.completions()[0].generated
+
+    def test_eos_clips_mid_round(self, params):
+        prompt = _prompts(1, rng=3)[0]
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=1, prompt_bucket=16)
+        plain.submit(prompt, 12)
+        plain.run_until_drained()
+        stream = plain.completions()[0].generated
+        eos = stream[4]  # retire mid-stream (and possibly mid-round)
+        want = stream[: stream.index(eos) + 1]
+        spec = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+            spec_gamma=3, eos_id=eos,
+        )
+        spec.submit(prompt, 12)
+        spec.run_until_drained()
+        assert spec.completions()[0].generated == want
+
+    def test_shallow_draft(self, params):
+        """Any same-vocab draft works — here the target's first layer."""
+        shallow = dict(params, blocks=params["blocks"][:1])
+        reqs = [(p, 10) for p in _prompts(3, rng=11)]
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=16)
+        spec = ServeEngine(
+            params=params, cfg=CFG, n_slots=2, prompt_bucket=16,
+            spec_gamma=2, draft_params=shallow,
+        )
+        assert _streams(plain, reqs) == _streams(spec, reqs)
+        # the draft cache really is shallower
+        assert spec._d_cache.k.shape[0] == 1
+
+    def test_int8_draft_is_default(self, params):
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16, spec_gamma=2
+        )
+        ref = quantize_blocks(params)
+        assert jax.tree.structure(eng.draft_params) == jax.tree.structure(ref)
+
+    def test_validation(self, params):
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16, spec_gamma=4
+        )
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit([1, 2, 3], 4, temperature=0.7)
+        with pytest.raises(ValueError, match="slack"):
+            eng.submit([1, 2, 3], CFG.max_seq - 3)  # no room for gamma
+        with pytest.raises(ValueError, match="compose"):
+            ServeEngine(
+                params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+                spec_gamma=2, prefix_bucket=8,
+            )
+
+    def test_draft_cache_isolated_per_slot(self, params):
+        """A retiring slot's stale draft rows never leak into a new
+        request admitted to the same slot."""
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+            spec_gamma=2, draft_params=params,
+        )
+        for prompt in _prompts(3, rng=5):
+            eng.submit(prompt, 8)
+            eng.run_until_drained()
+        streams = {c.request_id: c.generated for c in eng.completions()}
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=1, prompt_bucket=16)
+        for prompt in _prompts(3, rng=5):
+            plain.submit(prompt, 8)
+            plain.run_until_drained()
+        want = {c.request_id: c.generated for c in plain.completions()}
+        assert streams == want
+
