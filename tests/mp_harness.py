@@ -1,0 +1,69 @@
+"""Shared harness for REAL two-process jax.distributed tests.
+
+One implementation of the fake-cluster → slice-test1 → CDI-env →
+subprocess-worker flow (coordinator re-pointing, CPU forcing, orphan
+cleanup), used by tests/test_multiprocess.py (training collective) and
+tests/test_multiprocess_serve.py (DP-sharded serving)."""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SPECS = REPO_ROOT / "demo" / "specs" / "quickstart"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_two_process_workers(cluster, tmp_path, worker_src: str,
+                            n_devices: int = 2, timeout: int = 300):
+    """Apply slice-test1 scaled to 2 hosts, hand each pod's CDI env to a
+    separate python process running ``worker_src``, and return the parsed
+    last-line JSON of each worker.  A failing worker never orphans its
+    sibling (the survivor would block in jax.distributed.initialize for
+    its full init timeout)."""
+    from k8s_dra_driver_tpu.e2e.dryrun import force_cpu_env
+    from k8s_dra_driver_tpu.e2e.spec_runner import apply_spec
+
+    spec = (SPECS / "slice-test1.yaml").read_text().replace(
+        "replicas: 4", "replicas: 2"
+    )
+    spec_path = tmp_path / "slice-test1-2host.yaml"
+    spec_path.write_text(spec)
+    pods = apply_spec(cluster, spec_path)
+    assert len(pods) == 2
+
+    port = free_port()
+    children = []
+    for pod in pods:
+        env = dict(pod.env)
+        # the seat wired tpu-host-0:8476; re-point at this test's real TCP
+        # port on localhost (the cluster DNS name cannot resolve here)
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        force_cpu_env(env, n_devices=n_devices)
+        env["PYTHONPATH"] = str(REPO_ROOT)
+        children.append(
+            subprocess.Popen(
+                [sys.executable, "-c", worker_src],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for child in children:
+            out, err = child.communicate(timeout=timeout)
+            assert child.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.kill()
+                c.wait()
+    return outs

@@ -15,20 +15,9 @@ cluster (demo/specs/quickstart/README.md); this test closes that loop
 hermetically.
 """
 
-import json
-import socket
-import subprocess
-import sys
-from pathlib import Path
-
-
 from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
-from k8s_dra_driver_tpu.e2e.dryrun import force_cpu_env
 from k8s_dra_driver_tpu.e2e.harness import make_cluster
-from k8s_dra_driver_tpu.e2e.spec_runner import apply_spec
-
-REPO_ROOT = Path(__file__).resolve().parent.parent
-SPECS = REPO_ROOT / "demo" / "specs" / "quickstart"
+from tests.mp_harness import run_two_process_workers
 
 # What each worker process runs: the slice-test1 container command's core
 # (consumer bootstrap) + a cross-process collective the pod-log check
@@ -54,12 +43,6 @@ print(json.dumps({
 """
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def test_two_process_membership_claim_runs_cross_process_collective(tmp_path):
     cluster = make_cluster(
         hosts=2, topology="v5e-16", work_dir=str(tmp_path), slice_domain="mp-demo"
@@ -67,48 +50,7 @@ def test_two_process_membership_claim_runs_cross_process_collective(tmp_path):
     manager = SliceManager(cluster.server)
     manager.start()
     try:
-        # slice-test1 scaled to this 2-host cluster
-        spec = (SPECS / "slice-test1.yaml").read_text().replace(
-            "replicas: 4", "replicas: 2"
-        )
-        spec_path = tmp_path / "slice-test1-2host.yaml"
-        spec_path.write_text(spec)
-        pods = apply_spec(cluster, spec_path)
-        assert len(pods) == 2
-
-        port = _free_port()
-        children = []
-        for pod in pods:
-            env = dict(pod.env)
-            # the seat wired tpu-host-0:8476; re-point at this test's real
-            # TCP port on localhost (the cluster DNS name cannot resolve here)
-            env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-            force_cpu_env(env, n_devices=2)  # 2 virtual chips per "host"
-            env["PYTHONPATH"] = str(REPO_ROOT)
-            children.append(
-                subprocess.Popen(
-                    [sys.executable, "-c", WORKER],
-                    env=env,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
-                    text=True,
-                )
-            )
-        outs = []
-        try:
-            for child in children:
-                out, err = child.communicate(timeout=180)
-                assert child.returncode == 0, f"worker failed:\n{err[-2000:]}"
-                outs.append(json.loads(out.strip().splitlines()[-1]))
-        finally:
-            # one worker failing must not orphan its sibling: the survivor
-            # would block in jax.distributed.initialize for its full init
-            # timeout waiting on a coordinator that will never answer
-            for c in children:
-                if c.poll() is None:
-                    c.kill()
-                    c.wait()
-
+        outs = run_two_process_workers(cluster, tmp_path, WORKER, timeout=180)
         workers = sorted(o["worker"] for o in outs)
         assert workers == [0, 1]  # distinct driver-assigned identities
         for o in outs:
