@@ -247,3 +247,18 @@ class TestDaemonConnectRetry:
         ctx = consumer.attach(environ=env, init_distributed=False)
         with pytest.raises(ConnectionError, match="not reachable"):
             ctx.daemon_client(retries=2, retry_delay_s=0.05)
+
+
+class TestServeDemo:
+    def test_serve_demo_runs_to_completion(self, capsys):
+        """`consumer --serve-demo` drains the paged engine on whatever
+        devices the claim wired (CPU here) and prints one JSON summary —
+        the inference analog of the nvidia-smi pod-log check."""
+        rc = consumer.main(["--serve-demo"])
+        assert rc == 0
+        lines = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+        summary = next(d["serve_demo"] for d in lines if "serve_demo" in d)
+        assert summary["completed"] == 4
+        assert summary["generated_tokens"] == 12 + 10 + 8 + 6
+        assert summary["prefix_block_hits"] > 0  # the shared block paid off
+        assert summary["pool_free_blocks"] > 0
