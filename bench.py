@@ -165,6 +165,21 @@ def _data_plane_body() -> dict:
             out["decode_int8"] = _decode_throughput(cfg, quantize_blocks(params))
         except Exception as exc:  # noqa: BLE001
             out["decode_int8"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # Weight-only int4 (group-wise packed nibbles): half the weight
+        # bytes again; same exactness contract vs its dequantized view.
+        try:
+            from k8s_dra_driver_tpu.models.quant import quantize_blocks as qb
+
+            out["decode_int4"] = {
+                **_decode_throughput(cfg, qb(params, bits=4)),
+                # measured SLOWER than bf16 here: the nibble unpack is
+                # per-step compute and this small model is overhead-bound,
+                # not weight-bandwidth-bound — the byte saving pays at
+                # scale (and as the speculative draft's storage)
+                "note": "unpack-bound on the small bench model",
+            }
+        except Exception as exc:  # noqa: BLE001
+            out["decode_int4"] = {"error": f"{type(exc).__name__}: {exc}"}
         # int8 MXU ceiling (the quantized-compute headroom over bf16).
         try:
             from k8s_dra_driver_tpu.ops.collectives import matmul_int8_tops

@@ -95,3 +95,88 @@ class TestQuantizedModel:
         agree = float((np.asarray(ref) == np.asarray(out)).mean())
         assert agree > 0.7  # random-init logits are near-uniform; trained
         # models agree far more — the contract here is "sane, not garbage"
+
+
+class TestInt4:
+    def test_pack_unpack_exact(self):
+        """Values already on the int4 grid survive the pack/unpack round
+        trip (each group carries a ±7 so the derived scale lands exactly
+        on the grid's step)."""
+        from k8s_dra_driver_tpu.models.quant import Quantized4Matrix
+
+        rng = np.random.RandomState(0)
+        step = 0.25
+        q = rng.randint(-7, 8, size=(128, 32)).astype(np.float32)
+        q[0::64] = 7.0  # pin every group's max -> scale == step exactly
+        w = jnp.asarray(q * step)
+        qm = Quantized4Matrix.quantize(w, group_size=64)
+        np.testing.assert_allclose(
+            np.asarray(qm.dequant(), np.float32), np.asarray(w), atol=1e-6
+        )
+
+    def test_groupwise_beats_columnwise_on_outliers(self):
+        """The reason for group scales: one outlier row must not wreck the
+        whole column's resolution."""
+        from k8s_dra_driver_tpu.models.quant import Quantized4Matrix
+
+        w = jax.random.normal(jax.random.PRNGKey(2), (256, 16), jnp.float32)
+        w = w.at[0].mul(50.0)  # outlier in group 0 only
+        qm = Quantized4Matrix.quantize(w, group_size=64)
+        err = jnp.abs(qm.dequant().astype(jnp.float32) - w)[64:]  # other groups
+        rel = float(err.max() / jnp.abs(w[64:]).max())
+        assert rel < 0.12  # int4 step within a clean group, not outlier-scaled
+
+    def test_block_weight_bytes_are_half_of_int8(self):
+        """Compare the BLOCK weights only (embeddings stay unquantized and
+        dominate this tiny config's total)."""
+        params = _params()
+        blocks = lambda p: {"blocks": p["blocks"]}  # noqa: E731
+        b4, dense = quantized_bytes(blocks(quantize_blocks(params, bits=4)))
+        b8, _ = quantized_bytes(blocks(quantize_blocks(params, bits=8)))
+        assert b4 < 0.62 * b8  # ~4.5 bits vs ~8.25 bits per weight
+        assert b4 < 0.40 * dense
+
+    def test_greedy_decode_equals_manually_dequantized_params(self):
+        """The same exactness contract as int8: storage changes, numbers
+        don't."""
+        from k8s_dra_driver_tpu.models.quant import Quantized4Matrix
+
+        params = _params()
+        qp = quantize_blocks(params, bits=4)
+        deq = dict(qp)
+        deq["blocks"] = [
+            {k: (mat(v) if isinstance(v, Quantized4Matrix) else v)
+             for k, v in blk.items()}
+            for blk in qp["blocks"]
+        ]
+        prompt = burnin.sample_tokens(jax.random.PRNGKey(6), CFG, batch=2, seq=8)
+        out_q = decode.greedy_decode(qp, prompt, 12, cfg=CFG, batch_prefill=True)
+        out_d = decode.greedy_decode(deq, prompt, 12, cfg=CFG, batch_prefill=True)
+        np.testing.assert_array_equal(np.asarray(out_q), np.asarray(out_d))
+
+    def test_int4_as_speculative_draft(self):
+        """int4's extra error only moves ACCEPTANCE, never output — the
+        natural draft config (half the draft's HBM bytes again)."""
+        from k8s_dra_driver_tpu.models import speculative
+
+        params = _params()
+        prompt = burnin.sample_tokens(jax.random.PRNGKey(7), CFG, batch=2, seq=6)
+        want = decode.greedy_decode(params, prompt, 14, cfg=CFG, batch_prefill=True)
+        got = speculative.speculative_decode(
+            params, quantize_blocks(params, bits=4), prompt, 14, CFG, gamma=3
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_bad_bits_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="bits"):
+            quantize_blocks(_params(), bits=2)
+
+    def test_odd_input_dim_rejected(self):
+        import pytest
+
+        from k8s_dra_driver_tpu.models.quant import Quantized4Matrix
+
+        with pytest.raises(ValueError, match="divisible"):
+            Quantized4Matrix.quantize(jnp.zeros((66, 8)), group_size=64)
