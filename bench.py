@@ -168,10 +168,8 @@ def _data_plane_body() -> dict:
         # Weight-only int4 (group-wise packed nibbles): half the weight
         # bytes again; same exactness contract vs its dequantized view.
         try:
-            from k8s_dra_driver_tpu.models.quant import quantize_blocks as qb
-
             out["decode_int4"] = {
-                **_decode_throughput(cfg, qb(params, bits=4)),
+                **_decode_throughput(cfg, quantize_blocks(params, bits=4)),
                 # measured SLOWER than bf16 here: the nibble unpack is
                 # per-step compute and this small model is overhead-bound,
                 # not weight-bandwidth-bound — the byte saving pays at

@@ -17,6 +17,8 @@ the TPU framework ships (SURVEY.md §2.11).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -143,17 +145,21 @@ def mat(w):
 _BLOCK_WEIGHT_KEYS = ("qkv", "attn_out", "mlp_up", "mlp_down")
 
 
-def quantize_blocks(params: dict, bits: int = 8) -> dict:
+def quantize_blocks(params: dict, bits: int = 8, group_size: int = 64) -> dict:
     """Quantize the transformer-block matmul weights (the bulk of the
     parameter bytes); embeddings / norms / positions stay in the compute
     dtype (tied_logits indexes embed by row, and norm gains are tiny).
     ``bits``: 8 (per-column int8) or 4 (group-wise packed int4 — half the
     weight bytes again; the natural SPECULATIVE DRAFT, where int4's extra
-    quantization error only moves acceptance, never output)."""
+    quantization error only moves acceptance, never output).
+    ``group_size`` (int4 only): input rows per scale; pick one that
+    divides every block weight's input dim (d_model and d_ff)."""
     if bits == 8:
         quantizer = QuantizedMatrix.quantize
     elif bits == 4:
-        quantizer = Quantized4Matrix.quantize
+        quantizer = functools.partial(
+            Quantized4Matrix.quantize, group_size=group_size
+        )
     else:
         raise ValueError(f"bits must be 8 or 4, got {bits}")
     out = dict(params)

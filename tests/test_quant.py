@@ -167,6 +167,21 @@ class TestInt4:
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_group_size_passthrough(self):
+        """Dims that 64 does not divide work with a caller-chosen group."""
+        from k8s_dra_driver_tpu.models.quant import Quantized4Matrix
+
+        cfg = burnin.ModelConfig(
+            vocab_size=64, d_model=48, n_heads=4, n_layers=1, d_ff=96, max_seq=32
+        )
+        params = burnin.init_params(jax.random.PRNGKey(8), cfg)
+        import pytest
+
+        with pytest.raises(ValueError, match="divisible"):
+            quantize_blocks(params, bits=4)  # 48 % 64 != 0
+        qp = quantize_blocks(params, bits=4, group_size=16)
+        assert isinstance(qp["blocks"][0]["qkv"], Quantized4Matrix)
+
     def test_bad_bits_rejected(self):
         import pytest
 
