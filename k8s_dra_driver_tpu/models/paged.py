@@ -224,6 +224,68 @@ def paged_decode_step(
     return tied_logits(x, params)[:, 0], cache
 
 
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "attn_impl", "interpret")
+)
+def paged_decode_chunk(
+    params,
+    cache: PagedKVCache,
+    block_table: jax.Array,  # [B, max_blocks] i32
+    window: jax.Array,       # [B, S] int32 — known tokens from each frontier
+    pos: jax.Array,          # [B] i32 — window[:, j] sits at pos + j
+    *,
+    cfg: ModelConfig,
+    active=None,
+    attn_impl: str = "xla",
+    interpret: bool = False,
+):
+    """Score ``S`` known tokens per row in ONE pass over the paged cache —
+    the paged mirror of :func:`decode.decode_chunk` (per-layer: scatter the
+    window's k/v into the pool, then windowed paged attention where query j
+    attends positions <= pos + j).  This is what makes SPECULATIVE
+    verification compose with paging: the verify window runs through the
+    block table instead of a dense row.  Returns (logits [B, S, V] f32,
+    updated cache)."""
+    b, s = window.shape
+    bs = cache.block_size
+    rows = jnp.arange(b)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [B, S]
+
+    x = params["embed"][window]
+    if not cfg.rope:
+        x = x + params["pos_embed"][positions]
+
+    block_ids = block_table[rows[:, None], positions // bs]  # [B, S]
+    offs = positions % bs
+    if active is not None:
+        # stale tables on inactive rows may point at REASSIGNED blocks —
+        # divert their writes to the null block (see paged_decode_step)
+        block_ids = jnp.where(active[:, None], block_ids, NULL_BLOCK)
+
+    new_k, new_v = cache.k, cache.v
+    for li, p in enumerate(params["blocks"]):
+        q, k, v = qkv_proj(x, p, cfg, positions=positions)
+        new_k = new_k.at[li, block_ids, :, offs].set(k.astype(new_k.dtype))
+        new_v = new_v.at[li, block_ids, :, offs].set(v.astype(new_v.dtype))
+        cache = PagedKVCache(k=new_k, v=new_v)
+        if attn_impl == "kernel":
+            attn = paged_attention.paged_window_attention(
+                q, cache.k[li], cache.v[li], block_table, pos,
+                interpret=interpret,
+            )
+        else:
+            attn = paged_attention.paged_window_attention_xla(
+                q, cache.k[li], cache.v[li], block_table, pos
+            )
+        x = x + jnp.einsum(
+            "bsd,de->bse", attn.reshape(b, s, cfg.d_model), _mat(p["attn_out"])
+        )
+        x = mlp_residual(x, p)
+
+    return tied_logits(x, params), cache
+
+
 def paged_prefill(
     params,
     prompt: jax.Array,  # [B, P]
@@ -332,6 +394,31 @@ def paged_prefill_suffix(
     )
 
 
+def _paged_spec_round(
+    params, draft_params, cache: PagedKVCache, d_cache, table, last, pos,
+    active, *, cfg: ModelConfig, gamma: int, attn_impl: str, interpret: bool,
+):
+    """ONE speculative round over the PAGED cache: the shared draft
+    proposal (serve.draft_propose — dense draft cache) plus a paged verify
+    chunk through the block table.  Same acceptance rule as everywhere
+    (speculative.accept_advance).  Returns (target [B, gamma+1],
+    advance [B], cache, d_cache)."""
+    from k8s_dra_driver_tpu.models import serve
+    from k8s_dra_driver_tpu.models.speculative import accept_advance
+
+    d_cache, proposed = serve.draft_propose(
+        draft_params, d_cache, last, pos, active, cfg=cfg, gamma=gamma
+    )
+    window = jnp.concatenate([last[:, None], proposed], axis=1)
+    logits, cache = paged_decode_chunk(
+        params, cache, table, window, pos, cfg=cfg, active=active,
+        attn_impl=attn_impl, interpret=interpret,
+    )
+    target = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    _, advance = accept_advance(proposed, target, active)
+    return target, advance, cache, d_cache
+
+
 def _paged_step_all(
     params, cache, table, tokens, pos, active, temps, keys,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
@@ -418,6 +505,13 @@ class PagedServeEngine:
     # submit().  Composes with the prefix store (shared blocks count as
     # already-done chunks).  Streams identical either way (tested).
     prefill_chunk_blocks: int = 0
+    # Speculative serving over the PAGED cache: > 0 advances every active
+    # greedy slot up to gamma+1 tokens per round — dense draft cache +
+    # paged verify chunk through the block table.  Greedy-only; int8
+    # self-draft default.  Composes with prefix sharing and chunked
+    # admission (streams identical — tested).
+    spec_gamma: int = 0
+    draft_params: object = None
 
     def __post_init__(self):
         cfg = self.cfg
@@ -471,6 +565,24 @@ class PagedServeEngine:
         # chunked-admission queue: FIFO of dicts, head advances one chunk
         # per step() (see prefill_chunk_blocks)
         self._admitting: list[dict] = []
+        self._d_cache = self._spec_fn = self._draft_prefill_fn = None
+        if self.spec_gamma > 0:
+            from k8s_dra_driver_tpu.models import serve
+
+            self.draft_params, self._d_cache = serve.make_draft_state(
+                self.params, self.draft_params, cfg, self.n_slots,
+                self.cache_dtype,
+            )
+            self._spec_fn = jax.jit(
+                functools.partial(
+                    _paged_spec_round, cfg=cfg, gamma=self.spec_gamma,
+                    attn_impl=self.attn_impl, interpret=self.interpret,
+                ),
+                donate_argnums=(2, 3),  # pool + draft cache, like _step_fn
+            )
+            self._draft_prefill_fn = jax.jit(
+                functools.partial(serve._prefill_draft_row, cfg=cfg)
+            )
 
     # -- public API --------------------------------------------------------
     @property
@@ -492,7 +604,10 @@ class PagedServeEngine:
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
-        serve.check_submit(prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq)
+        serve.check_submit(
+            prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq,
+            spec_gamma=self.spec_gamma, temperature=temperature,
+        )
         try:
             slot = self._slots.index(None)
         except ValueError:
@@ -578,6 +693,11 @@ class PagedServeEngine:
                     self.params, padded, self._cache, prefill_row
                 )
             self._store_prefix_blocks(prompt, slot, storable, cached)
+            if self.spec_gamma > 0:
+                # the draft model needs the prompt's k/v too (its layers)
+                self._d_cache = self._draft_prefill_fn(
+                    self.draft_params, self._d_cache, padded, len(prompt), slot
+                )
             first_tok, self._cache = self._first_fn(
                 self.params, self._cache, self._table, padded, len(prompt), slot,
                 jnp.float32(temperature), base_key,
@@ -637,6 +757,11 @@ class PagedServeEngine:
                     self.params, adm["padded"], self._cache, prefill_row,
                     cfg=self.cfg, done_blocks=adm["done"], chunk_len=chunk_len,
                 )
+            if self.spec_gamma > 0:
+                self._d_cache = self._draft_prefill_fn(
+                    self.draft_params, self._d_cache, adm["padded"],
+                    adm["plen"], slot,
+                )
             first_tok, self._cache = self._first_fn(
                 self.params, self._cache, self._table, adm["padded"],
                 adm["plen"], slot, jnp.float32(adm["temp"]), adm["key"],
@@ -674,11 +799,12 @@ class PagedServeEngine:
         self._retire(slot)
         self._update_gauges()
 
-    def step(self) -> int:
-        """Advance every active, non-stalled slot one token (and the
-        admission-queue head by one prefill chunk); returns the number of
-        slots stepped."""
-        self._advance_admission()
+    def _grow_active_slots(self, lookahead: int):
+        """Ensure every resident, non-admitting slot owns blocks covering
+        positions ``pos .. pos + lookahead`` (0 = the plain decode write;
+        spec_gamma = the verify window).  Slots the pool cannot serve STALL
+        for this step — they resume after a retirement frees blocks.
+        Returns (active mask, table_dirty)."""
         admitting = {a["slot"] for a in self._admitting}
         active = np.zeros((self.n_slots,), bool)
         table_dirty = False
@@ -686,17 +812,69 @@ class PagedServeEngine:
         for slot, st in enumerate(self._slots):
             if st is None or slot in admitting:
                 continue
-            blk = int(pos_np[slot]) // self.block_size
-            if blk >= len(self._owned[slot]):
+            needed = (int(pos_np[slot]) + lookahead) // self.block_size + 1
+            grew = True
+            while len(self._owned[slot]) < needed:
                 try:
                     (new_id,) = self._alloc.alloc(1)
                 except OutOfBlocks:
                     self.stalled_steps += 1  # resumes after a retirement
-                    continue
+                    grew = False
+                    break
                 self._owned[slot].append(new_id)
-                self._table_np[slot, blk] = new_id
+                self._table_np[slot, len(self._owned[slot]) - 1] = new_id
                 table_dirty = True
-            active[slot] = True
+            if grew:
+                active[slot] = True
+        return active, table_dirty
+
+    def _spec_step(self) -> int:
+        """One speculative ROUND over the paged pool: grow each active
+        slot's blocks to cover the verify window (pos .. pos+gamma), stall
+        rows the pool cannot serve, run the round, commit clipped tokens
+        (the dense engine's _spec_step contract, plus pool accounting)."""
+        from k8s_dra_driver_tpu.models import serve
+
+        active, table_dirty = self._grow_active_slots(lookahead=self.spec_gamma)
+        if not active.any():
+            return 0
+        if table_dirty:
+            self._table = jnp.asarray(self._table_np)
+        active_j = jnp.asarray(active)
+        target, advance, self._cache, self._d_cache = self._spec_fn(
+            self.params, self.draft_params, self._cache, self._d_cache,
+            self._table, self._last, self._pos, active_j,
+        )
+        rows = jnp.arange(self.n_slots)
+        new_last = target[rows, jnp.maximum(advance - 1, 0)]
+        self._last = jnp.where(active_j, new_last, self._last)
+        self._pos = self._pos + advance  # advance is already 0 when inactive
+        tgt = np.asarray(target)
+        adv = np.asarray(advance)
+        committed = 0
+        for slot, st in enumerate(self._slots):
+            if st is None or not active[slot]:
+                continue
+            for j in range(int(adv[slot])):
+                st.tokens.append(int(tgt[slot, j]))
+                committed += 1
+                n_gen = len(st.tokens) - st.prompt_len
+                hit_eos = self.eos_id is not None and st.tokens[-1] == self.eos_id
+                if n_gen >= st.max_tokens or hit_eos:
+                    break
+            self._retire(slot)
+        serve._M_TOKENS.inc(committed)
+        self._update_gauges()
+        return int(active.sum())
+
+    def step(self) -> int:
+        """Advance every active, non-stalled slot one token (and the
+        admission-queue head by one prefill chunk); returns the number of
+        slots stepped."""
+        self._advance_admission()
+        if self.spec_gamma > 0:
+            return self._spec_step()
+        active, table_dirty = self._grow_active_slots(lookahead=0)
         if not active.any():
             return 0
         if table_dirty:

@@ -52,10 +52,10 @@ _NEG_INF = -1e30
 
 def _paged_kernel(
     table_ref, lens_ref,  # scalar-prefetch: [B, max_blocks] i32, [B] i32
-    q_ref, k_ref, v_ref,  # [1,Hkv,G,d], [1,Hkv,bs,d], [1,Hkv,bs,d]
-    out_ref,              # [1,Hkv,G,d]
-    m_ref, l_ref, acc_ref,  # [Hkv*G,128], [Hkv*G,128], [Hkv*G,d]
-    *, block_size: int, num_blocks: int, scale: float,
+    q_ref, k_ref, v_ref,  # [1,Hkv,G*nq,d], [1,Hkv,bs,d], [1,Hkv,bs,d]
+    out_ref,              # [1,Hkv,G*nq,d]
+    m_ref, l_ref, acc_ref,  # [Hkv*G*nq,128], [Hkv*G*nq,128], [Hkv*G*nq,d]
+    *, block_size: int, num_blocks: int, scale: float, nq: int,
 ):
     b = pl.program_id(0)
     i = pl.program_id(1)
@@ -66,24 +66,29 @@ def _paged_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    # lens_ref[b] = keys attended by the LAST window query; query j of nq
+    # (causal window) attends k_pos <= length - nq + j.
     length = lens_ref[b]
 
     # Blocks at or past the slot's frontier hold no attended keys: no FLOPs
     # (and no fresh DMA — their index map repeats the last valid block).
     @pl.when(i * block_size < length)
     def _compute():
-        q = q_ref[0]             # [Hkv, G, d] — every head in one step
+        q = q_ref[0]             # [Hkv, G*nq, d] — every head in one step
         k = k_ref[0]             # [Hkv, bs, d]
         v = v_ref[0]
-        hkv, g, _ = q.shape
+        hkv, gnq, _ = q.shape
         s = jax.lax.dot_general(
             q.astype(k.dtype), k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale                # [Hkv, G, bs]
+        ) * scale                # [Hkv, G*nq, bs]
         k_pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(k_pos < length, s, _NEG_INF)
+        # query index within the window is the FASTEST-varying factor of the
+        # row axis (layout contract with the caller's reshape)
+        j = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) % nq
+        s = jnp.where(k_pos <= length - nq + j, s, _NEG_INF)
 
-        s2 = s.reshape(hkv * g, block_size)  # head-major rows, online state
+        s2 = s.reshape(hkv * gnq, block_size)  # head-major rows, online state
         m_prev = m_ref[:, 0:1]
         l_prev = l_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, s2.max(axis=-1, keepdims=True))
@@ -93,11 +98,11 @@ def _paged_kernel(
             l_prev * correction + p.sum(axis=-1, keepdims=True), l_ref.shape
         )
         pv = jax.lax.dot_general(
-            p.reshape(hkv, g, block_size).astype(v.dtype), v,
+            p.reshape(hkv, gnq, block_size).astype(v.dtype), v,
             (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )                        # [Hkv, G, d]
-        acc_ref[:] = acc_ref[:] * correction + pv.reshape(hkv * g, -1)
+        )                        # [Hkv, G*nq, d]
+        acc_ref[:] = acc_ref[:] * correction + pv.reshape(hkv * gnq, -1)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(i == num_blocks - 1)
@@ -110,29 +115,37 @@ def _paged_kernel(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def paged_decode_attention(
-    q: jax.Array,            # [B, Hq, d] — ONE query per slot (decode)
+def paged_window_attention(
+    q: jax.Array,            # [B, nq, Hq, d] — a CAUSAL query window
     k_pool: jax.Array,       # [n_blocks, Hkv, block_size, d]
     v_pool: jax.Array,
     block_table: jax.Array,  # [B, max_blocks] i32 pool-block ids
-    lengths: jax.Array,      # [B] i32 — keys attended per slot (>= 1)
+    pos: jax.Array,          # [B] i32 — window query j sits at pos + j
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Ragged paged decode attention; returns [B, Hq, d] in q's dtype.
+    """Ragged paged attention over a short causal window — nq=1 is plain
+    decode; nq=gamma+1 is the speculative VERIFY pass.  Window query j
+    attends pool keys at positions <= pos + j (the window's own keys must
+    already be scattered into the pool).  Returns [B, nq, Hq, d].
 
     Pool layout is head-MAJOR (``[n_blocks, Hkv, bs, d]``): the TPU
     lowering requires a block's last two dims to tile (8, 128), so the
     per-grid-step slice must be ``[bs, d]``-shaped — the head axis cannot
     sit between them.
     """
-    b, hq, d = q.shape
+    b, nq, hq, d = q.shape
     n_pool, hkv, block_size, _ = k_pool.shape
     if hq % hkv:
         raise ValueError(f"query heads {hq} must be a multiple of kv heads {hkv}")
     groups = hq // hkv
     max_blocks = block_table.shape[1]
-    qg = q.reshape(b, hkv, groups, d)  # heads are contiguous per kv group
+    # row layout [Hkv, G*nq, d] with the window index FASTEST (the kernel's
+    # `iota % nq` mask contract)
+    qg = q.reshape(b, nq, hkv, groups, d).transpose(0, 2, 3, 1, 4).reshape(
+        b, hkv, groups * nq, d
+    )
+    lengths = pos + nq  # keys attended by the last window query
 
     def k_index(bi, i, table, lens):
         # Past-frontier steps REPEAT the last used block id: identical
@@ -145,17 +158,19 @@ def paged_decode_attention(
         num_scalar_prefetch=2,
         grid=(b, max_blocks),
         in_specs=[
-            pl.BlockSpec((1, hkv, groups, d), lambda bi, i, t, ln: (bi, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, hkv, groups * nq, d), lambda bi, i, t, ln: (bi, 0, 0, 0)
+            ),
             pl.BlockSpec((1, hkv, block_size, d), k_index),
             pl.BlockSpec((1, hkv, block_size, d), k_index),
         ],
         out_specs=pl.BlockSpec(
-            (1, hkv, groups, d), lambda bi, i, t, ln: (bi, 0, 0, 0)
+            (1, hkv, groups * nq, d), lambda bi, i, t, ln: (bi, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((hkv * groups, 128), jnp.float32),  # m
-            pltpu.VMEM((hkv * groups, 128), jnp.float32),  # l
-            pltpu.VMEM((hkv * groups, d), jnp.float32),    # acc
+            pltpu.VMEM((hkv * groups * nq, 128), jnp.float32),  # m
+            pltpu.VMEM((hkv * groups * nq, 128), jnp.float32),  # l
+            pltpu.VMEM((hkv * groups * nq, d), jnp.float32),    # acc
         ],
     )
     out = pl.pallas_call(
@@ -164,9 +179,10 @@ def paged_decode_attention(
             block_size=block_size,
             num_blocks=max_blocks,
             scale=1.0 / (d ** 0.5),
+            nq=nq,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, groups * nq, d), q.dtype),
         # batch rows are independent walks (scratch re-inits at i == 0), so
         # the row axis may reorder/pipeline; the block walk is sequential.
         compiler_params=pltpu.CompilerParams(
@@ -174,24 +190,53 @@ def paged_decode_attention(
         ),
         interpret=interpret,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
-    return out.reshape(b, hq, d)
+    return (
+        out.reshape(b, hkv, groups, nq, d)
+        .transpose(0, 3, 1, 2, 4)
+        .reshape(b, nq, hq, d)
+    )
 
 
-def paged_attention_xla(q, k_pool, v_pool, block_table, lengths):
-    """Gather-based reference: identical semantics, plain XLA.
+def paged_decode_attention(
+    q: jax.Array,            # [B, Hq, d] — ONE query per slot (decode)
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_table: jax.Array,
+    lengths: jax.Array,      # [B] i32 — keys attended per slot (>= 1)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single-query view of :func:`paged_window_attention` (nq = 1;
+    ``lengths = pos + 1``).  Returns [B, Hq, d] in q's dtype."""
+    out = paged_window_attention(
+        q[:, None], k_pool, v_pool, block_table,
+        jnp.asarray(lengths, jnp.int32) - 1, interpret=interpret,
+    )
+    return out[:, 0]
 
-    ``pool[table]`` materializes the slot-major view ``[B, max_blocks*bs,
-    Hkv, d]`` and runs the dense grouped attention with a position mask —
-    the oracle the kernel is tested against, and the path for backends
-    without pallas support.
-    """
+
+def paged_window_attention_xla(q, k_pool, v_pool, block_table, pos):
+    """Gather-based window reference: identical semantics to
+    :func:`paged_window_attention`, plain XLA."""
     from k8s_dra_driver_tpu.models.decode import _masked_attention
 
-    b = q.shape[0]
+    b, nq = q.shape[0], q.shape[1]
     n_pool, hkv, block_size, d = k_pool.shape
-    # [B, mb, Hkv, bs, d] -> sequence-major [B, mb*bs, Hkv, d]
     k = k_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
     v = v_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
     k_pos = jnp.arange(k.shape[1])
-    mask = (k_pos[None, :] < lengths[:, None])[:, None, None]  # [B,1,1,K]
-    return _masked_attention(q[:, None], k, v, mask)[:, 0]
+    # [B, 1, nq, K]: window query j attends key positions <= pos + j
+    qpos = pos[:, None] + jnp.arange(nq)[None, :]
+    mask = (k_pos[None, None, :] <= qpos[:, :, None])[:, None]
+    return _masked_attention(q, k, v, mask)
+
+
+def paged_attention_xla(q, k_pool, v_pool, block_table, lengths):
+    """Gather-based decode reference — the nq=1 view of
+    :func:`paged_window_attention_xla` (ONE gather/mask implementation so
+    the oracle contract cannot drift), the kernel's test oracle and the
+    path for backends without pallas support."""
+    return paged_window_attention_xla(
+        q[:, None], k_pool, v_pool, block_table,
+        jnp.asarray(lengths, jnp.int32) - 1,
+    )[:, 0]

@@ -132,6 +132,44 @@ class TestKernelNumerics:
             )
 
 
+class TestWindowAttention:
+    @pytest.mark.parametrize("nq", [2, 4])
+    @pytest.mark.parametrize("impl", ["kernel", "xla"])
+    def test_window_matches_dense_causal(self, nq, impl):
+        """Window query j attends keys <= pos + j — checked against dense
+        attention over the sequence-major oracle arrays."""
+        c = _Case(
+            jax.random.PRNGKey(11), b=3, hq=4, hkv=2, d=32, bs=8, max_blocks=4
+        )
+        # frontier per row such that pos + nq - 1 stays in range
+        pos = jnp.minimum(c.lengths - 1, 8 * 4 - nq)
+        q = jax.random.normal(jax.random.PRNGKey(12), (3, nq, 4, 32), jnp.float32)
+        if impl == "kernel":
+            got = paged_attention.paged_window_attention(
+                q, c.k_pool, c.v_pool, c.table, pos, interpret=True
+            )
+        else:
+            got = paged_attention.paged_window_attention_xla(
+                q, c.k_pool, c.v_pool, c.table, pos
+            )
+        k_pos = jnp.arange(c.k_seq.shape[1])
+        qpos = pos[:, None] + jnp.arange(nq)[None, :]
+        mask = (k_pos[None, None, :] <= qpos[:, :, None])[:, None]
+        want = _masked_attention(q, c.k_seq, c.v_seq, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_nq1_equals_decode_attention(self):
+        c = _Case(jax.random.PRNGKey(13), b=2, hq=4, hkv=4, d=32, bs=8, max_blocks=2)
+        got = paged_attention.paged_window_attention(
+            c.q[:, None], c.k_pool, c.v_pool, c.table, c.lengths - 1,
+            interpret=True,
+        )[:, 0]
+        want = paged_attention.paged_decode_attention(
+            c.q, c.k_pool, c.v_pool, c.table, c.lengths, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
 class TestAllocator:
     def test_lifo_and_exhaustion(self):
         a = paged.BlockAllocator(5)  # usable: 1..4

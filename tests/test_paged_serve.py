@@ -263,6 +263,88 @@ class TestPoolAccounting:
         assert n2 < n1  # 2 of 3 chunks came from the store
         assert gen1 == gen2
 
+    def test_spec_streams_identical_to_plain_paged(self, params):
+        """Speculative rounds over the PAGED cache: same tokens as the
+        plain paged engine (and hence the dense one)."""
+        reqs = [(p, 12, 0.0, i) for i, p in enumerate(_prompts(4, rng=21))]
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        spec = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla", spec_gamma=3,
+        )
+        assert _streams(plain, reqs) == _streams(spec, reqs)
+
+    def test_spec_composes_with_prefix_and_chunked(self, params):
+        sys_prefix = list(np.arange(32) % CFG.vocab_size)
+        # the 4th request admits after a retirement, when the store is
+        # populated (concurrent admissions can't hit a store that fills at
+        # activation)
+        reqs = [(sys_prefix + [5, 7], 10, 0.0, 0), (sys_prefix + [9], 10, 0.0, 1),
+                ([3, 1], 12, 0.0, 2), (sys_prefix + [12], 8, 0.0, 3)]
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=48, attn_impl="xla",
+        )
+        fancy = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", spec_gamma=2,
+            prefix_cache_blocks=4, prefill_chunk_blocks=1,
+        )
+        assert _streams(plain, reqs) == _streams(fancy, reqs)
+        assert fancy.prefix_hits > 0
+
+    def test_spec_full_acceptance_grows_blocks(self, params):
+        """Self-draft with target weights: gamma+1 tokens per round across
+        block boundaries, pool fully returned after drain."""
+        gamma = 3
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=20, block_size=4,
+            prompt_bucket=16, attn_impl="xla", spec_gamma=gamma,
+            draft_params=params,
+        )
+        before = eng.free_blocks
+        eng.submit(_prompts(1)[0], 21)
+        rounds = 0
+        while eng.free_slots() < eng.n_slots:
+            eng.step()
+            rounds += 1
+        assert rounds == -(-(21 - 1) // (gamma + 1))
+        assert eng.free_blocks == before
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=20, block_size=4,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        plain.submit(_prompts(1)[0], 21)
+        plain.run_until_drained()
+        assert (
+            eng.completions()[0].generated == plain.completions()[0].generated
+        )
+
+    def test_spec_kernel_interpret_path(self, params):
+        reqs = [(p, 6, 0.0, i) for i, p in enumerate(_prompts(2, rng=31))]
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        spec = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="kernel", interpret=True, spec_gamma=2,
+        )
+        assert _streams(plain, reqs) == _streams(spec, reqs)
+
+    def test_spec_validation(self, params):
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=20, block_size=BS,
+            prompt_bucket=16, attn_impl="xla", spec_gamma=4,
+        )
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit([1, 2, 3], 4, temperature=0.5)
+        with pytest.raises(ValueError, match="slack"):
+            eng.submit([1, 2, 3], CFG.max_seq - 3)
+
     def test_metrics_land_in_registry(self, params):
         """The paged backend feeds the SAME serving counters as the dense
         engine (observability parity) plus the pool-free gauge."""
