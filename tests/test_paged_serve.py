@@ -323,6 +323,23 @@ class TestPoolAccounting:
             eng.completions()[0].generated == plain.completions()[0].generated
         )
 
+    def test_spec_with_int4_draft(self, params):
+        """The int4 self-draft through the paged spec engine: quantization
+        error moves acceptance only — streams stay identical."""
+        from k8s_dra_driver_tpu.models.quant import quantize_blocks
+
+        reqs = [(p, 8, 0.0, i) for i, p in enumerate(_prompts(2, rng=41))]
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        spec4 = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=40, block_size=BS,
+            prompt_bucket=16, attn_impl="xla", spec_gamma=2,
+            draft_params=quantize_blocks(params, bits=4),
+        )
+        assert _streams(plain, reqs) == _streams(spec4, reqs)
+
     def test_spec_kernel_interpret_path(self, params):
         reqs = [(p, 6, 0.0, i) for i, p in enumerate(_prompts(2, rng=31))]
         plain = paged.PagedServeEngine(
