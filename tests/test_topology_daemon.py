@@ -370,11 +370,26 @@ class TestProgram:
             assert Path(sock).exists(), "daemon socket never appeared"
             a = TopologyDaemonClient(sock, "a")
             b = TopologyDaemonClient(sock, "b")
-            assert a.acquire(quantum_ms=60000, scope="0")["ok"]
-            resp = b.acquire(quantum_ms=10, scope="0", timeout_ms=50)
-            assert not resp["ok"] and resp["holder"] == "a"
-            assert b.acquire(quantum_ms=10, scope="1", timeout_ms=500)["ok"]
-            a.close(), b.close()
+            try:
+                got = a.acquire(quantum_ms=60000, scope="0")
+                assert got["ok"], got
+                resp = b.acquire(quantum_ms=10, scope="0", timeout_ms=50)
+                assert not resp["ok"] and resp["holder"] == "a", resp
+                got = b.acquire(quantum_ms=10, scope="1", timeout_ms=500)
+                assert got["ok"], got
+            finally:
+                # close BEFORE terminate even when an assert failed, so
+                # teardown never depends on the daemon draining open
+                # connections under load
+                a.close(), b.close()
         finally:
             proc.terminate()
-            proc.wait(timeout=10)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out = proc.stdout.read()
+                proc.wait(timeout=10)
+                raise AssertionError(
+                    f"daemon did not exit after SIGTERM; output: {out!r}"
+                ) from None
