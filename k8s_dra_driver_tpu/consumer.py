@@ -222,10 +222,13 @@ def _serve_demo() -> int:
     )
     params = burnin.init_params(jax.random.PRNGKey(0), cfg)
     # 2 slots on purpose: the later shared-prefix requests admit after the
-    # first ones retired, so the prefix store demonstrably pays off
+    # first ones retired, so the prefix store demonstrably pays off.  The
+    # whole serving stack is on — prefix sharing, chunked admission, AND
+    # speculative rounds (the demo mix is greedy, speculation's contract).
     eng = PagedServeEngine(
         params=params, cfg=cfg, n_slots=2, n_blocks=40, block_size=16,
         prompt_bucket=32, prefix_cache_blocks=4, prefill_chunk_blocks=1,
+        spec_gamma=2,
     )
     shared = list(range(16))  # one full shared block across the mix
     pending = [
