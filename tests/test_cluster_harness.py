@@ -10,6 +10,7 @@ import stat
 import subprocess
 from pathlib import Path
 
+import pytest
 import yaml
 
 from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
@@ -54,6 +55,44 @@ class TestCreateClusterScript:
             assert labels["tpu.google.com/slice-host-id"] == str(i)
         # CDI must be enabled for kubelet->containerd device injection
         assert "enable_cdi = true" in cfg["containerdConfigPatches"][0]
+
+    def generate_split_config(self, tmp_path, env):
+        captured = tmp_path / "config.yaml"
+        stub = tmp_path / "kind"
+        stub.write_text(f"#!/bin/sh\ncat > {captured}\n")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        subprocess.run(
+            [str(REPO / "demo/clusters/kind/create-split-host-cluster.sh")],
+            env={**os.environ, "PATH": f"{tmp_path}:{os.environ['PATH']}", **env},
+            check=True, capture_output=True,
+        )
+        return yaml.safe_load(captured.read_text())
+
+    def test_split_host_variant_generates_disjoint_masks(self, tmp_path):
+        """The nvkind analog: N workers impersonate ONE host with
+        complementary '.'-separated visible-chips labels, and the masks
+        exactly tile the host's chips with no overlap."""
+        cfg = self.generate_split_config(
+            tmp_path, {"NUM_SPLITS": "2", "FAKE_TOPOLOGY": "v5e-8",
+                       "CHIPS_PER_HOST": "4"}
+        )
+        workers = [n for n in cfg["nodes"] if n["role"] == "worker"]
+        assert len(workers) == 2
+        seen: list[int] = []
+        for w in workers:
+            labels = w["labels"]
+            assert labels["tpu.google.com/fake-topology"] == "v5e-8"
+            assert labels["tpu.google.com/fake-host-id"] == "0"  # SAME host
+            mask = [int(p) for p in labels["tpu.google.com/visible-chips"].split(".")]
+            assert mask  # never an empty mask (would fail plugin startup)
+            seen += mask
+        assert sorted(seen) == [0, 1, 2, 3]  # disjoint and complete
+
+    def test_split_host_rejects_undividable_splits(self, tmp_path):
+        with pytest.raises(subprocess.CalledProcessError):
+            self.generate_split_config(
+                tmp_path, {"NUM_SPLITS": "3", "CHIPS_PER_HOST": "4"}
+            )
 
     def test_install_script_exists_and_parses(self):
         for script in (
