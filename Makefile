@@ -49,6 +49,11 @@ lint:
 helm-template:
 	$(PYTHON) -m tools.helm_render deployments/helm/tpu-dra-driver
 
+# Container images: host-arch, UBI variant, and the multi-arch manifest
+# (deployments/container/multi-arch.mk; reference multi-arch.mk analog).
+image image-ubi image-all image-push:
+	$(MAKE) -f deployments/container/multi-arch.mk $@
+
 clean:
 	$(MAKE) -C $(CPP_DIR) clean
 	rm -rf .pytest_cache

@@ -1,0 +1,37 @@
+# Multi-arch image builds (reference deployments/container/multi-arch.mk +
+# native-only.mk analog).  Include from the repo root:
+#   make -f deployments/container/multi-arch.mk image        # host arch
+#   make -f deployments/container/multi-arch.mk image-ubi    # UBI variant
+#   make -f deployments/container/multi-arch.mk image-all    # amd64+arm64 manifest
+#
+# TPU hosts are amd64 today, but the control-plane images (controller,
+# scheduler extender) also run on arm64 build/infra nodes — the same reason
+# the reference publishes a multi-arch manifest.
+
+IMAGE_REGISTRY ?= localhost:5000
+IMAGE_NAME     ?= tpu-dra-driver
+IMAGE_TAG      ?= dev
+IMAGE          := $(IMAGE_REGISTRY)/$(IMAGE_NAME):$(IMAGE_TAG)
+PLATFORMS      ?= linux/amd64,linux/arm64
+DOCKER         ?= docker
+
+.PHONY: image image-ubi image-all image-push
+
+# Native-only build (the reference's native-only.mk slot): host platform,
+# local daemon load — the developer inner loop.
+image:
+	$(DOCKER) build -f deployments/container/Dockerfile -t $(IMAGE) .
+
+image-ubi:
+	$(DOCKER) build -f deployments/container/Dockerfile.ubi -t $(IMAGE)-ubi .
+
+# Cross-platform manifest via buildx (the reference's multi-arch.mk slot);
+# requires a configured builder (docker buildx create --use).
+image-all:
+	$(DOCKER) buildx build --platform $(PLATFORMS) \
+	    -f deployments/container/Dockerfile -t $(IMAGE) --push .
+	$(DOCKER) buildx build --platform $(PLATFORMS) \
+	    -f deployments/container/Dockerfile.ubi -t $(IMAGE)-ubi --push .
+
+image-push: image
+	$(DOCKER) push $(IMAGE)

@@ -26,6 +26,7 @@ from k8s_dra_driver_tpu.api.sharing import (
     TpuSharing,
 )
 from k8s_dra_driver_tpu.api.tpuconfig import (
+    SliceGroupConfig,
     SliceMembershipConfig,
     SubsliceConfig,
     TpuConfig,
@@ -43,6 +44,7 @@ __all__ = [
     "ErrInvalidLimit",
     "HbmLimits",
     "SharingStrategy",
+    "SliceGroupConfig",
     "SliceMembershipConfig",
     "SpatialPartitionConfig",
     "SubsliceConfig",

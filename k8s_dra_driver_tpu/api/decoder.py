@@ -14,7 +14,12 @@ import enum
 from typing import Any, get_args, get_origin, get_type_hints
 
 from k8s_dra_driver_tpu.api.sharing import HbmLimits
-from k8s_dra_driver_tpu.api.tpuconfig import SliceMembershipConfig, SubsliceConfig, TpuConfig
+from k8s_dra_driver_tpu.api.tpuconfig import (
+    SliceGroupConfig,
+    SliceMembershipConfig,
+    SubsliceConfig,
+    TpuConfig,
+)
 from k8s_dra_driver_tpu.kube.serde import _unwrap_optional, snake_to_camel
 
 API_GROUP = "resource.tpu.google.com"
@@ -25,7 +30,10 @@ class DecodeError(ValueError):
     pass
 
 
-_KINDS = {cls.KIND: cls for cls in (TpuConfig, SubsliceConfig, SliceMembershipConfig)}
+_KINDS = {
+    cls.KIND: cls
+    for cls in (TpuConfig, SubsliceConfig, SliceMembershipConfig, SliceGroupConfig)
+}
 
 
 class Decoder:

@@ -82,6 +82,30 @@ class SliceMembershipConfig:
                 raise ValueError(f"extraEnv key {key!r} is not an UPPER_SNAKE env name")
 
 
+@dataclass
+class SliceGroupConfig:
+    """Opaque config for multi-slice GROUP seats (the DCN scale above
+    SliceMembershipConfig): optional overrides for the megascale wiring
+    injected at Prepare time.  ``megascale_port`` is the DCN transport
+    port each slice's coordinator listens on."""
+
+    KIND = "SliceGroupConfig"
+
+    megascale_port: Optional[int] = None
+    extra_env: dict[str, str] = field(default_factory=dict)
+
+    def normalize(self) -> None:
+        if self.megascale_port is None:
+            self.megascale_port = 8081  # megascale DCN transport default
+
+    def validate(self) -> None:
+        if self.megascale_port is not None and not 0 < self.megascale_port < 65536:
+            raise ValueError(f"megascalePort out of range: {self.megascale_port}")
+        for key in self.extra_env:
+            if not key or key != key.upper() or not key.replace("_", "").isalnum():
+                raise ValueError(f"extraEnv key {key!r} is not an UPPER_SNAKE env name")
+
+
 def default_tpu_config() -> TpuConfig:
     """Lowest-precedence config applied when a claim carries none
     (device_state.go:210-221's defaults-insertion)."""

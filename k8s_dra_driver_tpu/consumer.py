@@ -51,10 +51,29 @@ class ClaimContext:
     worker_id: Optional[int] = None
     host_count: Optional[int] = None
     coordinator_address: str = ""
+    # Multislice (DCN) identity from the slice-GROUP seat: which slice of
+    # how many, and the cross-slice megascale coordinator.
+    num_slices: Optional[int] = None
+    slice_id: Optional[int] = None
+    megascale_coordinator: str = ""
 
     @property
     def multi_host(self) -> bool:
         return self.host_count is not None and self.host_count > 1
+
+    @property
+    def multi_slice(self) -> bool:
+        return self.num_slices is not None and self.num_slices > 1
+
+    @property
+    def global_worker_id(self) -> Optional[int]:
+        """Process id across the WHOLE group (slice-major), or the
+        intra-slice worker id when single-slice."""
+        if not self.multi_slice:
+            return self.worker_id
+        if self.worker_id is None or self.host_count is None:
+            return None
+        return self.slice_id * self.host_count + self.worker_id
 
     @property
     def shared(self) -> bool:
@@ -200,6 +219,9 @@ def attach(environ=None, init_distributed: bool = True) -> ClaimContext:
         worker_id=_int("TPU_WORKER_ID"),
         host_count=_int("TPU_HOST_COUNT"),
         coordinator_address=env.get("JAX_COORDINATOR_ADDRESS", ""),
+        num_slices=_int("MEGASCALE_NUM_SLICES"),
+        slice_id=_int("MEGASCALE_SLICE_ID"),
+        megascale_coordinator=env.get("MEGASCALE_COORDINATOR_ADDRESS", ""),
     )
     if init_distributed:
         ctx.initialize_distributed()

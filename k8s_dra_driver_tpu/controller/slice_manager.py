@@ -43,6 +43,11 @@ log = get_logger("tpu-dra-controller.slice-manager")
 
 SLICE_DOMAIN_LABEL = "tpu.google.com/slice-domain"
 SLICE_HOST_ID_LABEL = "tpu.google.com/slice-host-id"
+# Multi-slice jobs: the provisioner labels every node of every member slice
+# with the GROUP the slices were joined into (GKE multislice over DCN) —
+# the next scale up from the per-domain seats (the reference's IMEX pattern
+# tops out at one NVLink domain; imex.go:371-416).
+SLICE_GROUP_LABEL = "tpu.google.com/slice-group"
 
 # Global seat budget and per-slice cap (imex.go:43-46's 2048/128 analogs).
 DRIVER_MEMBERSHIP_LIMIT = 2048
@@ -69,6 +74,15 @@ def _parse_host_id(raw: str | None) -> int | None:
 class _Domain:
     nodes: dict[str, int] = field(default_factory=dict)  # node name -> host id
     offset: int = -1
+    # slice-group membership: group label seen per node (a domain's group is
+    # decided by its worker-0 node; conflicting labels log loudly)
+    groups: dict[str, str] = field(default_factory=dict)  # node name -> group
+
+    def group(self) -> str | None:
+        """The domain's group: what its LOWEST-host-id labeled node says
+        (deterministic under conflicting labels, which _publish warns on)."""
+        labeled = [(self.nodes.get(n, 1 << 30), g) for n, g in self.groups.items()]
+        return min(labeled)[1] if labeled else None
 
 
 class SliceManager:
@@ -128,6 +142,7 @@ class SliceManager:
         node = event.object
         domain = node.metadata.labels.get(SLICE_DOMAIN_LABEL)
         host_id = _parse_host_id(node.metadata.labels.get(SLICE_HOST_ID_LABEL))
+        group = node.metadata.labels.get(SLICE_GROUP_LABEL)
         with self._lock:
             if event.type == "DELETED" or domain is None or host_id is None:
                 # Malformed/missing host-id: the node cannot take a seat —
@@ -143,17 +158,27 @@ class SliceManager:
                     )
                 changed = self._forget_node(node.metadata.name)
             else:
-                changed = self._remember_node(domain, node.metadata.name, host_id)
+                changed = self._remember_node(
+                    domain, node.metadata.name, host_id, group
+                )
             if changed:
                 self._publish()
 
-    def _remember_node(self, domain: str, node_name: str, host_id: int) -> bool:
+    def _remember_node(
+        self, domain: str, node_name: str, host_id: int, group: str | None = None
+    ) -> bool:
         # A node can move between domains (slice re-provisioned): drop any
         # old membership first.
         changed = self._forget_node(node_name, except_domain=domain)
         d = self._domains.setdefault(domain, _Domain())
         if d.nodes.get(node_name) != host_id:
             d.nodes[node_name] = host_id
+            changed = True
+        if d.groups.get(node_name) != group:
+            if group is None:
+                d.groups.pop(node_name, None)
+            else:
+                d.groups[node_name] = group
             changed = True
         return changed
 
@@ -164,6 +189,7 @@ class SliceManager:
                 continue
             if node_name in d.nodes:
                 del d.nodes[node_name]
+                d.groups.pop(node_name, None)
                 changed = True
                 if not d.nodes:  # last node: domain gone (imex.go:233-277)
                     del self._domains[domain]
@@ -257,7 +283,78 @@ class SliceManager:
                     ]
                 ),
             )
+        self._publish_groups(pools)
         self._controller.update(DriverResources(pools=pools))
+
+    def _publish_groups(self, pools: dict[str, Pool]) -> None:
+        """Slice-GROUP seat pools: one pool per group of slice domains, one
+        seat per member domain (ordinal = sorted-domain rank).  The seat
+        carries the megascale fan-out and the cross-slice (DCN)
+        coordinator — slice 0's intra-slice coordinator host.  The imex
+        domain-pool pattern applied one level up (imex.go:371-416 →
+        SURVEY.md §2.11.3's multislice frontier)."""
+        from k8s_dra_driver_tpu.plugin.deviceinfo import SliceGroupSeatInfo
+
+        groups: dict[str, list[tuple[str, _Domain]]] = {}
+        for domain, d in sorted(self._domains.items()):
+            g = d.group()
+            if g is None:
+                continue
+            conflicting = {x for x in d.groups.values() if x != g}
+            if conflicting:
+                log.warning(
+                    "domain %s: conflicting %s labels %s; using worker-0's %r",
+                    domain, SLICE_GROUP_LABEL,
+                    sorted(conflicting | {g}), g,
+                )
+            groups.setdefault(g, []).append((domain, d))
+        for g, members in sorted(groups.items()):
+            num_slices = len(members)
+            coordinator = self._group_coordinator(members)
+            for slice_id, (domain, d) in enumerate(members):
+                # Per-(group, domain) pool with one seat PER HOST and a
+                # selector on BOTH labels: allocation can only hand a pod
+                # a seat carrying its OWN slice's identity, and every pod
+                # of the slice binds its own seat (the membership-seat
+                # granularity, one level up).
+                devices = [
+                    SliceGroupSeatInfo(
+                        group=g,
+                        domain=domain,
+                        slice_id=slice_id,
+                        num_slices=num_slices,
+                        worker_id=worker_id,
+                        host_count=len(d.nodes),
+                        coordinator_address=coordinator,
+                    ).get_device()
+                    for worker_id in sorted(set(d.nodes.values()))
+                ]
+                chunks = [
+                    Slice(devices=devices[i : i + MEMBERSHIP_PER_SLICE_LIMIT])
+                    for i in range(0, len(devices), MEMBERSHIP_PER_SLICE_LIMIT)
+                ] or [Slice(devices=[])]
+                pools[f"slicegroup-{g}-{domain}"] = Pool(
+                    slices=chunks,
+                    node_selector=NodeSelector(
+                        node_selector_terms=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    NodeSelectorRequirement(
+                                        key=SLICE_GROUP_LABEL, values=[g]
+                                    ),
+                                    NodeSelectorRequirement(
+                                        key=SLICE_DOMAIN_LABEL, values=[domain]
+                                    ),
+                                ]
+                            )
+                        ]
+                    ),
+                )
+
+    def _group_coordinator(self, members: list[tuple[str, "_Domain"]]) -> str:
+        """Slice 0's worker-0 node hosts the cross-slice coordinator."""
+        _, d0 = members[0]
+        return self._coordinator_address(d0)
 
     def _coordinator_address(self, d: _Domain) -> str:
         """Worker 0's node is the jax.distributed coordinator."""

@@ -119,25 +119,66 @@ def run_dryrun(n_devices: int) -> None:
             )
         else:
             pp_mesh = build_mesh(devices, pp_shape)
-            # Both TP modes: classic megatron (replicated activations, psum)
-            # and megatron-sp (seq-sharded residual + overlapped
-            # collective-matmul rings).
-            for tp_mode in ("megatron", "megatron-sp"):
-                pp_fns = pp_burnin.build_pp_train_step(cfg, pp_mesh, tp_mode=tp_mode)
-                with pp_mesh:
-                    params, opt_state = pp_fns.init(jax.random.PRNGKey(0))
-                    tokens = jax.device_put(
-                        burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=64),
-                        jax.sharding.NamedSharding(
-                            pp_mesh, jax.sharding.PartitionSpec("data", None)
-                        ),
+            # Both TP modes — classic megatron (replicated activations,
+            # psum) and megatron-sp (seq-sharded residual + overlapped
+            # collective-matmul rings) — and both attention families:
+            # MHA + learned positions, and the modern GQA + RoPE geometry
+            # (whole KV groups per TP shard, rotation inside the stage
+            # scan — the flagship config the round-3 pipeline rejected).
+            pp_legs = [(cfg, "")]
+            if modern.kv_heads % pp_shape.model == 0:
+                pp_legs.append((modern, f"gqa kv={modern.kv_heads} + rope, "))
+            for leg_cfg, leg_tag in pp_legs:
+                for tp_mode in ("megatron", "megatron-sp"):
+                    pp_fns = pp_burnin.build_pp_train_step(
+                        leg_cfg, pp_mesh, tp_mode=tp_mode
                     )
-                    params, opt_state, loss = pp_fns.step(params, opt_state, tokens)
-                    jax.block_until_ready(loss)
-                print(
-                    f"dryrun_multichip: mesh pipe={pp_shape.pipe} data={pp_shape.data} "
-                    f"model={pp_shape.model} (pipeline, {tp_mode}) loss={float(loss):.4f}"
-                )
+                    with pp_mesh:
+                        params, opt_state = pp_fns.init(jax.random.PRNGKey(0))
+                        tokens = jax.device_put(
+                            burnin.sample_tokens(
+                                jax.random.PRNGKey(1), leg_cfg, batch=4, seq=64
+                            ),
+                            jax.sharding.NamedSharding(
+                                pp_mesh, jax.sharding.PartitionSpec("data", None)
+                            ),
+                        )
+                        params, opt_state, loss = pp_fns.step(
+                            params, opt_state, tokens
+                        )
+                        jax.block_until_ready(loss)
+                    print(
+                        f"dryrun_multichip: mesh pipe={pp_shape.pipe} "
+                        f"data={pp_shape.data} model={pp_shape.model} "
+                        f"(pipeline, {leg_tag}{tp_mode}) loss={float(loss):.4f}"
+                    )
+
+    # Multislice / DCN: hybrid data parallelism over a 2-slice group mesh
+    # (parallel/mesh.build_multislice_mesh — slice axis OUTERMOST so only
+    # the gradient all-reduce crosses the slow cross-slice links, TP stays
+    # on each slice's ICI).  The data-plane leg of the slice-GROUP seats
+    # the controller publishes (controller/slice_manager._publish_groups).
+    if n_devices >= 8 and n_devices % 2 == 0:
+        from k8s_dra_driver_tpu.parallel.mesh import build_multislice_mesh
+
+        ms_shape = MeshShape(data=2, model=n_devices // 4)
+        ms_mesh = build_multislice_mesh(devices, 2, ms_shape)
+        ms_fns = burnin.build_train_step(cfg, mesh=ms_mesh)
+        with ms_mesh:
+            params, opt_state = ms_fns.init(jax.random.PRNGKey(0))
+            tokens = jax.device_put(
+                burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=8, seq=64),
+                jax.sharding.NamedSharding(
+                    ms_mesh, jax.sharding.PartitionSpec(("slice", "data"), None)
+                ),
+            )
+            params, opt_state, loss = ms_fns.step(params, opt_state, tokens)
+            jax.block_until_ready(loss)
+        print(
+            f"dryrun_multichip: mesh slice=2 data={ms_shape.data} "
+            f"model={ms_shape.model} (multislice hybrid-dp over dcn) "
+            f"loss={float(loss):.4f}"
+        )
 
     # Expert parallelism: a top-2 GShard-MoE grad step with all_to_all
     # dispatch over the data/expert axis (k=1 Switch is the same code path

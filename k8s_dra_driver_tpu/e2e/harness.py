@@ -26,11 +26,13 @@ from k8s_dra_driver_tpu.scheduler.allocator import Allocator
 TPU_CLASS = "tpu.google.com"
 SUBSLICE_CLASS = "subslice.tpu.google.com"
 MEMBERSHIP_CLASS = "membership.tpu.google.com"
+SLICEGROUP_CLASS = "slicegroup.tpu.google.com"
 
 _CLASS_SELECTORS = {
     TPU_CLASS: "tpu",
     SUBSLICE_CLASS: "subslice",
     MEMBERSHIP_CLASS: "membership",
+    SLICEGROUP_CLASS: "slicegroup",
 }
 
 # Hardware classes additionally require the device to be healthy; membership
@@ -43,7 +45,7 @@ def cel_selector(expr: str) -> DeviceSelector:
 
 
 def install_device_classes(server: InMemoryAPIServer) -> None:
-    """The three DeviceClasses the helm chart ships (templates/deviceclass-*,
+    """The DeviceClasses the helm chart ships (templates/deviceclasses.yaml,
     SURVEY.md §2.6), selecting on driver + type attribute."""
     for name, devtype in _CLASS_SELECTORS.items():
         expr = (
@@ -140,12 +142,19 @@ def make_cluster(
     work_dir: str | None = None,
     slice_domain: str = "",
     daemon_controller: bool = True,
+    slices: int = 1,
+    slice_group: str = "",
 ) -> Cluster:
     """Build a cluster of ``hosts`` TPU hosts sharing one fake slice topology.
 
     Each host gets a Node object (labeled with the slice domain for the
     multi-host controller), a DeviceState whose plugin publishes its
     inventory, and its own cdi/checkpoint dirs under ``work_dir``.
+
+    ``slices > 1`` splits the hosts evenly across that many slice DOMAINS
+    (``{slice_domain}-{s}``, per-domain host ids), and ``slice_group``
+    additionally labels every node with the multislice group — the GKE
+    multislice provisioning shape the slice-GROUP controller watches.
     """
     from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
 
@@ -155,12 +164,23 @@ def make_cluster(
         _install_daemon_controller(server)
     work_dir = work_dir or tempfile.mkdtemp(prefix="tpu-dra-e2e-")
     cluster = Cluster(server=server)
+    if slices > 1 and hosts % slices:
+        raise ValueError(f"{hosts} hosts do not split into {slices} slices")
+    per_slice = hosts // slices
     for host_id in range(hosts):
         name = f"tpu-host-{host_id}"
         labels = {"kubernetes.io/hostname": name}
         if slice_domain:
-            labels["tpu.google.com/slice-domain"] = slice_domain
-            labels["tpu.google.com/slice-host-id"] = str(host_id)
+            if slices > 1:
+                labels["tpu.google.com/slice-domain"] = (
+                    f"{slice_domain}-{host_id // per_slice}"
+                )
+                labels["tpu.google.com/slice-host-id"] = str(host_id % per_slice)
+            else:
+                labels["tpu.google.com/slice-domain"] = slice_domain
+                labels["tpu.google.com/slice-host-id"] = str(host_id)
+            if slice_group:
+                labels["tpu.google.com/slice-group"] = slice_group
         server.create(Node(metadata=ObjectMeta(name=name, labels=labels)))
         driver = Driver(
             server,

@@ -29,7 +29,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from k8s_dra_driver_tpu.models.quant import mat as _mat
+from k8s_dra_driver_tpu.models.quant import matmul_last as _mm
 
 
 @dataclass(frozen=True)
@@ -218,7 +218,7 @@ def qkv_proj(x, p, cfg: ModelConfig, positions=None):
     b, s, _ = x.shape
     h, hkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     y = _rms_norm(x, p["ln1"])
-    qkv = jnp.einsum("bsd,de->bse", y, _mat(p["qkv"]))
+    qkv = _mm(y, p["qkv"])
     q, k, v = jnp.split(qkv, [h * hd, (h + hkv) * hd], axis=-1)
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, hkv, hd)
@@ -244,8 +244,8 @@ def repeat_kv(kv, cfg: ModelConfig):
 def mlp_residual(x, p):
     """ln2 + gelu MLP with residual (shared with decode)."""
     y = _rms_norm(x, p["ln2"])
-    y = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, _mat(p["mlp_up"])))
-    return x + jnp.einsum("bsf,fd->bsd", y, _mat(p["mlp_down"]))
+    y = jax.nn.gelu(_mm(y, p["mlp_up"]))
+    return x + _mm(y, p["mlp_down"])
 
 
 def tied_logits(x, params):
@@ -260,7 +260,7 @@ def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
     # Training widens GQA k/v to one head per query head: every attention
     # backend (dense/flash/ring/ulysses) then sees the MHA shape it knows.
     attn = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, s, d)
-    x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
+    x = x + _mm(attn, p["attn_out"])
     x = _constrain(x, act_spec)
     return _constrain(mlp_residual(x, p), act_spec)
 
@@ -442,7 +442,13 @@ def build_train_step(
             init=jax.jit(init), step=jax.jit(step, donate_argnums=(0, 1))
         )
 
-    act_spec = P("data", "seq", None)
+    # Hybrid data parallelism over multislice meshes (parallel/mesh.py
+    # build_multislice_mesh): when the mesh carries a 'slice' axis, the
+    # batch shards over (slice, data) — the per-step gradient all-reduce is
+    # the ONE collective allowed to cross the slow DCN links, while
+    # seq/model per-token collectives stay on each slice's ICI.
+    batch_axes = ("slice", "data") if "slice" in mesh.axis_names else "data"
+    act_spec = P(batch_axes, "seq", None)
     scheme = sequence_parallel
     if scheme == "auto":
         scheme = "ring" if mesh.shape.get("seq", 1) > 1 else "none"
@@ -497,7 +503,7 @@ def build_train_step(
         pspecs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    data_sharding = NamedSharding(mesh, P("data", None))
+    data_sharding = NamedSharding(mesh, P(batch_axes, None))
 
     def init(key):
         params = init_params(key, cfg)

@@ -47,7 +47,7 @@ from k8s_dra_driver_tpu.models.burnin import (
     qkv_proj,
     tied_logits,
 )
-from k8s_dra_driver_tpu.models.quant import mat as _mat
+from k8s_dra_driver_tpu.models.quant import matmul_last as _mm
 from k8s_dra_driver_tpu.ops import paged_attention
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 
@@ -61,16 +61,18 @@ _M_POOL_FREE = REGISTRY.gauge(
 
 
 class PagedKVCache(NamedTuple):
-    """Per-layer stacked block pools: [L, n_blocks, Hkv, block_size, hd]
-    (head-major — the pallas kernel's DMA tile must be [bs, hd]-trailing,
-    see ops/paged_attention.paged_decode_attention)."""
+    """Per-layer stacked block pools: [L, n_blocks, Hkv, hd, block_size]
+    (head-major and TRANSPOSED — positions on the minormost/lane axis, so
+    the pallas kernel's manual DMA tiles are exact lane multiples and K
+    arrives in VMEM already in K^T form; see
+    ops/paged_attention.paged_window_attention)."""
 
     k: jax.Array
     v: jax.Array
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[3]
+        return self.k.shape[4]
 
     @property
     def n_blocks(self) -> int:
@@ -80,7 +82,7 @@ class PagedKVCache(NamedTuple):
 def init_paged_cache(
     cfg: ModelConfig, n_blocks: int, block_size: int, dtype=jnp.float32
 ) -> PagedKVCache:
-    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, cfg.head_dim, block_size)
     return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -135,33 +137,30 @@ class BlockAllocator:
 
     def free(self, ids) -> None:
         """Drop one reference per id; a block returns to the pool when its
-        last reference drops."""
+        last reference drops.  Atomic: the WHOLE list is validated before
+        any block is released, so a bad id mid-list (out of range, more
+        drops than references) cannot leave the allocator and the caller's
+        owned-list disagreeing about the earlier ids."""
+        ids = [int(i) for i in ids]
+        drops: dict[int, int] = {}
         for i in ids:
             if not 0 < i < self.n_blocks:
                 raise ValueError(f"block id {i} out of range (null block is 0)")
-            refs = self._refs.get(int(i), 0)
-            if refs < 1:
+            drops[i] = drops.get(i, 0) + 1
+        for i, n in drops.items():
+            if self._refs.get(i, 0) < n:
                 raise ValueError(f"double free of block {i}")
+        for i in ids:
+            refs = self._refs[i]
             if refs == 1:
-                del self._refs[int(i)]
-                self._free.append(int(i))
+                del self._refs[i]
+                self._free.append(i)
             else:
-                self._refs[int(i)] = refs - 1
+                self._refs[i] = refs - 1
 
 
 def blocks_needed(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
-
-
-def _attend(q, cache, li, block_table, lengths, attn_impl, interpret):
-    if attn_impl == "kernel":
-        return paged_attention.paged_decode_attention(
-            q, cache.k[li], cache.v[li], block_table, lengths,
-            interpret=interpret,
-        )
-    return paged_attention.paged_attention_xla(
-        q, cache.k[li], cache.v[li], block_table, lengths
-    )
 
 
 def default_attn_impl() -> str:
@@ -187,41 +186,13 @@ def paged_decode_step(
 ):
     """One incremental step over the paged cache — the paged mirror of
     :func:`decode.decode_step` (same qkv/mlp/logits helpers, so numerics
-    cannot drift).  Returns (logits [B, V] f32, updated cache)."""
-    b = token.shape[0]
-    bs = cache.block_size
-    rows = jnp.arange(b)
-    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-
-    x = params["embed"][token][:, None]  # [B, 1, D]
-    if not cfg.rope:
-        x = x + params["pos_embed"][pos[:, None]]
-
-    block_ids = block_table[rows, pos // bs]
-    offs = pos % bs
-    if active is not None:
-        # stale tables on inactive rows may point at REASSIGNED blocks —
-        # divert their writes to the null block instead of gating values
-        # (a duplicate-index scatter against the new owner is unordered)
-        block_ids = jnp.where(active, block_ids, NULL_BLOCK)
-    lengths = pos + 1
-
-    new_k, new_v = cache.k, cache.v
-    for li, p in enumerate(params["blocks"]):
-        q, k, v = qkv_proj(x, p, cfg, positions=pos[:, None])
-        # pool is [L, N, Hkv, bs, hd]: row r writes [Hkv, hd] at
-        # (block_ids[r], :, offs[r]) — the advanced indices bracket the
-        # head slice, so the result subspace leads with the batch axis.
-        new_k = new_k.at[li, block_ids, :, offs].set(k[:, 0].astype(new_k.dtype))
-        new_v = new_v.at[li, block_ids, :, offs].set(v[:, 0].astype(new_v.dtype))
-        cache = PagedKVCache(k=new_k, v=new_v)
-        attn = _attend(
-            q[:, 0], cache, li, block_table, lengths, attn_impl, interpret
-        ).reshape(b, 1, cfg.d_model)
-        x = x + jnp.einsum("bsd,de->bse", attn, _mat(p["attn_out"]))
-        x = mlp_residual(x, p)
-
-    return tied_logits(x, params)[:, 0], cache
+    cannot drift).  The S=1 view of :func:`paged_decode_chunk`; returns
+    (logits [B, V] f32, updated cache)."""
+    logits, cache = paged_decode_chunk(
+        params, cache, block_table, token[:, None], pos, cfg=cfg,
+        active=active, attn_impl=attn_impl, interpret=interpret,
+    )
+    return logits[:, 0], cache
 
 
 @functools.partial(
@@ -240,12 +211,19 @@ def paged_decode_chunk(
     interpret: bool = False,
 ):
     """Score ``S`` known tokens per row in ONE pass over the paged cache —
-    the paged mirror of :func:`decode.decode_chunk` (per-layer: scatter the
-    window's k/v into the pool, then windowed paged attention where query j
+    the paged mirror of :func:`decode.decode_chunk` (per-layer: append the
+    window's k/v to the pool, then windowed paged attention where query j
     attends positions <= pos + j).  This is what makes SPECULATIVE
     verification compose with paging: the verify window runs through the
     block table instead of a dense row.  Returns (logits [B, S, V] f32,
-    updated cache)."""
+    updated cache).
+
+    The kernel path FUSES the cache write into the attention kernel
+    (ops/paged_attention.paged_append_attention): the pools thread through
+    the pallas call aliased in-out, so the serving loop never copies them
+    — the XLA scatter the fallback path uses forces a full pool copy
+    around every custom call when both appear in one jitted step (the
+    round-3 paged uniform-batch tax, eliminated in round 4)."""
     b, s = window.shape
     bs = cache.block_size
     rows = jnp.arange(b)
@@ -256,31 +234,36 @@ def paged_decode_chunk(
     if not cfg.rope:
         x = x + params["pos_embed"][positions]
 
+    if attn_impl == "kernel":
+        new_k, new_v = cache.k, cache.v
+        for li, p in enumerate(params["blocks"]):
+            q, k, v = qkv_proj(x, p, cfg, positions=positions)
+            attn, new_k, new_v = paged_attention.paged_append_attention(
+                q, k, v, new_k, new_v, block_table, pos, li,
+                write_mask=active, interpret=interpret,
+            )
+            x = x + _mm(attn.reshape(b, s, cfg.d_model), p["attn_out"])
+            x = mlp_residual(x, p)
+        return tied_logits(x, params), PagedKVCache(k=new_k, v=new_v)
+
     block_ids = block_table[rows[:, None], positions // bs]  # [B, S]
     offs = positions % bs
     if active is not None:
         # stale tables on inactive rows may point at REASSIGNED blocks —
-        # divert their writes to the null block (see paged_decode_step)
+        # divert their writes to the null block instead of gating values
+        # (a duplicate-index scatter against the new owner is unordered)
         block_ids = jnp.where(active[:, None], block_ids, NULL_BLOCK)
 
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
         q, k, v = qkv_proj(x, p, cfg, positions=positions)
-        new_k = new_k.at[li, block_ids, :, offs].set(k.astype(new_k.dtype))
-        new_v = new_v.at[li, block_ids, :, offs].set(v.astype(new_v.dtype))
+        new_k = new_k.at[li, block_ids, :, :, offs].set(k.astype(new_k.dtype))
+        new_v = new_v.at[li, block_ids, :, :, offs].set(v.astype(new_v.dtype))
         cache = PagedKVCache(k=new_k, v=new_v)
-        if attn_impl == "kernel":
-            attn = paged_attention.paged_window_attention(
-                q, cache.k[li], cache.v[li], block_table, pos,
-                interpret=interpret,
-            )
-        else:
-            attn = paged_attention.paged_window_attention_xla(
-                q, cache.k[li], cache.v[li], block_table, pos
-            )
-        x = x + jnp.einsum(
-            "bsd,de->bse", attn.reshape(b, s, cfg.d_model), _mat(p["attn_out"])
+        attn = paged_attention.paged_window_attention_xla(
+            q, cache.k[li], cache.v[li], block_table, pos
         )
+        x = x + _mm(attn.reshape(b, s, cfg.d_model), p["attn_out"])
         x = mlp_residual(x, p)
 
     return tied_logits(x, params), cache
@@ -309,11 +292,11 @@ def paged_prefill(
     dense, last_logits = decode.prefill(
         params, prompt, cfg, max_seq=p_pad, cache_dtype=cache.k.dtype
     )
-    # [L, B, p_pad, Hkv, hd] -> blocks, then head-major to match the pool:
-    # [L, B, nb, Hkv, bs, hd]
+    # [L, B, p_pad, Hkv, hd] -> blocks, then head-major TRANSPOSED to match
+    # the pool: [L, B, nb, Hkv, hd, bs]
     l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
-    kb = dense.k.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
-    vb = dense.v.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
+    kb = dense.k.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
+    vb = dense.v.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
     ids = block_table[:, :nb]
     return (
         PagedKVCache(k=cache.k.at[:, ids].set(kb), v=cache.v.at[:, ids].set(vb)),
@@ -321,64 +304,68 @@ def paged_prefill(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "done_blocks", "chunk_len"))
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len"))
 def paged_prefill_chunk(
     params,
     prompt: jax.Array,       # [1, bucket] padded prompt
     cache: PagedKVCache,
     block_table_row: jax.Array,  # [1, >= ceil(bucket/bs)] — done ids first
+    done_blocks: jax.Array,  # scalar i32 — leading FULL blocks already pooled
     *,
     cfg: ModelConfig,
-    done_blocks: int,        # leading FULL blocks already in the pool
     chunk_len: int,          # tokens to prefill this call
 ):
-    """Incremental admission: gather the already-pooled leading blocks'
-    k/v into a dense scratch row (only as wide as this chunk needs), run
-    ONE `decode_chunk` over positions ``[done, done + chunk_len)``
-    (``pos0`` re-derives positions, RoPE included), and scatter only the
-    chunk's blocks back into the pool.  The done blocks are never
-    re-written — whether they came from THIS request's earlier chunks
-    (chunked prefill) or from the SHARED prefix store (block-level prefix
-    cache): either way the attended bytes are the ones a full prefill
-    produces, the dense engine's prefix-cache bit-equality argument
-    (serve._prefill_suffix_into_slot).  Intermediate chunks must be
-    block-aligned; the final chunk may end anywhere in the bucket.
-    Returns the updated cache."""
+    """Incremental admission: gather the row's pooled blocks' k/v into a
+    dense scratch row, run ONE `decode_chunk` over positions
+    ``[done, done + chunk_len)`` (``pos0`` re-derives positions, RoPE
+    included), and scatter only the chunk's blocks back into the pool.
+    The done blocks are never re-written — whether they came from THIS
+    request's earlier chunks (chunked prefill) or from the SHARED prefix
+    store (block-level prefix cache): either way the attended bytes are
+    the ones a full prefill produces, the dense engine's prefix-cache
+    bit-equality argument (serve._prefill_suffix_into_slot).  Chunks must
+    start block-aligned; the final chunk may end anywhere in the bucket.
+    Returns the updated cache.
+
+    ``done_blocks`` is a DYNAMIC operand on purpose: only ``chunk_len``
+    shapes the program, so chunked admission compiles at most
+    ``prefill_chunk_blocks`` variants EVER (the intermediate width plus
+    the possible final widths), not one per (done, chunk) pair a long
+    prompt walks through.  The price is static-shaped work over the whole
+    prefill row (gather all ``mbp`` blocks, attend over the full bucket —
+    stale bytes past the frontier are causally masked); buckets are small,
+    recompiles are not.  The caller must ensure
+    ``done_blocks*bs + chunk_len <= bucket`` (unverifiable on a traced
+    scalar)."""
     b, bucket = prompt.shape
     bs = cache.block_size
+    mbp = block_table_row.shape[1]
+    p_pad = mbp * bs
+    if chunk_len > bucket:
+        raise ValueError(f"chunk_len {chunk_len} exceeds bucket {bucket}")
+    done_blocks = jnp.asarray(done_blocks, jnp.int32)
     done_len = done_blocks * bs
-    end = done_len + chunk_len
-    if end > bucket:
-        raise ValueError(f"chunk [{done_len}, {end}) exceeds bucket {bucket}")
-    end_blocks = blocks_needed(end, bs)
-    p_pad = end_blocks * bs
+    chunk_blocks = blocks_needed(chunk_len, bs)
     l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
 
-    row = decode.init_cache(cfg, 1, p_pad, dtype=cache.k.dtype)
-    if done_blocks:
-        ids = block_table_row[0, :done_blocks]
-        # pool [L, N, Hkv, bs, hd] -> [L, done, Hkv, bs, hd] -> seq-major
-        pre_k = cache.k[:, ids].transpose(0, 1, 3, 2, 4).reshape(
-            l, 1, done_len, hkv, hd
-        )
-        pre_v = cache.v[:, ids].transpose(0, 1, 3, 2, 4).reshape(
-            l, 1, done_len, hkv, hd
-        )
-        row = decode.KVCache(
-            k=row.k.at[:, :, :done_len].set(pre_k),
-            v=row.v.at[:, :, :done_len].set(pre_v),
-        )
-    chunk = prompt[:, done_len:end]
-    _, row = decode.decode_chunk(
-        params, row, chunk, done_len, cfg=cfg, k_window=end
-    )
+    # Gather the WHOLE prefill row (fixed width): blocks at or past the
+    # frontier hold stale/zero bytes, but decode_chunk's causal mask keeps
+    # any query from attending past its own position, so they are inert.
+    ids = block_table_row[0, :mbp]
+    # pool [L, N, Hkv, hd, bs] -> [L, mbp, Hkv, hd, bs] -> seq-major
+    pre_k = cache.k[:, ids].transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
+    pre_v = cache.v[:, ids].transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
+    row = decode.KVCache(k=pre_k, v=pre_v)
+    chunk = jax.lax.dynamic_slice(prompt, (0, done_len), (1, chunk_len))
+    _, row = decode.decode_chunk(params, row, chunk, done_len, cfg=cfg)
     # scatter ONLY the chunk's blocks (done ones are pooled already)
-    kb = row.k.reshape(l, b, end_blocks, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
-    vb = row.v.reshape(l, b, end_blocks, bs, hkv, hd).transpose(0, 1, 2, 4, 3, 5)
-    ids = block_table_row[:, done_blocks:end_blocks]
+    kb = row.k.reshape(l, b, mbp, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
+    vb = row.v.reshape(l, b, mbp, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
+    kb = jax.lax.dynamic_slice_in_dim(kb, done_blocks, chunk_blocks, axis=2)
+    vb = jax.lax.dynamic_slice_in_dim(vb, done_blocks, chunk_blocks, axis=2)
+    ids = jax.lax.dynamic_slice(block_table_row, (0, done_blocks), (1, chunk_blocks))
     return PagedKVCache(
-        k=cache.k.at[:, ids].set(kb[:, :, done_blocks:]),
-        v=cache.v.at[:, ids].set(vb[:, :, done_blocks:]),
+        k=cache.k.at[:, ids].set(kb), v=cache.v.at[:, ids].set(vb)
     )
 
 
@@ -386,10 +373,11 @@ def paged_prefill_suffix(
     params, prompt, cache, block_table_row, *, cfg, cached_blocks
 ):
     """Prefix-hit admission = one chunk covering everything after the
-    shared prefix."""
+    shared prefix.  (``chunk_len`` still varies with the hit depth here —
+    one compiled variant per distinct cached-block count, bounded by the
+    prefill width and amortized across all requests sharing the store.)"""
     return paged_prefill_chunk(
-        params, prompt, cache, block_table_row, cfg=cfg,
-        done_blocks=cached_blocks,
+        params, prompt, cache, block_table_row, cached_blocks, cfg=cfg,
         chunk_len=prompt.shape[1] - cached_blocks * cache.block_size,
     )
 
@@ -521,6 +509,18 @@ class PagedServeEngine:
             )
         if self.attn_impl is None:
             self.attn_impl = default_attn_impl()
+        if (
+            self.attn_impl == "kernel"
+            and not self.interpret
+            and jax.default_backend() == "tpu"
+            and self.block_size % 128
+        ):
+            # fail at construction, not deep inside the first submit()'s
+            # trace: the TPU DMA kernel's copies must be lane-tile exact
+            raise ValueError(
+                f"block_size {self.block_size} needs % 128 == 0 for the TPU "
+                "kernel path; use a 128-multiple or attn_impl='xla'"
+            )
         bs = self.block_size
         self._mb = blocks_needed(cfg.max_seq, bs)        # table width
         self._mbp = blocks_needed(self.prompt_bucket, bs)  # prefill width
@@ -744,7 +744,7 @@ class PagedServeEngine:
             if real_end - adm["done"] * bs > self.prefill_chunk_blocks * bs:
                 self._cache = paged_prefill_chunk(
                     self.params, adm["padded"], self._cache, prefill_row,
-                    cfg=self.cfg, done_blocks=adm["done"],
+                    adm["done"], cfg=self.cfg,
                     chunk_len=self.prefill_chunk_blocks * bs,
                 )
                 adm["done"] += self.prefill_chunk_blocks
@@ -755,7 +755,7 @@ class PagedServeEngine:
             if chunk_len > 0:
                 self._cache = paged_prefill_chunk(
                     self.params, adm["padded"], self._cache, prefill_row,
-                    cfg=self.cfg, done_blocks=adm["done"], chunk_len=chunk_len,
+                    adm["done"], cfg=self.cfg, chunk_len=chunk_len,
                 )
             if self.spec_gamma > 0:
                 self._d_cache = self._draft_prefill_fn(

@@ -32,42 +32,36 @@ from k8s_dra_driver_tpu.models.burnin import (
 from k8s_dra_driver_tpu.ops.pipeline import pipeline_apply, stack_blocks, stage_scan
 
 
-def _headmajor_qkv(w, cfg: ModelConfig):
-    """[D, q|k|v packed] -> [D, head-major (h, 3, hd)] so TP column shards
-    hold whole heads."""
-    if cfg.rope:
-        raise NotImplementedError(
-            "pipeline TP variant supports learned positions only (rope=False); "
-            "RoPE plumbing through the stage scan is a follow-up"
-        )
-    if cfg.kv_heads != cfg.n_heads:
-        # GQA packs [q(Hq) | k(Hkv) | v(Hkv)] — the 3-equal-chunk head-major
-        # repack below would scramble it.  Shard-whole-(q-head + its kv
-        # group) repacking is a follow-up; fail loudly, not numerically.
-        raise NotImplementedError(
-            "pipeline TP variant supports MHA only (n_kv_heads == n_heads); "
-            f"got n_heads={cfg.n_heads} n_kv_heads={cfg.kv_heads}"
-        )
+def _groupmajor_qkv(w, cfg: ModelConfig):
+    """[D, q(Hq)|k(Hkv)|v(Hkv) packed] -> [D, group-major (Hkv, G*hd q +
+    hd k + hd v)] so TP column shards hold whole KV GROUPS — each shard's
+    columns carry G query heads together with THEIR kv head, which is what
+    lets GQA tensor-shard without widening or scrambling the narrow k/v.
+    MHA (G=1) reduces to the head-major [q_h | k_h | v_h] layout."""
     d = cfg.d_model
-    return (
-        w.reshape(d, 3, cfg.n_heads, cfg.head_dim)
-        .transpose(0, 2, 1, 3)
-        .reshape(d, 3 * d)
-    )
+    h, hkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    g = cfg.kv_groups
+    wq = w[:, : h * hd].reshape(d, hkv, g * hd)
+    wk = w[:, h * hd : (h + hkv) * hd].reshape(d, hkv, hd)
+    wv = w[:, (h + hkv) * hd :].reshape(d, hkv, hd)
+    return jnp.concatenate([wq, wk, wv], axis=2).reshape(d, (h + 2 * hkv) * hd)
 
 
 def pp_params_from_dense(dense: dict, cfg: ModelConfig) -> dict:
     """Convert burnin's dense param tree to the pipeline layout (stacked
-    blocks + head-major qkv)."""
+    blocks + group-major qkv).  RoPE configs carry no pos_embed — positions
+    are rotated into q/k inside the stage scan."""
     blocks = [
-        {**blk, "qkv": _headmajor_qkv(blk["qkv"], cfg)} for blk in dense["blocks"]
+        {**blk, "qkv": _groupmajor_qkv(blk["qkv"], cfg)} for blk in dense["blocks"]
     ]
-    return {
+    out = {
         "embed": dense["embed"],
-        "pos_embed": dense["pos_embed"],
         "ln_f": dense["ln_f"],
         "blocks": stack_blocks(blocks),
     }
+    if not cfg.rope:
+        out["pos_embed"] = dense["pos_embed"]
+    return out
 
 # Stacked-block param layout: leading dim = layer, sharded over `pipe`;
 # Megatron TP layout on the trailing dims.
@@ -82,19 +76,33 @@ _STACKED_SPECS = {
 
 
 def _tp_attention_core(qkv, b: int, s: int, tp: int, cfg: ModelConfig, dtype):
-    """Shared attention math for BOTH TP block variants: head-major qkv
-    [b, s, h_loc*3*hd] -> attention output [b, s, d/tp].  One
+    """Shared attention math for BOTH TP block variants: group-major qkv
+    [b, s, (Hkv/tp)*(G+2)*hd] -> attention output [b, s, d/tp].  One
     implementation so the mask/f32-softmax/scaling policy cannot drift
-    between tp modes."""
-    h_loc = cfg.n_heads // tp
-    hd = cfg.head_dim
-    qkv = qkv.reshape(b, s, h_loc, 3, hd)
-    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(dtype)
+    between tp modes.  GQA contracts each local KV head against its G
+    query heads directly (the narrow k/v is never widened), and RoPE
+    rotates q/k by absolute position right here — inside the stage scan —
+    so pipeline stages need no position plumbing beyond the sequence
+    length."""
+    from k8s_dra_driver_tpu.models.burnin import rope_rotate
+
+    hkv_loc = cfg.kv_heads // tp
+    g, hd = cfg.kv_groups, cfg.head_dim
+    qkv = qkv.reshape(b, s, hkv_loc, (g + 2) * hd)
+    q = qkv[..., : g * hd].reshape(b, s, hkv_loc * g, hd)
+    k = qkv[..., g * hd : (g + 1) * hd]  # [b, s, hkv_loc, hd]
+    v = qkv[..., (g + 1) * hd :]
+    if cfg.rope:
+        pos = jnp.arange(s, dtype=jnp.int32)
+        q = rope_rotate(q, pos, cfg)
+        k = rope_rotate(k, pos, cfg)
+    qg = q.reshape(b, s, hkv_loc, g, hd)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) / jnp.sqrt(hd).astype(dtype)
     mask = jnp.tril(jnp.ones((s, s), bool))
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
     weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, s, cfg.d_model // tp)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    return out.reshape(b, s, cfg.d_model // tp)
 
 
 def _manual_tp_block_sp(x, p, cfg: ModelConfig, tp: int):
@@ -136,7 +144,7 @@ def _manual_tp_block(x, p, cfg: ModelConfig, tp: int):
     b, s, _d = x.shape
 
     y = _rms_norm(x, p["ln1"])
-    # p["qkv"] is head-major (see _headmajor_qkv): each TP shard's columns
+    # p["qkv"] is group-major (see _groupmajor_qkv): each TP shard's columns
     # are whole heads carrying their own q,k,v — a naive [q|k|v]-packed
     # column shard would split k across devices.
     qkv = jnp.einsum("bsd,de->bse", y, p["qkv"])  # [b, s, h_loc*3*hd]
@@ -171,8 +179,12 @@ def build_pp_train_step(
         raise ValueError("the pipeline path composes with data/model axes only")
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide into {pp} stages")
-    if cfg.n_heads % tp:
-        raise ValueError(f"n_heads ({cfg.n_heads}) not divisible by model axis {tp}")
+    if cfg.kv_heads % tp:
+        # TP shards whole KV groups (each query head rides with its kv
+        # head), so the KV head count is the sharding granularity.
+        raise ValueError(
+            f"n_kv_heads ({cfg.kv_heads}) not divisible by model axis {tp}"
+        )
     if cfg.d_ff % tp or cfg.d_model % tp:
         raise ValueError(
             f"d_ff ({cfg.d_ff}) and d_model ({cfg.d_model}) must be divisible "
@@ -183,9 +195,10 @@ def build_pp_train_step(
 
     outer_specs = {
         "embed": P("model", None),
-        "pos_embed": P(),
         "ln_f": P(),
     }
+    if not cfg.rope:  # the table exists only without RoPE; specs must match
+        outer_specs["pos_embed"] = P()
     param_shardings = {
         **{k: NamedSharding(mesh, s) for k, s in outer_specs.items()},
         "blocks": {k: NamedSharding(mesh, s) for k, s in _STACKED_SPECS.items()},
@@ -224,7 +237,9 @@ def build_pp_train_step(
                 f"megatron-sp shards the sequence over the model axis: "
                 f"seq {s} must be divisible by {tp}"
             )
-        x = params["embed"][tokens] + params["pos_embed"][:s]
+        x = params["embed"][tokens]
+        if not cfg.rope:
+            x = x + params["pos_embed"][:s]
         x_mb = x.reshape(n_micro, b // n_micro, s, cfg.d_model)
         x = pipe_body(params["blocks"], x_mb).reshape(b, s, cfg.d_model)
         x = _rms_norm(x, params["ln_f"])

@@ -74,11 +74,19 @@ class Quantized4Matrix:
     GROUP-WISE f32 scales: int4's 15 levels need a tighter dynamic range
     than a whole column, so each ``group_size`` input rows of a column get
     their own scale — the standard int4 weight-only recipe (~4.5 bits per
-    weight with the scales).  Dequant unpacks + scales at the consuming
-    matmul; HBM holds one byte per TWO weights."""
+    weight with the scales).  HBM holds one byte per TWO weights.
+
+    Within each group, byte ``i`` packs rows ``i`` (low nibble) and
+    ``i + gs/2`` (high) — HALF-SPLIT per group, NOT even/odd interleave:
+    dequant is then two nibble-mask chains joined by a block CONCAT
+    (contiguous half-group stripes, original row order preserved), which
+    XLA fuses into the consuming dot's operand load.  The round-3
+    interleaved layout needed a stride-2 stack+reshape relayout that XLA
+    materialized as a full-width bf16 weight every step — the
+    "unpack-bound" decode_int4 tax."""
 
     def __init__(self, packed, scale, group_size: int, dtype=jnp.bfloat16):
-        self.packed = packed        # [in//2, out] uint8, row-interleaved
+        self.packed = packed        # [in//2, out] uint8, per-group halves
         self.scale = scale          # [in//group_size, out] f32
         self.group_size = group_size
         self.dtype = dtype
@@ -106,29 +114,35 @@ class Quantized4Matrix:
         dtype = dtype or w.dtype
         n_in, n_out = w.shape
         group_size = min(group_size, n_in)
-        if n_in % group_size or n_in % 2:
+        if n_in % group_size or group_size % 2:
             raise ValueError(
-                f"in dim {n_in} must be even and divisible by group {group_size}"
+                f"in dim {n_in} must be divisible by an even group "
+                f"{group_size} (per-group half-split packing)"
             )
         w32 = w.astype(jnp.float32).reshape(n_in // group_size, group_size, n_out)
         scale = jnp.max(jnp.abs(w32), axis=1) / 7.0     # [groups, out]
         scale = jnp.where(scale == 0, 1.0, scale)
-        q = jnp.clip(jnp.round(w32 / scale[:, None]), -8, 7)
-        q = q.reshape(n_in, n_out).astype(jnp.int8)
-        biased = (q + 8).astype(jnp.uint8)
-        packed = biased[0::2] | (biased[1::2] << 4)     # [in//2, out]
+        q = jnp.clip(jnp.round(w32 / scale[:, None]), -8, 7).astype(jnp.int8)
+        biased = (q + 8).astype(jnp.uint8)     # [groups, gs, out]
+        half = group_size // 2
+        packed = (biased[:, :half] | (biased[:, half:] << 4)).reshape(
+            n_in // 2, n_out
+        )
         return cls(packed, scale, group_size, dtype)
 
     def dequant(self) -> jax.Array:
-        """Unpack + group-scale; XLA fuses into the consuming dot's operand
-        load, so the HBM read stays nibble-sized."""
-        low = (self.packed & 0xF).astype(jnp.int8) - 8
-        high = (self.packed >> 4).astype(jnp.int8) - 8
+        """Unpack + group-scale in the compute dtype.  Two nibble-mask
+        chains + one contiguous per-group concat (no cross-row shuffle) —
+        XLA fuses the whole chain into the consuming dot's operand load
+        (quant.matmul_last), so the HBM read stays nibble-sized."""
         n_in, n_out = self.shape
-        q = jnp.stack([low, high], axis=1).reshape(n_in, n_out)
-        w = q.astype(jnp.float32).reshape(
-            n_in // self.group_size, self.group_size, n_out
-        ) * self.scale[:, None]
+        gs = self.group_size
+        half = gs // 2
+        p = self.packed.reshape(n_in // gs, half, n_out)
+        low = (p & 0xF).astype(jnp.int8) - 8
+        high = (p >> 4).astype(jnp.int8) - 8
+        q = jnp.concatenate([low, high], axis=1)        # [groups, gs, out]
+        w = q.astype(jnp.float32) * self.scale[:, None]
         return w.reshape(n_in, n_out).astype(self.dtype)
 
 
@@ -140,6 +154,17 @@ def mat(w):
     for plain arrays — the one helper every weight-consuming einsum goes
     through, so quantized params are drop-in."""
     return w.dequant() if isinstance(w, _QUANTIZED) else w
+
+
+def matmul_last(x, w):
+    """``x @ w`` contracting x's LAST axis — THE weight-consuming matmul
+    every model path routes through (burnin.qkv_proj / mlp_residual and
+    everything built on them), so quantized params are drop-in on the hot
+    path too.  One dot in one place: the accumulation order is identical
+    for quantized and plain weights (the bit-exactness contract
+    tests/test_quant.py pins), and a future fused dequant-dot kernel has
+    exactly one seam to land in."""
+    return x @ mat(w)
 
 
 _BLOCK_WEIGHT_KEYS = ("qkv", "attn_out", "mlp_up", "mlp_down")

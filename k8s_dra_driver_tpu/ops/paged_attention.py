@@ -5,22 +5,27 @@ count times context length is bounded by the WORST CASE sequence, and every
 decode step's attention reads the whole ``max_seq`` stripe per slot.  Paged
 attention breaks that coupling the vLLM way, designed TPU-first here:
 
-* the KV cache is a POOL of fixed-size blocks ``[n_blocks, block_size,
-  Hkv, hd]`` shared by all slots; a per-slot *block table* lists which pool
-  blocks hold its keys, in order;
+* the KV cache is a POOL of fixed-size blocks ``[n_blocks, Hkv, hd,
+  block_size]`` (head-major, positions on LANES) shared by all slots; a
+  per-slot *block table* lists which pool blocks hold its keys, in order;
 * capacity is bounded by TOTAL tokens across slots (sum of lengths), not
   ``n_slots x max_seq`` — ragged batches pack; long-context slots coexist
   with short ones (the long-context first-class mandate, SURVEY.md §5);
-* the decode kernel walks only the blocks a slot actually uses: grid
-  ``(batch, block)`` with the block axis innermost, the block table
-  SCALAR-PREFETCHED so each step's ``BlockSpec`` index map picks the
-  right pool block to DMA (every KV head rides one fetch — maximal DMA
-  granularity), and online-softmax state in VMEM scratch across the
-  block walk (same structure as ops/flash_attention.py).  Steps past a
-  slot's last used block are predicated off with ``pl.when`` AND their
-  index map repeats the previous block id, so Mosaic skips the re-fetch —
-  a slot at length 300 with 128-token blocks reads 3 blocks, not
-  ``max_blocks``: per-step HBM traffic follows the RAGGED lengths.
+* the decode kernel walks only the blocks a slot actually uses: the
+  pool stays in HBM (``memory_space=ANY``) and the kernel drives its own
+  DOUBLE-BUFFERED multi-block DMA pipeline — each grid step hand-issues
+  ``pages_per_step`` block fetches for the NEXT alive step
+  (``pltpu.make_async_copy`` into the other half of a 2-deep VMEM
+  buffer) before waiting on its own, so the i+1 fetch rides under the
+  step-i FLOPs and the per-grid-step dispatch overhead (~1µs, the round-3
+  uniform-batch tax) amortizes over ``pages_per_step`` blocks at once;
+  online-softmax state lives in VMEM scratch across the walk (same
+  structure as ops/flash_attention.py).  Steps fully past a slot's
+  frontier neither fetch nor compute (the prefetch chain skips straight
+  to the next row), and partial tail steps clamp their page indices to
+  the slot's last used block — a slot at length 300 with 128-token
+  blocks reads 3 blocks, not ``max_blocks``: per-step HBM traffic
+  follows the RAGGED lengths.
 
 GQA falls out of the layout: queries arrive grouped ``[B, Hkv, G, hd]`` and
 each grid step contracts one KV head's block against its G query heads —
@@ -51,44 +56,166 @@ _NEG_INF = -1e30
 
 
 def _paged_kernel(
-    table_ref, lens_ref,  # scalar-prefetch: [B, max_blocks] i32, [B] i32
-    q_ref, k_ref, v_ref,  # [1,Hkv,G*nq,d], [1,Hkv,bs,d], [1,Hkv,bs,d]
-    out_ref,              # [1,Hkv,G*nq,d]
-    m_ref, l_ref, acc_ref,  # [Hkv*G*nq,128], [Hkv*G*nq,128], [Hkv*G*nq,d]
-    *, block_size: int, num_blocks: int, scale: float, nq: int,
+    *refs,
+    block_size: int, pages: int, num_super: int, batch: int,
+    max_blocks: int, scale: float, nq: int, append: bool,
 ):
+    """Grid ``(batch, superblock)``; each step covers ``pages`` pool blocks
+    fetched by hand-rolled double-buffered DMA (see module docstring).
+    ``buf_ref`` tracks which buffer half the CURRENT step's data landed in;
+    ``init_ref`` makes the first alive step fetch its own data (every later
+    step's was prefetched by its predecessor).
+
+    ``append=True`` is the FUSED append+attend form: the pools arrive
+    STACKED over layers ([L, n_pool, Hkv, d, bs]) and aliased in-out, the
+    ``li_ref`` scalar picks the layer, and each row's ``nq`` new k/v
+    vectors (positions ``length-nq .. length-1``) are blended into the
+    fetched frontier page(s) in VMEM and DMA'd back — the engine's
+    per-token cache write WITHOUT an XLA scatter, whose carried-buffer
+    copies around the custom call were the round-3 paged tax."""
+    if append:
+        (table_ref, lens_ref, wmask_ref, li_ref, buf_ref, init_ref,
+         q_ref, nk_ref, nv_ref, _k_in, _v_in,
+         out_ref, ko_ref, vo_ref,
+         m_ref, l_ref, acc_ref, k_buf, v_buf, k_sem, v_sem, w_sem) = refs
+        k_hbm, v_hbm = ko_ref, vo_ref  # aliased in-out buffers
+        li = li_ref[0]
+        page = lambda ref, idx: ref.at[li, idx]
+    else:
+        (table_ref, lens_ref, buf_ref, init_ref,
+         q_ref, k_hbm, v_hbm, out_ref,
+         m_ref, l_ref, acc_ref, k_buf, v_buf, k_sem, v_sem) = refs
+        page = lambda ref, idx: ref.at[idx]
     b = pl.program_id(0)
     i = pl.program_id(1)
-
-    @pl.when(i == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    # lens_ref[b] = keys attended by the LAST window query; query j of nq
-    # (causal window) attends k_pos <= length - nq + j.
+    span = pages * block_size  # keys per superblock step
     length = lens_ref[b]
 
-    # Blocks at or past the slot's frontier hold no attended keys: no FLOPs
-    # (and no fresh DMA — their index map repeats the last valid block).
-    @pl.when(i * block_size < length)
-    def _compute():
+    def fetches(bi, ii, slot):
+        """The 2*pages async copies filling buffer half ``slot`` with
+        superblock ``ii`` of row ``bi``.  Each page moves one contiguous
+        ``[Hkv, d, bs]`` stripe (positions on LANES — the transposed pool
+        layout keeps every copy's minormost dim an exact lane-tile
+        multiple, which Mosaic requires of manual DMAs).  Page indices
+        past the row's last used block clamp to it (their keys mask off)
+        so table reads never go out of bounds and the tail DMA stays
+        well-defined."""
+        last = jnp.maximum((lens_ref[bi] - 1) // block_size, 0)
+        cps = []
+        for p in range(pages):
+            j = jnp.minimum(ii * pages + p, jnp.minimum(last, max_blocks - 1))
+            idx = table_ref[bi * max_blocks + j]
+            dst = pl.ds(p * block_size, block_size)
+            cps.append(pltpu.make_async_copy(
+                page(k_hbm, idx), k_buf.at[slot, :, :, dst], k_sem.at[slot]
+            ))
+            cps.append(pltpu.make_async_copy(
+                page(v_hbm, idx), v_buf.at[slot, :, :, dst], v_sem.at[slot]
+            ))
+        return cps
+
+    def writebacks(slot):
+        """The (at most 2 per k/v) copies flushing blended frontier pages
+        back to the pool.  The ``nq`` appended positions span at most two
+        consecutive blocks (wrapper enforces nq <= block_size); each step
+        flushes only blocks it fetched, so a window crossing a superblock
+        boundary is flushed half by each step.  ``wmask`` gates rows whose
+        writes must not land (engine-inactive rows hold STALE tables)."""
+        first_new = (length - nq) // block_size
+        cps = []
+        for t in range(2):
+            blk = first_new + t
+            cond = (
+                (blk >= i * pages)
+                & (blk < (i + 1) * pages)
+                & (blk * block_size < length)
+                & (wmask_ref[b] != 0)
+            )
+            p_loc = jnp.clip(blk - i * pages, 0, pages - 1)
+            idx = table_ref[b * max_blocks + jnp.clip(blk, 0, max_blocks - 1)]
+            src = pl.ds(p_loc * block_size, block_size)
+            cps.append((cond, pltpu.make_async_copy(
+                k_buf.at[slot, :, :, src], page(k_hbm, idx), w_sem
+            )))
+            cps.append((cond, pltpu.make_async_copy(
+                v_buf.at[slot, :, :, src], page(v_hbm, idx), w_sem
+            )))
+        return cps
+
+    # Superblocks fully past the slot's frontier hold no attended keys: no
+    # DMA, no FLOPs — the predecessor's prefetch already targeted the next
+    # ALIVE step, skipping straight into the next row's walk.
+    @pl.when(i * span < length)
+    def _step():
+        first = init_ref[0]
+        init_ref[0] = 0
+        slot = buf_ref[0]
+
+        @pl.when(first == 1)
+        def _fetch_own():  # very first alive step: nobody prefetched for us
+            for c in fetches(b, i, slot):
+                c.start()
+
+        @pl.when(i == 0)
+        def _init_state():
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        # Next ALIVE step: (b, i+1) while it still holds attended keys,
+        # else the next row's first superblock (every row has length >= 1).
+        next_b, next_i = jax.lax.cond(
+            (i + 1) * span < length,
+            lambda: (b, i + 1),
+            lambda: (b + 1, 0),
+        )
+
+        @pl.when(next_b < batch)
+        def _prefetch_next():  # rides under THIS step's compute
+            nslot = 1 - slot
+            for c in fetches(next_b, next_i, nslot):
+                c.start()
+            buf_ref[0] = nslot
+
+        for c in fetches(b, i, slot):
+            c.wait()
         q = q_ref[0]             # [Hkv, G*nq, d] — every head in one step
-        k = k_ref[0]             # [Hkv, bs, d]
-        v = v_ref[0]
-        hkv, gnq, _ = q.shape
+        hkv, gnq, _d = q.shape
+        k = k_buf[slot]          # [Hkv, d, span] — K^T, the MXU-native form
+        v = v_buf[slot]
+        if append:
+            # Blend the nq new k/v vectors into this step's span (a lane
+            # select per new position — sub-µs next to the page DMAs),
+            # store the blended span back so the write-back flushes it,
+            # then flush the touched page(s) under the compute below.
+            lane = jax.lax.broadcasted_iota(jnp.int32, k.shape, 2)
+            for jw in range(nq):
+                l_j = length - nq + jw - i * span
+                hit = lane == l_j  # never true when the position is
+                #                    outside this step's span
+                k = jnp.where(hit, nk_ref[0, :, :, jw][:, :, None], k)
+                v = jnp.where(hit, nv_ref[0, :, :, jw][:, :, None], v)
+            k_buf[slot] = k
+            v_buf[slot] = v
+            wb = writebacks(slot)
+            for cond, c in wb:
+                @pl.when(cond)
+                def _start(c=c):
+                    c.start()
         s = jax.lax.dot_general(
-            q.astype(k.dtype), k, (((2,), (2,)), ((0,), (0,))),
+            q.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale                # [Hkv, G*nq, bs]
-        k_pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        # query index within the window is the FASTEST-varying factor of the
-        # row axis (layout contract with the caller's reshape)
+        ) * scale                # [Hkv, G*nq, span]
+        k_pos = i * span + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        # lens_ref[b] = keys attended by the LAST window query; query j of
+        # nq (causal window) attends k_pos <= length - nq + j.  The query
+        # index is the FASTEST-varying factor of the row axis (layout
+        # contract with the caller's reshape).  Clamped duplicate tail
+        # pages land at k_pos >= length, so they mask off here too.
         j = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) % nq
         s = jnp.where(k_pos <= length - nq + j, s, _NEG_INF)
 
-        s2 = s.reshape(hkv * gnq, block_size)  # head-major rows, online state
+        s2 = s.reshape(hkv * gnq, span)  # head-major rows, online state
         m_prev = m_ref[:, 0:1]
         l_prev = l_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, s2.max(axis=-1, keepdims=True))
@@ -98,14 +225,21 @@ def _paged_kernel(
             l_prev * correction + p.sum(axis=-1, keepdims=True), l_ref.shape
         )
         pv = jax.lax.dot_general(
-            p.reshape(hkv, gnq, block_size).astype(v.dtype), v,
-            (((2,), (1,)), ((0,), (0,))),
+            p.reshape(hkv, gnq, span).astype(v.dtype), v,
+            (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                        # [Hkv, G*nq, d]
         acc_ref[:] = acc_ref[:] * correction + pv.reshape(hkv * gnq, -1)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        if append:
+            # the flush rode under the dots; settle it before the buffer
+            # half can be refilled two steps from now
+            for cond, c in wb:
+                @pl.when(cond)
+                def _wait(c=c):
+                    c.wait()
 
-    @pl.when(i == num_blocks - 1)
+    @pl.when(i == num_super - 1)
     def _finalize():
         out_ref[0] = (
             (acc_ref[:] / l_ref[:, 0:1])
@@ -114,14 +248,29 @@ def _paged_kernel(
         )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def default_pages_per_step(
+    block_size: int, max_blocks: int, hkv: int, d: int, itemsize: int
+) -> int:
+    """Pages per superblock step: as many as a ~6MB double-buffer budget
+    allows (2 buffer halves x k+v x [hkv, d, span]).  Measured on v5e, the
+    per-grid-step cost is ~1µs FIXED — independent of the DMA size — so
+    the fastest walk is the one with the fewest steps: at 2k context one
+    whole-row superblock puts the kernel AT the HBM roofline (16µs vs the
+    XLA dense path's 25µs for b16/h8/kv2/d64); only when the budget (or a
+    wide-head/f32 pool) forces it does the walk take more steps."""
+    span_budget = (6 << 20) // (4 * hkv * d * itemsize)
+    return max(1, min(max_blocks, span_budget // block_size))
+
+
+@functools.partial(jax.jit, static_argnames=("pages_per_step", "interpret"))
 def paged_window_attention(
     q: jax.Array,            # [B, nq, Hq, d] — a CAUSAL query window
-    k_pool: jax.Array,       # [n_blocks, Hkv, block_size, d]
+    k_pool: jax.Array,       # [n_blocks, Hkv, d, block_size] — transposed
     v_pool: jax.Array,
     block_table: jax.Array,  # [B, max_blocks] i32 pool-block ids
     pos: jax.Array,          # [B] i32 — window query j sits at pos + j
     *,
+    pages_per_step: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Ragged paged attention over a short causal window — nq=1 is plain
@@ -129,17 +278,33 @@ def paged_window_attention(
     attends pool keys at positions <= pos + j (the window's own keys must
     already be scattered into the pool).  Returns [B, nq, Hq, d].
 
-    Pool layout is head-MAJOR (``[n_blocks, Hkv, bs, d]``): the TPU
-    lowering requires a block's last two dims to tile (8, 128), so the
-    per-grid-step slice must be ``[bs, d]``-shaped — the head axis cannot
-    sit between them.
+    Pool layout is head-major and TRANSPOSED (``[n_blocks, Hkv, d, bs]``
+    — features on sublanes, positions on lanes): each page's DMA moves
+    one contiguous ``[Hkv, d, bs]`` stripe whose minormost dim is the
+    block size, so with ``bs % 128 == 0`` the copy is an exact lane-tile
+    multiple (Mosaic rejects manual DMAs with a lane-PADDED minormost
+    dim, which head_dim 64 would be), every KV head rides one fetch, and
+    K lands in VMEM already in the K^T form the q·kᵀ MXU dot wants.
+    ``pages_per_step`` pool blocks are fetched per grid step through the
+    kernel's own double-buffered DMA pipeline (module docstring); the
+    default targets ~1024 keys per step.
     """
     b, nq, hq, d = q.shape
-    n_pool, hkv, block_size, _ = k_pool.shape
+    n_pool, hkv, _d, block_size = k_pool.shape
     if hq % hkv:
         raise ValueError(f"query heads {hq} must be a multiple of kv heads {hkv}")
+    if not interpret and jax.default_backend() == "tpu" and block_size % 128:
+        raise ValueError(
+            f"the TPU DMA path needs block_size % 128 == 0, got {block_size} "
+            "(smaller blocks: use the XLA gather path)"
+        )
     groups = hq // hkv
     max_blocks = block_table.shape[1]
+    pages = pages_per_step or default_pages_per_step(
+        block_size, max_blocks, hkv, d, jnp.dtype(k_pool.dtype).itemsize
+    )
+    pages = min(pages, max_blocks)
+    num_super = -(-max_blocks // pages)
     # row layout [Hkv, G*nq, d] with the window index FASTEST (the kernel's
     # `iota % nq` mask contract)
     qg = q.reshape(b, nq, hkv, groups, d).transpose(0, 2, 3, 1, 4).reshape(
@@ -147,54 +312,192 @@ def paged_window_attention(
     )
     lengths = pos + nq  # keys attended by the last window query
 
-    def k_index(bi, i, table, lens):
-        # Past-frontier steps REPEAT the last used block id: identical
-        # consecutive indices make the pipeline skip the DMA, so HBM reads
-        # track the ragged lengths, not max_blocks.
-        last = jnp.maximum((lens[bi] - 1) // block_size, 0)
-        return (table[bi, jnp.minimum(i, last)], 0, 0, 0)
-
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, max_blocks),
+        num_scalar_prefetch=4,
+        grid=(b, num_super),
         in_specs=[
             pl.BlockSpec(
-                (1, hkv, groups * nq, d), lambda bi, i, t, ln: (bi, 0, 0, 0)
+                (1, hkv, groups * nq, d), lambda bi, i, *_: (bi, 0, 0, 0)
             ),
-            pl.BlockSpec((1, hkv, block_size, d), k_index),
-            pl.BlockSpec((1, hkv, block_size, d), k_index),
+            pl.BlockSpec(memory_space=pl.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # v pool stays in HBM
         ],
         out_specs=pl.BlockSpec(
-            (1, hkv, groups * nq, d), lambda bi, i, t, ln: (bi, 0, 0, 0)
+            (1, hkv, groups * nq, d), lambda bi, i, *_: (bi, 0, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((hkv * groups * nq, 128), jnp.float32),  # m
             pltpu.VMEM((hkv * groups * nq, 128), jnp.float32),  # l
             pltpu.VMEM((hkv * groups * nq, d), jnp.float32),    # acc
+            pltpu.VMEM((2, hkv, d, pages * block_size), k_pool.dtype),
+            pltpu.VMEM((2, hkv, d, pages * block_size), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel,
             block_size=block_size,
-            num_blocks=max_blocks,
+            pages=pages,
+            num_super=num_super,
+            batch=b,
+            max_blocks=max_blocks,
             scale=1.0 / (d ** 0.5),
             nq=nq,
+            append=False,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, groups * nq, d), q.dtype),
-        # batch rows are independent walks (scratch re-inits at i == 0), so
-        # the row axis may reorder/pipeline; the block walk is sequential.
+        # the cross-row prefetch chain (last superblock of row r fetches
+        # row r+1's first) makes BOTH axes order-dependent
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
+            dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
-    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool, v_pool)
+    )(
+        block_table.astype(jnp.int32).reshape(-1),
+        lengths.astype(jnp.int32),
+        jnp.zeros((1,), jnp.int32),  # buffer index
+        jnp.ones((1,), jnp.int32),   # first-alive-step flag
+        qg, k_pool, v_pool,
+    )
     return (
         out.reshape(b, hkv, groups, nq, d)
         .transpose(0, 3, 1, 2, 4)
         .reshape(b, nq, hq, d)
     )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pages_per_step", "interpret")
+)
+def paged_append_attention(
+    q: jax.Array,            # [B, nq, Hq, d] — a CAUSAL query window
+    new_k: jax.Array,        # [B, nq, Hkv, d] — k/v for positions
+    new_v: jax.Array,        #                   pos .. pos+nq-1
+    k_pools: jax.Array,      # [L, n_blocks, Hkv, d, bs] — STACKED pools
+    v_pools: jax.Array,
+    block_table: jax.Array,  # [B, max_blocks] i32 pool-block ids
+    pos: jax.Array,          # [B] i32 — window query j sits at pos + j
+    layer,                   # scalar i32 — which stacked layer to use
+    write_mask: jax.Array | None = None,  # [B] bool; False = don't write
+    *,
+    pages_per_step: int | None = None,
+    interpret: bool = False,
+):
+    """FUSED append+attend over the stacked per-layer pools: blend each
+    row's ``nq`` new k/v vectors into its frontier page(s) inside the
+    kernel (write-back DMA rides under the attention dots) and attend the
+    result — :func:`paged_window_attention` semantics with the cache write
+    included.  Returns ``(out [B, nq, Hq, d], k_pools, v_pools)`` where
+    the pools are the SAME buffers threaded through (``input_output_
+    aliases``), so a serving loop carries them with zero copies: the XLA
+    scatter this replaces forced a full pool copy around every custom
+    call (the round-3 uniform-batch tax).  Rows with ``write_mask`` False
+    attend but never write (engine-inactive rows hold stale tables)."""
+    b, nq, hq, d = q.shape
+    n_layers, n_pool, hkv, _d, block_size = k_pools.shape
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} must be a multiple of kv heads {hkv}")
+    if nq > block_size:
+        raise ValueError(
+            f"append window {nq} exceeds block_size {block_size} "
+            "(new positions must span at most two blocks)"
+        )
+    if not interpret and jax.default_backend() == "tpu" and block_size % 128:
+        raise ValueError(
+            f"the TPU DMA path needs block_size % 128 == 0, got {block_size} "
+            "(smaller blocks: use the XLA gather path)"
+        )
+    groups = hq // hkv
+    max_blocks = block_table.shape[1]
+    pages = pages_per_step or default_pages_per_step(
+        block_size, max_blocks, hkv, d, jnp.dtype(k_pools.dtype).itemsize
+    )
+    pages = min(pages, max_blocks)
+    num_super = -(-max_blocks // pages)
+    qg = q.reshape(b, nq, hkv, groups, d).transpose(0, 2, 3, 1, 4).reshape(
+        b, hkv, groups * nq, d
+    )
+    # kernel-facing layout [B, Hkv, d, nq] in POOL dtype (the blend selects
+    # between buffer lanes and these vectors)
+    nk = new_k.transpose(0, 2, 3, 1).astype(k_pools.dtype)
+    nv = new_v.transpose(0, 2, 3, 1).astype(v_pools.dtype)
+    lengths = pos + nq
+    if write_mask is None:
+        write_mask = jnp.ones((b,), jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(b, num_super),
+        in_specs=[
+            pl.BlockSpec(
+                (1, hkv, groups * nq, d), lambda bi, i, *_: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, hkv, d, nq), lambda bi, i, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, d, nq), lambda bi, i, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # k pools stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # v pools stay in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, hkv, groups * nq, d), lambda bi, i, *_: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((hkv * groups * nq, 128), jnp.float32),  # m
+            pltpu.VMEM((hkv * groups * nq, 128), jnp.float32),  # l
+            pltpu.VMEM((hkv * groups * nq, d), jnp.float32),    # acc
+            pltpu.VMEM((2, hkv, d, pages * block_size), k_pools.dtype),
+            pltpu.VMEM((2, hkv, d, pages * block_size), v_pools.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,  # write-back flush
+        ],
+    )
+    out, k_out, v_out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel,
+            block_size=block_size,
+            pages=pages,
+            num_super=num_super,
+            batch=b,
+            max_blocks=max_blocks,
+            scale=1.0 / (d ** 0.5),
+            nq=nq,
+            append=True,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, groups * nq, d), q.dtype),
+            jax.ShapeDtypeStruct(k_pools.shape, k_pools.dtype),
+            jax.ShapeDtypeStruct(v_pools.shape, v_pools.dtype),
+        ],
+        # inputs are (table, lens, wmask, layer, buf, init, qg, nk, nv,
+        # k_pools, v_pools): thread the pools through in place
+        input_output_aliases={9: 1, 10: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32).reshape(-1),
+        lengths.astype(jnp.int32),
+        jnp.asarray(write_mask, jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),  # buffer index
+        jnp.ones((1,), jnp.int32),   # first-alive-step flag
+        qg, nk, nv, k_pools, v_pools,
+    )
+    out = (
+        out.reshape(b, hkv, groups, nq, d)
+        .transpose(0, 3, 1, 2, 4)
+        .reshape(b, nq, hq, d)
+    )
+    return out, k_out, v_out
 
 
 def paged_decode_attention(
@@ -204,13 +507,15 @@ def paged_decode_attention(
     block_table: jax.Array,
     lengths: jax.Array,      # [B] i32 — keys attended per slot (>= 1)
     *,
+    pages_per_step: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Single-query view of :func:`paged_window_attention` (nq = 1;
     ``lengths = pos + 1``).  Returns [B, Hq, d] in q's dtype."""
     out = paged_window_attention(
         q[:, None], k_pool, v_pool, block_table,
-        jnp.asarray(lengths, jnp.int32) - 1, interpret=interpret,
+        jnp.asarray(lengths, jnp.int32) - 1,
+        pages_per_step=pages_per_step, interpret=interpret,
     )
     return out[:, 0]
 
@@ -221,9 +526,10 @@ def paged_window_attention_xla(q, k_pool, v_pool, block_table, pos):
     from k8s_dra_driver_tpu.models.decode import _masked_attention
 
     b, nq = q.shape[0], q.shape[1]
-    n_pool, hkv, block_size, d = k_pool.shape
-    k = k_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
-    v = v_pool[block_table].transpose(0, 1, 3, 2, 4).reshape(b, -1, hkv, d)
+    n_pool, hkv, d, block_size = k_pool.shape
+    # [B, mb, Hkv, d, bs] -> sequence-major [B, mb*bs, Hkv, d]
+    k = k_pool[block_table].transpose(0, 1, 4, 2, 3).reshape(b, -1, hkv, d)
+    v = v_pool[block_table].transpose(0, 1, 4, 2, 3).reshape(b, -1, hkv, d)
     k_pos = jnp.arange(k.shape[1])
     # [B, 1, nq, K]: window query j attends key positions <= pos + j
     qpos = pos[:, None] + jnp.arange(nq)[None, :]

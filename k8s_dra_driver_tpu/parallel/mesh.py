@@ -75,6 +75,46 @@ def mesh_for(devices, want_seq: bool = False) -> Mesh:
     return build_mesh(devices, auto_mesh_shape(len(devices), want_seq=want_seq))
 
 
+MULTISLICE_AXES = ("slice",) + AXES
+
+
+def multislice_env_shape(env: dict[str, str] | None = None) -> tuple[int, int]:
+    """(num_slices, slice_id) from the driver-injected megascale env
+    (plugin/device_state.py group-seat wiring); (1, 0) when single-slice."""
+    env = os.environ if env is None else env
+    return (
+        int(env.get("MEGASCALE_NUM_SLICES", "1")),
+        int(env.get("MEGASCALE_SLICE_ID", "0")),
+    )
+
+
+def build_multislice_mesh(devices, n_slices: int, shape: MeshShape) -> Mesh:
+    """DCN-aware mesh over ``n_slices`` slices: axes ``('slice', 'pipe',
+    'data', 'seq', 'model')`` with the slice axis OUTERMOST, so the only
+    collectives that cross the slow cross-slice (DCN) links are the ones
+    that can afford to — per-step gradient all-reduce over
+    ``('slice', 'data')`` hybrid data parallelism, or one pipeline
+    hand-off per tick — while seq/model per-token collectives stay on
+    each slice's ICI (the scaling-book recipe: bandwidth-hungry axes
+    innermost).
+
+    ``devices`` must be ordered slice-major (each slice's devices
+    contiguous — ``jax.devices()`` is, under multislice).  ``shape``
+    describes the PER-SLICE mesh."""
+    if len(devices) % n_slices:
+        raise ValueError(f"{len(devices)} devices do not split into {n_slices} slices")
+    per = len(devices) // n_slices
+    if shape.total != per:
+        raise ValueError(
+            f"per-slice shape {shape} needs {shape.total} devices, "
+            f"got {per} per slice"
+        )
+    arr = np.array(devices).reshape(
+        n_slices, shape.pipe, shape.data, shape.seq, shape.model
+    )
+    return Mesh(arr, MULTISLICE_AXES)
+
+
 def validate_claimed_mesh(mesh: Mesh, env: dict[str, str]) -> None:
     """Cross-check a mesh against the driver-injected bounds env."""
     bounds = env.get("TPU_CHIPS_PER_PROCESS_BOUNDS")

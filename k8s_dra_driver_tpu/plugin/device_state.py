@@ -24,6 +24,7 @@ from typing import Callable, Optional
 from k8s_dra_driver_tpu import DRIVER_NAME
 from k8s_dra_driver_tpu.api import (
     Decoder,
+    SliceGroupConfig,
     SliceMembershipConfig,
     SubsliceConfig,
     TpuConfig,
@@ -36,10 +37,12 @@ from k8s_dra_driver_tpu.plugin.cdi import CDIHandler, ContainerEdits
 from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointFile
 from k8s_dra_driver_tpu.plugin.deviceinfo import (
     DEVICE_TYPE_CHIP,
+    DEVICE_TYPE_GROUP_SEAT,
     DEVICE_TYPE_MEMBERSHIP,
     DEVICE_TYPE_SUBSLICE,
     AllocatableDevice,
     AllocatableDevices,
+    SliceGroupSeatInfo,
     SliceMembershipInfo,
 )
 from k8s_dra_driver_tpu.plugin.prepared import (
@@ -287,6 +290,27 @@ class DeviceState:
                     )
             if new_topology == self.topology and new_layout == self._layout:
                 return False
+            # The visible-chips mask was validated against the STARTUP chip
+            # count; a hot-reloaded topology with fewer chips would make
+            # from_topology silently drop the now-out-of-range positions —
+            # the quiet mis-publication the strict parse exists to prevent.
+            # Keep the previous (still-consistent) inventory and tell the
+            # operator: the mask label and the hardware must be reconciled.
+            if self._visible is not None:
+                bad = sorted(
+                    p for p in self._visible if p >= len(new_topology.chips)
+                )
+                if bad:
+                    import logging
+
+                    logging.getLogger(__name__).error(
+                        "visible-chips positions %s out of range for reloaded "
+                        "topology (%d chips); keeping previous inventory until "
+                        "the mask is fixed",
+                        bad,
+                        len(new_topology.chips),
+                    )
+                    return False
             self.topology = new_topology
             self._layout = new_layout
             self.allocatable = AllocatableDevices.from_topology(
@@ -427,6 +451,18 @@ class DeviceState:
                             coordinator_address=attrs["coordinatorAddress"].value,
                         )
                     )
+                if attrs.get("type") and attrs["type"].value == DEVICE_TYPE_GROUP_SEAT:
+                    return AllocatableDevice(
+                        group_seat=SliceGroupSeatInfo(
+                            group=attrs["sliceGroup"].value,
+                            domain=attrs["sliceDomain"].value,
+                            slice_id=attrs["sliceId"].value,
+                            num_slices=attrs["numSlices"].value,
+                            worker_id=attrs["workerId"].value,
+                            host_count=attrs["hostCount"].value,
+                            coordinator_address=attrs["coordinatorAddress"].value,
+                        )
+                    )
         return None
 
     def _check_health(self, device: AllocatableDevice) -> None:
@@ -449,6 +485,10 @@ class DeviceState:
             return default_tpu_config()
         if kind == DEVICE_TYPE_SUBSLICE:
             return default_subslice_config()
+        if kind == DEVICE_TYPE_GROUP_SEAT:
+            cfg = SliceGroupConfig()
+            cfg.normalize()
+            return cfg
         cfg = SliceMembershipConfig()
         cfg.normalize()
         return cfg
@@ -463,6 +503,10 @@ class DeviceState:
                 isinstance(cfg, SliceMembershipConfig)
                 and device.kind == DEVICE_TYPE_MEMBERSHIP
             )
+            or (
+                isinstance(cfg, SliceGroupConfig)
+                and device.kind == DEVICE_TYPE_GROUP_SEAT
+            )
         )
         if not ok:
             raise PrepareError(
@@ -476,8 +520,26 @@ class DeviceState:
         if isinstance(cfg, SliceMembershipConfig):
             env = {"JAX_COORDINATOR_PORT": str(cfg.coordinator_port), **cfg.extra_env}
             if cfg.megascale:
+                # single-slice default: let libtpu self-discover.  A claim
+                # that ALSO binds a slice-GROUP seat gets the explicit
+                # cross-slice coordinator from that seat instead.
                 env["MEGASCALE_COORDINATOR_ADDRESS"] = "auto"
             return ContainerEdits(env=env), DeviceConfigState(strategy="Membership", env={})
+        if isinstance(cfg, SliceGroupConfig):
+            # Cross-slice (DCN) megascale wiring: the group seat's
+            # coordinator host + the config's DCN transport port.  The
+            # identity env (NUM_SLICES / SLICE_ID) is seat-derived and
+            # injected by _wiring_env; this layer carries the tunables.
+            env = {"MEGASCALE_PORT": str(cfg.megascale_port), **cfg.extra_env}
+            seat = next(
+                (d.group_seat for d in devices if d.group_seat is not None), None
+            )
+            if seat is not None and seat.coordinator_address:
+                host = seat.coordinator_address.rsplit(":", 1)[0]
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                    f"{host}:{cfg.megascale_port}"
+                )
+            return ContainerEdits(env=env), DeviceConfigState(strategy="SliceGroup", env={})
 
         sharing = cfg.sharing
         strategy = sharing.strategy
@@ -534,6 +596,19 @@ class DeviceState:
             env["TPU_HOST_COUNT"] = str(m.host_count)
             if m.coordinator_address:
                 env["JAX_COORDINATOR_ADDRESS"] = m.coordinator_address
+        group_seats = [d for d in devices if d.group_seat is not None]
+        if len(group_seats) > 1:
+            raise PrepareError(
+                "a claim may bind at most one slice-group seat per config "
+                f"group, got {[d.name for d in group_seats]}"
+            )
+        for d in group_seats:
+            g = d.group_seat
+            # The multislice identity: which slice of how many this pod's
+            # host belongs to (MEGASCALE_COORDINATOR_ADDRESS/PORT come from
+            # the SliceGroupConfig layer, _apply_config).
+            env["MEGASCALE_NUM_SLICES"] = str(g.num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(g.slice_id)
         return env
 
     def _prepared_device(
@@ -545,15 +620,15 @@ class DeviceState:
         elif device.subslice is not None:
             topo = device.subslice.topology
             paths = [topo.chips[i].device_path for i in device.subslice.subslice.chip_indices]
-        # Membership seats exist only in the per-claim transient spec (the
-        # base spec covers local hardware); emitting a base-qualified id for
-        # them would hand kubelet a CDI name no spec defines.
+        # Membership/group seats exist only in the per-claim transient spec
+        # (the base spec covers local hardware); emitting a base-qualified
+        # id for them would hand kubelet a CDI name no spec defines.
         cdi_ids = [
             self.cdi.qualified_name(
                 self.cdi.claim_device_name(claim.metadata.uid, device.name)
             )
         ]
-        if device.membership is None:
+        if device.membership is None and device.group_seat is None:
             cdi_ids.insert(0, self.cdi.qualified_name(device.name))
         return PreparedDevice(
             kind=device.kind,

@@ -23,6 +23,7 @@ from k8s_dra_driver_tpu.tpuinfo.binding import ChipInfo, TopologyInfo
 DEVICE_TYPE_CHIP = "tpu"
 DEVICE_TYPE_SUBSLICE = "subslice"
 DEVICE_TYPE_MEMBERSHIP = "membership"
+DEVICE_TYPE_GROUP_SEAT = "slicegroup"
 
 _PRODUCT_NAMES = {
     "v4": "tpu-v4",
@@ -180,6 +181,56 @@ class SliceMembershipInfo:
         return Device(name=self.name, basic=BasicDevice(attributes=attrs))
 
 
+@dataclass
+class SliceGroupSeatInfo:
+    """One multi-SLICE group seat — the next scale up from
+    :class:`SliceMembershipInfo` (GKE multislice over DCN, where the
+    reference's IMEX domain pattern tops out at one NVLink domain,
+    cmd/nvidia-dra-controller/imex.go:371-416).
+
+    Published by the cluster controller per slice GROUP: a group joins
+    several slice domains into one job, and each member domain gets one
+    seat PER HOST (allocation granularity — every pod binds its own),
+    all carrying the domain's ordinal (``slice_id``), the group fan-out
+    (``num_slices``), and the cross-slice (DCN) coordinator — the
+    MEGASCALE wiring a multislice JAX process needs.  A pod claims its
+    slice's membership seat (intra-slice ICI wiring) AND a group seat of
+    its slice (cross-slice DCN wiring); the two compose.  The pool is
+    per-(group, domain) and node-selected on BOTH labels, so allocation
+    can only hand a pod its own slice's identity.
+    """
+
+    group: str
+    domain: str
+    slice_id: int
+    num_slices: int
+    worker_id: int = 0
+    host_count: int = 0
+    coordinator_address: str = ""
+
+    @property
+    def name(self) -> str:
+        return f"groupseat-{self.slice_id}-{self.worker_id}"
+
+    @property
+    def uuid(self) -> str:
+        return f"{self.group}/slice-{self.slice_id}/worker-{self.worker_id}"
+
+    def get_device(self) -> Device:
+        attrs = {
+            "type": DeviceAttribute.of(DEVICE_TYPE_GROUP_SEAT),
+            "uuid": DeviceAttribute.of(self.uuid),
+            "sliceGroup": DeviceAttribute.of(self.group),
+            "sliceDomain": DeviceAttribute.of(self.domain),
+            "sliceId": DeviceAttribute.of(self.slice_id),
+            "numSlices": DeviceAttribute.of(self.num_slices),
+            "workerId": DeviceAttribute.of(self.worker_id),
+            "hostCount": DeviceAttribute.of(self.host_count),
+            "coordinatorAddress": DeviceAttribute.of(self.coordinator_address),
+        }
+        return Device(name=self.name, basic=BasicDevice(attributes=attrs))
+
+
 def _semverish(version: str) -> str:
     """Coerce free-form driver versions into the semver the `version`
     attribute type requires (deviceinfo.go stamps driverVersion similarly)."""
@@ -197,6 +248,7 @@ class AllocatableDevice:
     chip: TpuChipInfo | None = None
     subslice: TpuSubsliceInfo | None = None
     membership: SliceMembershipInfo | None = None
+    group_seat: SliceGroupSeatInfo | None = None
 
     @property
     def kind(self) -> str:
@@ -206,11 +258,13 @@ class AllocatableDevice:
             return DEVICE_TYPE_SUBSLICE
         if self.membership is not None:
             return DEVICE_TYPE_MEMBERSHIP
+        if self.group_seat is not None:
+            return DEVICE_TYPE_GROUP_SEAT
         raise ValueError("empty AllocatableDevice")
 
     @property
     def impl(self):
-        return self.chip or self.subslice or self.membership
+        return self.chip or self.subslice or self.membership or self.group_seat
 
     @property
     def name(self) -> str:

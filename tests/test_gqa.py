@@ -128,11 +128,27 @@ class TestTraining:
             for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(params))
         )
 
-    def test_pipeline_tp_rejects_gqa_loudly(self, params):
+    def test_pipeline_repack_carries_whole_kv_groups(self, params):
+        """Round 3 rejected GQA here; the group-major repack now shards
+        whole (q-group + kv head) columns — round-trip exactness is the
+        cheap invariant, full pipeline parity lives in
+        tests/test_pipeline.py::TestPPGqaRope."""
         from k8s_dra_driver_tpu.models import pp_burnin
 
-        with pytest.raises(NotImplementedError, match="MHA only"):
-            pp_burnin.pp_params_from_dense(params, GQA)
+        pp = pp_burnin.pp_params_from_dense(params, GQA)
+        h, hkv, hd = GQA.n_heads, GQA.kv_heads, GQA.head_dim
+        d = GQA.d_model
+        w = params["blocks"][0]["qkv"]
+        got = pp["blocks"]["qkv"][0]
+        # invert the group-major layout and recover the dense packing
+        g = h // hkv
+        grouped = got.reshape(d, hkv, (g + 2) * hd)
+        wq = grouped[..., : g * hd].reshape(d, h * hd)
+        wk = grouped[..., g * hd : (g + 1) * hd].reshape(d, hkv * hd)
+        wv = grouped[..., (g + 1) * hd :].reshape(d, hkv * hd)
+        np.testing.assert_array_equal(
+            np.asarray(jnp.concatenate([wq, wk, wv], axis=1)), np.asarray(w)
+        )
 
     def test_full_head_mask_splits_into_groups(self):
         """ALiBi-style per-query-head masks work on the grouped path."""

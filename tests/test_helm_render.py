@@ -53,13 +53,14 @@ class TestDefaultRender:
         ):
             assert kind in kinds, f"missing {kind}"
 
-    def test_three_deviceclasses_with_driver_cel(self, default_docs):
+    def test_four_deviceclasses_with_driver_cel(self, default_docs):
         classes = _by_kind(default_docs)["DeviceClass"]
         names = {c["metadata"]["name"] for c in classes}
         assert names == {
             "tpu.google.com",
             "subslice.tpu.google.com",
             "membership.tpu.google.com",
+            "slicegroup.tpu.google.com",
         }
         for c in classes:
             exprs = [s["cel"]["expression"] for s in c["spec"]["selectors"]]
@@ -122,10 +123,25 @@ class TestDefaultRender:
             e["name"]: e.get("value")
             for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
         }
-        assert env["DEVICE_CLASSES"] == "tpu,subslice,membership"
+        assert env["DEVICE_CLASSES"] == "tpu,subslice,membership,slicegroup"
 
 
 class TestVariants:
+    def test_openshift_rolebinding_off_by_default(self, default_docs):
+        kinds = _by_kind(default_docs)
+        assert "RoleBinding" not in kinds  # explicit opt-in, never implicit
+
+    def test_openshift_rolebinding_binds_privileged_scc(self):
+        docs = render_chart_docs(
+            CHART, values_override={"openshift": {"enabled": True}}
+        )
+        rb = _by_kind(docs)["RoleBinding"][0]
+        assert rb["metadata"]["name"].endswith("-openshift-privileged")
+        assert rb["roleRef"]["name"] == "system:openshift:scc:privileged"
+        subject = rb["subjects"][0]
+        assert subject["kind"] == "ServiceAccount"
+        assert subject["namespace"] == rb["metadata"]["namespace"]
+
     def test_extender_disabled_by_default(self, default_docs):
         kinds = _by_kind(default_docs)
         assert "Service" not in kinds

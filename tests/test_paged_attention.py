@@ -40,18 +40,18 @@ class _Case:
             table = table_perm(table)
         self.table = jnp.asarray(table)
         n_pool = 1 + b * max_blocks
-        k_pool = np.zeros((n_pool, hkv, bs, d), np.float32)
-        v_pool = np.zeros((n_pool, hkv, bs, d), np.float32)
+        k_pool = np.zeros((n_pool, hkv, d, bs), np.float32)
+        v_pool = np.zeros((n_pool, hkv, d, bs), np.float32)
         for r in range(b):
             for i in range(max_blocks):
                 blk = int(table[r, i])
-                # [bs, hkv, d] -> head-major [hkv, bs, d]
+                # [bs, hkv, d] -> head-major transposed [hkv, d, bs]
                 k_pool[blk] = np.asarray(
                     self.k_seq[r, i * bs : (i + 1) * bs], np.float32
-                ).transpose(1, 0, 2)
+                ).transpose(1, 2, 0)
                 v_pool[blk] = np.asarray(
                     self.v_seq[r, i * bs : (i + 1) * bs], np.float32
-                ).transpose(1, 0, 2)
+                ).transpose(1, 2, 0)
         self.k_pool = jnp.asarray(k_pool).astype(dtype)
         self.v_pool = jnp.asarray(v_pool).astype(dtype)
 

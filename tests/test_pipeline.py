@@ -129,6 +129,57 @@ class TestPPBurnin:
         with pytest.raises(ValueError, match="data/model"):
             pp_burnin.build_pp_train_step(cfg, seq_mesh)
 
+class TestPPGqaRope:
+    """Pipeline TP over the modern serving geometry: GQA (whole KV groups
+    per TP shard — _groupmajor_qkv) + RoPE rotated inside the stage scan.
+    Round 3 rejected both with NotImplementedError; the flagship config
+    (burnin.FLAGSHIP_MODERN's shape) must train in every tp_mode."""
+
+    CFG = burnin.ModelConfig(n_kv_heads=2, rope=True)  # TINY + gqa + rope
+
+    @pytest.mark.parametrize("tp_mode", ["megatron", "megatron-sp"])
+    def test_gqa_rope_loss_matches_dense(self, tp_mode):
+        cfg = self.CFG
+        mesh = build_mesh(cpu_devices(8), MeshShape(pipe=2, data=2, model=2))
+        tokens = host(burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=8, seq=32))
+        dense = jax.tree.map(host, burnin.init_params(jax.random.PRNGKey(0), cfg))
+        with cpu_scope():
+            ref = float(jax.jit(lambda p, t: burnin.loss_fn(p, t, cfg))(dense, tokens))
+
+        fns = pp_burnin.build_pp_train_step(cfg, mesh, tp_mode=tp_mode)
+        with mesh:
+            params = pp_burnin.pp_params_from_dense(
+                jax.tree.map(jnp.asarray, dense), cfg
+            )
+            assert "pos_embed" not in params  # RoPE carries no table
+            opt_state = burnin.make_optimizer().init(params)
+            sharded_tokens = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+            _, _, loss = fns.step(params, opt_state, sharded_tokens)
+        assert abs(float(loss) - ref) < 0.05
+
+    def test_gqa_rope_training_reduces_loss(self):
+        cfg = self.CFG
+        mesh = build_mesh(cpu_devices(8), MeshShape(pipe=2, data=2, model=2))
+        fns = pp_burnin.build_pp_train_step(cfg, mesh, lr=1e-2, tp_mode="megatron-sp")
+        with mesh:
+            params, opt_state = fns.init(jax.random.PRNGKey(0))
+            tokens = jax.device_put(
+                burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=8, seq=32),
+                NamedSharding(mesh, P("data", None)),
+            )
+            first = None
+            for _ in range(4):
+                params, opt_state, loss = fns.step(params, opt_state, tokens)
+                first = first if first is not None else float(loss)
+        assert float(loss) < first
+
+    def test_kv_head_divisibility_validated(self):
+        cfg = burnin.ModelConfig(n_kv_heads=1, rope=True)  # 1 kv head, tp=2
+        mesh = build_mesh(cpu_devices(8), MeshShape(pipe=2, data=2, model=2))
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            pp_burnin.build_pp_train_step(cfg, mesh)
+
+
 class TestMegatronSP:
     def test_sp_mode_matches_dense_loss(self):
         """megatron-sp (seq-sharded residual + overlapped collective-matmul

@@ -143,9 +143,13 @@ class TestTraining:
         _, _, loss = fns.step(p, opt, tokens)
         assert np.isfinite(float(loss))
 
-    def test_pipeline_tp_rejects_rope_loudly(self):
+    def test_pipeline_params_carry_no_position_table(self):
+        """Round 3 rejected RoPE here; rotation now happens inside the
+        stage scan (pp_burnin._tp_attention_core) and the converted tree
+        must carry no dead pos_embed — full pipeline parity lives in
+        tests/test_pipeline.py::TestPPGqaRope."""
         from k8s_dra_driver_tpu.models import pp_burnin
 
         params = burnin.init_params(jax.random.PRNGKey(0), ROPE)
-        with pytest.raises(NotImplementedError, match="learned positions"):
-            pp_burnin.pp_params_from_dense(params, ROPE)
+        pp = pp_burnin.pp_params_from_dense(params, ROPE)
+        assert "pos_embed" not in pp
