@@ -205,6 +205,27 @@ def main() -> None:
         names = sorted(d["device_name"] for d in pod.devices)
         print(f"  {pod.name:22s} -> {names}")
 
+    print("\n== multislice-test1: two slices, one group, megascale wiring ==")
+    from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
+
+    ms = make_cluster(
+        hosts=4, topology="v5e-16", slice_domain="v5e-16-demo",
+        slices=2, slice_group="demo-job",
+    )
+    manager = SliceManager(ms.server)
+    manager.start()
+    try:
+        for pod in apply_spec(ms, specs / "quickstart" / "multislice-test1.yaml"):
+            print(
+                f"  {pod.name:28s} node={pod.node} "
+                f"slice={pod.env.get('MEGASCALE_SLICE_ID')}/"
+                f"{pod.env.get('MEGASCALE_NUM_SLICES')} "
+                f"worker={pod.env.get('TPU_WORKER_ID')} "
+                f"dcn={pod.env.get('MEGASCALE_COORDINATOR_ADDRESS')}"
+            )
+    finally:
+        manager.stop()
+
 
 if __name__ == "__main__":
     main()

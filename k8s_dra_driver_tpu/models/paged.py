@@ -58,6 +58,10 @@ NULL_BLOCK = 0  # reserved: inactive rows scatter here; never allocated
 _M_POOL_FREE = REGISTRY.gauge(
     "tpu_serve_kv_pool_free_blocks", "free KV pool blocks right now"
 )
+_M_PREEMPTIONS = REGISTRY.counter(
+    "tpu_serve_preemptions_total",
+    "requests evicted under pool pressure for later recompute-resume",
+)
 
 
 class PagedKVCache(NamedTuple):
@@ -500,6 +504,16 @@ class PagedServeEngine:
     # admission (streams identical — tested).
     spec_gamma: int = 0
     draft_params: object = None
+    # Preemption (vLLM's recompute fallback): when the pool is exhausted
+    # and EVERY resident slot stalls, evict the YOUNGEST resumable request
+    # — free its blocks, park its tokens + sampler state, re-prefill it
+    # when the pool breathes — instead of deadlocking until a retirement
+    # that may never come.  Resumption is bit-exact: sampling keys fold by
+    # absolute position (serve.sample_next), so the re-admitted stream
+    # continues exactly where it stopped (tested).  A request grown past
+    # prompt_bucket can no longer re-prefill in one pass and becomes
+    # unpreemptable; if every resident is, the wedge error stands.
+    preempt_on_stall: bool = False
 
     def __post_init__(self):
         cfg = self.cfg
@@ -537,6 +551,8 @@ class PagedServeEngine:
         self._next_id = 0
         self._completions: list = []
         self.stalled_steps = 0  # slot-steps skipped waiting for a block
+        self._preempted: list[dict] = []  # FIFO of parked requests
+        self.preempted_count = 0
         kw = dict(
             cfg=cfg, top_k=self.top_k,
             attn_impl=self.attn_impl, interpret=self.interpret,
@@ -608,6 +624,16 @@ class PagedServeEngine:
             prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq,
             spec_gamma=self.spec_gamma, temperature=temperature,
         )
+        if self._preempted:
+            # Parked requests hold no reservation, so an eager caller
+            # re-filling every freed slot would starve them forever: give
+            # them strict priority — drain what fits now, and refuse new
+            # admissions while any remain parked.
+            self._readmit()
+            if self._preempted:
+                raise RuntimeError(
+                    "no free slot (preempted requests pending re-admission)"
+                )
         try:
             slot = self._slots.index(None)
         except ValueError:
@@ -828,6 +854,139 @@ class PagedServeEngine:
                 active[slot] = True
         return active, table_dirty
 
+    def _preempt_one(self) -> bool:
+        """Evict the YOUNGEST resumable resident request (highest request
+        id still short enough to re-prefill): free its blocks, park its
+        tokens and sampler state on the re-admission queue.  Returns
+        whether a victim was evicted."""
+        admitting = {a["slot"] for a in self._admitting}
+        victim, vslot = None, -1
+        for slot, st in enumerate(self._slots):
+            if st is None or slot in admitting:
+                continue
+            if len(st.tokens) + 1 > self.prompt_bucket:
+                continue  # grown past one-pass re-prefill: not resumable
+            if victim is None or st.request_id > victim.request_id:
+                victim, vslot = st, slot
+        if victim is None:
+            return False
+        temps = np.asarray(self._temps)
+        self._preempted.append(
+            dict(st=victim, temp=float(temps[vslot]), key=self._keys[vslot])
+        )
+        self._slots[vslot] = None
+        self._alloc.free(self._owned[vslot])
+        self._owned[vslot] = []
+        self._table_np[vslot, :] = NULL_BLOCK
+        # table upload deferred: the caller (_grow_or_preempt) batches the
+        # device transfer with the growth pass's own table_dirty
+        self.preempted_count += 1
+        _M_PREEMPTIONS.inc()
+        return True
+
+    def _readmit(self) -> None:
+        """Re-prefill parked requests (FIFO) while a slot AND their blocks
+        are free.  The parked token list (prompt + generated so far)
+        re-admits AS the prompt; the next step then generates the next
+        token at the same position with the same fold-by-position sampler
+        key — the stream continues bit-exactly.  The prefix store is
+        consulted like any admission (hits can only ever cover ORIGINAL
+        prompt blocks — generated positions are never stored — so a hot
+        shared prefix is not re-prefilled on every preempt cycle); fresh
+        blocks from a resume are not stored back (conservative: the walk
+        that decides storability ran at first admission)."""
+        from k8s_dra_driver_tpu.models import serve
+
+        while self._preempted:
+            r = self._preempted[0]
+            st = r["st"]
+            tokens = st.tokens
+            bs = self.block_size
+            try:
+                slot = self._slots.index(None)
+            except ValueError:
+                return
+            cached_ids: list[int] = []
+            if self.prefix_cache_blocks > 0:
+                storable = min((len(tokens) - 1) // bs, (self.prompt_bucket - 1) // bs)
+                for i in range(storable):
+                    key = tuple(tokens[: (i + 1) * bs])
+                    if key not in self._prefix_store:
+                        break
+                    self._prefix_store.move_to_end(key)
+                    cached_ids.append(self._alloc.share(self._prefix_store[key]))
+            cached = len(cached_ids)
+            need = blocks_needed(len(tokens) + 1, bs)
+            if self._alloc.free_blocks < need - cached:
+                self._alloc.free(cached_ids)  # drop the hit refs we took
+                return
+            ids = cached_ids + self._alloc.alloc(need - cached)
+            self._owned[slot] = ids
+            self._table_np[slot, :] = NULL_BLOCK
+            self._table_np[slot, :need] = ids
+            self._table = jnp.asarray(self._table_np)
+            padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+            padded = padded.at[0, : len(tokens)].set(jnp.asarray(tokens, jnp.int32))
+            prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+            try:
+                if cached:
+                    self._cache = paged_prefill_suffix(
+                        self.params, padded, self._cache, prefill_row,
+                        cfg=self.cfg, cached_blocks=cached,
+                    )
+                else:
+                    self._cache, _ = self._prefill_fn(
+                        self.params, padded, self._cache, prefill_row
+                    )
+                if self.spec_gamma > 0:
+                    self._d_cache = self._draft_prefill_fn(
+                        self.draft_params, self._d_cache, padded, len(tokens), slot
+                    )
+            except BaseException as exc:
+                # failed re-admission: release the reservation AND surface
+                # an errored Completion — the caller holds the request id,
+                # and a silently re-parked request is indistinguishable
+                # from one still streaming (same contract as the chunked-
+                # admission failure path)
+                self._alloc.free(ids)
+                self._owned[slot] = []
+                self._table_np[slot, :] = NULL_BLOCK
+                self._table = jnp.asarray(self._table_np)
+                self._preempted.pop(0)
+                self._completions.append(
+                    serve.Completion(
+                        request_id=st.request_id, tokens=list(st.tokens),
+                        generated=list(st.tokens[st.prompt_len :]),
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
+                )
+                raise
+            self._preempted.pop(0)
+            self._slots[slot] = st
+            self._last = self._last.at[slot].set(tokens[-1])
+            self._pos = self._pos.at[slot].set(len(tokens) - 1)
+            self._temps = self._temps.at[slot].set(r["temp"])
+            self._keys = self._keys.at[slot].set(r["key"])
+            self._update_gauges()
+
+    def _grow_or_preempt(self, lookahead: int):
+        """_grow_active_slots, escalating to preemption when the whole
+        resident set stalls with nothing admitting (preempt_on_stall).
+        Evictions mark the table dirty; the device upload batches with the
+        caller's."""
+        active, table_dirty = self._grow_active_slots(lookahead)
+        if self.preempt_on_stall and not active.any() and not self._admitting:
+            while any(s is not None for s in self._slots):
+                if not self._preempt_one():
+                    break
+                table_dirty = True  # victim rows were NULLed host-side
+                active, dirty2 = self._grow_active_slots(lookahead)
+                table_dirty = table_dirty or dirty2
+                if active.any():
+                    break
+            self._update_gauges()
+        return active, table_dirty
+
     def _spec_step(self) -> int:
         """One speculative ROUND over the paged pool: grow each active
         slot's blocks to cover the verify window (pos .. pos+gamma), stall
@@ -835,7 +994,7 @@ class PagedServeEngine:
         (the dense engine's _spec_step contract, plus pool accounting)."""
         from k8s_dra_driver_tpu.models import serve
 
-        active, table_dirty = self._grow_active_slots(lookahead=self.spec_gamma)
+        active, table_dirty = self._grow_or_preempt(lookahead=self.spec_gamma)
         if not active.any():
             return 0
         if table_dirty:
@@ -869,12 +1028,14 @@ class PagedServeEngine:
 
     def step(self) -> int:
         """Advance every active, non-stalled slot one token (and the
-        admission-queue head by one prefill chunk); returns the number of
-        slots stepped."""
+        admission-queue head by one prefill chunk, and re-admit preempted
+        requests the pool can now hold); returns the number of slots
+        stepped."""
+        self._readmit()
         self._advance_admission()
         if self.spec_gamma > 0:
             return self._spec_step()
-        active, table_dirty = self._grow_active_slots(lookahead=0)
+        active, table_dirty = self._grow_or_preempt(lookahead=0)
         if not active.any():
             return 0
         if table_dirty:
@@ -902,10 +1063,11 @@ class PagedServeEngine:
         for _ in range(max_steps):
             admitting = bool(self._admitting)  # a chunk advancing IS progress
             if self.step() == 0 and not admitting:
-                if self.free_slots() == self.n_slots:
+                if self.free_slots() == self.n_slots and not self._preempted:
                     return
-                # every resident slot stalled and nothing can retire to
-                # free a block: the pool is too small for this resident set
+                # every resident slot stalled, nothing preemptable, and
+                # nothing can retire to free a block: the pool is too
+                # small for this resident set
                 raise RuntimeError("engine wedged: resident slots, no progress")
         raise RuntimeError("serving loop did not drain")
 

@@ -170,6 +170,129 @@ class TestWindowAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
+class TestAppendAttention:
+    """The FUSED append+attend kernel: every edge the engine relies on,
+    checked directly against scatter-then-attend with the XLA oracle."""
+
+    def _setup(self, *, b=3, nq=1, hq=4, hkv=2, d=32, bs=8, mb=4, layers=2,
+               pos=None, seed=21):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+        n_pool = 1 + b * mb
+        k5 = jax.random.normal(ks[0], (layers, n_pool, hkv, d, bs), jnp.float32)
+        v5 = jax.random.normal(ks[1], (layers, n_pool, hkv, d, bs), jnp.float32)
+        table = jnp.asarray(
+            1 + np.arange(b * mb, dtype=np.int32).reshape(b, mb)
+        )
+        q = jax.random.normal(ks[2], (b, nq, hq, d), jnp.float32)
+        new_k = jax.random.normal(ks[3], (b, nq, hkv, d), jnp.float32)
+        new_v = jax.random.normal(ks[4], (b, nq, hkv, d), jnp.float32)
+        if pos is None:
+            pos = jnp.asarray([0, bs - 1, bs * mb - nq][:b], jnp.int32)
+        return k5, v5, table, q, new_k, new_v, jnp.asarray(pos, jnp.int32)
+
+    def _oracle(self, k5, v5, table, q, new_k, new_v, pos, li, wmask=None):
+        """Scatter the window into layer ``li`` with plain indexing, then
+        run the gather-based reference attention."""
+        b, nq = q.shape[:2]
+        bs = k5.shape[4]
+        rows = jnp.arange(b)
+        positions = pos[:, None] + jnp.arange(nq)[None, :]
+        ids = table[rows[:, None], positions // bs]
+        offs = positions % bs
+        if wmask is not None:
+            ids = jnp.where(wmask[:, None], ids, 0)
+        kk = k5.at[li, ids, :, :, offs].set(new_k)
+        vv = v5.at[li, ids, :, :, offs].set(new_v)
+        out = paged_attention.paged_window_attention_xla(
+            q, kk[li], vv[li], table, pos
+        )
+        return out, kk, vv
+
+    @pytest.mark.parametrize("nq,pos", [
+        (1, [0, 7, 31]),       # fresh block start / block end / table end
+        (5, [0, 6, 27]),       # windows crossing block boundaries
+    ])
+    def test_matches_scatter_then_attend(self, nq, pos):
+        k5, v5, table, q, nk, nv, pos = self._setup(nq=nq, pos=pos)
+        out, ko, vo = paged_attention.paged_append_attention(
+            q, nk, nv, k5, v5, table, pos, 1, interpret=True
+        )
+        want, kw, vw = self._oracle(k5, v5, table, q, nk, nv, pos, 1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(ko), np.asarray(kw), atol=0)
+        np.testing.assert_allclose(np.asarray(vo), np.asarray(vw), atol=0)
+
+    def test_window_crossing_superblock_boundary(self):
+        """pages_per_step=1 forces one block per grid step, so a window
+        spanning two blocks is blended and flushed by TWO different steps."""
+        k5, v5, table, q, nk, nv, pos = self._setup(nq=4, pos=[6, 14, 22])
+        out, ko, vo = paged_attention.paged_append_attention(
+            q, nk, nv, k5, v5, table, pos, 0, pages_per_step=1, interpret=True
+        )
+        want, kw, vw = self._oracle(k5, v5, table, q, nk, nv, pos, 0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(ko), np.asarray(kw), atol=0)
+
+    def test_write_mask_gates_pool_writes_only(self):
+        """Masked rows attend (output still defined) but write NOTHING —
+        the engine's stale-table safety for inactive slots."""
+        k5, v5, table, q, nk, nv, pos = self._setup()
+        wmask = jnp.asarray([True, False, True])
+        out, ko, vo = paged_attention.paged_append_attention(
+            q, nk, nv, k5, v5, table, pos, 0, write_mask=wmask, interpret=True
+        )
+        # row 1's blocks are bit-identical to the input pool
+        row1_blocks = np.asarray(table[1])
+        np.testing.assert_array_equal(
+            np.asarray(ko[0, row1_blocks]), np.asarray(k5[0, row1_blocks])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(vo[0, row1_blocks]), np.asarray(v5[0, row1_blocks])
+        )
+        # unmasked rows' writes landed
+        _, kw, _ = self._oracle(k5, v5, table, q, nk, nv, pos, 0, wmask=wmask)
+        row0_blocks = np.asarray(table[0])
+        np.testing.assert_array_equal(
+            np.asarray(ko[0, row0_blocks]), np.asarray(kw[0, row0_blocks])
+        )
+
+    def test_only_target_layer_written(self):
+        k5, v5, table, q, nk, nv, pos = self._setup(layers=3)
+        _, ko, vo = paged_attention.paged_append_attention(
+            q, nk, nv, k5, v5, table, pos, 2, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(ko[0]), np.asarray(k5[0]))
+        np.testing.assert_array_equal(np.asarray(ko[1]), np.asarray(k5[1]))
+        assert np.any(np.asarray(ko[2]) != np.asarray(k5[2]))
+
+    def test_untouched_blocks_preserved(self):
+        """Blocks before the frontier (incl. potentially SHARED prefix
+        blocks) are never flushed — only the page(s) holding the appended
+        positions change."""
+        k5, v5, table, q, nk, nv, pos = self._setup(
+            nq=1, pos=[17, 17, 17], mb=4, bs=8
+        )
+        _, ko, _ = paged_attention.paged_append_attention(
+            q, nk, nv, k5, v5, table, pos, 0, interpret=True
+        )
+        frontier = {int(table[r, 17 // 8]) for r in range(3)}
+        for blk in range(k5.shape[1]):
+            if blk not in frontier:
+                np.testing.assert_array_equal(
+                    np.asarray(ko[0, blk]), np.asarray(k5[0, blk]),
+                    err_msg=f"block {blk} was touched",
+                )
+
+    def test_window_larger_than_block_rejected(self):
+        k5, v5, table, q, nk, nv, pos = self._setup(nq=1)
+        big = jnp.zeros((3, 9, 4, 32))
+        bigkv = jnp.zeros((3, 9, 2, 32))
+        with pytest.raises(ValueError, match="at most two blocks"):
+            paged_attention.paged_append_attention(
+                big, bigkv, bigkv, k5, v5, table, pos, 0, interpret=True
+            )
+
+
 class TestAllocator:
     def test_lifo_and_exhaustion(self):
         a = paged.BlockAllocator(5)  # usable: 1..4

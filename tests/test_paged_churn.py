@@ -8,9 +8,10 @@ Two layers of assurance beyond the targeted tests:
   store and every engine lean on);
 * a seeded CHURN harness pushes one randomized request mix through the
   dense engine, the plain paged engine, and the paged engine with EVERY
-  feature on (prefix sharing + chunked admission + speculative rounds) —
-  token streams must be identical across all three.  SURVEY.md §4.5:
-  invest in the testing the reference never built.
+  feature on (prefix sharing + chunked admission + speculative rounds —
+  plus a starved-pool variant with recompute preemption armed) — token
+  streams must be identical across all four.  SURVEY.md §4.5: invest in
+  the testing the reference never built.
 """
 
 import jax
@@ -147,4 +148,13 @@ class TestEngineChurn:
                 prefill_chunk_blocks=1, spec_gamma=2,
             )
         )
-        assert dense == plain == fancy
+        # a STARVED pool with every feature on: prefix sharing + chunked
+        # admission + speculation + preemption interacting under pressure
+        # (pool deliberately below the resident set's worst-case demand)
+        starved_eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=3, n_blocks=16, block_size=BS,
+            prompt_bucket=48, attn_impl="xla", prefix_cache_blocks=6,
+            prefill_chunk_blocks=1, spec_gamma=2, preempt_on_stall=True,
+        )
+        starved = drain(starved_eng)
+        assert dense == plain == fancy == starved

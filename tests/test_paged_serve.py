@@ -84,6 +84,104 @@ class TestParityWithDense:
         assert pag.completions()[0].generated == want
 
 
+class TestPreemption:
+    """preempt_on_stall: a pool too small for the resident set evicts the
+    youngest request (recompute-style) instead of wedging, and the
+    re-admitted stream continues bit-exactly."""
+
+    # two 6-token prompts, long generations: both slots outgrow a 7-block
+    # pool (bs=4) mid-flight — one request alone needs 7 blocks to finish,
+    # so the only way through is evicting the other and resuming it after
+    REQS = [([1, 2, 3, 4, 5, 6], 20), ([7, 8, 9, 10, 11, 12], 20)]
+
+    def _run(self, params, *, n_blocks, preempt, temperature=0.0, **kw):
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=n_blocks,
+            block_size=4, prompt_bucket=32, preempt_on_stall=preempt, **kw,
+        )
+        for prompt, mt in self.REQS:
+            eng.submit(prompt, mt, temperature=temperature, seed=11)
+        eng.run_until_drained()
+        out = {c.request_id: c.generated for c in eng.completions()}
+        return eng, out
+
+    def test_streams_survive_preemption(self):
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        _, want = self._run(params, n_blocks=40, preempt=False)  # roomy pool
+        eng, got = self._run(params, n_blocks=8, preempt=True)   # starved
+        assert eng.preempted_count > 0  # the scenario actually preempted
+        assert got == want
+
+    def test_sampled_streams_survive_preemption(self):
+        """Temperature > 0: the parked base key + fold-by-position step
+        keys must reproduce the identical sampled continuation."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        _, want = self._run(params, n_blocks=40, preempt=False, temperature=0.8)
+        eng, got = self._run(params, n_blocks=8, preempt=True, temperature=0.8)
+        assert eng.preempted_count > 0
+        assert got == want
+
+    def test_submit_cannot_starve_parked_requests(self):
+        """New submissions are refused while requests sit parked — parked
+        work holds no reservation, so without priority an eager caller
+        would re-fill every freed slot forever."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=8, block_size=4,
+            prompt_bucket=32, preempt_on_stall=True,
+        )
+        for prompt, mt in self.REQS:
+            eng.submit(prompt, mt)
+        # step until a preemption happens
+        for _ in range(200):
+            eng.step()
+            if eng.preempted_count:
+                break
+        assert eng.preempted_count == 1
+        # pool still too tight to re-admit: a new submit must be refused
+        # in favor of the parked request
+        with pytest.raises(RuntimeError, match="preempted requests pending"):
+            eng.submit([40, 41, 42], 2)
+        eng.run_until_drained()
+        out = {c.request_id: len(c.generated) for c in eng.completions()}
+        assert out == {0: 20, 1: 20}  # both originals completed in full
+
+    def test_disabled_still_wedges(self):
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        with pytest.raises(RuntimeError, match="wedged"):
+            self._run(params, n_blocks=8, preempt=False)
+
+    def test_unpreemptable_when_grown_past_bucket_wedges(self):
+        """Requests grown beyond prompt_bucket cannot re-prefill in one
+        pass; with every resident unpreemptable the wedge error stands."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=8, block_size=4,
+            prompt_bucket=8, preempt_on_stall=True,
+        )
+        for prompt, mt in self.REQS:
+            eng.submit(prompt, mt, temperature=0.0)
+        with pytest.raises(RuntimeError, match="wedged"):
+            eng.run_until_drained()
+
+
+class TestTpuBlockSizeGuard:
+    def test_unaligned_block_size_fails_at_construction(self, params, monkeypatch):
+        """On a TPU backend the kernel path's DMA needs lane-tile-exact
+        blocks; the engine must say so at construction, not deep inside
+        the first submit()'s trace."""
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with pytest.raises(ValueError, match="128"):
+            paged.PagedServeEngine(
+                params=params, cfg=CFG, n_slots=1, n_blocks=9, block_size=16
+            )
+        # explicit xla fallback keeps small blocks usable
+        paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=1, n_blocks=9, block_size=16,
+            attn_impl="xla",
+        )
+
+
 class TestPoolAccounting:
     def test_blocks_freed_on_retirement(self, params):
         pag = paged.PagedServeEngine(

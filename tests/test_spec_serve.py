@@ -126,6 +126,32 @@ class TestSpecServe:
                 spec_gamma=2, prefix_bucket=8,
             )
 
+    def test_slack_bound_is_exact(self, params):
+        """The verify-window bound admits EXACTLY up to the deepest write:
+        a slot's last round starts at pos = plen + max_tokens - 2 and
+        writes pos..pos+gamma, so plen + max_tokens + gamma - 1 == max_seq
+        must be admissible — and run to completion without tripping the
+        completion-path cache-overrun assertion."""
+        gamma = 4
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+            spec_gamma=gamma,
+        )
+        plen = 3
+        max_tokens = CFG.max_seq - plen - gamma + 1  # exactly at the bound
+        eng.submit([1, 2, 3], max_tokens)
+        for _ in range(5000):
+            eng.step()
+            done = eng.completions()
+            if done:
+                assert len(done[0].generated) == max_tokens
+                break
+        else:
+            raise AssertionError("request did not complete")
+        # one past the bound is rejected
+        with pytest.raises(ValueError, match="slack"):
+            eng.submit([1, 2, 3], max_tokens + 1)
+
     def test_draft_cache_isolated_per_slot(self, params):
         """A retiring slot's stale draft rows never leak into a new
         request admitted to the same slot."""
