@@ -98,12 +98,26 @@ class ClaimContext:
         jax.distributed.initialize(**kwargs)
 
     def build_mesh(self, want_seq: bool = False):
-        """The claimed chips as a Mesh (all visible devices, every host)."""
+        """The claimed chips as a Mesh (all visible devices, every host).
+
+        Under a slice-GROUP claim (``multi_slice``) the mesh gains a
+        leading ``slice`` axis sized by MEGASCALE_NUM_SLICES with each
+        slice's devices contiguous — hybrid data parallelism crosses DCN
+        on that axis only, seq/model collectives stay on per-slice ICI
+        (parallel/mesh.build_multislice_mesh)."""
         import jax
 
-        from k8s_dra_driver_tpu.parallel.mesh import auto_mesh_shape, build_mesh
+        from k8s_dra_driver_tpu.parallel.mesh import (
+            auto_mesh_shape,
+            build_mesh,
+            build_multislice_mesh,
+        )
 
         devices = jax.devices()
+        if self.multi_slice:
+            per_slice = len(devices) // self.num_slices
+            shape = auto_mesh_shape(per_slice, want_seq=want_seq)
+            return build_multislice_mesh(devices, self.num_slices, shape)
         shape = auto_mesh_shape(len(devices), want_seq=want_seq)
         return build_mesh(devices, shape)
 

@@ -204,7 +204,7 @@ def rope_rotate(x, positions, cfg: ModelConfig):
     ).astype(x.dtype)
 
 
-def qkv_proj(x, p, cfg: ModelConfig, positions=None):
+def qkv_proj(x, p, cfg: ModelConfig, positions=None, delta=None):
     """ln1 + fused QKV projection -> q [B, S, H, hd], k/v [B, S, Hkv, hd].
     Shared with the incremental decode path (models/decode.py) so the two
     can't drift.  With GQA (kv_heads < n_heads) k/v carry fewer heads —
@@ -214,11 +214,18 @@ def qkv_proj(x, p, cfg: ModelConfig, positions=None):
     any attention backend and before the cache write — so every consumer
     (dense/flash/ring/ulysses, chunked decode, speculation) inherits RoPE
     without knowing it exists.  ``positions``: [S] or [B, S]; defaults to
-    ``arange(S)`` (the training forward's implicit positions)."""
+    ``arange(S)`` (the training forward's implicit positions).
+
+    ``delta``: optional ``delta(name, y) -> additive projection update``
+    hook over the SAME normalized input the base projection consumes —
+    how per-request LoRA adapters apply at serving time
+    (models/lora.adapter_delta) without a second projection-code path."""
     b, s, _ = x.shape
     h, hkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     y = _rms_norm(x, p["ln1"])
     qkv = _mm(y, p["qkv"])
+    if delta is not None:
+        qkv = qkv + delta("qkv", y)
     q, k, v = jnp.split(qkv, [h * hd, (h + hkv) * hd], axis=-1)
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, hkv, hd)
@@ -241,11 +248,18 @@ def repeat_kv(kv, cfg: ModelConfig):
     return jnp.repeat(kv, cfg.kv_groups, axis=2)
 
 
-def mlp_residual(x, p):
-    """ln2 + gelu MLP with residual (shared with decode)."""
+def mlp_residual(x, p, delta=None):
+    """ln2 + gelu MLP with residual (shared with decode).  ``delta``: the
+    per-request adapter hook, as in :func:`qkv_proj`."""
     y = _rms_norm(x, p["ln2"])
-    y = jax.nn.gelu(_mm(y, p["mlp_up"]))
-    return x + _mm(y, p["mlp_down"])
+    h = _mm(y, p["mlp_up"])
+    if delta is not None:
+        h = h + delta("mlp_up", y)
+    h = jax.nn.gelu(h)
+    out = _mm(h, p["mlp_down"])
+    if delta is not None:
+        out = out + delta("mlp_down", h)
+    return x + out
 
 
 def tied_logits(x, params):

@@ -127,7 +127,7 @@ def _masked_attention(q, k, v, mask):
 
 def decode_chunk(
     params, cache: KVCache, tokens: jax.Array, pos0, *, cfg: ModelConfig,
-    active=None, k_window: int | None = None,
+    active=None, k_window: int | None = None, adapters=None,
 ):
     """THE incremental forward: score ``S`` known tokens in one pass.
 
@@ -143,7 +143,10 @@ def decode_chunk(
     bound on attended key positions — when the caller knows every query
     sits below it (prefill: queries 0..S-1 never see keys >= S), slicing
     the cache view to ``[:k_window]`` avoids paying attention FLOPs over
-    the whole max_seq cache on the admission hot path.
+    the whole max_seq cache on the admission hot path.  ``adapters``:
+    optional ``(bank, ids)`` — per-ROW LoRA from a serving bank
+    (lora.stack_adapters): row r's projections gain its adapter's
+    low-rank update via the shared qkv/mlp delta hooks.
 
     Returns (logits [B, S, V] f32 — one distribution per chunk position —
     and the updated cache).  This is the ONLY per-layer cache loop:
@@ -175,10 +178,16 @@ def decode_chunk(
 
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
+        delta = None
+        if adapters is not None:
+            from k8s_dra_driver_tpu.models import lora
+
+            bank, ids = adapters
+            delta = lora.adapter_delta(bank["blocks"][li], ids, bank["scale"])
         # q: [B, S, H, hd]; k/v: [B, S, Hkv, hd].  positions flow in so
         # RoPE rotates by ABSOLUTE position mid-stream (cache holds
         # rotated keys; history needs no re-rotation).
-        q, k, v = qkv_proj(x, p, cfg, positions=positions)
+        q, k, v = qkv_proj(x, p, cfg, positions=positions, delta=delta)
         k_new = k.astype(new_k.dtype)
         v_new = v.astype(new_v.dtype)
         if uniform:
@@ -209,13 +218,16 @@ def decode_chunk(
             q, new_k[li][:, :k_limit], new_v[li][:, :k_limit], mask
         ).reshape(b, s, cfg.d_model)
         x = x + _mm(attn, p["attn_out"])
-        x = mlp_residual(x, p)
+        if delta is not None:
+            x = x + delta("attn_out", attn)
+        x = mlp_residual(x, p, delta=delta)
 
     return tied_logits(x, params), KVCache(k=new_k, v=new_v)
 
 
 def decode_step(
-    params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig, active=None
+    params, cache: KVCache, token: jax.Array, pos, *, cfg: ModelConfig,
+    active=None, adapters=None,
 ):
     """One incremental step — the S=1 view of :func:`decode_chunk`.
 
@@ -226,7 +238,8 @@ def decode_step(
     Returns (logits [B, V] f32 for position ``pos``, updated cache).
     """
     logits, cache = decode_chunk(
-        params, cache, token[:, None], pos, cfg=cfg, active=active
+        params, cache, token[:, None], pos, cfg=cfg, active=active,
+        adapters=adapters,
     )
     return logits[:, 0], cache
 
@@ -327,7 +340,7 @@ def sample_decode(
 
 
 def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,
-            cache_dtype=jnp.float32):
+            cache_dtype=jnp.float32, adapters=None):
     """Fill the KV cache for the whole prompt in ONE forward pass.
 
     Sequential per-token prefill wastes the MXU: the prompt is fully known,
@@ -346,5 +359,7 @@ def prefill(params, prompt: jax.Array, cfg: ModelConfig, max_seq: int,
     cache = init_cache(cfg, b, max_seq, dtype=cache_dtype)
     # k_window=p_len: prompt queries never see keys beyond the prompt, so
     # attention stays [B,H,P,P] (not [B,H,P,max_seq]) on the admission path.
-    logits, cache = decode_chunk(params, cache, prompt, 0, cfg=cfg, k_window=p_len)
+    logits, cache = decode_chunk(
+        params, cache, prompt, 0, cfg=cfg, k_window=p_len, adapters=adapters
+    )
     return cache, logits[:, -1]

@@ -113,6 +113,82 @@ def adapter_param_count(adapters: dict) -> int:
     return sum(x.size for x in jax.tree.leaves(adapters))
 
 
+def stack_adapters(cfg: ModelConfig, lora: LoraConfig, adapter_list) -> dict:
+    """Serving-time adapter BANK for per-request LoRA (the S-LoRA serving
+    shape): the given adapter trees stacked on a leading axis, with index
+    0 reserved as the IDENTITY adapter (all zeros — a request with no
+    adapter pays the same delta matmuls and adds exact float zeros, so
+    one compiled step serves every mix).  Per layer:
+    ``{target: {"a": [n+1, d_in, r], "b": [n+1, r, d_out]}}``.
+
+    Unlike :func:`merge` (one adapter folded into the weights — zero
+    overhead, one model per engine), a bank serves MANY fine-tunes
+    concurrently over one base: each slot gathers its own A/B rows inside
+    the shared step (burnin.qkv_proj/mlp_residual's ``delta`` hook), at
+    the cost of two rank-``r`` matmuls per projection."""
+    lora.validate(cfg)
+    targets = set(lora.targets)
+    for j, ad in enumerate(adapter_list):
+        if len(ad["blocks"]) != cfg.n_layers:
+            raise ValueError(
+                f"adapter {j} has {len(ad['blocks'])} layers, model has "
+                f"{cfg.n_layers}"
+            )
+        got = set(ad["blocks"][0])
+        if got != targets:
+            # a targets subset would SILENTLY serve a partial fine-tune —
+            # the one failure mode worse than a crash here
+            raise ValueError(
+                f"adapter {j} targets {sorted(got)} != bank targets "
+                f"{sorted(targets)}"
+            )
+    dims = burnin.block_matrix_shapes(cfg)
+    blocks = []
+    for li in range(cfg.n_layers):
+        blk = {}
+        for name in lora.targets:
+            d_in, d_out = dims[name]
+            # one allocation per stacked array (row 0 = the identity)
+            blk[name] = {
+                "a": jnp.stack(
+                    [jnp.zeros((d_in, lora.rank), jnp.float32)]
+                    + [ad["blocks"][li][name]["a"] for ad in adapter_list]
+                ),
+                "b": jnp.stack(
+                    [jnp.zeros((lora.rank, d_out), jnp.float32)]
+                    + [ad["blocks"][li][name]["b"] for ad in adapter_list]
+                ),
+            }
+        blocks.append(blk)
+    return {"blocks": blocks, "scale": lora.scale}
+
+
+def bank_size(bank: dict) -> int:
+    """Number of entries in a serving bank (identity slot included) — the
+    ONE place that knows the stacked layout, so engines never introspect
+    it by hand."""
+    first = next(iter(bank["blocks"][0].values()))
+    return int(first["a"].shape[0])
+
+
+def adapter_delta(bank_layer: dict, ids, scale):
+    """The per-row low-rank update hook for ONE layer of a serving bank:
+    ``delta(name, y) = scale * (y @ A[ids]) @ B[ids]`` (f32 compute, cast
+    back) — each batch row applies ITS request's adapter.  Targets the
+    bank doesn't carry contribute exact zero."""
+
+    def delta(name, y):
+        ab = bank_layer.get(name)
+        if ab is None:
+            return jnp.zeros((), y.dtype)
+        a = ab["a"][ids]  # [B, d_in, r]
+        b = ab["b"][ids]  # [B, r, d_out]
+        xa = jnp.einsum("bsd,bdr->bsr", y.astype(jnp.float32), a)
+        return (scale * jnp.einsum("bsr,bro->bso", xa, b)).astype(y.dtype)
+
+    return delta
+
+
 def build_lora_train_step(
     cfg: ModelConfig,
     lora: LoraConfig = LoraConfig(),

@@ -187,6 +187,7 @@ def paged_decode_step(
     active=None,             # [B] bool; inactive rows write the null block
     attn_impl: str = "xla",
     interpret: bool = False,
+    adapters=None,
 ):
     """One incremental step over the paged cache — the paged mirror of
     :func:`decode.decode_step` (same qkv/mlp/logits helpers, so numerics
@@ -195,6 +196,7 @@ def paged_decode_step(
     logits, cache = paged_decode_chunk(
         params, cache, block_table, token[:, None], pos, cfg=cfg,
         active=active, attn_impl=attn_impl, interpret=interpret,
+        adapters=adapters,
     )
     return logits[:, 0], cache
 
@@ -213,6 +215,7 @@ def paged_decode_chunk(
     active=None,
     attn_impl: str = "xla",
     interpret: bool = False,
+    adapters=None,
 ):
     """Score ``S`` known tokens per row in ONE pass over the paged cache —
     the paged mirror of :func:`decode.decode_chunk` (per-layer: append the
@@ -238,16 +241,28 @@ def paged_decode_chunk(
     if not cfg.rope:
         x = x + params["pos_embed"][positions]
 
+    def layer_delta(li):
+        if adapters is None:
+            return None
+        from k8s_dra_driver_tpu.models import lora
+
+        bank, ids = adapters
+        return lora.adapter_delta(bank["blocks"][li], ids, bank["scale"])
+
     if attn_impl == "kernel":
         new_k, new_v = cache.k, cache.v
         for li, p in enumerate(params["blocks"]):
-            q, k, v = qkv_proj(x, p, cfg, positions=positions)
+            delta = layer_delta(li)
+            q, k, v = qkv_proj(x, p, cfg, positions=positions, delta=delta)
             attn, new_k, new_v = paged_attention.paged_append_attention(
                 q, k, v, new_k, new_v, block_table, pos, li,
                 write_mask=active, interpret=interpret,
             )
-            x = x + _mm(attn.reshape(b, s, cfg.d_model), p["attn_out"])
-            x = mlp_residual(x, p)
+            attn = attn.reshape(b, s, cfg.d_model)
+            x = x + _mm(attn, p["attn_out"])
+            if delta is not None:
+                x = x + delta("attn_out", attn)
+            x = mlp_residual(x, p, delta=delta)
         return tied_logits(x, params), PagedKVCache(k=new_k, v=new_v)
 
     block_ids = block_table[rows[:, None], positions // bs]  # [B, S]
@@ -260,15 +275,19 @@ def paged_decode_chunk(
 
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
-        q, k, v = qkv_proj(x, p, cfg, positions=positions)
+        delta = layer_delta(li)
+        q, k, v = qkv_proj(x, p, cfg, positions=positions, delta=delta)
         new_k = new_k.at[li, block_ids, :, :, offs].set(k.astype(new_k.dtype))
         new_v = new_v.at[li, block_ids, :, :, offs].set(v.astype(new_v.dtype))
         cache = PagedKVCache(k=new_k, v=new_v)
         attn = paged_attention.paged_window_attention_xla(
             q, cache.k[li], cache.v[li], block_table, pos
         )
-        x = x + _mm(attn.reshape(b, s, cfg.d_model), p["attn_out"])
-        x = mlp_residual(x, p)
+        attn = attn.reshape(b, s, cfg.d_model)
+        x = x + _mm(attn, p["attn_out"])
+        if delta is not None:
+            x = x + delta("attn_out", attn)
+        x = mlp_residual(x, p, delta=delta)
 
     return tied_logits(x, params), cache
 
@@ -278,6 +297,7 @@ def paged_prefill(
     prompt: jax.Array,  # [B, P]
     cache: PagedKVCache,
     block_table: jax.Array,  # [B, >= ceil(P/bs)] i32 — disjoint, owned rows
+    adapters=None,
     *,
     cfg: ModelConfig,
 ):
@@ -294,7 +314,8 @@ def paged_prefill(
     nb = blocks_needed(p_len, bs)
     p_pad = nb * bs
     dense, last_logits = decode.prefill(
-        params, prompt, cfg, max_seq=p_pad, cache_dtype=cache.k.dtype
+        params, prompt, cfg, max_seq=p_pad, cache_dtype=cache.k.dtype,
+        adapters=adapters,
     )
     # [L, B, p_pad, Hkv, hd] -> blocks, then head-major TRANSPOSED to match
     # the pool: [L, B, nb, Hkv, hd, bs]
@@ -318,6 +339,7 @@ def paged_prefill_chunk(
     *,
     cfg: ModelConfig,
     chunk_len: int,          # tokens to prefill this call
+    adapters=None,
 ):
     """Incremental admission: gather the row's pooled blocks' k/v into a
     dense scratch row, run ONE `decode_chunk` over positions
@@ -361,7 +383,9 @@ def paged_prefill_chunk(
     pre_v = cache.v[:, ids].transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
     row = decode.KVCache(k=pre_k, v=pre_v)
     chunk = jax.lax.dynamic_slice(prompt, (0, done_len), (1, chunk_len))
-    _, row = decode.decode_chunk(params, row, chunk, done_len, cfg=cfg)
+    _, row = decode.decode_chunk(
+        params, row, chunk, done_len, cfg=cfg, adapters=adapters
+    )
     # scatter ONLY the chunk's blocks (done ones are pooled already)
     kb = row.k.reshape(l, b, mbp, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
     vb = row.v.reshape(l, b, mbp, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
@@ -374,7 +398,8 @@ def paged_prefill_chunk(
 
 
 def paged_prefill_suffix(
-    params, prompt, cache, block_table_row, *, cfg, cached_blocks
+    params, prompt, cache, block_table_row, *, cfg, cached_blocks,
+    adapters=None,
 ):
     """Prefix-hit admission = one chunk covering everything after the
     shared prefix.  (``chunk_len`` still varies with the hit depth here —
@@ -383,6 +408,7 @@ def paged_prefill_suffix(
     return paged_prefill_chunk(
         params, prompt, cache, block_table_row, cached_blocks, cfg=cfg,
         chunk_len=prompt.shape[1] - cached_blocks * cache.block_size,
+        adapters=adapters,
     )
 
 
@@ -412,7 +438,7 @@ def _paged_spec_round(
 
 
 def _paged_step_all(
-    params, cache, table, tokens, pos, active, temps, keys,
+    params, cache, table, tokens, pos, active, temps, keys, adapters=None,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
 ):
     """One paged decode step for every slot at its own position + the
@@ -422,13 +448,13 @@ def _paged_step_all(
 
     logits, cache = paged_decode_step(
         params, cache, table, tokens, pos, cfg=cfg, active=active,
-        attn_impl=attn_impl, interpret=interpret,
+        attn_impl=attn_impl, interpret=interpret, adapters=adapters,
     )
     return serve.sample_next(logits, pos, temps, keys, top_k=top_k), cache
 
 
 def _paged_first_token(
-    params, cache, table, prompt, plen, slot, temp, key,
+    params, cache, table, prompt, plen, slot, temp, key, adapters=None,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
 ):
     """Admission tail: re-run the per-slot step at ``plen - 1`` over the
@@ -445,6 +471,7 @@ def _paged_first_token(
         jnp.arange(n_slots) == slot,
         jnp.full((n_slots,), temp, jnp.float32),
         jnp.broadcast_to(key, (n_slots, *key.shape)),
+        adapters,
         cfg=cfg, top_k=top_k, attn_impl=attn_impl, interpret=interpret,
     )
     return tok[slot], cache
@@ -504,6 +531,14 @@ class PagedServeEngine:
     # admission (streams identical — tested).
     spec_gamma: int = 0
     draft_params: object = None
+    # Per-request LoRA serving over the paged pool (S-LoRA shape): a
+    # stacked bank (lora.stack_adapters); submit(..., adapter=k) applies
+    # fine-tune k to that request inside the shared step.  Composes with
+    # prefix sharing (the block store keys by adapter — adapted k/v never
+    # leak across fine-tunes), chunked admission, and preemption (the
+    # adapter id parks and restores with the request); speculative
+    # serving is a loud non-compose, as in the dense engine.
+    adapter_bank: dict | None = None
     # Preemption (vLLM's recompute fallback): when the pool is exhausted
     # and EVERY resident slot stalls, evict the YOUNGEST resumable request
     # — free its blocks, park its tokens + sampler state, re-prefill it
@@ -553,6 +588,17 @@ class PagedServeEngine:
         self.stalled_steps = 0  # slot-steps skipped waiting for a block
         self._preempted: list[dict] = []  # FIFO of parked requests
         self.preempted_count = 0
+        self._adapter_ids = jnp.zeros((self.n_slots,), jnp.int32)
+        self._n_adapters = 0
+        if self.adapter_bank is not None:
+            if self.spec_gamma > 0:
+                raise ValueError(
+                    "adapter_bank does not compose with speculative serving "
+                    "yet (the verify pass would need adapter-aware drafts)"
+                )
+            from k8s_dra_driver_tpu.models import lora
+
+            self._n_adapters = lora.bank_size(self.adapter_bank)
         kw = dict(
             cfg=cfg, top_k=self.top_k,
             attn_impl=self.attn_impl, interpret=self.interpret,
@@ -614,9 +660,11 @@ class PagedServeEngine:
         max_tokens: int,
         temperature: float = 0.0,
         seed: int | None = None,
+        adapter: int = 0,
     ) -> int:
         """Admit when a slot AND the prompt's blocks are available; raises
-        RuntimeError otherwise (admission control is the caller's)."""
+        RuntimeError otherwise (admission control is the caller's).
+        ``adapter``: bank index for per-request LoRA (0 = the base)."""
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
@@ -624,6 +672,12 @@ class PagedServeEngine:
             prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq,
             spec_gamma=self.spec_gamma, temperature=temperature,
         )
+        if adapter and self.adapter_bank is None:
+            raise ValueError("adapter requested but the engine has no adapter_bank")
+        if self.adapter_bank is not None and not 0 <= adapter < self._n_adapters:
+            raise ValueError(
+                f"adapter {adapter} out of range [0, {self._n_adapters})"
+            )
         if self._preempted:
             # Parked requests hold no reservation, so an eager caller
             # re-filling every freed slot would starve them forever: give
@@ -644,6 +698,9 @@ class PagedServeEngine:
         padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
         request_id = self._next_id
         base_key = jax.random.PRNGKey(request_id if seed is None else seed)
+        # ids set BEFORE the prefill: the admission tail's first-token step
+        # already runs with this slot's adapter
+        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
 
         # Prefix-store hit walk: the longest run of leading FULL blocks
         # whose token content is already pooled.  Two caps: (plen-1)//bs
@@ -658,7 +715,7 @@ class PagedServeEngine:
         cached_ids: list[int] = []
         if self.prefix_cache_blocks > 0:
             for i in range(storable):
-                key = tuple(prompt[: (i + 1) * bs])
+                key = self._prefix_key(prompt, i, adapter)
                 if key not in self._prefix_store:
                     break
                 self._prefix_store.move_to_end(key)  # LRU touch
@@ -697,6 +754,7 @@ class PagedServeEngine:
                     slot=slot, prompt=list(prompt), padded=padded,
                     plen=len(prompt), done=cached, storable=storable,
                     cached=cached, temp=temperature, key=base_key,
+                    adapter=adapter,
                 )
             )
             # _M_REQUESTS counts at ACTIVATION (matching the non-chunked
@@ -709,16 +767,17 @@ class PagedServeEngine:
             # row's owned blocks are the null block (a scratch sink — those
             # positions are beyond plen+1 and re-written before ever attended).
             prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+            row_ad = self._row_adapters(adapter)
             if cached:
                 self._cache = paged_prefill_suffix(
                     self.params, padded, self._cache, prefill_row,
-                    cfg=self.cfg, cached_blocks=cached,
+                    cfg=self.cfg, cached_blocks=cached, adapters=row_ad,
                 )
             else:
                 self._cache, _ = self._prefill_fn(
-                    self.params, padded, self._cache, prefill_row
+                    self.params, padded, self._cache, prefill_row, row_ad
                 )
-            self._store_prefix_blocks(prompt, slot, storable, cached)
+            self._store_prefix_blocks(prompt, slot, storable, cached, adapter)
             if self.spec_gamma > 0:
                 # the draft model needs the prompt's k/v too (its layers)
                 self._d_cache = self._draft_prefill_fn(
@@ -726,7 +785,7 @@ class PagedServeEngine:
                 )
             first_tok, self._cache = self._first_fn(
                 self.params, self._cache, self._table, padded, len(prompt), slot,
-                jnp.float32(temperature), base_key,
+                jnp.float32(temperature), base_key, self._adapters(),
             )
         except BaseException:
             # a failed admission (device OOM, interrupt) must return its
@@ -767,11 +826,12 @@ class PagedServeEngine:
         real_end = min(blocks_needed(adm["plen"], bs) * bs, self.prompt_bucket)
         prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
         try:
+            row_ad = self._row_adapters(adm.get("adapter", 0))
             if real_end - adm["done"] * bs > self.prefill_chunk_blocks * bs:
                 self._cache = paged_prefill_chunk(
                     self.params, adm["padded"], self._cache, prefill_row,
                     adm["done"], cfg=self.cfg,
-                    chunk_len=self.prefill_chunk_blocks * bs,
+                    chunk_len=self.prefill_chunk_blocks * bs, adapters=row_ad,
                 )
                 adm["done"] += self.prefill_chunk_blocks
                 return
@@ -782,6 +842,7 @@ class PagedServeEngine:
                 self._cache = paged_prefill_chunk(
                     self.params, adm["padded"], self._cache, prefill_row,
                     adm["done"], cfg=self.cfg, chunk_len=chunk_len,
+                    adapters=row_ad,
                 )
             if self.spec_gamma > 0:
                 self._d_cache = self._draft_prefill_fn(
@@ -791,6 +852,7 @@ class PagedServeEngine:
             first_tok, self._cache = self._first_fn(
                 self.params, self._cache, self._table, adm["padded"],
                 adm["plen"], slot, jnp.float32(adm["temp"]), adm["key"],
+                self._adapters(),
             )
         except BaseException as exc:
             # failed mid-admission: release the reservation entirely AND
@@ -814,7 +876,8 @@ class PagedServeEngine:
         self._admitting.pop(0)
         serve._M_REQUESTS.inc()  # successful admission, like the sync path
         self._store_prefix_blocks(
-            adm["prompt"], slot, adm["storable"], adm["cached"]
+            adm["prompt"], slot, adm["storable"], adm["cached"],
+            adm.get("adapter", 0),
         )
         self._slots[slot].tokens.append(int(first_tok))
         self._last = self._last.at[slot].set(first_tok)
@@ -871,8 +934,12 @@ class PagedServeEngine:
         if victim is None:
             return False
         temps = np.asarray(self._temps)
+        ads = np.asarray(self._adapter_ids)
         self._preempted.append(
-            dict(st=victim, temp=float(temps[vslot]), key=self._keys[vslot])
+            dict(
+                st=victim, temp=float(temps[vslot]), key=self._keys[vslot],
+                adapter=int(ads[vslot]),
+            )
         )
         self._slots[vslot] = None
         self._alloc.free(self._owned[vslot])
@@ -906,11 +973,12 @@ class PagedServeEngine:
                 slot = self._slots.index(None)
             except ValueError:
                 return
+            adapter = r.get("adapter", 0)
             cached_ids: list[int] = []
             if self.prefix_cache_blocks > 0:
                 storable = min((len(tokens) - 1) // bs, (self.prompt_bucket - 1) // bs)
                 for i in range(storable):
-                    key = tuple(tokens[: (i + 1) * bs])
+                    key = self._prefix_key(tokens, i, adapter)
                     if key not in self._prefix_store:
                         break
                     self._prefix_store.move_to_end(key)
@@ -928,15 +996,17 @@ class PagedServeEngine:
             padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
             padded = padded.at[0, : len(tokens)].set(jnp.asarray(tokens, jnp.int32))
             prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+            self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+            row_ad = self._row_adapters(adapter)
             try:
                 if cached:
                     self._cache = paged_prefill_suffix(
                         self.params, padded, self._cache, prefill_row,
-                        cfg=self.cfg, cached_blocks=cached,
+                        cfg=self.cfg, cached_blocks=cached, adapters=row_ad,
                     )
                 else:
                     self._cache, _ = self._prefill_fn(
-                        self.params, padded, self._cache, prefill_row
+                        self.params, padded, self._cache, prefill_row, row_ad
                     )
                 if self.spec_gamma > 0:
                     self._d_cache = self._draft_prefill_fn(
@@ -1043,7 +1113,7 @@ class PagedServeEngine:
         active_j = jnp.asarray(active)
         next_tok, self._cache = self._step_fn(
             self.params, self._cache, self._table, self._last, self._pos,
-            active_j, self._temps, self._keys,
+            active_j, self._temps, self._keys, self._adapters(),
         )
         self._last = jnp.where(active_j, next_tok, self._last)
         self._pos = jnp.where(active_j, self._pos + 1, self._pos)
@@ -1076,8 +1146,27 @@ class PagedServeEngine:
         return out
 
     # -- internals ---------------------------------------------------------
+    def _prefix_key(self, prompt: list[int], i: int, adapter: int):
+        """Store key for prompt block i: token content, plus the adapter id
+        when a bank is live — adapted k/v must never cross fine-tunes."""
+        key = tuple(prompt[: (i + 1) * self.block_size])
+        return (adapter, key) if self.adapter_bank is not None else key
+
+    def _adapters(self):
+        """(bank, per-slot ids) for the jitted fns, or None when off."""
+        if self.adapter_bank is None:
+            return None
+        return (self.adapter_bank, self._adapter_ids)
+
+    def _row_adapters(self, adapter: int):
+        """Single-row adapter context for the [1, bucket] admission paths."""
+        if self.adapter_bank is None:
+            return None
+        return (self.adapter_bank, jnp.asarray([adapter], jnp.int32))
+
     def _store_prefix_blocks(
-        self, prompt: list[int], slot: int, storable: int, cached: int
+        self, prompt: list[int], slot: int, storable: int, cached: int,
+        adapter: int = 0,
     ) -> None:
         """Insert this admission's freshly computed full prompt blocks into
         the LRU prefix store (each entry holds one reference, so stored
@@ -1086,7 +1175,7 @@ class PagedServeEngine:
             return
         self.prefix_misses += max(storable - cached, 0)
         for i in range(cached, storable):
-            key = tuple(prompt[: (i + 1) * self.block_size])
+            key = self._prefix_key(prompt, i, adapter)
             if key in self._prefix_store:
                 self._prefix_store.move_to_end(key)
                 continue

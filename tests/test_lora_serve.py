@@ -1,0 +1,239 @@
+"""Per-request LoRA serving (S-LoRA shape): one base model + a stacked
+adapter bank, each request applying its own fine-tune inside the shared
+engine step.  Contracts: the identity adapter changes nothing, a banked
+adapter reproduces the MERGED model's stream, fine-tunes never leak
+across slots or through the prefix cache."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, lora
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+CFG = burnin.ModelConfig(
+    vocab_size=89, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=128
+)
+LORA = lora.LoraConfig(rank=4, alpha=8.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _trained_adapter(seed: int) -> dict:
+    """An adapter with NONZERO B (init gives B=0 = identity), scaled small
+    enough to stay in-distribution but large enough that streams visibly
+    diverge from the base."""
+    ad = lora.init_adapters(jax.random.PRNGKey(seed), CFG, LORA)
+    for li, blk in enumerate(ad["blocks"]):
+        for name, ab in blk.items():
+            # deterministic per-(layer, name) fold: hash() is randomized
+            # per process and would make the adapters flaky across runs
+            tag = li * 1000 + sum(ord(c) for c in name)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), tag)
+            ab["b"] = 0.3 * jax.random.normal(key, ab["b"].shape, jnp.float32)
+    return ad
+
+
+@pytest.fixture(scope="module")
+def bank(params):
+    return lora.stack_adapters(CFG, LORA, [_trained_adapter(1), _trained_adapter(2)])
+
+
+def _drain(eng, reqs):
+    out = {}
+    for prompt, max_tokens, adapter in reqs:
+        eng.submit(prompt, max_tokens, adapter=adapter)
+    eng.run_until_drained()
+    for c in eng.completions():
+        out[c.request_id] = c.generated
+    return out
+
+
+PROMPTS = [[3, 14, 15, 9, 2], [6, 53, 58, 9], [7, 1, 8, 2, 8, 1]]
+
+
+class TestAdapterServing:
+    def test_identity_adapter_streams_identical(self, params, bank):
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=3, prompt_bucket=16)
+        banked = ServeEngine(
+            params=params, cfg=CFG, n_slots=3, prompt_bucket=16,
+            adapter_bank=bank,
+        )
+        reqs = [(p, 10, 0) for p in PROMPTS]
+        assert _drain(plain, reqs) == _drain(banked, reqs)
+
+    def test_mixed_batch_logits_match_merged_models(self, params, bank):
+        """A 3-row batch with ids [0, 1, 2] produces (per row) the logits
+        of the corresponding MERGED model, to fp tolerance — the separate
+        low-rank delta and the weight merge are the same math in different
+        accumulation order, so logits agree to bf16 noise while token
+        streams may legitimately flip on near-ties."""
+        from k8s_dra_driver_tpu.models import decode
+
+        prompts = jnp.asarray(
+            [[3, 14, 15, 9, 2], [6, 53, 58, 9, 1], [7, 1, 8, 2, 8]], jnp.int32
+        )
+        ids = jnp.asarray([0, 1, 2], jnp.int32)
+        _, logits = decode.prefill(
+            params, prompts, CFG, max_seq=32, adapters=(bank, ids)
+        )
+        models = [
+            params,
+            lora.merge(params, _trained_adapter(1), LORA),
+            lora.merge(params, _trained_adapter(2), LORA),
+        ]
+        for i, model in enumerate(models):
+            _, solo = decode.prefill(model, prompts[i : i + 1], CFG, max_seq=32)
+            np.testing.assert_allclose(
+                np.asarray(logits[i]), np.asarray(solo[0]), atol=0.1,
+                err_msg=f"row {i} diverged from its merged model",
+            )
+        # rows 1/2 are genuinely different models from row 0
+        assert float(jnp.abs(logits[1] - logits[0]).max()) > 1.0
+
+    def test_mixed_adapters_bind_per_request(self, params, bank):
+        """No cross-slot leakage, proven EXACTLY: permuting the bank and
+        the submitted ids together is the same math in the same batch
+        positions, so streams must be bit-identical — any row reading a
+        neighbor's adapter breaks the correspondence."""
+        bank_swapped = lora.stack_adapters(
+            CFG, LORA, [_trained_adapter(2), _trained_adapter(1)]
+        )
+        reqs = [(PROMPTS[0], 9, 1), (PROMPTS[1], 9, 2), (PROMPTS[2], 9, 0)]
+        got = _drain(
+            ServeEngine(
+                params=params, cfg=CFG, n_slots=3, prompt_bucket=16,
+                adapter_bank=bank,
+            ),
+            reqs,
+        )
+        swapped_reqs = [(PROMPTS[0], 9, 2), (PROMPTS[1], 9, 1), (PROMPTS[2], 9, 0)]
+        want = _drain(
+            ServeEngine(
+                params=params, cfg=CFG, n_slots=3, prompt_bucket=16,
+                adapter_bank=bank_swapped,
+            ),
+            swapped_reqs,
+        )
+        assert got == want
+        # and the three streams are pairwise distinct (adapters bite)
+        streams = list(got.values())
+        assert streams[0] != streams[2] and streams[1] != streams[2]
+
+    def test_prefix_cache_keys_by_adapter(self, params, bank):
+        """Two fine-tunes sharing a prompt prefix must NOT share cached
+        prefix k/v — the store keys by adapter."""
+        shared = [11, 12, 13, 14, 15, 16, 17, 18]  # > prefix_bucket
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+            prefix_bucket=4, adapter_bank=bank,
+        )
+        r1 = _drain(eng, [(shared + [20], 8, 1)])
+        r2 = _drain(eng, [(shared + [20], 8, 2)])
+        assert eng.prefix_hits == 0  # different adapters: no cross-hit
+        # same adapter again: NOW it hits, stream unchanged
+        r1b = _drain(eng, [(shared + [20], 8, 1)])
+        assert eng.prefix_hits == 1
+        assert list(r1.values())[0] == list(r1b.values())[0]
+        # and the two fine-tunes produced different streams
+        assert list(r1.values())[0] != list(r2.values())[0]
+
+    def test_validation(self, params, bank):
+        with pytest.raises(ValueError, match="no adapter_bank"):
+            ServeEngine(params=params, cfg=CFG, n_slots=1, prompt_bucket=16).submit(
+                [1, 2], 2, adapter=1
+            )
+        eng = ServeEngine(
+            params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+            adapter_bank=bank,
+        )
+        with pytest.raises(ValueError, match="out of range"):
+            eng.submit([1, 2], 2, adapter=3)
+        with pytest.raises(ValueError, match="speculative"):
+            ServeEngine(
+                params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
+                adapter_bank=bank, spec_gamma=2,
+            )
+
+    def test_bank_layer_mismatch_rejected(self, params):
+        ad = _trained_adapter(1)
+        ad["blocks"] = ad["blocks"][:1]
+        with pytest.raises(ValueError, match="layers"):
+            lora.stack_adapters(CFG, LORA, [ad])
+
+
+class TestPagedAdapterServing:
+    """The same per-request-adapter contracts over the PAGED engine — and
+    the interactions paging adds: block-level prefix sharing keyed by
+    adapter, and preemption parking/restoring the adapter id."""
+
+    def _engine(self, params, bank, **kw):
+        from k8s_dra_driver_tpu.models import paged
+
+        return paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=3, n_blocks=40, block_size=8,
+            prompt_bucket=16, attn_impl="xla", adapter_bank=bank, **kw,
+        )
+
+    def test_identity_adapter_streams_identical(self, params, bank):
+        from k8s_dra_driver_tpu.models import paged
+
+        plain = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=3, n_blocks=40, block_size=8,
+            prompt_bucket=16, attn_impl="xla",
+        )
+        reqs = [(p, 10, 0) for p in PROMPTS]
+        assert _drain(plain, reqs) == _drain(self._engine(params, bank), reqs)
+
+    def test_mixed_adapters_bind_per_request(self, params, bank):
+        bank_swapped = lora.stack_adapters(
+            CFG, LORA, [_trained_adapter(2), _trained_adapter(1)]
+        )
+        got = _drain(
+            self._engine(params, bank),
+            [(PROMPTS[0], 9, 1), (PROMPTS[1], 9, 2), (PROMPTS[2], 9, 0)],
+        )
+        want = _drain(
+            self._engine(params, bank_swapped),
+            [(PROMPTS[0], 9, 2), (PROMPTS[1], 9, 1), (PROMPTS[2], 9, 0)],
+        )
+        assert got == want
+        streams = list(got.values())
+        assert streams[0] != streams[2] and streams[1] != streams[2]
+
+    def test_block_prefix_store_keys_by_adapter(self, params, bank):
+        shared = list(range(20, 36))  # 2 full 8-token blocks
+        eng = self._engine(params, bank, prefix_cache_blocks=6)
+        r1 = _drain(eng, [(shared[:12] + [40], 8, 1)])
+        hits_after_first = eng.prefix_hits
+        r2 = _drain(eng, [(shared[:12] + [40], 8, 2)])
+        assert eng.prefix_hits == hits_after_first  # no cross-adapter hit
+        r1b = _drain(eng, [(shared[:12] + [40], 8, 1)])
+        assert eng.prefix_hits > hits_after_first  # same adapter DOES hit
+        assert list(r1.values())[0] == list(r1b.values())[0]
+        assert list(r1.values())[0] != list(r2.values())[0]
+
+    def test_preemption_restores_adapter(self, params, bank):
+        """A preempted adapted request resumes with ITS adapter: streams
+        under a starved pool equal the roomy-pool run, per adapter."""
+        from k8s_dra_driver_tpu.models import paged
+
+        reqs = [([1, 2, 3, 4, 5, 6], 14, 1), ([7, 8, 9, 10, 11, 12], 14, 2)]
+
+        def run(n_blocks, preempt):
+            eng = paged.PagedServeEngine(
+                params=params, cfg=CFG, n_slots=2, n_blocks=n_blocks,
+                block_size=4, prompt_bucket=32, attn_impl="xla",
+                adapter_bank=bank, preempt_on_stall=preempt,
+            )
+            out = _drain(eng, reqs)
+            return eng, out
+
+        _, want = run(40, False)
+        eng, got = run(7, True)
+        assert eng.preempted_count > 0
+        assert got == want

@@ -266,3 +266,24 @@ class TestMultisliceMesh:
         assert multislice_env_shape(
             {"MEGASCALE_NUM_SLICES": "4", "MEGASCALE_SLICE_ID": "2"}
         ) == (4, 2)
+
+    def test_consumer_builds_multislice_mesh(self, monkeypatch):
+        """A group-seat claim context turns the global device view into a
+        slice-leading mesh (the DCN axis) without the pod knowing the
+        topology beyond its injected env."""
+        import jax
+
+        from k8s_dra_driver_tpu import consumer
+
+        devs = cpu_devices(8)  # resolve BEFORE patching (it calls jax.devices)
+        monkeypatch.setattr(jax, "devices", lambda *a: devs)
+        ctx = consumer.attach(
+            environ={"MEGASCALE_NUM_SLICES": "2", "MEGASCALE_SLICE_ID": "1"},
+            init_distributed=False,
+        )
+        mesh = ctx.build_mesh()
+        assert mesh.axis_names[0] == "slice"
+        assert mesh.devices.shape[0] == 2
+        # single-slice context keeps the plain mesh
+        plain = consumer.attach(environ={}, init_distributed=False).build_mesh()
+        assert "slice" not in plain.axis_names
