@@ -85,20 +85,22 @@ def run_control_plane() -> list[float]:
     return samples
 
 
-def run_data_plane() -> dict:
+def run_data_plane(sink: dict | None = None) -> dict:
     # BENCH_PROFILE_DIR: capture a jax.profiler trace of the whole data
     # plane (XPlane protos viewable in TensorBoard/xprof) — the data-plane
     # counterpart of the control plane's /debug/traces spans.
+    # ``sink``: filled INCREMENTALLY per block, so the watchdog can salvage
+    # completed measurements when a later block hangs the device link.
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")
     if profile_dir:
         import jax
 
         with jax.profiler.trace(profile_dir):
-            return _data_plane_body()
-    return _data_plane_body()
+            return _data_plane_body(sink)
+    return _data_plane_body(sink)
 
 
-def _data_plane_body() -> dict:
+def _data_plane_body(sink: dict | None = None) -> dict:
     import jax
 
     from k8s_dra_driver_tpu.models import burnin
@@ -135,7 +137,8 @@ def _data_plane_body() -> dict:
             f"({total*1e3:.1f}ms total vs {rtt*1e3:.1f}ms RTT); raise steps"
         )
     step_ms = (total - rtt) / steps * 1000
-    out = {
+    out = sink if sink is not None else {}
+    out.update({
         "backend": jax.default_backend(),
         "burnin_step_ms": round(step_ms, 2),
         "burnin_loss": round(last_loss, 4),
@@ -144,9 +147,11 @@ def _data_plane_body() -> dict:
         # accounting, which does NOT credit the remat re-forward) over the
         # measured step time, against the v5e bf16 nominal peak.
         **_train_mfu(cfg, batch=4, step_ms=step_ms),
-        # chained-scan measurement amortizing + subtracting tunnel RTT
-        "matmul_tflops": round(matmul_tflops(size=4096, chain=128), 1),
-    }
+    })
+    # separate statement ON PURPOSE: the chained matmul probe is a prime
+    # hang site, and the burn-in numbers above must already be in the sink
+    # when the watchdog salvages a timeout
+    out["matmul_tflops"] = round(matmul_tflops(size=4096, chain=128), 1)
     if jax.default_backend() == "tpu":
         # Pallas flash vs XLA dense attention — the kernel-level win the
         # framework ships for the long-context path.  The block sweep
@@ -563,7 +568,7 @@ def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:
 
     def worker():
         try:
-            result.update(run_data_plane())
+            run_data_plane(sink=result)  # fills result per block
         except Exception as exc:  # noqa: BLE001 - report, don't die
             result["error"] = f"{type(exc).__name__}: {exc}"
 
@@ -573,7 +578,13 @@ def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:
     t.start()
     t.join(timeout_s)
     if t.is_alive():
-        return {"error": f"data plane timed out after {timeout_s:.0f}s (hung device link?)"}
+        # salvage whatever blocks completed before the hang: measurements
+        # already in ``result`` are real — only the stuck tail is lost
+        return {
+            **result,
+            "error": f"data plane timed out after {timeout_s:.0f}s "
+                     "(hung device link?)",
+        }
     return result
 
 

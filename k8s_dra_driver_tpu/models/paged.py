@@ -414,7 +414,8 @@ def paged_prefill_suffix(
 
 def _paged_spec_round(
     params, draft_params, cache: PagedKVCache, d_cache, table, last, pos,
-    active, *, cfg: ModelConfig, gamma: int, attn_impl: str, interpret: bool,
+    active, adapters=None,
+    *, cfg: ModelConfig, gamma: int, attn_impl: str, interpret: bool,
 ):
     """ONE speculative round over the PAGED cache: the shared draft
     proposal (serve.draft_propose — dense draft cache) plus a paged verify
@@ -430,7 +431,7 @@ def _paged_spec_round(
     window = jnp.concatenate([last[:, None], proposed], axis=1)
     logits, cache = paged_decode_chunk(
         params, cache, table, window, pos, cfg=cfg, active=active,
-        attn_impl=attn_impl, interpret=interpret,
+        attn_impl=attn_impl, interpret=interpret, adapters=adapters,
     )
     target = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     _, advance = accept_advance(proposed, target, active)
@@ -535,9 +536,10 @@ class PagedServeEngine:
     # stacked bank (lora.stack_adapters); submit(..., adapter=k) applies
     # fine-tune k to that request inside the shared step.  Composes with
     # prefix sharing (the block store keys by adapter — adapted k/v never
-    # leak across fine-tunes), chunked admission, and preemption (the
-    # adapter id parks and restores with the request); speculative
-    # serving is a loud non-compose, as in the dense engine.
+    # leak across fine-tunes), chunked admission, preemption (the adapter
+    # id parks and restores with the request), AND speculative rounds
+    # (adapters apply to the paged verify chunk; the base-model draft
+    # stays sound — the any-draft contract).
     adapter_bank: dict | None = None
     # Preemption (vLLM's recompute fallback): when the pool is exhausted
     # and EVERY resident slot stalls, evict the YOUNGEST resumable request
@@ -591,11 +593,6 @@ class PagedServeEngine:
         self._adapter_ids = jnp.zeros((self.n_slots,), jnp.int32)
         self._n_adapters = 0
         if self.adapter_bank is not None:
-            if self.spec_gamma > 0:
-                raise ValueError(
-                    "adapter_bank does not compose with speculative serving "
-                    "yet (the verify pass would need adapter-aware drafts)"
-                )
             from k8s_dra_driver_tpu.models import lora
 
             self._n_adapters = lora.bank_size(self.adapter_bank)
@@ -1072,7 +1069,7 @@ class PagedServeEngine:
         active_j = jnp.asarray(active)
         target, advance, self._cache, self._d_cache = self._spec_fn(
             self.params, self.draft_params, self._cache, self._d_cache,
-            self._table, self._last, self._pos, active_j,
+            self._table, self._last, self._pos, active_j, self._adapters(),
         )
         rows = jnp.arange(self.n_slots)
         new_last = target[rows, jnp.maximum(advance - 1, 0)]

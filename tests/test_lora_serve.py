@@ -153,11 +153,28 @@ class TestAdapterServing:
         )
         with pytest.raises(ValueError, match="out of range"):
             eng.submit([1, 2], 2, adapter=3)
-        with pytest.raises(ValueError, match="speculative"):
+
+    def test_speculative_compose(self, params, bank):
+        """Speculation composes with adapters: the verify pass applies the
+        request's adapter while the draft stays the base model — streams
+        bit-equal the non-speculative banked engine (the any-draft
+        contract, per adapter)."""
+        reqs = [(PROMPTS[0], 10, 1), (PROMPTS[1], 10, 2), (PROMPTS[2], 10, 0)]
+        plain = _drain(
             ServeEngine(
-                params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
-                adapter_bank=bank, spec_gamma=2,
-            )
+                params=params, cfg=CFG, n_slots=3, prompt_bucket=16,
+                adapter_bank=bank,
+            ),
+            reqs,
+        )
+        spec = _drain(
+            ServeEngine(
+                params=params, cfg=CFG, n_slots=3, prompt_bucket=16,
+                adapter_bank=bank, spec_gamma=3,
+            ),
+            reqs,
+        )
+        assert plain == spec
 
     def test_bank_layer_mismatch_rejected(self, params):
         ad = _trained_adapter(1)
@@ -216,6 +233,14 @@ class TestPagedAdapterServing:
         assert eng.prefix_hits > hits_after_first  # same adapter DOES hit
         assert list(r1.values())[0] == list(r1b.values())[0]
         assert list(r1.values())[0] != list(r2.values())[0]
+
+    def test_paged_speculative_compose(self, params, bank):
+        from k8s_dra_driver_tpu.models import paged
+
+        reqs = [(PROMPTS[0], 10, 1), (PROMPTS[1], 10, 2), (PROMPTS[2], 10, 0)]
+        plain = _drain(self._engine(params, bank), reqs)
+        spec = _drain(self._engine(params, bank, spec_gamma=3), reqs)
+        assert plain == spec
 
     def test_preemption_restores_adapter(self, params, bank):
         """A preempted adapted request resumes with ITS adapter: streams
