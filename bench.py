@@ -344,11 +344,12 @@ def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
         for i in range(n_requests)
     ]
 
-    def drive(spec_gamma: int) -> dict:
+    def drive(spec_gamma: int, adapter_bank=None, adapter: int = 0) -> dict:
         eng = paged.PagedServeEngine(
             params=params, cfg=cfg, n_slots=n_slots, n_blocks=129,
             block_size=block_size, prompt_bucket=512,
             cache_dtype=jnp.bfloat16, spec_gamma=spec_gamma,
+            adapter_bank=adapter_bank,
         )
         queue = list(requests)
         ttfts: list[float] = []
@@ -360,7 +361,7 @@ def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
                 prompt, mt = queue[0]
                 t0 = time.perf_counter()
                 try:
-                    eng.submit(prompt, max_tokens=mt)
+                    eng.submit(prompt, max_tokens=mt, adapter=adapter)
                 except RuntimeError:
                     break  # out of blocks: decode until a retirement frees
                 ttfts.append(time.perf_counter() - t0)
@@ -383,7 +384,7 @@ def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
 
     plain = drive(0)
     spec = drive(4)
-    return {
+    out = {
         "engine": "PagedServeEngine",
         "n_slots": n_slots,
         "block_size": block_size,
@@ -396,6 +397,25 @@ def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
         "note": "host-driven loop: absolute tok/s is dispatch-RTT-bound; "
                 "the spec ratio tracks tokens committed per dispatch",
     }
+    # Per-request LoRA price tag: the same workload with every request on
+    # bank adapter 1 — two rank-r delta matmuls per projection per step.
+    try:
+        from k8s_dra_driver_tpu.models import lora
+
+        lcfg = lora.LoraConfig(rank=8)
+        ad = lora.init_adapters(jax.random.PRNGKey(9), cfg, lcfg)
+        bank = lora.stack_adapters(cfg, lcfg, [ad])
+        adapted = drive(0, adapter_bank=bank, adapter=1)
+        out["adapter"] = {
+            **adapted,
+            "rank": lcfg.rank,
+            "vs_plain": round(
+                adapted["tokens_per_s"] / plain["tokens_per_s"], 2
+            ),
+        }
+    except Exception as exc:  # noqa: BLE001 - price tag is best-effort
+        out["adapter"] = {"error": f"{type(exc).__name__}: {exc}"}
+    return out
 
 
 V5E_BF16_PEAK_TFLOPS = 197.0  # nominal single-chip bf16 peak
@@ -579,12 +599,16 @@ def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:
     t.join(timeout_s)
     if t.is_alive():
         # salvage whatever blocks completed before the hang: measurements
-        # already in ``result`` are real — only the stuck tail is lost
-        return {
-            **result,
-            "error": f"data plane timed out after {timeout_s:.0f}s "
-                     "(hung device link?)",
-        }
+        # already in ``result`` are real — only the stuck tail is lost.
+        # Key-snapshot copy: the daemon worker may still be INSERTING into
+        # the sink concurrently (a slow-but-alive block finishing late),
+        # and a plain dict unpack can die with "changed size during
+        # iteration" — exactly in the scenario this guard protects.
+        salvaged = {k: result[k] for k in list(result)}
+        salvaged["error"] = (
+            f"data plane timed out after {timeout_s:.0f}s (hung device link?)"
+        )
+        return salvaged
     return result
 
 
@@ -594,9 +618,10 @@ def main() -> int:
     # The data-plane proof is best-effort reporting: a flaky accelerator
     # tunnel must not suppress the headline control-plane metric.
     data = _run_data_plane_guarded(
-        # 1100s: the attention block sweep adds ~3 compiles on a cold chip,
-        # and the speculative block compiles chained while_loops
-        timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "1100"))
+        # 1600s: the attention block sweep adds ~3 compiles on a cold
+        # chip, the speculative block compiles chained while_loops, and
+        # the engine-level serving benches step through the tunnel
+        timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "1600"))
     )
     print(
         f"# control-plane: {len(samples)} cycles, p50={p50:.2f}ms "
