@@ -249,7 +249,7 @@ def _serve_demo() -> int:
     chunked admission, ending in ONE JSON summary line."""
     import jax
 
-    from k8s_dra_driver_tpu.models import burnin
+    from k8s_dra_driver_tpu.models import burnin, lora
     from k8s_dra_driver_tpu.models.paged import PagedServeEngine
 
     cfg = burnin.ModelConfig(
@@ -257,26 +257,32 @@ def _serve_demo() -> int:
         d_ff=256, max_seq=128, rope=True,
     )
     params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    lcfg = lora.LoraConfig(rank=4)
+    bank = lora.stack_adapters(
+        cfg, lcfg, [lora.init_adapters(jax.random.PRNGKey(7), cfg, lcfg)]
+    )
     # 2 slots on purpose: the later shared-prefix requests admit after the
     # first ones retired, so the prefix store demonstrably pays off.  The
-    # whole serving stack is on — prefix sharing, chunked admission, AND
-    # speculative rounds (the demo mix is greedy, speculation's contract).
+    # whole serving stack is on — prefix sharing, chunked admission,
+    # speculative rounds (the demo mix is greedy, speculation's contract),
+    # recompute preemption armed, and a LoRA adapter bank (one request
+    # runs on adapter 1).
     eng = PagedServeEngine(
         params=params, cfg=cfg, n_slots=2, n_blocks=40, block_size=16,
         prompt_bucket=32, prefix_cache_blocks=4, prefill_chunk_blocks=1,
-        spec_gamma=2,
+        spec_gamma=2, preempt_on_stall=True, adapter_bank=bank,
     )
     shared = list(range(16))  # one full shared block across the mix
     pending = [
-        (shared + [20, 21], 12), (shared + [30], 10),
-        ([40, 41, 42], 8), (shared + [50, 51, 52], 6),
+        (shared + [20, 21], 12, 0), (shared + [30], 10, 0),
+        ([40, 41, 42], 8, 1), (shared + [50, 51, 52], 6, 0),
     ]
     streams = {}
     for _ in range(2000):
         while pending:
-            prompt, max_tokens = pending[0]
+            prompt, max_tokens, adapter = pending[0]
             try:
-                eng.submit(prompt, max_tokens)
+                eng.submit(prompt, max_tokens, adapter=adapter)
                 pending.pop(0)
             except RuntimeError:
                 break  # engine full: step until a retirement frees room
@@ -298,6 +304,8 @@ def _serve_demo() -> int:
             "generated_tokens": sum(streams.values()),
             "prefix_block_hits": eng.prefix_hits,
             "stalled_steps": eng.stalled_steps,
+            "preemptions": eng.preempted_count,
+            "adapters_in_bank": lora.bank_size(bank),
             "pool_free_blocks": eng.free_blocks,
         }
     }, sort_keys=True))
