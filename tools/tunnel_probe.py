@@ -1,0 +1,66 @@
+"""Fast accelerator-tunnel liveness probe.
+
+``python tools/tunnel_probe.py [timeout_s]`` — exits 0 and prints the backend
+name if a real matmul completes on the default jax backend within the timeout,
+exits 1 otherwise.  Runs the probe in a subprocess because a dead axon tunnel
+makes backend init HANG (not raise), and a hung in-process init can never be
+retried.  ``bench._wait_for_backend`` imports :func:`probe` for the round-end
+artifact — one implementation, two call sites.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "(x @ x).block_until_ready();"
+    "print(jax.default_backend())"
+)
+
+
+def probe(timeout_s: float = 90.0, quiet: bool = False) -> bool:
+    """One subprocess attempt to init the backend and run a real matmul.
+
+    ``start_new_session`` + killpg on timeout: jax may spawn grandchildren
+    holding the stdout pipe, and a child stuck in an uninterruptible
+    device-driver call survives a plain ``kill()`` — either would turn
+    ``subprocess.run``'s post-timeout ``communicate()`` into a second
+    unbounded hang, exactly the failure this subprocess exists to bound.
+    """
+    say = (lambda *a: None) if quiet else (lambda *a: print(*a))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_CODE],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # D-state child: give up on reaping, report down
+        say("tunnel_probe: TIMEOUT (backend init hung)")
+        return False
+    if proc.returncode == 0:
+        say(f"tunnel_probe: OK backend={out.strip().splitlines()[-1]}")
+        return True
+    tail = (err or "").strip().splitlines()
+    say(f"tunnel_probe: DOWN rc={proc.returncode} {tail[-1] if tail else ''}")
+    return False
+
+
+if __name__ == "__main__":
+    t = float(sys.argv[1]) if len(sys.argv) > 1 else 90.0
+    sys.exit(0 if probe(t) else 1)
