@@ -602,12 +602,17 @@ def _wait_for_backend(max_wait_s: float) -> dict:
                 break
             time.sleep(sleep_for)
             waited += sleep_for
-        budget = max_wait_s - waited
-        if budget <= 0 and not (attempt == 0 and max_wait_s > 0):
+        # A sleep is ALWAYS followed by a probe (budget only gates the
+        # sleeps): ending the wait on a sleep would report a backend that
+        # recovered during it as down — the exact outage-voids-round
+        # failure this retry exists to prevent.
+        if max_wait_s <= 0:
             break
         attempt += 1
         t0 = time.perf_counter()
-        ok = probe(timeout_s=min(90.0, max(budget, 5.0)), quiet=True)
+        ok = probe(
+            timeout_s=min(90.0, max(max_wait_s - waited, 5.0)), quiet=True
+        )
         waited += time.perf_counter() - t0
         if ok:
             return {"ok": True, "attempts": attempt, "waited_s": round(waited, 1)}
@@ -661,11 +666,13 @@ def main() -> int:
         # 1600s: the attention block sweep adds ~3 compiles on a cold
         # chip, the speculative block compiles chained while_loops, and
         # the engine-level serving benches step through the tunnel.
-        # When the bounded-backoff probe never saw the backend, one short
-        # guarded attempt still runs (the probe can false-negative on a
-        # cold cache) but must not stall the artifact for half an hour.
+        # When the bounded-backoff probe TRIED and never saw the backend,
+        # one short guarded attempt still runs (the probe can
+        # false-negative on a cold cache) but must not stall the artifact
+        # for half an hour.  attempts == 0 means the wait was DISABLED,
+        # not that the backend is down — keep the full timeout then.
         timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "1600"))
-        if probe["ok"]
+        if probe["ok"] or probe["attempts"] == 0
         else float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S_DOWN", "240"))
     )
     data["backend_probe"] = probe

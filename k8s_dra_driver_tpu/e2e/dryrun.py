@@ -242,6 +242,45 @@ def run_dryrun(n_devices: int) -> None:
     print(f"dryrun_multichip: mesh data={n_devices} (sharded serving, "
           f"{sum(len(c.generated) for c in served)} tokens) ok")
 
+    # Distributed PAGED inference in the production configuration: block
+    # pool + slot axis sharded over the mesh (shard-local block tables,
+    # collective-free decode loop), composed with speculative rounds and
+    # per-request LoRA adapters — and the streams must be bit-identical
+    # to the single-device engine's.
+    from k8s_dra_driver_tpu.models import lora as lora_mod
+    from k8s_dra_driver_tpu.models.paged import PagedServeEngine
+
+    lcfg = lora_mod.LoraConfig(rank=2, alpha=4.0)
+    adapters = [
+        lora_mod.init_adapters(jax.random.PRNGKey(7 + i), cfg, lcfg)
+        for i in range(2)
+    ]
+    bank = lora_mod.stack_adapters(cfg, lcfg, adapters)
+    paged_kw = dict(
+        cfg=cfg, n_slots=n_devices, n_blocks=8 * n_devices, block_size=4,
+        prompt_bucket=16, attn_impl="xla", spec_gamma=2, adapter_bank=bank,
+        prefix_cache_blocks=2,
+    )
+    p_params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    streams = {}
+    for tag, mesh_arg in (("sharded", ep_mesh), ("single", None)):
+        peng = PagedServeEngine(
+            params=p_params, mesh=mesh_arg, slot_axis="data", **paged_kw
+        )
+        for i in range(n_devices):
+            peng.submit([1 + i, 2, 3, 4, 5], max_tokens=4, adapter=i % 3)
+        peng.run_until_drained()
+        streams[tag] = {
+            c.request_id: c.generated for c in peng.completions()
+        }
+    assert streams["sharded"] == streams["single"], (
+        f"sharded paged streams diverged: {streams}"
+    )
+    assert len(streams["sharded"]) == n_devices
+    print(f"dryrun_multichip: mesh data={n_devices} (sharded PAGED serving "
+          f"+ spec + lora, {sum(map(len, streams['sharded'].values()))} "
+          f"tokens, bit-equal single-device) ok")
+
 
 def _pick_devices(n_devices: int):
     """Prefer the forced-CPU virtual platform for dry runs; on hosts where

@@ -368,11 +368,120 @@ KINDS = {
 }
 
 
-def to_json(obj: Any) -> dict:
+# ---------------------------------------------------------------------------
+# Versioned serde seam: resource.k8s.io/v1beta1 <-> v1
+#
+# The internal model stays v1beta1-shaped (the version the reference pins);
+# the seam converts at the WIRE level, so the types can follow upstream's
+# graduation without a rewrite.  The two structural differences on this
+# surface:
+#   * ResourceSlice devices: v1beta1 wraps per-device data in ``basic:``
+#     and renders capacity as plain quantity strings; v1 flattens the
+#     device and wraps each capacity in ``{value: <quantity>}``.
+#   * ResourceClaim requests: v1 moves the single-device fields under the
+#     ``exactly:`` one-of (deviceClassName/selectors/allocationMode/count/
+#     adminAccess).
+# DeviceClass is shape-identical in both versions.
+# ---------------------------------------------------------------------------
+
+RESOURCE_API_VERSIONS = ("resource.k8s.io/v1beta1", "resource.k8s.io/v1")
+
+
+def _claim_spec_to_v1(spec: dict) -> dict:
+    devices = dict(spec.get("devices") or {})
+    reqs = []
+    for r in devices.get("requests") or []:
+        r = dict(r)
+        exactly = {
+            k: r.pop(k)
+            for k in (
+                "deviceClassName", "selectors", "allocationMode", "count",
+                "adminAccess",
+            )
+            if k in r
+        }
+        reqs.append({**r, "exactly": exactly})
+    if reqs:
+        devices["requests"] = reqs
+    return {**spec, "devices": devices}
+
+
+def _claim_spec_from_v1(spec: dict) -> dict:
+    devices = dict(spec.get("devices") or {})
+    reqs = []
+    for r in devices.get("requests") or []:
+        r = dict(r)
+        exactly = r.pop("exactly", None) or {}
+        reqs.append({**r, **exactly})
+    if reqs:
+        devices["requests"] = reqs
+    return {**spec, "devices": devices}
+
+
+def _to_v1_wire(kind: str, data: dict) -> dict:
+    data = _fast_deepcopy(data)
+    if kind == "ResourceSlice":
+        for dev in (data.get("spec") or {}).get("devices") or []:
+            basic = dev.pop("basic", None) or {}
+            dev.update(basic)
+            if "capacity" in dev:
+                dev["capacity"] = {
+                    k: {"value": v} for k, v in dev["capacity"].items()
+                }
+    elif kind == "ResourceClaim":
+        if data.get("spec"):
+            data["spec"] = _claim_spec_to_v1(data["spec"])
+    elif kind == "ResourceClaimTemplate":
+        tmpl = data.get("spec") or {}
+        if tmpl.get("spec"):
+            tmpl["spec"] = _claim_spec_to_v1(tmpl["spec"])
+    return data
+
+
+def _from_v1_wire(kind: str, body: dict) -> dict:
+    body = _fast_deepcopy(body)
+    if kind == "ResourceSlice":
+        for dev in (body.get("spec") or {}).get("devices") or []:
+            if "basic" in dev:
+                continue  # already v1beta1-shaped
+            basic = {}
+            if "attributes" in dev:
+                basic["attributes"] = dev.pop("attributes")
+            if "capacity" in dev:
+                basic["capacity"] = {
+                    k: (v["value"] if isinstance(v, dict) else v)
+                    for k, v in dev.pop("capacity").items()
+                }
+            if basic:
+                dev["basic"] = basic
+    elif kind == "ResourceClaim":
+        if body.get("spec"):
+            body["spec"] = _claim_spec_from_v1(body["spec"])
+    elif kind == "ResourceClaimTemplate":
+        tmpl = body.get("spec") or {}
+        if tmpl.get("spec"):
+            tmpl["spec"] = _claim_spec_from_v1(tmpl["spec"])
+    return body
+
+
+def to_json(obj: Any, api_version: str | None = None) -> dict:
+    """Render ``obj`` for the wire.  ``api_version`` selects the serialized
+    version for resource.k8s.io kinds (default: the pinned v1beta1); other
+    groups ignore it."""
     data = serde.to_json(obj)
     kind = getattr(type(obj), "KIND", None)
     if kind:
-        data = {"apiVersion": type(obj).API_VERSION, "kind": kind, **data}
+        ver = type(obj).API_VERSION
+        if api_version is not None and ver.startswith("resource.k8s.io/"):
+            if api_version not in RESOURCE_API_VERSIONS:
+                raise ValueError(
+                    f"unsupported resource.k8s.io version {api_version!r} "
+                    f"(known: {RESOURCE_API_VERSIONS})"
+                )
+            ver = api_version
+            if api_version.endswith("/v1"):
+                data = _to_v1_wire(kind, data)
+        data = {"apiVersion": ver, "kind": kind, **data}
     return data
 
 
@@ -381,6 +490,8 @@ def from_json(data: dict) -> Any:
     if kind not in KINDS:
         raise ValueError(f"unknown kind {kind!r}")
     body = {k: v for k, v in data.items() if k not in ("apiVersion", "kind")}
+    if data.get("apiVersion") == "resource.k8s.io/v1":
+        body = _from_v1_wire(kind, body)
     return serde.from_json(KINDS[kind], body)
 
 

@@ -478,6 +478,80 @@ def _paged_first_token(
     return tok[slot], cache
 
 
+def _paged_first_token_local(
+    params, cache, table, prompt, plen, onehot, temp, key, adapters,
+    *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool, axis: str,
+):
+    """shard_map body of the admission tail: like :func:`_paged_first_token`
+    but the target slot arrives as a ONE-HOT over the (locally sharded) slot
+    axis — a global slot index means nothing inside a shard — and the
+    sampled token leaves via ``psum`` so every device returns the same
+    replicated scalar.  Rows off this shard (onehot all-False) write the
+    null block, the same inactive-row contract as the step program."""
+    local = table.shape[0]
+    last_tok = prompt[0, plen - 1]
+    pos = jnp.full((local,), plen - 1, jnp.int32)
+    tok, cache = _paged_step_all(
+        params, cache, table,
+        jnp.full((local,), last_tok, jnp.int32),
+        pos, onehot,
+        jnp.full((local,), temp, jnp.float32),
+        jnp.broadcast_to(key, (local, *key.shape)),
+        adapters,
+        cfg=cfg, top_k=top_k, attn_impl=attn_impl, interpret=interpret,
+    )
+    tok_here = jnp.sum(jnp.where(onehot, tok, 0).astype(jnp.int32))
+    return jax.lax.psum(tok_here, axis), cache
+
+
+def _paged_prefill_masked(params, prompt, cache, row, flag, adapters, *, cfg):
+    """shard_map body of whole-prompt admission: every device runs the same
+    prefill program (SPMD), but only the pool shard whose ``flag`` is set
+    keeps the block writes — everyone else's scatter is diverted to their
+    local null block (the reserved scratch sink, never attended).  The
+    returned last-position logits are replicated by construction (prompt
+    and params are unvarying)."""
+    row = jnp.where(flag[0], row, NULL_BLOCK)
+    return paged_prefill(params, prompt, cache, row, adapters, cfg=cfg)
+
+
+def _paged_prefill_chunk_masked(
+    params, prompt, cache, row, done, flag, adapters, *, cfg, chunk_len,
+):
+    """shard_map body of chunked/suffix admission — same null-block
+    diversion as :func:`_paged_prefill_masked`.  Off-shard devices also
+    GATHER their own pool's bytes at the masked (null) ids for the done
+    prefix, which is garbage — harmless, because everything they compute
+    from it is scattered back into their null block."""
+    row = jnp.where(flag[0], row, NULL_BLOCK)
+    return paged_prefill_chunk(
+        params, prompt, cache, row, done, cfg=cfg, chunk_len=chunk_len,
+        adapters=adapters,
+    )
+
+
+def _prefill_draft_row_masked(draft_params, d_cache, prompt, plen, onehot, *, cfg):
+    """shard_map body of the DRAFT-cache admission write: the dense draft
+    cache shards its slot axis, so the one-row write becomes a select over
+    the local rows (``onehot`` picks at most one).  Mirrors
+    serve._prefill_draft_row's zero-tail contract."""
+    from k8s_dra_driver_tpu.models import serve
+
+    n_draft = d_cache.k.shape[0]
+    row, _ = decode.prefill(
+        draft_params, prompt, cfg, max_seq=d_cache.k.shape[2],
+        cache_dtype=d_cache.k.dtype,
+    )
+    keep = (jnp.arange(d_cache.k.shape[2]) < plen)[None, :, None, None]
+    new_k = jnp.where(keep, row.k[:n_draft, 0], 0).astype(d_cache.k.dtype)
+    new_v = jnp.where(keep, row.v[:n_draft, 0], 0).astype(d_cache.v.dtype)
+    sel = onehot[None, :, None, None, None]
+    return serve.KVCache(
+        k=jnp.where(sel, new_k[:, None], d_cache.k),
+        v=jnp.where(sel, new_v[:, None], d_cache.v),
+    )
+
+
 @dataclasses.dataclass
 class PagedServeEngine:
     """Continuous batching over the paged pool — the capacity-first engine.
@@ -551,6 +625,20 @@ class PagedServeEngine:
     # prompt_bucket can no longer re-prefill in one pass and becomes
     # unpreemptable; if every resident is, the wedge error stands.
     preempt_on_stall: bool = False
+    # Data-parallel PAGED serving: shard the SLOT axis over a mesh axis —
+    # each device owns n_slots/axis_size slots AND n_blocks/axis_size pool
+    # blocks (its own null block included), so the hot step's
+    # gather/scatter through the block table is LOCAL by construction
+    # (jax.shard_map; no collectives in the decode loop).  Block-table
+    # entries hold ids local to the owning shard; the host runs one
+    # allocator and prefix store per shard and admits a request to a slot
+    # whose shard has its blocks.  Every per-slot op is row-independent,
+    # so the engine's bit-equality contract extends to the sharded engine
+    # — paged + speculative + LoRA + prefix + chunked admission +
+    # preemption all compose (tested).  Weights replicate (TP composes at
+    # the params level, orthogonal to slot scheduling).
+    mesh: object | None = None
+    slot_axis: str = "data"
 
     def __post_init__(self):
         cfg = self.cfg
@@ -575,23 +663,90 @@ class PagedServeEngine:
         bs = self.block_size
         self._mb = blocks_needed(cfg.max_seq, bs)        # table width
         self._mbp = blocks_needed(self.prompt_bucket, bs)  # prefill width
-        self._alloc = BlockAllocator(self.n_blocks)
-        self._cache = init_paged_cache(cfg, self.n_blocks, bs, dtype=self.cache_dtype)
+        self._axis_size = 1
+        if self.mesh is not None:
+            if self.slot_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"slot_axis {self.slot_axis!r} is not a mesh axis "
+                    f"(mesh has {list(self.mesh.shape)})"
+                )
+            ax_size = self.mesh.shape[self.slot_axis]
+            if self.n_slots % ax_size:
+                raise ValueError(
+                    f"n_slots ({self.n_slots}) must divide over "
+                    f"{self.slot_axis!r} axis size {ax_size}"
+                )
+            if self.n_blocks % ax_size:
+                raise ValueError(
+                    f"n_blocks ({self.n_blocks}) must divide over "
+                    f"{self.slot_axis!r} axis size {ax_size}"
+                )
+            if self.n_blocks // ax_size < 2:
+                raise ValueError(
+                    f"n_blocks ({self.n_blocks}) leaves < 2 blocks per shard "
+                    f"(each shard reserves its own null block)"
+                )
+            self._axis_size = ax_size
+        # Slots and pool blocks partition CONTIGUOUSLY over the axis (the
+        # same split NamedSharding applies to the arrays), one allocator +
+        # prefix store per shard; table entries are SHARD-LOCAL block ids.
+        self._spg = self.n_slots // self._axis_size      # slots per shard
+        self._npd = self.n_blocks // self._axis_size     # blocks per shard
+        self._allocs = [BlockAllocator(self._npd) for _ in range(self._axis_size)]
+        # Group-0 views (THE group when unsharded) — the names tests and
+        # single-device tooling have always used.
+        self._alloc = self._allocs[0]
         self._table_np = np.full((self.n_slots, self._mb), NULL_BLOCK, np.int32)
-        self._table = jnp.asarray(self._table_np)
         self._owned: list[list[int]] = [[] for _ in range(self.n_slots)]
-        self._last = jnp.zeros((self.n_slots,), jnp.int32)
-        self._pos = jnp.zeros((self.n_slots,), jnp.int32)
-        self._temps = jnp.zeros((self.n_slots,), jnp.float32)
-        self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
         self._slots: list = [None] * self.n_slots
         self._next_id = 0
         self._completions: list = []
         self.stalled_steps = 0  # slot-steps skipped waiting for a block
         self._preempted: list[dict] = []  # FIFO of parked requests
         self.preempted_count = 0
-        self._adapter_ids = jnp.zeros((self.n_slots,), jnp.int32)
         self._n_adapters = 0
+        if self.mesh is None:
+            self._cache = init_paged_cache(
+                cfg, self.n_blocks, bs, dtype=self.cache_dtype
+            )
+            self._table = jnp.asarray(self._table_np)
+            self._last = jnp.zeros((self.n_slots,), jnp.int32)
+            self._pos = jnp.zeros((self.n_slots,), jnp.int32)
+            self._temps = jnp.zeros((self.n_slots,), jnp.float32)
+            self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
+            self._adapter_ids = jnp.zeros((self.n_slots,), jnp.int32)
+        else:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            slot_s = NamedSharding(self.mesh, P(self.slot_axis))
+            pool_s = NamedSharding(self.mesh, P(None, self.slot_axis))
+            # State is CREATED sharded (jit with out_shardings): the full
+            # unsharded pool never materializes on one device — at serving
+            # scale that intermediate is the peak-memory point (the dense
+            # engine's own pattern, serve.ServeEngine.__post_init__).
+            self._cache = jax.jit(
+                lambda: init_paged_cache(cfg, self.n_blocks, bs, dtype=self.cache_dtype),
+                out_shardings=PagedKVCache(k=pool_s, v=pool_s),
+            )()
+            make = jax.jit(
+                lambda: (
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                    jnp.zeros((self.n_slots,), jnp.float32),
+                    jnp.stack([jax.random.PRNGKey(0)] * self.n_slots),
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                ),
+                out_shardings=(slot_s, slot_s, slot_s, slot_s, slot_s),
+            )
+            self._last, self._pos, self._temps, self._keys, self._adapter_ids = (
+                make()
+            )
+            self.params = jax.device_put(
+                self.params, NamedSharding(self.mesh, P())
+            )
+            self._table = None
+            self._upload_table()
         if self.adapter_bank is not None:
             from k8s_dra_driver_tpu.models import lora
 
@@ -610,20 +765,74 @@ class PagedServeEngine:
         # resident request; submit()'s block-recovery path relies on the
         # old cache surviving a failed call.  One pool copy per admission,
         # amortized over the request's whole token stream, buys that.
-        self._step_fn = jax.jit(
-            functools.partial(_paged_step_all, **kw), donate_argnums=(1,)
-        )
-        self._first_fn = jax.jit(functools.partial(_paged_first_token, **kw))
-        self._prefill_fn = jax.jit(functools.partial(paged_prefill, cfg=cfg))
+        self._chunk_fns: dict = {}  # mesh path: chunk_len -> compiled fn
+        if self.mesh is None:
+            self._step_fn = jax.jit(
+                functools.partial(_paged_step_all, **kw), donate_argnums=(1,)
+            )
+            self._first_fn = jax.jit(functools.partial(_paged_first_token, **kw))
+            self._prefill_fn = jax.jit(functools.partial(paged_prefill, cfg=cfg))
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            ax = self.slot_axis
+            # shard_map specs: pool blocks + dense-cache slots shard the
+            # same axis as the per-slot row vectors; params, prompts and
+            # single-row admission adapters replicate.  The hot loop is
+            # local by construction — no collective anywhere in the step
+            # (the only psum in the engine is the admission tail's scalar
+            # token broadcast).
+            cache_p = PagedKVCache(k=P(None, ax), v=P(None, ax))
+            row_p = P(ax)
+            ad_p = (P(), P(ax)) if self.adapter_bank is not None else P()
+            self._step_fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(_paged_step_all, **kw),
+                    mesh=self.mesh,
+                    in_specs=(P(), cache_p, row_p, row_p, row_p, row_p,
+                              row_p, row_p, ad_p),
+                    out_specs=(row_p, cache_p),
+                ),
+                donate_argnums=(1,),
+            )
+            self._first_fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(_paged_first_token_local, **kw, axis=ax),
+                    mesh=self.mesh,
+                    in_specs=(P(), cache_p, row_p, P(), P(), row_p, P(),
+                              P(), ad_p),
+                    out_specs=(P(), cache_p),
+                )
+            )
+            self._prefill_fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(_paged_prefill_masked, cfg=cfg),
+                    mesh=self.mesh,
+                    in_specs=(P(), P(), cache_p, P(), row_p, P()),
+                    out_specs=(cache_p, P()),
+                )
+            )
         from collections import OrderedDict
 
-        # prefix store: tokens[0:(i+1)*bs] -> pool block id (holds one ref)
-        self._prefix_store: OrderedDict = OrderedDict()
+        # prefix stores, one per pool shard (ONE store when unsharded):
+        # tokens[0:(i+1)*bs] -> shard-local pool block id (holds one ref)
+        self._prefix_stores: list[OrderedDict] = [
+            OrderedDict() for _ in range(self._axis_size)
+        ]
+        self._prefix_store = self._prefix_stores[0]  # group-0 view
         self.prefix_hits = 0     # blocks reused across submits
         self.prefix_misses = 0   # storable blocks computed fresh
         # chunked-admission queue: FIFO of dicts, head advances one chunk
         # per step() (see prefill_chunk_blocks)
         self._admitting: list[dict] = []
+        # Multi-controller serving: when the mesh spans OS processes,
+        # host readbacks of sharded state must allgather (every process
+        # runs this same scheduler in lockstep — the standard JAX
+        # multi-controller pattern, same as the dense engine).
+        self._multiprocess = self.mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in self.mesh.devices.flat
+        )
         self._d_cache = self._spec_fn = self._draft_prefill_fn = None
         if self.spec_gamma > 0:
             from k8s_dra_driver_tpu.models import serve
@@ -632,21 +841,60 @@ class PagedServeEngine:
                 self.params, self.draft_params, cfg, self.n_slots,
                 self.cache_dtype,
             )
-            self._spec_fn = jax.jit(
-                functools.partial(
-                    _paged_spec_round, cfg=cfg, gamma=self.spec_gamma,
-                    attn_impl=self.attn_impl, interpret=self.interpret,
-                ),
-                donate_argnums=(2, 3),  # pool + draft cache, like _step_fn
-            )
-            self._draft_prefill_fn = jax.jit(
-                functools.partial(serve._prefill_draft_row, cfg=cfg)
-            )
+            if self.mesh is None:
+                self._spec_fn = jax.jit(
+                    functools.partial(
+                        _paged_spec_round, cfg=cfg, gamma=self.spec_gamma,
+                        attn_impl=self.attn_impl, interpret=self.interpret,
+                    ),
+                    donate_argnums=(2, 3),  # pool + draft cache, like _step_fn
+                )
+                self._draft_prefill_fn = jax.jit(
+                    functools.partial(serve._prefill_draft_row, cfg=cfg)
+                )
+            else:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                ax = self.slot_axis
+                cache_p = PagedKVCache(k=P(None, ax), v=P(None, ax))
+                dkv_p = serve.KVCache(k=P(None, ax), v=P(None, ax))
+                row_p = P(ax)
+                ad_p = (P(), P(ax)) if self.adapter_bank is not None else P()
+                # make_draft_state built the draft cache unsharded (a
+                # transient the size of ONE dense cache — not the pool);
+                # commit it to the slot sharding the round fns expect.
+                dkv_s = NamedSharding(self.mesh, P(None, ax))
+                self._d_cache = jax.device_put(self._d_cache, dkv_s)
+                self.draft_params = jax.device_put(
+                    self.draft_params, NamedSharding(self.mesh, P())
+                )
+                self._spec_fn = jax.jit(
+                    jax.shard_map(
+                        functools.partial(
+                            _paged_spec_round, cfg=cfg, gamma=self.spec_gamma,
+                            attn_impl=self.attn_impl, interpret=self.interpret,
+                        ),
+                        mesh=self.mesh,
+                        in_specs=(P(), P(), cache_p, dkv_p, row_p, row_p,
+                                  row_p, row_p, ad_p),
+                        out_specs=(row_p, row_p, cache_p, dkv_p),
+                    ),
+                    donate_argnums=(2, 3),
+                )
+                self._draft_prefill_fn = jax.jit(
+                    jax.shard_map(
+                        functools.partial(_prefill_draft_row_masked, cfg=cfg),
+                        mesh=self.mesh,
+                        in_specs=(P(), dkv_p, P(), P(), row_p),
+                        out_specs=dkv_p,
+                    )
+                )
 
     # -- public API --------------------------------------------------------
     @property
     def free_blocks(self) -> int:
-        return self._alloc.free_blocks
+        return sum(a.free_blocks for a in self._allocs)
 
     def free_slots(self) -> int:
         return sum(1 for s in self._slots if s is None)
@@ -685,19 +933,20 @@ class PagedServeEngine:
                 raise RuntimeError(
                     "no free slot (preempted requests pending re-admission)"
                 )
-        try:
-            slot = self._slots.index(None)
-        except ValueError:
-            raise RuntimeError("no free slot") from None
+        free = [s for s in range(self.n_slots) if self._slots[s] is None]
+        if not free:
+            raise RuntimeError("no free slot")
         # padded prompt first: it is pure (no pool state), so a failure
-        # here can never strand allocated blocks
-        padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
-        padded = padded.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+        # here can never strand allocated blocks.  numpy ON PURPOSE: host
+        # arrays shard cleanly into any jitted program from every process
+        # of a multi-controller mesh; committed device arrays would not.
+        padded = np.zeros((1, self.prompt_bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
         request_id = self._next_id
-        base_key = jax.random.PRNGKey(request_id if seed is None else seed)
-        # ids set BEFORE the prefill: the admission tail's first-token step
-        # already runs with this slot's adapter
-        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+        # numpy key for the same multi-controller reason as ``padded``
+        base_key = np.asarray(
+            jax.random.PRNGKey(request_id if seed is None else seed)
+        )
 
         # Prefix-store hit walk: the longest run of leading FULL blocks
         # whose token content is already pooled.  Two caps: (plen-1)//bs
@@ -709,33 +958,26 @@ class PagedServeEngine:
         # reason); (bucket-1)//bs keeps the suffix chunk's width real.
         bs = self.block_size
         storable = min((len(prompt) - 1) // bs, (self.prompt_bucket - 1) // bs)
-        cached_ids: list[int] = []
-        if self.prefix_cache_blocks > 0:
-            for i in range(storable):
-                key = self._prefix_key(prompt, i, adapter)
-                if key not in self._prefix_store:
-                    break
-                self._prefix_store.move_to_end(key)  # LRU touch
-                cached_ids.append(self._alloc.share(self._prefix_store[key]))
-        cached = len(cached_ids)
-        self.prefix_hits += cached
-        if self.prefix_cache_blocks > 0 and storable > 0:
-            serve._M_PREFIX.inc(outcome="hit" if cached else "miss")
         # blocks for the prompt AND the first generated token's position;
         # shared prefix blocks satisfy the first `cached` entries
         need = blocks_needed(len(prompt) + 1, bs)
-        try:
-            ids = cached_ids + self._alloc.alloc(need - cached)
-        except OutOfBlocks:
-            self._alloc.free(cached_ids)  # drop the hit refs we just took
+        picked = self._pick_slot(prompt, need, storable, adapter)
+        if picked is None:
             raise RuntimeError(
-                f"no free blocks ({need - cached} needed, "
-                f"{self._alloc.free_blocks} free)"
-            ) from None
+                f"no free blocks ({need} needed, {self.free_blocks} free "
+                f"across {self._axis_size} shard(s))"
+            )
+        slot, ids, cached = picked
+        self.prefix_hits += cached
+        if self.prefix_cache_blocks > 0 and storable > 0:
+            serve._M_PREFIX.inc(outcome="hit" if cached else "miss")
+        # ids set BEFORE the prefill: the admission tail's first-token step
+        # already runs with this slot's adapter
+        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
         self._owned[slot] = ids
         self._table_np[slot, :] = NULL_BLOCK
         self._table_np[slot, :need] = ids
-        self._table = jnp.asarray(self._table_np)
+        self._upload_table()
 
         if self.prefill_chunk_blocks > 0:
             # Chunked admission: reserve the slot now, prefill at most
@@ -763,34 +1005,26 @@ class PagedServeEngine:
             # Prefill writes ceil(bucket/bs) block stripes; entries past the
             # row's owned blocks are the null block (a scratch sink — those
             # positions are beyond plen+1 and re-written before ever attended).
-            prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+            prefill_row = self._table_np[slot : slot + 1, : self._mbp].copy()
             row_ad = self._row_adapters(adapter)
             if cached:
-                self._cache = paged_prefill_suffix(
-                    self.params, padded, self._cache, prefill_row,
-                    cfg=self.cfg, cached_blocks=cached, adapters=row_ad,
-                )
+                self._run_prefill_suffix(padded, prefill_row, cached, slot, row_ad)
             else:
-                self._cache, _ = self._prefill_fn(
-                    self.params, padded, self._cache, prefill_row, row_ad
-                )
+                self._run_prefill(padded, prefill_row, slot, row_ad)
             self._store_prefix_blocks(prompt, slot, storable, cached, adapter)
             if self.spec_gamma > 0:
                 # the draft model needs the prompt's k/v too (its layers)
-                self._d_cache = self._draft_prefill_fn(
-                    self.draft_params, self._d_cache, padded, len(prompt), slot
-                )
-            first_tok, self._cache = self._first_fn(
-                self.params, self._cache, self._table, padded, len(prompt), slot,
-                jnp.float32(temperature), base_key, self._adapters(),
+                self._run_draft_prefill(padded, len(prompt), slot)
+            first_tok = self._first_token(
+                padded, len(prompt), slot, temperature, base_key
             )
         except BaseException:
             # a failed admission (device OOM, interrupt) must return its
             # blocks — the slot was never occupied, so nothing else will
-            self._alloc.free(self._owned[slot])
+            self._alloc_for(slot).free(self._owned[slot])
             self._owned[slot] = []
             self._table_np[slot, :] = NULL_BLOCK
-            self._table = jnp.asarray(self._table_np)
+            self._upload_table()
             raise
         self._next_id += 1
         self._slots[slot] = _Slot(
@@ -821,14 +1055,13 @@ class PagedServeEngine:
         # prefilling it would only delay activation — first-token latency
         # must scale with the prompt, not the bucket
         real_end = min(blocks_needed(adm["plen"], bs) * bs, self.prompt_bucket)
-        prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+        prefill_row = self._table_np[slot : slot + 1, : self._mbp].copy()
         try:
             row_ad = self._row_adapters(adm.get("adapter", 0))
             if real_end - adm["done"] * bs > self.prefill_chunk_blocks * bs:
-                self._cache = paged_prefill_chunk(
-                    self.params, adm["padded"], self._cache, prefill_row,
-                    adm["done"], cfg=self.cfg,
-                    chunk_len=self.prefill_chunk_blocks * bs, adapters=row_ad,
+                self._run_prefill_chunk(
+                    adm["padded"], prefill_row, adm["done"],
+                    self.prefill_chunk_blocks * bs, slot, row_ad,
                 )
                 adm["done"] += self.prefill_chunk_blocks
                 return
@@ -836,20 +1069,14 @@ class PagedServeEngine:
             # then activation
             chunk_len = real_end - adm["done"] * bs
             if chunk_len > 0:
-                self._cache = paged_prefill_chunk(
-                    self.params, adm["padded"], self._cache, prefill_row,
-                    adm["done"], cfg=self.cfg, chunk_len=chunk_len,
-                    adapters=row_ad,
+                self._run_prefill_chunk(
+                    adm["padded"], prefill_row, adm["done"], chunk_len,
+                    slot, row_ad,
                 )
             if self.spec_gamma > 0:
-                self._d_cache = self._draft_prefill_fn(
-                    self.draft_params, self._d_cache, adm["padded"],
-                    adm["plen"], slot,
-                )
-            first_tok, self._cache = self._first_fn(
-                self.params, self._cache, self._table, adm["padded"],
-                adm["plen"], slot, jnp.float32(adm["temp"]), adm["key"],
-                self._adapters(),
+                self._run_draft_prefill(adm["padded"], adm["plen"], slot)
+            first_tok = self._first_token(
+                adm["padded"], adm["plen"], slot, adm["temp"], adm["key"]
             )
         except BaseException as exc:
             # failed mid-admission: release the reservation entirely AND
@@ -859,10 +1086,10 @@ class PagedServeEngine:
             self._admitting.pop(0)
             st = self._slots[slot]
             self._slots[slot] = None
-            self._alloc.free(self._owned[slot])
+            self._alloc_for(slot).free(self._owned[slot])
             self._owned[slot] = []
             self._table_np[slot, :] = NULL_BLOCK
-            self._table = jnp.asarray(self._table_np)
+            self._upload_table()
             self._completions.append(
                 serve.Completion(
                     request_id=st.request_id, tokens=list(st.tokens),
@@ -894,7 +1121,7 @@ class PagedServeEngine:
         admitting = {a["slot"] for a in self._admitting}
         active = np.zeros((self.n_slots,), bool)
         table_dirty = False
-        pos_np = np.asarray(self._pos)
+        pos_np = self._readback(self._pos)
         for slot, st in enumerate(self._slots):
             if st is None or slot in admitting:
                 continue
@@ -902,7 +1129,7 @@ class PagedServeEngine:
             grew = True
             while len(self._owned[slot]) < needed:
                 try:
-                    (new_id,) = self._alloc.alloc(1)
+                    (new_id,) = self._alloc_for(slot).alloc(1)
                 except OutOfBlocks:
                     self.stalled_steps += 1  # resumes after a retirement
                     grew = False
@@ -914,15 +1141,19 @@ class PagedServeEngine:
                 active[slot] = True
         return active, table_dirty
 
-    def _preempt_one(self) -> bool:
+    def _preempt_one(self, group: int | None = None) -> bool:
         """Evict the YOUNGEST resumable resident request (highest request
         id still short enough to re-prefill): free its blocks, park its
-        tokens and sampler state on the re-admission queue.  Returns
-        whether a victim was evicted."""
+        tokens and sampler state on the re-admission queue.  ``group``
+        restricts victims to one pool shard (evicting elsewhere cannot
+        free the wedged shard's blocks).  Returns whether a victim was
+        evicted."""
         admitting = {a["slot"] for a in self._admitting}
         victim, vslot = None, -1
         for slot, st in enumerate(self._slots):
             if st is None or slot in admitting:
+                continue
+            if group is not None and self._group(slot) != group:
                 continue
             if len(st.tokens) + 1 > self.prompt_bucket:
                 continue  # grown past one-pass re-prefill: not resumable
@@ -930,16 +1161,17 @@ class PagedServeEngine:
                 victim, vslot = st, slot
         if victim is None:
             return False
-        temps = np.asarray(self._temps)
-        ads = np.asarray(self._adapter_ids)
+        temps = self._readback(self._temps)
+        ads = self._readback(self._adapter_ids)
+        keys = self._readback(self._keys)
         self._preempted.append(
             dict(
-                st=victim, temp=float(temps[vslot]), key=self._keys[vslot],
+                st=victim, temp=float(temps[vslot]), key=keys[vslot],
                 adapter=int(ads[vslot]),
             )
         )
         self._slots[vslot] = None
-        self._alloc.free(self._owned[vslot])
+        self._alloc_for(vslot).free(self._owned[vslot])
         self._owned[vslot] = []
         self._table_np[vslot, :] = NULL_BLOCK
         # table upload deferred: the caller (_grow_or_preempt) batches the
@@ -966,59 +1198,43 @@ class PagedServeEngine:
             st = r["st"]
             tokens = st.tokens
             bs = self.block_size
-            try:
-                slot = self._slots.index(None)
-            except ValueError:
-                return
             adapter = r.get("adapter", 0)
-            cached_ids: list[int] = []
-            if self.prefix_cache_blocks > 0:
-                storable = min((len(tokens) - 1) // bs, (self.prompt_bucket - 1) // bs)
-                for i in range(storable):
-                    key = self._prefix_key(tokens, i, adapter)
-                    if key not in self._prefix_store:
-                        break
-                    self._prefix_store.move_to_end(key)
-                    cached_ids.append(self._alloc.share(self._prefix_store[key]))
-            cached = len(cached_ids)
+            storable = min(
+                (len(tokens) - 1) // bs, (self.prompt_bucket - 1) // bs
+            )
             need = blocks_needed(len(tokens) + 1, bs)
-            if self._alloc.free_blocks < need - cached:
-                self._alloc.free(cached_ids)  # drop the hit refs we took
-                return
-            ids = cached_ids + self._alloc.alloc(need - cached)
+            picked = self._pick_slot(tokens, need, storable, adapter)
+            if picked is None:
+                return  # stays parked (FIFO head blocks the queue)
+            slot, ids, cached = picked
             self._owned[slot] = ids
             self._table_np[slot, :] = NULL_BLOCK
             self._table_np[slot, :need] = ids
-            self._table = jnp.asarray(self._table_np)
-            padded = jnp.zeros((1, self.prompt_bucket), jnp.int32)
-            padded = padded.at[0, : len(tokens)].set(jnp.asarray(tokens, jnp.int32))
-            prefill_row = jnp.asarray(self._table_np[slot : slot + 1, : self._mbp])
+            self._upload_table()
+            padded = np.zeros((1, self.prompt_bucket), np.int32)
+            padded[0, : len(tokens)] = tokens
+            prefill_row = self._table_np[slot : slot + 1, : self._mbp].copy()
             self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
             row_ad = self._row_adapters(adapter)
             try:
                 if cached:
-                    self._cache = paged_prefill_suffix(
-                        self.params, padded, self._cache, prefill_row,
-                        cfg=self.cfg, cached_blocks=cached, adapters=row_ad,
+                    self._run_prefill_suffix(
+                        padded, prefill_row, cached, slot, row_ad
                     )
                 else:
-                    self._cache, _ = self._prefill_fn(
-                        self.params, padded, self._cache, prefill_row, row_ad
-                    )
+                    self._run_prefill(padded, prefill_row, slot, row_ad)
                 if self.spec_gamma > 0:
-                    self._d_cache = self._draft_prefill_fn(
-                        self.draft_params, self._d_cache, padded, len(tokens), slot
-                    )
+                    self._run_draft_prefill(padded, len(tokens), slot)
             except BaseException as exc:
                 # failed re-admission: release the reservation AND surface
                 # an errored Completion — the caller holds the request id,
                 # and a silently re-parked request is indistinguishable
                 # from one still streaming (same contract as the chunked-
                 # admission failure path)
-                self._alloc.free(ids)
+                self._alloc_for(slot).free(ids)
                 self._owned[slot] = []
                 self._table_np[slot, :] = NULL_BLOCK
-                self._table = jnp.asarray(self._table_np)
+                self._upload_table()
                 self._preempted.pop(0)
                 self._completions.append(
                     serve.Completion(
@@ -1037,21 +1253,38 @@ class PagedServeEngine:
             self._update_gauges()
 
     def _grow_or_preempt(self, lookahead: int):
-        """_grow_active_slots, escalating to preemption when the whole
-        resident set stalls with nothing admitting (preempt_on_stall).
-        Evictions mark the table dirty; the device upload batches with the
-        caller's."""
+        """_grow_active_slots, escalating to preemption when a SHARD's
+        whole resident set stalls with nothing admitting there
+        (preempt_on_stall).  Per-shard on purpose: a wedged shard's pool
+        only breathes through its own retirements, which a fully stalled
+        set never produces — no matter how busy the other shards are
+        (with one shard, this is exactly the old whole-engine rule).
+        Evictions mark the table dirty; the device upload batches with
+        the caller's."""
         active, table_dirty = self._grow_active_slots(lookahead)
-        if self.preempt_on_stall and not active.any() and not self._admitting:
-            while any(s is not None for s in self._slots):
-                if not self._preempt_one():
-                    break
-                table_dirty = True  # victim rows were NULLed host-side
-                active, dirty2 = self._grow_active_slots(lookahead)
-                table_dirty = table_dirty or dirty2
-                if active.any():
-                    break
-            self._update_gauges()
+        if self.preempt_on_stall:
+            admitting_groups = {
+                self._group(a["slot"]) for a in self._admitting
+            }
+            evicted = False
+            for g in range(self._axis_size):
+                if g in admitting_groups:
+                    continue  # the admitting head will activate and retire
+                slots_g = range(g * self._spg, (g + 1) * self._spg)
+                while True:
+                    resident = [
+                        s for s in slots_g if self._slots[s] is not None
+                    ]
+                    if not resident or any(active[s] for s in resident):
+                        break
+                    if not self._preempt_one(group=g):
+                        break
+                    evicted = True
+                    table_dirty = True  # victim rows were NULLed host-side
+                    active, dirty2 = self._grow_active_slots(lookahead)
+                    table_dirty = table_dirty or dirty2
+            if evicted:
+                self._update_gauges()
         return active, table_dirty
 
     def _spec_step(self) -> int:
@@ -1065,8 +1298,8 @@ class PagedServeEngine:
         if not active.any():
             return 0
         if table_dirty:
-            self._table = jnp.asarray(self._table_np)
-        active_j = jnp.asarray(active)
+            self._upload_table()
+        active_j = self._slot_device(active)
         target, advance, self._cache, self._d_cache = self._spec_fn(
             self.params, self.draft_params, self._cache, self._d_cache,
             self._table, self._last, self._pos, active_j, self._adapters(),
@@ -1075,8 +1308,8 @@ class PagedServeEngine:
         new_last = target[rows, jnp.maximum(advance - 1, 0)]
         self._last = jnp.where(active_j, new_last, self._last)
         self._pos = self._pos + advance  # advance is already 0 when inactive
-        tgt = np.asarray(target)
-        adv = np.asarray(advance)
+        tgt = self._readback(target)
+        adv = self._readback(advance)
         committed = 0
         for slot, st in enumerate(self._slots):
             if st is None or not active[slot]:
@@ -1106,15 +1339,15 @@ class PagedServeEngine:
         if not active.any():
             return 0
         if table_dirty:
-            self._table = jnp.asarray(self._table_np)
-        active_j = jnp.asarray(active)
+            self._upload_table()
+        active_j = self._slot_device(active)
         next_tok, self._cache = self._step_fn(
             self.params, self._cache, self._table, self._last, self._pos,
             active_j, self._temps, self._keys, self._adapters(),
         )
         self._last = jnp.where(active_j, next_tok, self._last)
         self._pos = jnp.where(active_j, self._pos + 1, self._pos)
-        toks = np.asarray(next_tok).tolist()
+        toks = self._readback(next_tok).tolist()
         from k8s_dra_driver_tpu.models import serve
 
         serve._M_TOKENS.inc(int(active.sum()))
@@ -1143,6 +1376,181 @@ class PagedServeEngine:
         return out
 
     # -- internals ---------------------------------------------------------
+    def _group(self, slot: int) -> int:
+        """Pool shard owning this slot (always 0 when unsharded) — the
+        contiguous split NamedSharding applies to the slot axis."""
+        return slot // self._spg
+
+    def _alloc_for(self, slot: int) -> BlockAllocator:
+        return self._allocs[self._group(slot)]
+
+    def _pick_slot(self, tokens, need: int, storable: int, adapter: int):
+        """Admission slot choice, shared by submit() and _readmit(): walk
+        the free slots for the first whose SHARD can serve ``need`` blocks
+        (prefix hits are shard-local — a stored block only helps requests
+        admitted to the shard holding it — and count toward ``need``).
+        One candidate per shard: a second slot on a shard that just
+        refused cannot do better.  Deterministic order, so every
+        controller of a multi-process mesh picks the same slot.  On
+        success the blocks are ALLOCATED: returns (slot, ids, n_cached);
+        None when no free slot / no shard has capacity (any prefix refs
+        taken along the way are dropped again)."""
+        tried: set[int] = set()
+        for cand in range(self.n_slots):
+            if self._slots[cand] is not None:
+                continue
+            g = self._group(cand)
+            if g in tried:
+                continue
+            tried.add(g)
+            hits: list[int] = []
+            if self.prefix_cache_blocks > 0:
+                store = self._prefix_stores[g]
+                for i in range(storable):
+                    key = self._prefix_key(tokens, i, adapter)
+                    if key not in store:
+                        break
+                    store.move_to_end(key)  # LRU touch
+                    hits.append(self._allocs[g].share(store[key]))
+            try:
+                ids = hits + self._allocs[g].alloc(need - len(hits))
+            except OutOfBlocks:
+                self._allocs[g].free(hits)  # drop the hit refs we just took
+                continue
+            return cand, ids, len(hits)
+        return None
+
+    def _upload_table(self) -> None:
+        """Host block table -> device, sharded over the slot axis when a
+        mesh is set.  device_put FROM NUMPY on purpose: host arrays commit
+        to a global sharding from every process of a multi-controller
+        mesh; re-sharding a committed local device array would not."""
+        if self.mesh is None:
+            self._table = jnp.asarray(self._table_np)
+        else:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            self._table = jax.device_put(
+                self._table_np, NamedSharding(self.mesh, P(self.slot_axis))
+            )
+
+    def _slot_device(self, arr):
+        """Host per-slot vector -> device, slot-axis sharded under a mesh
+        (same numpy-origin rule as :meth:`_upload_table`)."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return jax.device_put(
+            np.asarray(arr), NamedSharding(self.mesh, P(self.slot_axis))
+        )
+
+    def _slot_onehot(self, slot: int):
+        """The sharded stand-in for a global slot index: shard_map bodies
+        can't interpret one (rows are shard-local), a one-hot they can."""
+        return self._slot_device(np.arange(self.n_slots) == slot)
+
+    def _group_flag(self, group: int):
+        """[axis_size] one-hot over pool shards — each device of the mesh
+        sees a single bool: 'do I keep this admission's block writes'."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        flag = np.zeros((self._axis_size,), bool)
+        flag[group] = True
+        return jax.device_put(
+            flag, NamedSharding(self.mesh, P(self.slot_axis))
+        )
+
+    def _readback(self, x) -> np.ndarray:
+        """Device -> host for state that may be sharded across PROCESSES:
+        remote shards cannot be addressed directly, so the multi-process
+        path allgathers (every controller runs the same step, so every
+        controller needs the same full vector anyway)."""
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    def _run_prefill(self, padded, prefill_row, slot, row_ad) -> None:
+        """Whole-prompt admission prefill into ``slot``'s shard."""
+        if self.mesh is None:
+            self._cache, _ = self._prefill_fn(
+                self.params, padded, self._cache, prefill_row, row_ad
+            )
+        else:
+            self._cache, _ = self._prefill_fn(
+                self.params, padded, self._cache, prefill_row,
+                self._group_flag(self._group(slot)), row_ad,
+            )
+
+    def _run_prefill_chunk(
+        self, padded, prefill_row, done, chunk_len, slot, row_ad
+    ) -> None:
+        """Chunked/suffix admission prefill.  Mesh path compiles one
+        masked variant per distinct ``chunk_len`` (same bounded set as the
+        unsharded module fns — the chunk width and the final widths)."""
+        if self.mesh is None:
+            self._cache = paged_prefill_chunk(
+                self.params, padded, self._cache, prefill_row, done,
+                cfg=self.cfg, chunk_len=chunk_len, adapters=row_ad,
+            )
+            return
+        fn = self._chunk_fns.get(chunk_len)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            ax = self.slot_axis
+            cache_p = PagedKVCache(k=P(None, ax), v=P(None, ax))
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(
+                        _paged_prefill_chunk_masked, cfg=self.cfg,
+                        chunk_len=chunk_len,
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(P(), P(), cache_p, P(), P(), P(ax), P()),
+                    out_specs=cache_p,
+                )
+            )
+            self._chunk_fns[chunk_len] = fn
+        self._cache = fn(
+            self.params, padded, self._cache, prefill_row, done,
+            self._group_flag(self._group(slot)), row_ad,
+        )
+
+    def _run_prefill_suffix(self, padded, prefill_row, cached, slot, row_ad):
+        """Prefix-hit admission = one chunk covering everything after the
+        shared prefix (the engine-level twin of paged_prefill_suffix)."""
+        self._run_prefill_chunk(
+            padded, prefill_row, cached,
+            padded.shape[1] - cached * self.block_size, slot, row_ad,
+        )
+
+    def _run_draft_prefill(self, padded, plen, slot) -> None:
+        if self.mesh is None:
+            self._d_cache = self._draft_prefill_fn(
+                self.draft_params, self._d_cache, padded, plen, slot
+            )
+        else:
+            self._d_cache = self._draft_prefill_fn(
+                self.draft_params, self._d_cache, padded, plen,
+                self._slot_onehot(slot),
+            )
+
+    def _first_token(self, padded, plen, slot, temp, key):
+        """Admission tail dispatch: global slot index unsharded, one-hot
+        sharded.  Returns the first generated token (replicated scalar)."""
+        sel = slot if self.mesh is None else self._slot_onehot(slot)
+        tok, self._cache = self._first_fn(
+            self.params, self._cache, self._table, padded, plen, sel,
+            jnp.float32(temp), np.asarray(key), self._adapters(),
+        )
+        return tok
+
     def _prefix_key(self, prompt: list[int], i: int, adapter: int):
         """Store key for prompt block i: token content, plus the adapter id
         when a bank is live — adapted k/v must never cross fine-tunes."""
@@ -1171,17 +1579,17 @@ class PagedServeEngine:
         if self.prefix_cache_blocks <= 0:
             return
         self.prefix_misses += max(storable - cached, 0)
+        g = self._group(slot)
+        store = self._prefix_stores[g]
         for i in range(cached, storable):
             key = self._prefix_key(prompt, i, adapter)
-            if key in self._prefix_store:
-                self._prefix_store.move_to_end(key)
+            if key in store:
+                store.move_to_end(key)
                 continue
-            self._prefix_store[key] = self._alloc.share(
-                int(self._table_np[slot, i])
-            )
-        while len(self._prefix_store) > self.prefix_cache_blocks:
-            _, old = self._prefix_store.popitem(last=False)  # LRU evict
-            self._alloc.free([old])
+            store[key] = self._allocs[g].share(int(self._table_np[slot, i]))
+        while len(store) > self.prefix_cache_blocks:
+            _, old = store.popitem(last=False)  # LRU evict
+            self._allocs[g].free([old])
 
     def _retire(self, slot: int) -> None:
         from k8s_dra_driver_tpu.models import serve
@@ -1192,16 +1600,16 @@ class PagedServeEngine:
         if done is not None:
             self._completions.append(done)
             self._slots[slot] = None
-            self._alloc.free(self._owned[slot])
+            self._alloc_for(slot).free(self._owned[slot])
             self._owned[slot] = []
             self._table_np[slot, :] = NULL_BLOCK
-            self._table = jnp.asarray(self._table_np)
+            self._upload_table()
 
     def _update_gauges(self) -> None:
         from k8s_dra_driver_tpu.models import serve
 
         serve._M_OCCUPANCY.set(self.n_slots - self.free_slots())
-        _M_POOL_FREE.set(self._alloc.free_blocks)
+        _M_POOL_FREE.set(self.free_blocks)
 
 
 @functools.partial(

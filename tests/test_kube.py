@@ -85,6 +85,59 @@ class TestSerde:
         assert back.metadata.name == "c"
 
 
+class TestV1SerdeSeam:
+    """resource.k8s.io/v1 wire seam: the same internal objects round-trip
+    under BOTH apiVersions (v1 flattens ResourceSlice devices + wraps
+    capacity values; ResourceClaim requests move under ``exactly:``)."""
+
+    def _slice(self):
+        rs = ResourceSlice(metadata=ObjectMeta(name="s1"))
+        rs.spec.driver = "tpu.google.com"
+        rs.spec.devices = [make_device("tpu-0", type="tpu", index=3)]
+        rs.spec.devices[0].basic.capacity = {"memorySlice0": "16Gi"}
+        return rs
+
+    def test_resource_slice_v1_wire_shape(self):
+        data = objects.to_json(self._slice(), api_version="resource.k8s.io/v1")
+        assert data["apiVersion"] == "resource.k8s.io/v1"
+        dev = data["spec"]["devices"][0]
+        assert "basic" not in dev  # v1 flattens the one-of wrapper
+        assert dev["attributes"]["index"] == {"int": 3}
+        assert dev["capacity"]["memorySlice0"] == {"value": "16Gi"}
+
+    def test_resource_slice_roundtrips_both_versions(self):
+        rs = self._slice()
+        for ver in objects.RESOURCE_API_VERSIONS:
+            data = objects.to_json(rs, api_version=ver)
+            back = objects.from_json(data)
+            assert objects.to_json(back) == objects.to_json(rs), ver
+
+    def test_resource_claim_roundtrips_both_versions(self):
+        claim = ResourceClaim(metadata=ObjectMeta(name="c"))
+        claim.spec.devices.requests = [
+            objects.DeviceRequest(
+                name="tpus", device_class_name="tpu.google.com", count=4
+            )
+        ]
+        v1 = objects.to_json(claim, api_version="resource.k8s.io/v1")
+        req = v1["spec"]["devices"]["requests"][0]
+        assert req["exactly"]["deviceClassName"] == "tpu.google.com"
+        assert req["exactly"]["count"] == 4
+        assert "deviceClassName" not in req
+        for ver in objects.RESOURCE_API_VERSIONS:
+            back = objects.from_json(objects.to_json(claim, api_version=ver))
+            assert objects.to_json(back) == objects.to_json(claim), ver
+
+    def test_unknown_resource_version_rejected(self):
+        with pytest.raises(ValueError, match="unsupported resource.k8s.io"):
+            objects.to_json(self._slice(), api_version="resource.k8s.io/v2")
+
+    def test_non_resource_kinds_ignore_version_override(self):
+        node = Node(metadata=ObjectMeta(name="n"))
+        data = objects.to_json(node, api_version="resource.k8s.io/v1")
+        assert data["apiVersion"] == "v1"
+
+
 class TestNodeSelector:
     def test_terms_or_expressions_and(self):
         sel = NodeSelector(

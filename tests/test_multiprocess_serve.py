@@ -62,6 +62,112 @@ print(json.dumps({
 """.replace("REQS", REQS)
 
 
+PAGED_WORKER = r"""
+import json
+from k8s_dra_driver_tpu import consumer
+
+ctx = consumer.attach()  # real jax.distributed.initialize over TCP
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from k8s_dra_driver_tpu.models import burnin, lora
+from k8s_dra_driver_tpu.models.paged import PagedServeEngine
+
+cfg = burnin.ModelConfig(
+    vocab_size=61, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=32
+)
+params = burnin.init_params(jax.random.PRNGKey(0), cfg)  # same on all hosts
+lcfg = lora.LoraConfig(rank=2, alpha=4.0)
+bank = lora.stack_adapters(
+    cfg, lcfg,
+    [lora.init_adapters(jax.random.PRNGKey(7 + i), cfg, lcfg) for i in range(2)],
+)
+mesh = Mesh(np.array(jax.devices()), ("data",))  # 2 hosts x 2 devices
+eng = PagedServeEngine(
+    params=params, cfg=cfg, n_slots=4, n_blocks=32, block_size=4,
+    prompt_bucket=8, attn_impl="xla", spec_gamma=2, adapter_bank=bank,
+    mesh=mesh, slot_axis="data",
+)
+pending = list(REQS)
+streams = {}
+for _ in range(500):
+    while pending:
+        prompt, max_tokens, adapter = pending[0]
+        try:
+            eng.submit(prompt, max_tokens, adapter=adapter)
+            pending.pop(0)
+        except RuntimeError:
+            break
+    stepped = eng.step()
+    for c in eng.completions():
+        streams[c.request_id] = c.generated
+    if not pending and stepped == 0 and eng.free_slots() == eng.n_slots:
+        break
+print(json.dumps({
+    "worker": ctx.worker_id,
+    "process_count": jax.process_count(),
+    "global_devices": len(jax.devices()),
+    "streams": {str(k): v for k, v in streams.items()},
+}))
+"""
+
+# paged mix exercises per-request adapters on top of speculative rounds
+PAGED_REQS = "[([5, 9, 2], 6, 0), ([11, 3], 8, 1), ([7, 7, 7, 1], 5, 2), ([2], 7, 0)]"
+
+
+def test_two_process_dp_sharded_paged_engine_bit_equal(tmp_path):
+    """The PRODUCTION serving shape across REAL processes: paged pool +
+    speculative rounds + per-request LoRA, slot/pool axes sharded over a
+    2-process global mesh — streams bit-equal the single-process engine."""
+    cluster = make_cluster(
+        hosts=2, topology="v5e-16", work_dir=str(tmp_path), slice_domain="mp-paged"
+    )
+    manager = SliceManager(cluster.server)
+    manager.start()
+    try:
+        outs = run_two_process_workers(
+            cluster, tmp_path, PAGED_WORKER.replace("REQS", PAGED_REQS)
+        )
+        assert sorted(o["worker"] for o in outs) == [0, 1]
+        for o in outs:
+            assert o["process_count"] == 2
+            assert o["global_devices"] == 4
+        assert outs[0]["streams"] == outs[1]["streams"]
+        assert sorted(outs[0]["streams"]) == ["0", "1", "2", "3"]
+
+        # ...and they are the SAME tokens the single-process engine serves
+        import jax
+
+        from k8s_dra_driver_tpu.models import burnin, lora
+        from k8s_dra_driver_tpu.models.paged import PagedServeEngine
+
+        cfg = burnin.ModelConfig(
+            vocab_size=61, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=32
+        )
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        lcfg = lora.LoraConfig(rank=2, alpha=4.0)
+        bank = lora.stack_adapters(
+            cfg, lcfg,
+            [lora.init_adapters(jax.random.PRNGKey(7 + i), cfg, lcfg)
+             for i in range(2)],
+        )
+        ref = PagedServeEngine(
+            params=params, cfg=cfg, n_slots=4, n_blocks=32, block_size=4,
+            prompt_bucket=8, attn_impl="xla", spec_gamma=2, adapter_bank=bank,
+        )
+        for prompt, max_tokens, adapter in [
+            ([5, 9, 2], 6, 0), ([11, 3], 8, 1), ([7, 7, 7, 1], 5, 2),
+            ([2], 7, 0),
+        ]:
+            ref.submit(prompt, max_tokens, adapter=adapter)
+        ref.run_until_drained()
+        want = {str(c.request_id): c.generated for c in ref.completions()}
+        assert outs[0]["streams"] == want
+    finally:
+        manager.stop()
+
+
 def test_two_process_dp_sharded_engine_serves_identical_streams(tmp_path):
     cluster = make_cluster(
         hosts=2, topology="v5e-16", work_dir=str(tmp_path), slice_domain="mp-serve"
