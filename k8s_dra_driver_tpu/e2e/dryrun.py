@@ -281,6 +281,60 @@ def run_dryrun(n_devices: int) -> None:
           f"+ spec + lora, {sum(map(len, streams['sharded'].values()))} "
           f"tokens, bit-equal single-device) ok")
 
+    # MULTISLICE serving: DP across two virtual slices, driven by the
+    # exact env contract the driver injects for a slice-group claim
+    # (demo/specs/quickstart/multislice-test1.yaml -> plugin/device_state
+    # MEGASCALE_* wiring -> consumer.attach).  Slots shard over
+    # ('slice', 'data'); the serving hot loop is row-local, so nothing
+    # crosses the slow DCN axis per step — and streams must still be
+    # bit-equal a single-slice engine's.  Gated like the pipeline stage:
+    # the device set must split into two slices.  The mesh builds over
+    # the dry run's OWN device pick (never bare jax.devices(): on hosts
+    # where an accelerator plugin wins the default-backend race that
+    # call dials the device link this module must stay off).
+    if n_devices >= 2 and n_devices % 2 == 0:
+        from k8s_dra_driver_tpu import consumer as consumer_mod
+        from k8s_dra_driver_tpu.parallel.mesh import (
+            auto_mesh_shape,
+            build_multislice_mesh,
+        )
+
+        ctx = consumer_mod.attach(
+            environ={
+                "MEGASCALE_NUM_SLICES": "2",
+                "MEGASCALE_SLICE_ID": "0",
+                "MEGASCALE_COORDINATOR_ADDRESS": "localhost:8081",
+            },
+            init_distributed=False,
+        )
+        assert ctx.multi_slice, "slice-group env contract not recognized"
+        ms_serve_mesh = build_multislice_mesh(
+            devices, ctx.num_slices,
+            auto_mesh_shape(n_devices // ctx.num_slices),
+        )
+        ms_streams = {}
+        for tag, mesh_arg, ax in (
+            ("multislice", ms_serve_mesh, ("slice", "data")),
+            ("single", None, "data"),
+        ):
+            eng = ServeEngine(
+                p_params, cfg, n_slots=4, prompt_bucket=16,
+                mesh=mesh_arg, slot_axis=ax,
+            )
+            for i in range(4):
+                eng.submit([3 + i, 1, 4], max_tokens=4)
+            eng.run_until_drained()
+            ms_streams[tag] = {
+                c.request_id: c.generated for c in eng.completions()
+            }
+        assert ms_streams["multislice"] == ms_streams["single"], (
+            f"multislice streams diverged: {ms_streams}"
+        )
+        print(f"dryrun_multichip: mesh slice=2 (multislice DP serving over "
+              f"('slice','data'), "
+              f"{sum(map(len, ms_streams['multislice'].values()))} "
+              f"tokens, bit-equal single-slice) ok")
+
 
 def _pick_devices(n_devices: int):
     """Prefer the forced-CPU virtual platform for dry runs; on hosts where

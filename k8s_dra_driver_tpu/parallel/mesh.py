@@ -115,6 +115,25 @@ def build_multislice_mesh(devices, n_slices: int, shape: MeshShape) -> Mesh:
     return Mesh(arr, MULTISLICE_AXES)
 
 
+def slot_axis_size(mesh: Mesh, slot_axis) -> int:
+    """Validate a serving engine's ``slot_axis`` (one mesh axis name or a
+    tuple of them — e.g. ``("slice", "data")`` for multislice DP serving)
+    against ``mesh`` and return the total shard count.  Shared by the
+    dense and paged engines so their semantics cannot drift."""
+    names = (slot_axis,) if isinstance(slot_axis, str) else tuple(slot_axis)
+    if not names:
+        raise ValueError("slot_axis must name at least one mesh axis")
+    if len(set(names)) != len(names):
+        raise ValueError(f"slot_axis {names} repeats a mesh axis")
+    missing = [n for n in names if n not in mesh.shape]
+    if missing:
+        raise ValueError(
+            f"slot_axis {missing} not a mesh axis "
+            f"(mesh has {list(mesh.shape)})"
+        )
+    return math.prod(mesh.shape[n] for n in names)
+
+
 def validate_claimed_mesh(mesh: Mesh, env: dict[str, str]) -> None:
     """Cross-check a mesh against the driver-injected bounds env."""
     bounds = env.get("TPU_CHIPS_PER_PROCESS_BOUNDS")
