@@ -232,6 +232,12 @@ def _data_plane_body(sink: dict | None = None) -> dict:
             out["serving"] = _serving_benchmark()
         except Exception as exc:  # noqa: BLE001
             out["serving"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # Preemption priced under pool pressure (VERDICT r4 weak #6): the
+        # same churn against a starved pool, stall-only vs evict+resume.
+        try:
+            out["serving_preemption"] = _serving_preemption_benchmark()
+        except Exception as exc:  # noqa: BLE001
+            out["serving_preemption"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out
 
 
@@ -306,6 +312,126 @@ def _paged_throughput(
     }
 
 
+def _drive_serving(eng, requests, adapter: int = 0) -> dict:
+    """FIFO-queue drive loop shared by the serving benches: submit as
+    capacity frees (parked preempted requests keep the loop alive), step,
+    collect completions, report wall-clock engine metrics.
+
+    Wedge-aware (run_until_drained's check, inlined because this loop
+    interleaves submits): a starved pool with ``preempt_on_stall=False``
+    can DEADLOCK — every resident stalls on a block none will ever free.
+    The loop then reports ``wedged: true`` with the partial counts
+    instead of spinning; that failure mode is itself the headline result
+    of the preemption bench."""
+    n_requests = len(requests)
+    queue = list(requests)
+    ttfts: list[float] = []
+    completions = []
+    steps = 0
+    start = time.perf_counter()
+    while queue or eng.free_slots() < eng.n_slots or eng._preempted:
+        submitted = False
+        while queue and eng.free_slots() > 0:
+            prompt, mt = queue[0]
+            t0 = time.perf_counter()
+            try:
+                eng.submit(prompt, max_tokens=mt, adapter=adapter)
+            except RuntimeError:
+                break  # out of blocks / parked pending: step until freed
+            submitted = True
+            ttfts.append(time.perf_counter() - t0)
+            queue.pop(0)
+        stepped = eng.step()
+        steps += 1
+        completions.extend(eng.completions())
+        if not stepped and not submitted and not eng._admitting:
+            if eng.free_slots() < eng.n_slots or eng._preempted or queue:
+                break  # wedged: resident slots (or parked work), no progress
+    wall = time.perf_counter() - start
+    gen = sum(len(c.generated) for c in completions)
+    wedged = len(completions) != n_requests
+    return {
+        "tokens_per_s": round(gen / wall, 1),
+        "requests_per_s": round(len(completions) / wall, 2),
+        "mean_ttft_ms": round(1000 * sum(ttfts) / max(len(ttfts), 1), 1),
+        "generated_tokens": gen,
+        "completed_requests": len(completions),
+        "engine_steps": steps,
+        "tokens_per_step": round(gen / steps, 2),
+        "wall_s": round(wall, 2),
+        **({"wedged": True} if wedged else {}),
+    }
+
+
+def _serving_preemption_benchmark(
+    n_slots=8, block_size=128, n_requests=24, n_blocks=17
+) -> dict:
+    """Price recompute-preemption under REAL pool pressure: every request
+    sits just under a block boundary and generates across it, against a
+    pool ~½ the resident working set — so slots stall on mid-flight
+    growth (not merely at admission), all-stall escalates to eviction,
+    and parked requests resume bit-exactly.  Stall-only vs
+    preempt-and-resume; the informative numbers are the on/off
+    tokens-per-second ratio and the stall/preemption counts — absolute
+    throughput is dispatch-RTT-bound like the serving block (vLLM's
+    recompute preemption is the analog; models/paged.py
+    ``preempt_on_stall``)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_tpu.models import burnin, paged
+
+    cfg = burnin.ModelConfig(
+        vocab_size=8192, d_model=512, n_heads=8, n_kv_heads=2, n_layers=4,
+        d_ff=2048, max_seq=2048, rope=True,
+    )
+    params = burnin.init_params(jax.random.PRNGKey(7), cfg)
+    rng = np.random.default_rng(5)
+    # 8 tokens under each boundary; every generation crosses at least one
+    plens = [120, 248, 376, 504]
+    mtoks = [16, 40, 64]
+    requests = [
+        (
+            rng.integers(0, cfg.vocab_size, plens[i % len(plens)]).tolist(),
+            mtoks[i % len(mtoks)],
+        )
+        for i in range(n_requests)
+    ]
+
+    def pressured(preempt: bool) -> tuple[dict, object]:
+        eng = paged.PagedServeEngine(
+            params=params, cfg=cfg, n_slots=n_slots, n_blocks=n_blocks,
+            block_size=block_size, prompt_bucket=512,
+            cache_dtype=jnp.bfloat16, preempt_on_stall=preempt,
+        )
+        return _drive_serving(eng, requests), eng
+
+    off, eng_off = pressured(False)
+    on, eng_on = pressured(True)
+    return {
+        "n_blocks": n_blocks,
+        "preempt_off": {**off, "stalled_steps": eng_off.stalled_steps},
+        "preempt_on": {
+            **on,
+            "stalled_steps": eng_on.stalled_steps,
+            "preemptions": eng_on.preempted_count,
+        },
+        "on_vs_off_tokens_per_s": (
+            None
+            if off.get("wedged") or on.get("wedged")
+            else round(on["tokens_per_s"] / max(off["tokens_per_s"], 1e-9), 2)
+        ),
+        "note": (
+            "pool ~1/2 of working set; a wedged preempt_off leg IS the "
+            "result — stall-only serving deadlocks where recompute-"
+            "preemption completes the workload (why the engine defaults "
+            "preempt_on_stall=True)"
+        ),
+    }
+
+
 def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
     """ENGINE-level serving on the live chip: PagedServeEngine driven with
     mixed-length churn (prompts 48..448 tokens, 24..56 generated, slots
@@ -351,36 +477,7 @@ def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
             cache_dtype=jnp.bfloat16, spec_gamma=spec_gamma,
             adapter_bank=adapter_bank,
         )
-        queue = list(requests)
-        ttfts: list[float] = []
-        completions = []
-        steps = 0
-        start = time.perf_counter()
-        while queue or eng.free_slots() < n_slots:
-            while queue and eng.free_slots() > 0:
-                prompt, mt = queue[0]
-                t0 = time.perf_counter()
-                try:
-                    eng.submit(prompt, max_tokens=mt, adapter=adapter)
-                except RuntimeError:
-                    break  # out of blocks: decode until a retirement frees
-                ttfts.append(time.perf_counter() - t0)
-                queue.pop(0)
-            eng.step()
-            steps += 1
-            completions.extend(eng.completions())
-        wall = time.perf_counter() - start
-        gen = sum(len(c.generated) for c in completions)
-        assert len(completions) == n_requests, "serving bench lost requests"
-        return {
-            "tokens_per_s": round(gen / wall, 1),
-            "requests_per_s": round(n_requests / wall, 2),
-            "mean_ttft_ms": round(1000 * sum(ttfts) / len(ttfts), 1),
-            "generated_tokens": gen,
-            "engine_steps": steps,
-            "tokens_per_step": round(gen / steps, 2),
-            "wall_s": round(wall, 2),
-        }
+        return _drive_serving(eng, requests, adapter=adapter)
 
     plain = drive(0)
     spec = drive(4)

@@ -616,15 +616,30 @@ class PagedServeEngine:
     # stays sound — the any-draft contract).
     adapter_bank: dict | None = None
     # Preemption (vLLM's recompute fallback): when the pool is exhausted
-    # and EVERY resident slot stalls, evict the YOUNGEST resumable request
-    # — free its blocks, park its tokens + sampler state, re-prefill it
-    # when the pool breathes — instead of deadlocking until a retirement
-    # that may never come.  Resumption is bit-exact: sampling keys fold by
-    # absolute position (serve.sample_next), so the re-admitted stream
-    # continues exactly where it stopped (tested).  A request grown past
-    # prompt_bucket can no longer re-prefill in one pass and becomes
-    # unpreemptable; if every resident is, the wedge error stands.
-    preempt_on_stall: bool = False
+    # and EVERY resident slot stalls, evict the lowest-priority resumable
+    # request — free its blocks, park its tokens + sampler state,
+    # re-prefill it when the pool breathes — instead of deadlocking until
+    # a retirement that may never come.  Resumption is bit-exact:
+    # sampling keys fold by absolute position (serve.sample_next), so the
+    # re-admitted stream continues exactly where it stopped (tested).  A
+    # request grown past prompt_bucket can no longer re-prefill in one
+    # pass and becomes unpreemptable; if every resident is, the wedge
+    # error stands.  Default ON from measurement (bench
+    # `serving_preemption` block): under a pool ~half the working set the
+    # stall-only engine DEADLOCKS at 0 completed requests where
+    # preemption completes the whole workload — vLLM ships recompute
+    # preemption on by default for the same reason.  Set False only when
+    # the pool is provisioned for the full resident worst case and the
+    # admission-time wedge error is preferred over eviction latency.
+    #
+    # Per-request PRIORITY (submit(..., priority=k), higher = more
+    # important) orders every scarcity decision: block growth under a
+    # tight pool serves high-priority slots first (low-priority ones
+    # stall), preemption evicts the lowest-priority resumable victim
+    # (youngest within a tier), and re-admission drains high-priority
+    # parked requests first (FIFO within a tier).  Priority never changes
+    # WHAT a request generates — only when (tested).
+    preempt_on_stall: bool = True
     # Data-parallel PAGED serving: shard the SLOT axis over a mesh axis —
     # each device owns n_slots/axis_size slots AND n_blocks/axis_size pool
     # blocks (its own null block included), so the hot step's
@@ -699,6 +714,7 @@ class PagedServeEngine:
         self._alloc = self._allocs[0]
         self._table_np = np.full((self.n_slots, self._mb), NULL_BLOCK, np.int32)
         self._owned: list[list[int]] = [[] for _ in range(self.n_slots)]
+        self._prio: list[int] = [0] * self.n_slots
         self._slots: list = [None] * self.n_slots
         self._next_id = 0
         self._completions: list = []
@@ -907,10 +923,13 @@ class PagedServeEngine:
         temperature: float = 0.0,
         seed: int | None = None,
         adapter: int = 0,
+        priority: int = 0,
     ) -> int:
         """Admit when a slot AND the prompt's blocks are available; raises
         RuntimeError otherwise (admission control is the caller's).
-        ``adapter``: bank index for per-request LoRA (0 = the base)."""
+        ``adapter``: bank index for per-request LoRA (0 = the base).
+        ``priority``: scarcity ranking (see the class docstring) — it
+        orders stalls, evictions and re-admissions, never token content."""
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
@@ -975,6 +994,7 @@ class PagedServeEngine:
         # ids set BEFORE the prefill: the admission tail's first-token step
         # already runs with this slot's adapter
         self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+        self._prio[slot] = priority
         self._owned[slot] = ids
         self._table_np[slot, :] = NULL_BLOCK
         self._table_np[slot, :need] = ids
@@ -1123,7 +1143,18 @@ class PagedServeEngine:
         active = np.zeros((self.n_slots,), bool)
         table_dirty = False
         pos_np = self._readback(self._pos)
-        for slot, st in enumerate(self._slots):
+        # Scarcity order: high priority grows first (so a tight pool
+        # stalls the LOW-priority slots), older request first within a
+        # tier.  Deterministic for multi-controller lockstep.
+        order = sorted(
+            range(self.n_slots),
+            key=lambda s: (
+                -self._prio[s],
+                self._slots[s].request_id if self._slots[s] else 0,
+            ),
+        )
+        for slot in order:
+            st = self._slots[slot]
             if st is None or slot in admitting:
                 continue
             needed = (int(pos_np[slot]) + lookahead) // self.block_size + 1
@@ -1143,12 +1174,12 @@ class PagedServeEngine:
         return active, table_dirty
 
     def _preempt_one(self, group: int | None = None) -> bool:
-        """Evict the YOUNGEST resumable resident request (highest request
-        id still short enough to re-prefill): free its blocks, park its
-        tokens and sampler state on the re-admission queue.  ``group``
-        restricts victims to one pool shard (evicting elsewhere cannot
-        free the wedged shard's blocks).  Returns whether a victim was
-        evicted."""
+        """Evict the lowest-PRIORITY resumable resident request (youngest
+        — highest request id — within a tier, still short enough to
+        re-prefill): free its blocks, park its tokens and sampler state on
+        the re-admission queue.  ``group`` restricts victims to one pool
+        shard (evicting elsewhere cannot free the wedged shard's blocks).
+        Returns whether a victim was evicted."""
         admitting = {a["slot"] for a in self._admitting}
         victim, vslot = None, -1
         for slot, st in enumerate(self._slots):
@@ -1158,7 +1189,10 @@ class PagedServeEngine:
                 continue
             if len(st.tokens) + 1 > self.prompt_bucket:
                 continue  # grown past one-pass re-prefill: not resumable
-            if victim is None or st.request_id > victim.request_id:
+            if victim is None or (
+                (self._prio[slot], -st.request_id)
+                < (self._prio[vslot], -victim.request_id)
+            ):
                 victim, vslot = st, slot
         if victim is None:
             return False
@@ -1168,9 +1202,12 @@ class PagedServeEngine:
         self._preempted.append(
             dict(
                 st=victim, temp=float(temps[vslot]), key=keys[vslot],
-                adapter=int(ads[vslot]),
+                adapter=int(ads[vslot]), priority=self._prio[vslot],
             )
         )
+        # re-admission drains high priority first, FIFO within a tier
+        # (stable sort over park order)
+        self._preempted.sort(key=lambda r: -r.get("priority", 0))
         self._slots[vslot] = None
         self._alloc_for(vslot).free(self._owned[vslot])
         self._owned[vslot] = []
@@ -1216,6 +1253,7 @@ class PagedServeEngine:
             padded[0, : len(tokens)] = tokens
             prefill_row = self._table_np[slot : slot + 1, : self._mbp].copy()
             self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+            self._prio[slot] = r.get("priority", 0)
             row_ad = self._row_adapters(adapter)
             try:
                 if cached:

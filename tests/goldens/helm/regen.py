@@ -1,0 +1,42 @@
+"""Regenerate the helm render goldens in this directory.
+
+Run after an INTENDED chart or renderer change, review the diff, commit:
+    python tests/goldens/helm/regen.py
+The configs live in tools/helm_crosscheck.py (one source of truth for the
+goldens here and the real-helm comparison in CI).
+"""
+
+import pathlib
+import sys
+
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parents[3]
+sys.path.insert(0, str(REPO))
+
+from tools.helm_crosscheck import CHART, CONFIGS, _key  # noqa: E402
+from tools.helm_render import _parse_set, render_chart_docs  # noqa: E402
+
+HEADER = """\
+# GOLDEN render of the tpu-dra-driver chart — canonical (parsed, kind/name-
+# sorted, yaml.safe_dump) form, pinning tools/helm_render.py's semantics.
+# Regenerate: python tests/goldens/helm/regen.py
+# Cross-checked against REAL `helm template` by tools/helm_crosscheck.py
+# wherever a helm binary exists (the CI helm-crosscheck job); this hermetic
+# environment has none, so divergences surface there, regressions here.
+"""
+
+
+def canonical(sets: list[str]) -> str:
+    docs = render_chart_docs(CHART, values_override=_parse_set(sets))
+    docs = sorted(docs, key=lambda d: str(_key(d)))
+    return HEADER + "\n".join(
+        "---\n" + yaml.safe_dump(d, sort_keys=True) for d in docs
+    )
+
+
+if __name__ == "__main__":
+    here = pathlib.Path(__file__).parent
+    for name, sets in CONFIGS.items():
+        (here / f"{name}.yaml").write_text(canonical(sets))
+        print(f"wrote {name}.yaml")

@@ -13,6 +13,7 @@ import pathlib
 import pytest
 import yaml
 
+from tools.helm_crosscheck import CONFIGS as CROSSCHECK_CONFIGS
 from tools.helm_render import (
     ChartFail,
     RenderError,
@@ -400,3 +401,46 @@ class TestSelftestKnob:
         plugin = _by_kind(default_docs)["DaemonSet"][0]["spec"]["template"]["spec"]["containers"][0]
         env = {e["name"]: e.get("value") for e in plugin["env"]}
         assert "TPU_SELFTEST_INTERVAL_S" not in env
+
+
+class TestGoldenRender:
+    """Full-output golden comparison (the VERDICT-r4 golden-render check):
+    each pinned values configuration must render EXACTLY the canonical
+    document stream vendored under tests/goldens/helm/.  The goldens pin
+    the renderer's semantics against regression here; the CI
+    helm-crosscheck job compares the same configs against REAL
+    ``helm template`` (tools/helm_crosscheck.py) — whitespace is out of
+    scope by construction (comparison is post-YAML-parse).  Regenerate
+    after an intended change: python tests/goldens/helm/regen.py."""
+
+    GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "goldens" / "helm"
+
+    def _configs(self):
+        return CROSSCHECK_CONFIGS
+
+    def test_every_config_has_a_golden(self):
+        names = {p.stem for p in self.GOLDEN_DIR.glob("*.yaml")}
+        assert names == set(self._configs())
+
+    @pytest.mark.parametrize("name", sorted(CROSSCHECK_CONFIGS))
+    def test_render_matches_golden(self, name):
+        import importlib
+
+        regen = importlib.import_module("tests.goldens.helm.regen")
+        want = (self.GOLDEN_DIR / f"{name}.yaml").read_text()
+        got = regen.canonical(self._configs()[name])
+        assert got == want, (
+            f"{name} render diverged from its golden; if intended, "
+            f"regenerate via python tests/goldens/helm/regen.py"
+        )
+
+    def test_goldens_parse_and_carry_core_kinds(self):
+        docs = [
+            d
+            for d in yaml.safe_load_all(
+                (self.GOLDEN_DIR / "default.yaml").read_text()
+            )
+            if d
+        ]
+        kinds = {d["kind"] for d in docs}
+        assert {"DaemonSet", "Deployment", "DeviceClass", "ClusterRole"} <= kinds

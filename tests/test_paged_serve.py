@@ -146,6 +146,81 @@ class TestPreemption:
         out = {c.request_id: len(c.generated) for c in eng.completions()}
         assert out == {0: 20, 1: 20}  # both originals completed in full
 
+    def test_priority_picks_the_victim(self):
+        """Eviction targets the LOWEST-priority resumable request — the
+        plain youngest-first rule only breaks ties inside a tier.  Here
+        the younger request outranks the older one, so the old rule's
+        victim (the youngest) must survive."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=8, block_size=4,
+            prompt_bucket=32, preempt_on_stall=True,
+        )
+        eng.submit(self.REQS[0][0], 20, priority=0)   # request 0: low
+        eng.submit(self.REQS[1][0], 20, priority=5)   # request 1: high
+        for _ in range(200):
+            eng.step()
+            if eng.preempted_count:
+                break
+        assert eng.preempted_count == 1
+        assert eng._preempted[0]["st"].request_id == 0  # low prio parked
+        eng.run_until_drained()
+        out = {c.request_id: len(c.generated) for c in eng.completions()}
+        assert out == {0: 20, 1: 20}  # parked request still completes fully
+
+    def test_priority_orders_stalls_not_tokens(self):
+        """Under a tight pool, block growth serves high priority first —
+        but the streams stay bit-identical to an unpressured run."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        prios = [0, 5, 1, 3]
+        reqs = [([10 + i, 20 + i, 30 + i], 12) for i in range(4)]
+
+        def run(n_blocks):
+            eng = paged.PagedServeEngine(
+                params=params, cfg=CFG, n_slots=2, n_blocks=n_blocks,
+                block_size=4, prompt_bucket=32, preempt_on_stall=True,
+            )
+            pending = list(zip(reqs, prios))
+            out = {}
+            for _ in range(500):
+                while pending:
+                    (prompt, mt), pr = pending[0]
+                    try:
+                        eng.submit(prompt, mt, priority=pr)
+                        pending.pop(0)
+                    except RuntimeError:
+                        break
+                stepped = eng.step()
+                for c in eng.completions():
+                    out[c.request_id] = c.generated
+                if (not pending and stepped == 0
+                        and eng.free_slots() == eng.n_slots
+                        and not eng._preempted):
+                    return out
+            raise RuntimeError("did not drain")
+
+        assert run(n_blocks=64) == run(n_blocks=9)
+
+    def test_readmission_drains_high_priority_first(self):
+        """Multiple parked requests re-admit priority-first (FIFO within a
+        tier), not in park order."""
+        params = burnin.init_params(jax.random.PRNGKey(0), CFG)
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=3, n_blocks=10, block_size=4,
+            prompt_bucket=32, preempt_on_stall=True,
+        )
+        eng.submit([1, 2, 3, 4, 5, 6], 20, priority=2)
+        eng.submit([7, 8, 9, 10, 11, 12], 20, priority=0)
+        eng.submit([13, 14, 15, 16, 17, 18], 20, priority=1)
+        for _ in range(400):
+            eng.step()
+            if len(eng._preempted) >= 2:
+                break
+        prios = [r["priority"] for r in eng._preempted]
+        assert prios == sorted(prios, reverse=True)  # high first in queue
+        eng.run_until_drained()
+        assert {c.request_id for c in eng.completions()} == {0, 1, 2}
+
     def test_disabled_still_wedges(self):
         params = burnin.init_params(jax.random.PRNGKey(0), CFG)
         with pytest.raises(RuntimeError, match="wedged"):

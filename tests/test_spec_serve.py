@@ -120,11 +120,6 @@ class TestSpecServe:
             eng.submit([1, 2, 3], 4, temperature=0.7)
         with pytest.raises(ValueError, match="slack"):
             eng.submit([1, 2, 3], CFG.max_seq - 3)  # no room for gamma
-        with pytest.raises(ValueError, match="compose"):
-            ServeEngine(
-                params=params, cfg=CFG, n_slots=1, prompt_bucket=16,
-                spec_gamma=2, prefix_bucket=8,
-            )
 
     def test_slack_bound_is_exact(self, params):
         """The verify-window bound admits EXACTLY up to the deepest write:
@@ -170,3 +165,81 @@ class TestSpecServe:
         want = {c.request_id: c.generated for c in plain.completions()}
         assert streams == want
 
+
+
+class TestSpecComposition:
+    """The round-5 composition closes: speculative rounds on the DENSE
+    engine now compose with the slot-sharded mesh and with the prefix
+    cache — streams stay bit-equal the plain engine's either way."""
+
+    def _mesh(self, n):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices("cpu")[:n]), ("data",))
+
+    def test_spec_mesh_streams_identical(self, params):
+        reqs = [(p, 12) for p in _prompts(5)]
+        plain = ServeEngine(params=params, cfg=CFG, n_slots=4, prompt_bucket=16)
+        spec = ServeEngine(
+            params=params, cfg=CFG, n_slots=4, prompt_bucket=16,
+            spec_gamma=3, mesh=self._mesh(4), slot_axis="data",
+        )
+        assert _streams(plain, reqs) == _streams(spec, reqs)
+
+    def test_spec_prefix_streams_identical(self, params):
+        """Shared system prompt + speculation: the prefix-hit admission
+        path feeds the draft cache exactly like the miss path."""
+        sys_prefix = list(range(1, 9))  # 8 tokens = the prefix bucket
+        reqs = [(sys_prefix + p, 10) for p in _prompts(6, rng=3)]
+        plain = ServeEngine(
+            params=params, cfg=CFG, n_slots=2, prompt_bucket=32
+        )
+        spec = ServeEngine(
+            params=params, cfg=CFG, n_slots=2, prompt_bucket=32,
+            spec_gamma=2, prefix_bucket=8, prefix_cache_entries=4,
+        )
+        want = _streams(plain, reqs)
+        assert _streams(spec, reqs) == want
+        assert spec.prefix_hits > 0  # the cache actually served hits
+
+    def test_spec_mesh_prefix_lora_all_at_once(self, params):
+        """Everything the dense engine offers in one configuration."""
+        from k8s_dra_driver_tpu.models import lora
+
+        lcfg = lora.LoraConfig(rank=2, alpha=4.0)
+        bank = lora.stack_adapters(
+            CFG, lcfg,
+            [lora.init_adapters(jax.random.PRNGKey(5), CFG, lcfg)],
+        )
+        sys_prefix = list(range(1, 9))
+        reqs = [(sys_prefix + p, 8) for p in _prompts(4, rng=11)]
+
+        def drive(**kw):
+            eng = ServeEngine(
+                params=params, cfg=CFG, n_slots=4, prompt_bucket=32,
+                adapter_bank=bank, **kw,
+            )
+            pending = list(reqs)
+            out = {}
+            for _ in range(5000):
+                while pending:
+                    prompt, mt = pending[0]
+                    try:
+                        eng.submit(prompt, mt, adapter=1)
+                        pending.pop(0)
+                    except RuntimeError:
+                        break
+                stepped = eng.step()
+                for c in eng.completions():
+                    out[c.request_id] = c.generated
+                if (not pending and stepped == 0
+                        and eng.free_slots() == eng.n_slots):
+                    return out
+            raise RuntimeError("queue did not drain")
+
+        want = drive()
+        got = drive(
+            spec_gamma=2, prefix_bucket=8, mesh=self._mesh(4),
+            slot_axis="data",
+        )
+        assert got == want

@@ -1,0 +1,110 @@
+"""Cross-check tools/helm_render.py against REAL ``helm template``.
+
+The first-party renderer implements the Go-template subset the chart uses;
+this script pins that subset's SEMANTICS to upstream helm wherever a helm
+binary exists (CI has one; the hermetic dev environment does not — there the
+golden tests in tests/test_helm_render.py hold the line instead).
+
+For each values configuration it renders the chart both ways, parses the
+document streams, normalizes (sort by kind/name — document ORDER is a
+filename artifact in both renderers), and deep-compares the object trees.
+Whitespace and comments are out of scope by construction: the comparison is
+post-YAML-parse.
+
+Exit codes: 0 = all configs match, 1 = divergence (diff printed),
+3 = no helm binary on PATH (skipped).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CHART = REPO / "deployments" / "helm" / "tpu-dra-driver"
+
+# The same configurations the goldens pin (tests/test_helm_render.py).
+CONFIGS: dict[str, list[str]] = {
+    "default": [],
+    "openshift-extender": [
+        "openshift.enabled=true",
+        "extenderPort=8082",
+        "extenderTLSSecret=extender-tls",
+        'extenderAllowedCIDRs=["10.0.0.0/28"]',
+    ],
+    "fake-minimal": [
+        'deviceClasses=["tpu"]',
+        "fakeTopology=v5e-16",
+        "httpPort=-1",
+        "image.tag=dev",
+    ],
+}
+
+
+def _key(doc: dict) -> tuple:
+    return (
+        doc.get("kind", ""),
+        doc.get("metadata", {}).get("name", ""),
+        doc.get("metadata", {}).get("namespace", ""),
+    )
+
+
+def _ours(sets: list[str]) -> dict[tuple, dict]:
+    sys.path.insert(0, str(REPO))
+    from tools.helm_render import _parse_set, render_chart_docs
+
+    docs = render_chart_docs(CHART, values_override=_parse_set(sets))
+    return {_key(d): d for d in docs}
+
+
+def _helms(sets: list[str]) -> dict[tuple, dict]:
+    cmd = ["helm", "template", "tpu-dra-driver", str(CHART),
+           "--namespace", "tpu-dra-driver"]
+    for pair in sets:
+        # helm's --set grammar has no JSON lists/objects ({a,b} only);
+        # --set-json carries them with the same semantics _parse_set's
+        # yaml.safe_load gives the first-party renderer.
+        raw = pair.partition("=")[2]
+        if raw.startswith("[") or raw.startswith("{"):
+            cmd += ["--set-json", pair]
+        else:
+            cmd += ["--set", pair]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"helm template failed (rc={proc.returncode}): {proc.stderr.strip()}"
+        )
+    docs = [d for d in yaml.safe_load_all(proc.stdout) if d is not None]
+    return {_key(d): d for d in docs}
+
+
+def main() -> int:
+    if shutil.which("helm") is None:
+        print("helm_crosscheck: no helm binary on PATH — skipped")
+        return 3
+    failed = False
+    for name, sets in CONFIGS.items():
+        ours, helms = _ours(sets), _helms(sets)
+        if ours == helms:
+            print(f"helm_crosscheck: {name}: {len(ours)} docs match")
+            continue
+        failed = True
+        print(f"helm_crosscheck: {name}: DIVERGED", file=sys.stderr)
+        for k in sorted(set(ours) | set(helms), key=str):
+            a, b = ours.get(k), helms.get(k)
+            if a != b:
+                print(f"--- {k}: ours={'<absent>' if a is None else ''}"
+                      f" helm={'<absent>' if b is None else ''}",
+                      file=sys.stderr)
+                if a is not None and b is not None:
+                    print(yaml.safe_dump({"ours": a, "helm": b}),
+                          file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
