@@ -719,7 +719,9 @@ class PagedServeEngine:
         self._next_id = 0
         self._completions: list = []
         self.stalled_steps = 0  # slot-steps skipped waiting for a block
-        self._preempted: list[dict] = []  # FIFO of parked requests
+        # parked requests: priority-descending, FIFO within a tier
+        # (_preempt_one keeps it sorted)
+        self._preempted: list[dict] = []
         self.preempted_count = 0
         self._n_adapters = 0
         if self.mesh is None:
@@ -1219,8 +1221,9 @@ class PagedServeEngine:
         return True
 
     def _readmit(self) -> None:
-        """Re-prefill parked requests (FIFO) while a slot AND their blocks
-        are free.  The parked token list (prompt + generated so far)
+        """Re-prefill parked requests (priority-first, FIFO within a
+        tier — the queue order _preempt_one maintains) while a slot AND
+        their blocks are free.  The parked token list (prompt + generated so far)
         re-admits AS the prompt; the next step then generates the next
         token at the same position with the same fold-by-position sampler
         key — the stream continues bit-exactly.  The prefix store is

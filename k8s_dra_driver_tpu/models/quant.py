@@ -85,20 +85,28 @@ class Quantized4Matrix:
     materialized as a full-width bf16 weight every step — the
     "unpack-bound" decode_int4 tax."""
 
-    def __init__(self, packed, scale, group_size: int, dtype=jnp.bfloat16):
+    def __init__(self, packed, scale, group_size: int, dtype=jnp.bfloat16,
+                 kernel: bool = False):
         self.packed = packed        # [in//2, out] uint8, per-group halves
         self.scale = scale          # [in//group_size, out] f32
         self.group_size = group_size
         self.dtype = dtype
+        # Route matmul_last through the fused pallas dequant-dot kernel
+        # (ops/int4_matmul.py).  Part of the AUX data on purpose: the
+        # flag changes the traced program, and aux participates in the
+        # jit cache key, so flipping it retraces instead of silently
+        # reusing the other path's compilation.
+        self.kernel = kernel
 
     def tree_flatten(self):
-        return (self.packed, self.scale), (self.group_size, self.dtype)
+        return (self.packed, self.scale), (self.group_size, self.dtype,
+                                           self.kernel)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         packed, scale = children
-        group_size, dtype = aux
-        return cls(packed, scale, group_size, dtype)
+        group_size, dtype, kernel = aux
+        return cls(packed, scale, group_size, dtype, kernel)
 
     @property
     def shape(self):
@@ -109,7 +117,8 @@ class Quantized4Matrix:
         return 2
 
     @classmethod
-    def quantize(cls, w: jax.Array, group_size: int = 64, dtype=None):
+    def quantize(cls, w: jax.Array, group_size: int = 64, dtype=None,
+                 kernel: bool = False):
         """w: [in, out] float -> symmetric per-(group, column) int4."""
         dtype = dtype or w.dtype
         n_in, n_out = w.shape
@@ -128,7 +137,7 @@ class Quantized4Matrix:
         packed = (biased[:, :half] | (biased[:, half:] << 4)).reshape(
             n_in // 2, n_out
         )
-        return cls(packed, scale, group_size, dtype)
+        return cls(packed, scale, group_size, dtype, kernel)
 
     def dequant(self) -> jax.Array:
         """Unpack + group-scale in the compute dtype.  Two nibble-mask
@@ -162,15 +171,26 @@ def matmul_last(x, w):
     everything built on them), so quantized params are drop-in on the hot
     path too.  One dot in one place: the accumulation order is identical
     for quantized and plain weights (the bit-exactness contract
-    tests/test_quant.py pins), and a future fused dequant-dot kernel has
-    exactly one seam to land in."""
+    tests/test_quant.py pins).  The fused int4 dequant-dot kernel
+    (ops/int4_matmul.py) lands exactly here, opted in PER MATRIX
+    (``Quantized4Matrix.kernel`` — aux data, so flipping it retraces);
+    its K-tiled accumulation order differs from the one-dot XLA path, so
+    the bit-exactness contract stays pinned on the default."""
+    if isinstance(w, Quantized4Matrix) and w.kernel:
+        from k8s_dra_driver_tpu.ops import int4_matmul as i4
+
+        if i4.fits(w) and jax.default_backend() == "tpu":
+            return i4.int4_matmul(x, w)
     return x @ mat(w)
 
 
 _BLOCK_WEIGHT_KEYS = ("qkv", "attn_out", "mlp_up", "mlp_down")
 
 
-def quantize_blocks(params: dict, bits: int = 8, group_size: int = 64) -> dict:
+def quantize_blocks(
+    params: dict, bits: int = 8, group_size: int = 64,
+    kernel: bool | None = None,
+) -> dict:
     """Quantize the transformer-block matmul weights (the bulk of the
     parameter bytes); embeddings / norms / positions stay in the compute
     dtype (tied_logits indexes embed by row, and norm gains are tiny).
@@ -178,12 +198,19 @@ def quantize_blocks(params: dict, bits: int = 8, group_size: int = 64) -> dict:
     weight bytes again; the natural SPECULATIVE DRAFT, where int4's extra
     quantization error only moves acceptance, never output).
     ``group_size`` (int4 only): input rows per scale; pick one that
-    divides every block weight's input dim (d_model and d_ff)."""
+    divides every block weight's input dim (d_model and d_ff).
+    ``kernel`` (int4 only): route these matrices through the fused pallas
+    dequant-dot kernel (see matmul_last); None = the TPU_INT4_KERNEL=1
+    env opt-in."""
+    if kernel is None:
+        import os
+
+        kernel = os.environ.get("TPU_INT4_KERNEL", "") == "1"
     if bits == 8:
         quantizer = QuantizedMatrix.quantize
     elif bits == 4:
         quantizer = functools.partial(
-            Quantized4Matrix.quantize, group_size=group_size
+            Quantized4Matrix.quantize, group_size=group_size, kernel=kernel
         )
     else:
         raise ValueError(f"bits must be 8 or 4, got {bits}")

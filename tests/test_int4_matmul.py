@@ -1,0 +1,118 @@
+"""Fused int4 dequant-dot kernel (ops/int4_matmul.py): the opt-in
+throughput path for weight-only int4.  Interpret mode on CPU; the bench's
+decode_int4 block A/Bs it on the real chip (TPU_INT4_KERNEL=1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_dra_driver_tpu.models.quant import Quantized4Matrix
+from k8s_dra_driver_tpu.ops import int4_matmul as i4
+
+
+def _qm(k=256, n=256, gs=64, dtype=jnp.float32, seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed), (k, n), jnp.float32)
+    return Quantized4Matrix.quantize(w, group_size=gs, dtype=dtype)
+
+
+class TestKernel:
+    def test_matches_dequant_dot_f32(self):
+        qm = _qm()
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 256), jnp.float32)
+        want = x @ qm.dequant()
+        got = i4.int4_matmul(x, qm, block_n=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_matches_dequant_dot_bf16(self):
+        qm = _qm(dtype=jnp.bfloat16)
+        x = jax.random.normal(
+            jax.random.PRNGKey(2), (16, 256), jnp.float32
+        ).astype(jnp.bfloat16)
+        want = (x @ qm.dequant()).astype(jnp.float32)
+        got = i4.int4_matmul(
+            x, qm, block_n=128, block_k=128, interpret=True
+        ).astype(jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2
+        )
+
+    def test_single_k_tile_is_exact_order(self):
+        """With ONE K tile the kernel's accumulation order equals the
+        plain dot's — results must be bit-identical, pinning that the
+        unpack chain itself introduces no drift."""
+        qm = _qm(k=128, n=128)
+        x = jax.random.normal(jax.random.PRNGKey(3), (16, 128), jnp.float32)
+        want = x @ qm.dequant()
+        got = i4.int4_matmul(x, qm, block_n=128, block_k=128, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_leading_shape_and_row_padding(self):
+        """[B, S, K] inputs reshape through; a 2-row decode batch rides
+        the sublane padding and comes back unpadded."""
+        qm = _qm(k=128, n=128)
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 3, 128), jnp.float32)
+        want = x @ qm.dequant()
+        got = i4.int4_matmul(x, qm, block_n=128, block_k=128, interpret=True)
+        assert got.shape == (2, 3, 128)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_multi_tile_grid(self):
+        """K and N both larger than one block: the grid accumulates K
+        tiles and writes independent N tiles."""
+        qm = _qm(k=512, n=384, gs=64)
+        x = jax.random.normal(jax.random.PRNGKey(5), (16, 512), jnp.float32)
+        want = x @ qm.dequant()
+        got = i4.int4_matmul(x, qm, block_n=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestFit:
+    def test_fits_standard_shapes(self):
+        assert i4.fits(_qm(k=512, n=2048))
+        assert i4.fits(_qm(k=2048, n=512))
+
+    def test_unfittable_narrow_n(self):
+        assert not i4.fits(_qm(k=128, n=64))  # N below one lane tile
+
+    def test_block_clamp_to_group_multiple(self):
+        bk, bn = i4._fit_blocks(k=192, n=256, group_size=64,
+                                block_n=256, block_k=512)
+        assert bk in (64, 192) and 192 % bk == 0 and bk % 64 == 0
+        assert bn in (128, 256) and 256 % bn == 0
+
+    def test_matmul_last_seam_gated_off_by_default(self, monkeypatch):
+        """The kernel opt-in must not leak into default quantization —
+        the engine bit-exactness contract depends on the XLA path."""
+        from k8s_dra_driver_tpu.models import burnin, quant
+
+        cfg = burnin.ModelConfig(
+            vocab_size=61, d_model=64, n_heads=4, n_layers=1, d_ff=128,
+            max_seq=16,
+        )
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        monkeypatch.delenv("TPU_INT4_KERNEL", raising=False)
+        q = quant.quantize_blocks(params, bits=4)
+        assert not q["blocks"][0]["qkv"].kernel
+        monkeypatch.setenv("TPU_INT4_KERNEL", "1")
+        q = quant.quantize_blocks(params, bits=4)
+        assert q["blocks"][0]["qkv"].kernel
+        q = quant.quantize_blocks(params, bits=4, kernel=False)
+        assert not q["blocks"][0]["qkv"].kernel
+
+    def test_kernel_flag_changes_pytree_aux(self):
+        """kernel=True must change the treedef (jit cache key) — flipping
+        the flag retraces instead of reusing the other path's program."""
+        qm_off = _qm(k=128, n=128)
+        qm_on = Quantized4Matrix(
+            qm_off.packed, qm_off.scale, qm_off.group_size, qm_off.dtype,
+            kernel=True,
+        )
+        t_off = jax.tree_util.tree_structure(qm_off)
+        t_on = jax.tree_util.tree_structure(qm_on)
+        assert t_off != t_on
