@@ -114,29 +114,36 @@ def _data_plane_body(sink: dict | None = None) -> dict:
         vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048, max_seq=512
     )
     attention = "flash" if jax.default_backend() == "tpu" else "dense"
-    fns = burnin.build_train_step(cfg, attention=attention)
-    params, opt_state = fns.init(jax.random.PRNGKey(0))
     tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=4, seq=cfg.max_seq)
-    params, opt_state, loss = fns.step(params, opt_state, tokens)  # compile
-    float(loss)  # host readback: sync the warmup before the timer starts —
-    # on tunneled devices (axon) block_until_ready alone does not guarantee
-    # remote completion.
-    start = time.perf_counter()
-    steps = 50
-    for _ in range(steps):
-        params, opt_state, loss = fns.step(params, opt_state, tokens)
-    last_loss = float(loss)
-    total = time.perf_counter() - start
-    # The loop enqueues asynchronously; the closing readback pays ONE tunnel
-    # round trip, which at ~67ms would inflate a 5-step window by >2x.
     rtt = dispatch_rtt_seconds()
-    if total <= 1.5 * rtt:
-        # Same discipline as matmul_tflops: refuse to fabricate a reading.
-        raise RuntimeError(
-            f"burn-in timing dominated by dispatch RTT "
-            f"({total*1e3:.1f}ms total vs {rtt*1e3:.1f}ms RTT); raise steps"
-        )
-    step_ms = (total - rtt) / steps * 1000
+
+    def time_train(remat: str, steps: int = 50):
+        """Returns (step_ms, last_loss, trained_params) — the decode
+        blocks downstream reuse the trained weights (decode_speculative's
+        acceptance rate depends on them)."""
+        fns = burnin.build_train_step(cfg, attention=attention, remat=remat)
+        p, opt_state = fns.init(jax.random.PRNGKey(0))
+        p, opt_state, loss = fns.step(p, opt_state, tokens)  # compile
+        float(loss)  # host readback: sync the warmup before the timer
+        # starts — on tunneled devices (axon) block_until_ready alone does
+        # not guarantee remote completion.
+        start = time.perf_counter()
+        for _ in range(steps):
+            p, opt_state, loss = fns.step(p, opt_state, tokens)
+        last_loss = float(loss)
+        total = time.perf_counter() - start
+        # The loop enqueues asynchronously; the closing readback pays ONE
+        # tunnel round trip, which at ~67ms would inflate a 5-step window
+        # by >2x.
+        if total <= 1.5 * rtt:
+            # Same discipline as matmul_tflops: refuse to fabricate a reading.
+            raise RuntimeError(
+                f"burn-in timing dominated by dispatch RTT "
+                f"({total*1e3:.1f}ms total vs {rtt*1e3:.1f}ms RTT); raise steps"
+            )
+        return (total - rtt) / steps * 1000, last_loss, p
+
+    step_ms, last_loss, params = time_train("blocks")
     out = sink if sink is not None else {}
     out.update({
         "backend": jax.default_backend(),
@@ -148,6 +155,21 @@ def _data_plane_body(sink: dict | None = None) -> dict:
         # measured step time, against the v5e bf16 nominal peak.
         **_train_mfu(cfg, batch=4, step_ms=step_ms),
     })
+    # The remat-policy optimization, before/after in one artifact: "dots"
+    # saves matmul outputs so the backward never re-runs a dot — at bench
+    # shapes HBM has headroom and full per-block remat is pure recompute.
+    # Same numerics (policy-independent, tested); only step time moves.
+    try:
+        dots_ms, _, _ = time_train("dots")
+        out["burnin_step_ms_remat_dots"] = round(dots_ms, 2)
+        out["remat_dots_speedup"] = round(step_ms / dots_ms, 2)
+        out["train_mfu_remat_dots"] = _train_mfu(
+            cfg, batch=4, step_ms=dots_ms
+        )["train_mfu"]
+    except Exception as exc:  # noqa: BLE001 - partial data beats none
+        out["burnin_step_ms_remat_dots"] = {
+            "error": f"{type(exc).__name__}: {exc}"
+        }
     # separate statement ON PURPOSE: the chained matmul probe is a prime
     # hang site, and the burn-in numbers above must already be in the sink
     # when the watchdog salvages a timeout

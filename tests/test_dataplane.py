@@ -61,6 +61,43 @@ class TestBurninModel:
             first = first if first is not None else float(loss)
         assert float(loss) < first  # memorizing one batch must reduce loss
 
+    def test_remat_policy_changes_time_not_numerics(self):
+        """'blocks' / 'dots' / 'none' rematerialization must produce the
+        same losses and gradients up to bf16 rounding (XLA may fuse the
+        recompute differently, so saved-vs-rematerialized intermediates
+        can differ in the last bf16 bit) — only step time and peak HBM
+        move; the bench's before/after measurement depends on this."""
+        cfg = burnin.TINY
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=16)
+        ref_loss, ref_grads = None, None
+        for remat in ("blocks", "dots", "none"):
+            loss, grads = jax.jit(
+                jax.value_and_grad(
+                    lambda p, t, r=remat: burnin.loss_fn(p, t, cfg, remat=r)
+                )
+            )(params, tokens)
+            if ref_loss is None:
+                ref_loss, ref_grads = loss, grads
+                continue
+            np.testing.assert_allclose(
+                float(loss), float(ref_loss), rtol=1e-4
+            )
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    atol=4e-3, rtol=0.02,  # bf16-epsilon scale
+                ),
+                grads, ref_grads,
+            )
+
+    def test_remat_policy_validated(self):
+        cfg = burnin.TINY
+        params = burnin.init_params(jax.random.PRNGKey(3), cfg)
+        tokens = burnin.sample_tokens(jax.random.PRNGKey(4), cfg, batch=1, seq=8)
+        with pytest.raises(ValueError, match="remat"):
+            burnin.forward(params, tokens, cfg, remat="everything")
+
     def test_sharded_train_step(self, mesh8):
         cfg = burnin.TINY
         fns = burnin.build_train_step(cfg, mesh=mesh8)
