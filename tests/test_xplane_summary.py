@@ -1,0 +1,58 @@
+"""tools/xplane_summary.py against a REAL jax.profiler capture: the
+train-MFU profiling workflow must work end-to-end before the chip run
+depends on it."""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def capture_dir(tmp_path_factory):
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin
+
+    cfg = burnin.TINY
+    fns = burnin.build_train_step(cfg)
+    p, o = fns.init(jax.random.PRNGKey(0))
+    t = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=16)
+    p, o, loss = fns.step(p, o, t)  # compile outside the capture
+    d = tmp_path_factory.mktemp("prof")
+    with jax.profiler.trace(str(d)):
+        p, o, loss = fns.step(p, o, t)
+        float(loss)
+    return str(d)
+
+
+def _proto_available() -> bool:
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec(
+            "tensorflow.tsl.profiler.protobuf.xplane_pb2"
+        ) is not None
+    except ModuleNotFoundError:  # no tensorflow at all
+        return False
+
+
+@pytest.mark.skipif(not _proto_available(), reason="xplane proto unavailable")
+class TestSummarize:
+    def test_summarizes_real_capture(self, capture_dir):
+        from tools.xplane_summary import summarize
+
+        s = summarize(capture_dir, plane_filter="CPU", top=5)
+        assert s["total_ms"] > 0
+        assert s["buckets"]  # at least one bucket with time
+        assert 0 < len(s["top_ops"]) <= 5
+        assert abs(sum(b["pct"] for b in s["buckets"].values()) - 100) < 1e-6
+
+    def test_unknown_plane_lists_what_exists(self, capture_dir):
+        from tools.xplane_summary import summarize
+
+        with pytest.raises(ValueError, match="planes present"):
+            summarize(capture_dir, plane_filter="no-such-plane")
+
+    def test_missing_dir_fails_loud(self, tmp_path):
+        from tools.xplane_summary import load_xspaces
+
+        with pytest.raises(FileNotFoundError):
+            load_xspaces(str(tmp_path / "empty"))

@@ -1,0 +1,127 @@
+"""Summarize a jax.profiler XPlane capture: where does the step time go?
+
+Workflow (the train-MFU push): capture a profile through the bench —
+``BENCH_PROFILE_DIR=/tmp/prof python bench.py`` — then
+
+    python tools/xplane_summary.py /tmp/prof [--plane TPU] [--top 25]
+
+prints per-op total durations from the device plane, grouped into coarse
+buckets (matmul / attention-softmax / elementwise / reduce / copy-layout /
+other), so the gap between the matmul-probe ceiling and ``train_mfu``
+decomposes into attackable line items.
+
+Parses the ``*.xplane.pb`` protos with the XSpace schema that ships in
+the baked tensorflow (``tensorflow.tsl.profiler.protobuf.xplane_pb2``);
+the tensorboard profile plugin's own converter is broken against this TF
+build (missing ``xspace_to_tools_data`` binding), so we read the planes
+directly — it is just (plane -> line -> event(metadata_id, duration)).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import pathlib
+import re
+import sys
+
+# Word-boundary anchors matter: XLA op names are dotted/suffixed
+# ("convert.5", "expand_dims", "sort"), and bare substrings misroute them
+# ("conv" would claim every convert as matmul, "exp" would claim
+# expand_dims as attention) — corrupting exactly the matmul-vs-rest
+# decomposition this tool exists to produce.
+_BUCKETS = (
+    ("matmul", re.compile(r"\bdot\b|\bconv\b|\bfusion\b|\bgemm\b", re.I)),
+    ("attention/softmax", re.compile(r"softmax|\bexp\b|attention|flash", re.I)),
+    ("reduce/norm", re.compile(r"reduce|\bnorm\b|\bmean\b|variance", re.I)),
+    ("copy/layout", re.compile(
+        r"copy|transpose|reshape|bitcast|concat|slice|\bpad\b|gather|"
+        r"scatter|dynamic|expand_dims", re.I)),
+    ("elementwise", re.compile(
+        r"\badd\b|\bsub\b|\bmul\b|\bdiv\b|\bmax\b|\bmin\b|select|compare|"
+        r"tanh|rsqrt|convert|\band\b|\bor\b|\bxor\b", re.I)),
+)
+
+
+def _bucket(name: str) -> str:
+    for label, rx in _BUCKETS:
+        if rx.search(name):
+            return label
+    return "other"
+
+
+def load_xspaces(profile_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(pathlib.Path(profile_dir).rglob("*.xplane.pb"))
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {profile_dir}")
+    spaces = []
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(p.read_bytes())
+        spaces.append((p, xs))
+    return spaces
+
+
+def summarize(profile_dir: str, plane_filter: str = "TPU", top: int = 25) -> dict:
+    spaces = load_xspaces(profile_dir)
+    per_op: collections.Counter = collections.Counter()
+    planes_seen: list[str] = []
+    matched = False
+    for _, xs in spaces:
+        planes = [p for p in xs.planes if plane_filter.lower() in p.name.lower()]
+        planes_seen.extend(p.name for p in xs.planes)
+        if planes:
+            matched = True
+        for plane in planes:
+            meta = {m.id: m.name for m in plane.event_metadata.values()}
+            for line in plane.lines:
+                # per-op lines only: step/module summary lines double-count
+                if line.name.lower() in ("steps", "xla modules", "framework name scope"):
+                    continue
+                for ev in line.events:
+                    name = meta.get(ev.metadata_id, f"op#{ev.metadata_id}")
+                    per_op[name] += ev.duration_ps
+    if not matched:
+        raise ValueError(
+            f"no plane matching {plane_filter!r}; planes present: "
+            f"{sorted(set(planes_seen))}"
+        )
+    total = sum(per_op.values()) or 1
+    buckets: collections.Counter = collections.Counter()
+    for name, ps in per_op.items():
+        buckets[_bucket(name)] += ps
+    return {
+        "total_ms": total / 1e9,
+        "buckets": {
+            k: {"ms": v / 1e9, "pct": 100.0 * v / total}
+            for k, v in buckets.most_common()
+        },
+        "top_ops": [
+            {"op": n, "ms": ps / 1e9, "pct": 100.0 * ps / total}
+            for n, ps in per_op.most_common(top)
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("profile_dir")
+    ap.add_argument("--plane", default="TPU",
+                    help="substring of the device plane name (use 'CPU' for host-only captures)")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args(argv)
+    s = summarize(args.profile_dir, plane_filter=args.plane, top=args.top)
+    print(f"device time: {s['total_ms']:.3f} ms across ops")
+    print("\nbuckets:")
+    for k, v in s["buckets"].items():
+        print(f"  {k:<20} {v['ms']:>10.3f} ms  {v['pct']:5.1f}%")
+    print(f"\ntop {len(s['top_ops'])} ops:")
+    for row in s["top_ops"]:
+        print(f"  {row['pct']:5.1f}%  {row['ms']:>10.3f} ms  {row['op']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
