@@ -262,3 +262,5 @@ class TestServeDemo:
         assert summary["generated_tokens"] == 12 + 10 + 8 + 6
         assert summary["prefix_block_hits"] > 0  # the shared block paid off
         assert summary["pool_free_blocks"] > 0
+        # the 8-device CPU mesh means the demo ran the SHARDED engine
+        assert summary["sharded_over"] == 2

@@ -29,10 +29,18 @@ import sys
 # ("convert.5", "expand_dims", "sort"), and bare substrings misroute them
 # ("conv" would claim every convert as matmul, "exp" would claim
 # expand_dims as attention) — corrupting exactly the matmul-vs-rest
-# decomposition this tool exists to produce.
+# decomposition this tool exists to produce.  Fusions get their OWN
+# bucket: on TPU nearly all HLO time sits in "fusion.N" clusters whose
+# name says nothing about the fused root (elementwise loops, reduces and
+# matmul epilogues all look alike), and claiming them for any one class
+# would make the breakdown read as that class regardless of reality —
+# a large "fusion" bucket is itself the signal to open the trace in
+# xprof/TensorBoard where the fused HLO is visible.
 _BUCKETS = (
-    ("matmul", re.compile(r"\bdot\b|\bconv\b|\bfusion\b|\bgemm\b", re.I)),
-    ("attention/softmax", re.compile(r"softmax|\bexp\b|attention|flash", re.I)),
+    ("fusion", re.compile(r"\bfusion\b", re.I)),
+    ("matmul", re.compile(r"\bdot\b|\bconv(olution)?\b|\bgemm\b", re.I)),
+    ("attention/softmax", re.compile(
+        r"softmax|\bexp(onential)?\b|attention|flash", re.I)),
     ("reduce/norm", re.compile(r"reduce|\bnorm\b|\bmean\b|variance", re.I)),
     ("copy/layout", re.compile(
         r"copy|transpose|reshape|bitcast|concat|slice|\bpad\b|gather|"
