@@ -817,15 +817,18 @@ def main() -> int:
         max_wait_s=float(os.environ.get("BENCH_BACKEND_RETRY_S", "900"))
     )
     data = _run_data_plane_guarded(
-        # 1600s: the attention block sweep adds ~3 compiles on a cold
-        # chip, the speculative block compiles chained while_loops, and
-        # the engine-level serving benches step through the tunnel.
+        # 2400s: the attention block sweep adds ~3 compiles on a cold
+        # chip, the speculative block compiles chained while_loops, the
+        # engine-level serving + preemption benches step through the
+        # tunnel, and round 5 added the int4-kernel A/B and remat-dots
+        # timing (each a fresh compile); the sink salvages completed
+        # blocks if the budget still runs out.
         # When the bounded-backoff probe TRIED and never saw the backend,
         # one short guarded attempt still runs (the probe can
         # false-negative on a cold cache) but must not stall the artifact
         # for half an hour.  attempts == 0 means the wait was DISABLED,
         # not that the backend is down — keep the full timeout then.
-        timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "1600"))
+        timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "2400"))
         if probe["ok"] or probe["attempts"] == 0
         else float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S_DOWN", "240"))
     )

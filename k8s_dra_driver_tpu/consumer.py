@@ -267,9 +267,12 @@ def _serve_demo() -> int:
     # speculative rounds (the demo mix is greedy, speculation's contract),
     # recompute preemption armed, and a LoRA adapter bank (one request
     # runs on adapter 1).  With 2+ claimed devices the slot axis AND the
-    # block pool shard over a mesh (shard-local tables, collective-free
-    # decode) — the demo then proves the distributed production shape on
-    # the actual claim, not just single-chip.
+    # block pool shard over a 2-way mesh (shard-local tables,
+    # collective-free decode) — the demo then exercises the distributed
+    # engine path on the pod's own chips, not just single-chip.  The
+    # 2-device cap is tied to n_slots=2 (the engine requires
+    # n_slots % axis_size == 0); scaling the mesh wider means scaling
+    # n_slots/n_blocks with it.
     # local_devices ON PURPOSE: on a multi-host claim every process sees
     # all global devices via jax.devices(), and a mesh built from another
     # process's chips is unaddressable here — the demo is a per-pod

@@ -6,9 +6,12 @@ Workflow (the train-MFU push): capture a profile through the bench —
     python tools/xplane_summary.py /tmp/prof [--plane TPU] [--top 25]
 
 prints per-op total durations from the device plane, grouped into coarse
-buckets (matmul / attention-softmax / elementwise / reduce / copy-layout /
-other), so the gap between the matmul-probe ceiling and ``train_mfu``
-decomposes into attackable line items.
+buckets (fusion / matmul / attention-softmax / reduce / copy-layout /
+elementwise / other), so the gap between the matmul-probe ceiling and
+``train_mfu`` decomposes into attackable line items.  On TPU most HLO
+time sits in ``fusion.N`` clusters whose names hide the fused root — a
+dominant "fusion" bucket is the signal to open the capture in
+xprof/TensorBoard where the fused HLO is visible.
 
 Parses the ``*.xplane.pb`` protos with the XSpace schema that ships in
 the baked tensorflow (``tensorflow.tsl.profiler.protobuf.xplane_pb2``);
