@@ -587,6 +587,16 @@ def _train_mfu(cfg, batch: int, step_ms: float) -> dict:
     s = cfg.max_seq
     tokens = batch * s
     block_params = sum(a * b for a, b in block_matrix_shapes(cfg).values())
+    if cfg.n_experts:
+        # MoE: block_matrix_shapes drops the dense MLP pair; model-FLOPs
+        # convention credits the ROUTED top_k experts (+ the router).
+        # The shape-static reference path executes n_experts/top_k more
+        # MLP FLOPs than credited, so true hardware utilization is
+        # strictly higher — same direction as the remat convention.
+        block_params += (
+            cfg.moe_top_k * 2 * cfg.d_model * cfg.d_ff
+            + cfg.d_model * cfg.n_experts
+        )
     matmul_params = cfg.n_layers * block_params + cfg.vocab_size * cfg.d_model
     flops = 6 * matmul_params * tokens + 12 * batch * s * s * cfg.d_model * cfg.n_layers
     achieved_tflops = flops / (step_ms / 1000.0) / 1e12

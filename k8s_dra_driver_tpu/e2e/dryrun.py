@@ -281,6 +281,30 @@ def run_dryrun(n_devices: int) -> None:
           f"+ spec + lora, {sum(map(len, streams['sharded'].values()))} "
           f"tokens, bit-equal single-device) ok")
 
+    # MoE serving (the Mixtral family shape): deterministic top-k routing
+    # extends every bit-equality contract to expert models — here the
+    # sharded dense engine serves an n_experts=4 model bit-equal to the
+    # single-device engine (cfg.n_experts wires _moe_mlp through the
+    # SAME decode path; ops/moe stays the EP training fast path).
+    moe_cfg = dataclasses.replace(cfg, n_experts=4, moe_top_k=2)
+    moe_params = burnin.init_params(jax.random.PRNGKey(2), moe_cfg)
+    moe_streams = {}
+    for tag, mesh_arg in (("sharded", ep_mesh), ("single", None)):
+        eng = ServeEngine(
+            moe_params, moe_cfg, n_slots=n_devices, prompt_bucket=16,
+            mesh=mesh_arg, slot_axis="data",
+        )
+        for i in range(n_devices):
+            eng.submit([2 + i, 7, 1], max_tokens=4)
+        eng.run_until_drained()
+        moe_streams[tag] = {c.request_id: c.generated for c in eng.completions()}
+    assert moe_streams["sharded"] == moe_streams["single"], (
+        f"moe streams diverged: {moe_streams}"
+    )
+    print(f"dryrun_multichip: mesh data={n_devices} (MoE top-2 serving, "
+          f"{sum(map(len, moe_streams['sharded'].values()))} tokens, "
+          f"bit-equal single-device) ok")
+
     # MULTISLICE serving: DP across two virtual slices, driven by the
     # exact env contract the driver injects for a slice-group claim
     # (demo/specs/quickstart/multislice-test1.yaml -> plugin/device_state

@@ -54,6 +54,18 @@ class ModelConfig:
     # no re-rotation.  False = learned absolute embeddings (unchanged).
     rope: bool = False
     rope_base: float = 10000.0
+    # Mixture-of-experts MLP (the Mixtral family shape): every block's
+    # dense MLP becomes ``n_experts`` expert MLPs with a learned router;
+    # each token runs its ``moe_top_k`` highest-scoring experts, combined
+    # by the softmax over the SELECTED scores (the Mixtral convention).
+    # 0 = dense (every path byte-identical to before the flag existed).
+    # Routing is deterministic, so all the serving engines' bit-equality
+    # contracts extend to MoE models unchanged (tested).  This reference
+    # path computes shape-statically (all experts, combined by routing
+    # weight — XLA-friendly, exact); the capacity-based EP-sharded fast
+    # path for large-scale training is ops/moe.topk_moe.
+    n_experts: int = 0
+    moe_top_k: int = 2
 
     def __post_init__(self):
         if self.n_kv_heads is not None and (
@@ -67,6 +79,16 @@ class ModelConfig:
                 f"rope needs an even head_dim, got {self.head_dim} "
                 f"(d_model {self.d_model} / n_heads {self.n_heads})"
             )
+        if self.n_experts:
+            if self.n_experts < 2:
+                raise ValueError(
+                    f"n_experts ({self.n_experts}) must be >= 2 (0 = dense)"
+                )
+            if not 1 <= self.moe_top_k <= self.n_experts:
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) must be in "
+                    f"[1, n_experts={self.n_experts}]"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -97,21 +119,26 @@ TINY = ModelConfig()
 
 
 def block_matrix_shapes(cfg: ModelConfig) -> dict:
-    """THE shapes of a transformer block's matmul weights — single source
-    of truth shared by `init_params` and adapter construction
-    (models/lora.py), so a layout change (e.g. GQA shrinking qkv) breaks
-    loudly at one definition instead of deep in a jitted merge."""
-    return {
+    """THE shapes of a transformer block's 2-D matmul weights — single
+    source of truth shared by `init_params`, adapter construction
+    (models/lora.py) and weight-only quantization targets, so a layout
+    change (e.g. GQA shrinking qkv) breaks loudly at one definition
+    instead of deep in a jitted merge.  Under MoE the dense MLP pair is
+    replaced by per-expert stacks (3-D, MoE-owned — see init_params);
+    adapters and quantization then target the attention matmuls only."""
+    shapes = {
         # fused [q | k | v]: q keeps n_heads, k/v shrink to kv_heads (GQA)
         "qkv": (cfg.d_model, (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim),
         "attn_out": (cfg.d_model, cfg.d_model),
-        "mlp_up": (cfg.d_model, cfg.d_ff),
-        "mlp_down": (cfg.d_ff, cfg.d_model),
     }
+    if not cfg.n_experts:
+        shapes["mlp_up"] = (cfg.d_model, cfg.d_ff)
+        shapes["mlp_down"] = (cfg.d_ff, cfg.d_model)
+    return shapes
 
 
 def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
-    keys = iter(jax.random.split(key, 4 + 4 * cfg.n_layers))
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
     scale = cfg.d_model**-0.5
     shapes = block_matrix_shapes(cfg)
 
@@ -131,29 +158,48 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
         "blocks": [],
     }
     for _ in range(cfg.n_layers):
-        params["blocks"].append(
-            {
-                "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
-                "qkv": dense(next(keys), shapes["qkv"]),
-                "attn_out": dense(next(keys), shapes["attn_out"]),
-                "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
-                "mlp_up": dense(next(keys), shapes["mlp_up"]),
-                "mlp_down": dense(next(keys), shapes["mlp_down"]),
-            }
-        )
+        block = {
+            "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
+            "qkv": dense(next(keys), shapes["qkv"]),
+            "attn_out": dense(next(keys), shapes["attn_out"]),
+            "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
+        }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            # router in f32: routing decides top-k by comparison, and
+            # bf16 score ties would make expert choice resolution-bound
+            block["router"] = (
+                jax.random.normal(next(keys), (cfg.d_model, e), jnp.float32)
+                * scale
+            )
+            block["expert_up"] = dense(next(keys), (e, cfg.d_model, cfg.d_ff))
+            block["expert_down"] = dense(next(keys), (e, cfg.d_ff, cfg.d_model))
+        else:
+            block["mlp_up"] = dense(next(keys), shapes["mlp_up"])
+            block["mlp_down"] = dense(next(keys), shapes["mlp_down"])
+        params["blocks"].append(block)
     return params
 
 
 def param_pspecs(cfg: ModelConfig) -> dict:
-    """Megatron TP layout over the ``model`` axis."""
+    """Megatron TP layout over the ``model`` axis.  MoE expert stacks
+    shard their FF dim over ``model`` (column/row-parallel per expert —
+    the contraction over the sharded ff axis psums exactly like the
+    dense pair); the tiny router replicates.  Expert-parallel sharding
+    over a dedicated ``expert`` axis is ops/moe's capacity-based path."""
     block = {
         "ln1": P(),
         "qkv": P(None, "model"),       # column-parallel
         "attn_out": P("model", None),  # row-parallel (psum after)
         "ln2": P(),
-        "mlp_up": P(None, "model"),
-        "mlp_down": P("model", None),
     }
+    if cfg.n_experts:
+        block["router"] = P()
+        block["expert_up"] = P(None, None, "model")
+        block["expert_down"] = P(None, "model", None)
+    else:
+        block["mlp_up"] = P(None, "model")
+        block["mlp_down"] = P("model", None)
     out = {
         "embed": P("model", None),  # vocab-sharded embedding
         "ln_f": P(),
@@ -248,10 +294,53 @@ def repeat_kv(kv, cfg: ModelConfig):
     return jnp.repeat(kv, cfg.kv_groups, axis=2)
 
 
-def mlp_residual(x, p, delta=None):
-    """ln2 + gelu MLP with residual (shared with decode).  ``delta``: the
-    per-request adapter hook, as in :func:`qkv_proj`."""
+def _moe_mlp(y, p, top_k: int):
+    """Top-k expert MLP over normalized tokens ``y [..., d]`` (the
+    Mixtral shape): router scores -> top-k -> softmax over the SELECTED
+    scores -> weighted sum of those experts' gelu-MLP outputs.
+
+    Shape-static reference path: EVERY expert runs on every token and the
+    routing weights zero out the unselected ones — exact, deterministic
+    (the serving bit-equality contracts extend to MoE for free), and
+    XLA-friendly (two einsums over the stacked expert weights, no
+    data-dependent shapes).  Compute is E/k-times the routed minimum,
+    which is the right trade at serving batch sizes; the capacity-based
+    dispatch that pays only the routed FLOPs (and shards experts over an
+    ``expert`` mesh axis) is ops/moe.topk_moe, the large-scale training
+    path."""
+    *lead, d = y.shape
+    t = y.reshape(-1, d)
+    n_experts = p["router"].shape[1]
+    scores = t.astype(jnp.float32) @ p["router"]             # [T, E] f32
+    top_vals, top_idx = jax.lax.top_k(scores, top_k)         # [T, k]
+    gates = jax.nn.softmax(top_vals, axis=-1)                # [T, k]
+    onehot = jax.nn.one_hot(top_idx, n_experts, dtype=jnp.float32)
+    combine = jnp.einsum("tk,tke->te", gates, onehot)        # [T, E]
+    up = jnp.einsum("td,edf->tef", t, p["expert_up"])
+    h = jax.nn.gelu(up)
+    outs = jnp.einsum("tef,efd->ted", h, p["expert_down"])
+    out = jnp.einsum("te,ted->td", combine.astype(outs.dtype), outs)
+    return out.reshape(*lead, d)
+
+
+def mlp_residual(x, p, delta=None, top_k: int | None = None):
+    """ln2 + MLP with residual (shared with decode): dense gelu MLP, or
+    the top-k expert mixture when the block carries a ``router``
+    (cfg.n_experts — see :func:`_moe_mlp`).  ``top_k`` is REQUIRED for
+    MoE blocks (pass cfg.moe_top_k): a default would let a call site
+    that forgot to thread it silently route the wrong number of experts
+    — diverged streams instead of an error.  ``delta``: the per-request
+    adapter hook, as in :func:`qkv_proj`; adapters target the DENSE
+    matmuls (block_matrix_shapes), so MoE blocks take no mlp delta —
+    per-request LoRA still applies to their attention projections."""
     y = _rms_norm(x, p["ln2"])
+    if "router" in p:
+        if top_k is None:
+            raise ValueError(
+                "MoE block needs top_k (pass cfg.moe_top_k through "
+                "mlp_residual)"
+            )
+        return x + _moe_mlp(y, p, top_k)
     h = _mm(y, p["mlp_up"])
     if delta is not None:
         h = h + delta("mlp_up", y)
@@ -276,7 +365,7 @@ def _block(x, p, cfg: ModelConfig, act_spec, attn_fn=_full_attention):
     attn = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, s, d)
     x = x + _mm(attn, p["attn_out"])
     x = _constrain(x, act_spec)
-    return _constrain(mlp_residual(x, p), act_spec)
+    return _constrain(mlp_residual(x, p, top_k=cfg.moe_top_k), act_spec)
 
 
 def _wrap_remat(block, remat: str):

@@ -220,7 +220,7 @@ def decode_chunk(
         x = x + _mm(attn, p["attn_out"])
         if delta is not None:
             x = x + delta("attn_out", attn)
-        x = mlp_residual(x, p, delta=delta)
+        x = mlp_residual(x, p, delta=delta, top_k=cfg.moe_top_k)
 
     return tied_logits(x, params), KVCache(k=new_k, v=new_v)
 

@@ -62,6 +62,17 @@ class LoraConfig:
             raise ValueError(
                 f"rank {self.rank} >= d_model {cfg.d_model}: low-rank in name only"
             )
+        # MoE blocks replace the dense MLP pair with per-expert stacks;
+        # adapters target the 2-D matmuls only (burnin.block_matrix_shapes)
+        missing = [
+            t for t in self.targets if t not in burnin.block_matrix_shapes(cfg)
+        ]
+        if missing:
+            raise ValueError(
+                f"LoRA targets {missing} do not exist under this config "
+                f"(MoE replaces the dense MLP; use "
+                f"targets=('qkv', 'attn_out'))"
+            )
 
 
 def init_adapters(key: jax.Array, cfg: ModelConfig, lora: LoraConfig) -> dict:

@@ -262,7 +262,7 @@ def paged_decode_chunk(
             x = x + _mm(attn, p["attn_out"])
             if delta is not None:
                 x = x + delta("attn_out", attn)
-            x = mlp_residual(x, p, delta=delta)
+            x = mlp_residual(x, p, delta=delta, top_k=cfg.moe_top_k)
         return tied_logits(x, params), PagedKVCache(k=new_k, v=new_v)
 
     block_ids = block_table[rows[:, None], positions // bs]  # [B, S]
@@ -287,7 +287,7 @@ def paged_decode_chunk(
         x = x + _mm(attn, p["attn_out"])
         if delta is not None:
             x = x + delta("attn_out", attn)
-        x = mlp_residual(x, p, delta=delta)
+        x = mlp_residual(x, p, delta=delta, top_k=cfg.moe_top_k)
 
     return tied_logits(x, params), cache
 

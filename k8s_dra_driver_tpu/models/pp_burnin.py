@@ -51,6 +51,15 @@ def pp_params_from_dense(dense: dict, cfg: ModelConfig) -> dict:
     """Convert burnin's dense param tree to the pipeline layout (stacked
     blocks + group-major qkv).  RoPE configs carry no pos_embed — positions
     are rotated into q/k inside the stage scan."""
+    if cfg.n_experts:
+        # The stage scan's stacked-block specs model the DENSE MLP pair;
+        # MoE training runs on the non-pipelined mesh path (burnin TP
+        # shards expert FF dims) or ops/moe's EP dispatch.  Say so here,
+        # not deep inside a stacked-tree mismatch.
+        raise ValueError(
+            "pipeline training does not support MoE blocks; use "
+            "build_train_step (TP/DP/SP) or ops/moe.topk_moe (EP)"
+        )
     blocks = [
         {**blk, "qkv": _groupmajor_qkv(blk["qkv"], cfg)} for blk in dense["blocks"]
     ]

@@ -116,6 +116,93 @@ print(json.dumps({
 PAGED_REQS = "[([5, 9, 2], 6, 0), ([11, 3], 8, 1), ([7, 7, 7, 1], 5, 2), ([2], 7, 0)]"
 
 
+MULTISLICE_WORKER = r"""
+import json
+from k8s_dra_driver_tpu import consumer
+
+ctx = consumer.attach()  # real jax.distributed.initialize over TCP
+import jax
+import numpy as np
+
+from k8s_dra_driver_tpu.models import burnin
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+from k8s_dra_driver_tpu.parallel.mesh import MeshShape, build_multislice_mesh
+
+cfg = burnin.ModelConfig(
+    vocab_size=61, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=32
+)
+params = burnin.init_params(jax.random.PRNGKey(0), cfg)  # same on all hosts
+# 2 slices x data=2 over the 2-process global mesh: the slice axis spans
+# the PROCESS boundary — the DCN seam of a real multislice pod set.
+mesh = build_multislice_mesh(jax.devices(), 2, MeshShape(data=2))
+eng = ServeEngine(
+    params=params, cfg=cfg, n_slots=4, prompt_bucket=8,
+    mesh=mesh, slot_axis=("slice", "data"),
+)
+pending = list(REQS)
+streams = {}
+for _ in range(500):
+    while pending:
+        prompt, max_tokens = pending[0]
+        try:
+            eng.submit(prompt, max_tokens)
+            pending.pop(0)
+        except RuntimeError:
+            break
+    stepped = eng.step()
+    for c in eng.completions():
+        streams[c.request_id] = c.generated
+    if not pending and stepped == 0 and eng.free_slots() == eng.n_slots:
+        break
+print(json.dumps({
+    "worker": ctx.worker_id,
+    "process_count": jax.process_count(),
+    "slice_axis": int(mesh.shape["slice"]),
+    "streams": {str(k): v for k, v in streams.items()},
+}))
+""".replace("REQS", REQS)
+
+
+def test_two_process_multislice_serving_bit_equal(tmp_path):
+    """MULTISLICE serving across REAL processes: the slice axis spans the
+    process boundary (each OS process = one slice, the DCN seam), slots
+    shard over ('slice', 'data') tuple axes, and streams bit-equal the
+    single-process single-slice engine."""
+    cluster = make_cluster(
+        hosts=2, topology="v5e-16", work_dir=str(tmp_path),
+        slice_domain="mp-multislice",
+    )
+    manager = SliceManager(cluster.server)
+    manager.start()
+    try:
+        outs = run_two_process_workers(cluster, tmp_path, MULTISLICE_WORKER)
+        assert sorted(o["worker"] for o in outs) == [0, 1]
+        for o in outs:
+            assert o["process_count"] == 2
+            assert o["slice_axis"] == 2
+        assert outs[0]["streams"] == outs[1]["streams"]
+        assert sorted(outs[0]["streams"]) == ["0", "1", "2", "3"]
+
+        import jax
+
+        from k8s_dra_driver_tpu.models import burnin
+        from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+        cfg = burnin.ModelConfig(
+            vocab_size=61, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=32
+        )
+        params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+        ref = ServeEngine(params=params, cfg=cfg, n_slots=4, prompt_bucket=8)
+        for prompt, max_tokens in [([5, 9, 2], 6), ([11, 3], 8),
+                                   ([7, 7, 7, 1], 5), ([2], 7)]:
+            ref.submit(prompt, max_tokens)
+        ref.run_until_drained()
+        want = {str(c.request_id): c.generated for c in ref.completions()}
+        assert outs[0]["streams"] == want
+    finally:
+        manager.stop()
+
+
 def test_two_process_dp_sharded_paged_engine_bit_equal(tmp_path):
     """The PRODUCTION serving shape across REAL processes: paged pool +
     speculative rounds + per-request LoRA, slot/pool axes sharded over a
