@@ -1,0 +1,61 @@
+"""bench._wait_for_backend — the bounded retry that keeps one tunnel
+outage from voiding a round's data plane.  The real probe is a subprocess
+(tools/tunnel_probe.py); here it is monkeypatched so the schedule logic is
+testable without a device link."""
+
+import bench
+
+
+def _patch(monkeypatch, results, sleeps):
+    """probe() pops from ``results``; time.sleep records into ``sleeps``."""
+    import tools.tunnel_probe as tp
+
+    def fake_probe(timeout_s=90.0, quiet=False):
+        return results.pop(0) if results else False
+
+    monkeypatch.setattr(tp, "probe", fake_probe)
+    monkeypatch.setattr(
+        bench.time, "sleep", lambda s: sleeps.append(s)
+    )
+
+
+class TestWaitForBackend:
+    def test_zero_budget_disables_entirely(self, monkeypatch):
+        sleeps = []
+        _patch(monkeypatch, [True], sleeps)  # would succeed if probed
+        out = bench._wait_for_backend(0)
+        assert out == {"ok": False, "attempts": 0, "waited_s": 0.0}
+        assert sleeps == []
+
+    def test_immediate_success_needs_one_attempt(self, monkeypatch):
+        sleeps = []
+        _patch(monkeypatch, [True], sleeps)
+        out = bench._wait_for_backend(900)
+        assert out["ok"] and out["attempts"] == 1
+        assert sleeps == []  # first attempt has no preceding delay
+
+    def test_backoff_then_recovery(self, monkeypatch):
+        sleeps = []
+        _patch(monkeypatch, [False, False, True], sleeps)
+        out = bench._wait_for_backend(900)
+        assert out["ok"] and out["attempts"] == 3
+        assert sleeps == [30, 60]  # the documented backoff prefix
+
+    def test_every_sleep_is_followed_by_a_probe(self, monkeypatch):
+        """A recovered backend must never be reported down because the
+        budget expired during a sleep — the last act is always a probe
+        (the review finding that reshaped this loop)."""
+        probes = []
+        import tools.tunnel_probe as tp
+
+        def fake_probe(timeout_s=90.0, quiet=False):
+            probes.append(timeout_s)
+            return False
+
+        sleeps = []
+        monkeypatch.setattr(tp, "probe", fake_probe)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+        out = bench._wait_for_backend(100)
+        assert not out["ok"]
+        # one probe per loop iteration that slept (plus the first)
+        assert len(probes) == len(sleeps) + 1
