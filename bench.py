@@ -811,8 +811,23 @@ def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:
         # and a plain dict unpack can die with "changed size during
         # iteration" — exactly in the scenario this guard protects.
         salvaged = {k: result[k] for k in list(result)}
+        # Dump the in-process diag bundle (all thread stacks — including
+        # WHERE the worker is wedged — journal tail, spans, metrics) so the
+        # artifact points at evidence instead of guessing "hung link?".
+        try:
+            from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG, dump_diag_bundle
+
+            bundle = dump_diag_bundle(
+                WATCHDOG.bundle_dir,
+                reason=f"bench data plane timed out after {timeout_s:.0f}s",
+                state={"salvaged_blocks": sorted(salvaged)},
+            )
+            diag = f"diag bundle: {bundle}"
+        except Exception as exc:  # noqa: BLE001 - diagnostics must not mask the timeout
+            diag = f"diag bundle failed: {type(exc).__name__}: {exc}"
         salvaged["error"] = (
-            f"data plane timed out after {timeout_s:.0f}s (hung device link?)"
+            f"data plane timed out after {timeout_s:.0f}s "
+            f"(hung device link?); {diag}"
         )
         return salvaged
     return result

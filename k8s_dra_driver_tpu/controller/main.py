@@ -16,6 +16,7 @@ import threading
 from k8s_dra_driver_tpu.controller.slice_manager import SliceManager
 from k8s_dra_driver_tpu.e2e.harness import install_device_classes
 from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.logging import get_logger
 
 log = get_logger("tpu-dra-controller")
@@ -70,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    JOURNAL.record(
+        "controller", "start",
+        device_classes=args.device_classes, fake_cluster=args.fake_cluster,
+    )
     if args.fake_cluster:
         server = InMemoryAPIServer()
         install_device_classes(server)
@@ -101,10 +106,12 @@ def main(argv: list[str] | None = None) -> int:
 
             def started():
                 log.info("acquired leadership (%s); starting slice manager", identity)
+                JOURNAL.record("controller", "leadership.acquired", correlation=identity)
                 manager.start()
 
             def stopped():
                 log.info("lost leadership; stopping slice manager")
+                JOURNAL.record("controller", "leadership.lost", correlation=identity)
                 # Keep owned slices: the new leader publishes over them.
                 manager.stop(delete_owned=False)
 
@@ -157,6 +164,7 @@ def main(argv: list[str] | None = None) -> int:
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
+    JOURNAL.record("controller", "running")
     # Retry loop for transiently-failed domains (imex.go:131-151).
     while not stop.wait(timeout=1.0):
         if manager is not None:

@@ -22,6 +22,7 @@ from k8s_dra_driver_tpu.kube.objects import (
 )
 from k8s_dra_driver_tpu.plugin.device_state import DeviceState
 from k8s_dra_driver_tpu.scheduler.allocator import Allocator
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 
 TPU_CLASS = "tpu.google.com"
 SUBSLICE_CLASS = "subslice.tpu.google.com"
@@ -86,6 +87,10 @@ class Cluster:
 
     def schedule_and_prepare(self, claim: ResourceClaim, node_name: str) -> list[dict]:
         """The §3.2 hot path: allocate (scheduler) then Prepare (kubelet)."""
+        JOURNAL.record(
+            "e2e", "schedule_and_prepare", correlation=claim.metadata.uid,
+            claim=claim.metadata.name, node=node_name,
+        )
         allocated = self.allocator.allocate(
             claim, node_name=node_name, node_labels=self.node_labels(node_name)
         )
@@ -103,6 +108,10 @@ class Cluster:
                 f"claim {claim.metadata.name!r} has consumers "
                 f"{[r.name for r in current.status.reserved_for]}; delete the pods"
             )
+        JOURNAL.record(
+            "e2e", "unprepare_and_deallocate", correlation=claim.metadata.uid,
+            claim=claim.metadata.name, node=node_name,
+        )
         self.nodes[node_name].state.unprepare(claim.metadata.uid)
         self.allocator.deallocate(current)
 
@@ -112,6 +121,10 @@ class Cluster:
         (shared-claim lifecycle, gpu-test3 pattern)."""
         pod = self.server.get("Pod", name, namespace)
         node = pod.metadata.labels.get("_scheduled_node", "")
+        JOURNAL.record(
+            "e2e", "delete_pod", correlation=pod.metadata.uid,
+            pod=name, node=node,
+        )
         for ref in (pod.spec or {}).get("resourceClaims", []):
             claim = self.server.get(
                 ResourceClaim.KIND, claim_name_for_ref(name, ref), namespace

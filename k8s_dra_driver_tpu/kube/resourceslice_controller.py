@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from k8s_dra_driver_tpu.kube import objects
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.kube.objects import (
     Device,
     NodeSelector,
@@ -130,6 +131,11 @@ class ResourceSliceController:
             if not changed:
                 continue
             new_gen = current_gen + 1 if pool_existing else current_gen
+            JOURNAL.record(
+                "resourceslices", "pool.sync", correlation=pool_name,
+                owner=self._owner, generation=new_gen, slices=len(pool.slices),
+                devices=sum(len(sl.devices) for sl in pool.slices),
+            )
             for i, sl in enumerate(pool.slices):
                 want = build(i, sl, new_gen)
                 current = existing.get(want.metadata.name)
@@ -141,4 +147,8 @@ class ResourceSliceController:
 
         for name in existing:
             if name not in desired_names:
+                JOURNAL.record(
+                    "resourceslices", "slice.delete", correlation=name,
+                    owner=self._owner,
+                )
                 self._server.delete(ResourceSlice.KIND, name)

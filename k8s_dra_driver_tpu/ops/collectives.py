@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
 
 @dataclass(frozen=True)
 class BandwidthResult:
@@ -29,14 +31,19 @@ class BandwidthResult:
     algbw_gbps: float  # algorithmic bandwidth, GB/s
 
 
-def _time_fn(fn, *args, warmup: int = 2, iters: int = 10) -> float:
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    start = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - start) / iters
+def _time_fn(fn, *args, warmup: int = 2, iters: int = 10,
+             section: str = "collective") -> float:
+    # Guarded: a dead ICI link blocks block_until_ready forever; the armed
+    # guard is what turns that silence into a diag bundle naming `section`.
+    with WATCHDOG.guard(f"collectives.{section}") as g:
+        for _ in range(warmup):
+            jax.block_until_ready(fn(*args))
+            g.beat()
+        start = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - start) / iters
 
 
 def psum_bandwidth(
@@ -56,7 +63,7 @@ def psum_bandwidth(
         # psum output is replicated across `axis`; out_specs=P() asserts it.
         return jax.lax.psum(shard, axis)
 
-    secs = _time_fn(allreduce, x, iters=iters)
+    secs = _time_fn(allreduce, x, iters=iters, section="psum")
     payload = elems * jnp.dtype(dtype).itemsize
     algbw = (2 * (n - 1) / max(n, 1)) * payload / secs / 1e9 if n > 1 else payload / secs / 1e9
     return BandwidthResult("psum", axis, n, payload, secs, algbw)
@@ -77,7 +84,7 @@ def all_gather_bandwidth(
     def gather(shard):
         return jax.lax.all_gather(shard, axis, tiled=True)
 
-    secs = _time_fn(gather, x, iters=iters)
+    secs = _time_fn(gather, x, iters=iters, section="all_gather")
     payload = elems * jnp.dtype(dtype).itemsize
     algbw = ((n - 1) / max(n, 1)) * payload / secs / 1e9 if n > 1 else payload / secs / 1e9
     return BandwidthResult("all_gather", axis, n, payload, secs, algbw)
@@ -102,7 +109,7 @@ def all_to_all_bandwidth(
     def exchange(shard):
         return jax.lax.all_to_all(shard, axis, split_axis=0, concat_axis=0, tiled=True)
 
-    secs = _time_fn(exchange, x, iters=iters)
+    secs = _time_fn(exchange, x, iters=iters, section="all_to_all")
     payload = n * (per // n) * jnp.dtype(dtype).itemsize  # bytes per device
     algbw = ((n - 1) / max(n, 1)) * payload / secs / 1e9 if n > 1 else payload / secs / 1e9
     return BandwidthResult("all_to_all", axis, n, payload, secs, algbw)
@@ -136,10 +143,12 @@ def _timed_probe_seconds(f, arg, device, what: str) -> float:
     refusal — never a clamp — when dispatch noise buries the compute
     (clamping would fabricate the impossible readings this method exists to
     prevent)."""
-    float(f(arg))  # compile + sync
-    start = time.perf_counter()
-    float(f(arg))
-    total = time.perf_counter() - start
+    with WATCHDOG.guard(f"collectives.probe.{what}") as g:
+        float(f(arg))  # compile + sync
+        g.beat()
+        start = time.perf_counter()
+        float(f(arg))
+        total = time.perf_counter() - start
     rtt = dispatch_rtt_seconds(device)
     if total <= 1.5 * rtt:
         raise RuntimeError(
@@ -317,7 +326,7 @@ def ring_latency_us(mesh: Mesh, axis: str = "model", iters: int = 50) -> float:
     def hop(shard):
         return jax.lax.ppermute(shard, axis, perm)
 
-    secs = _time_fn(hop, x, iters=iters)
+    secs = _time_fn(hop, x, iters=iters, section="ppermute")
     return secs * 1e6
 
 

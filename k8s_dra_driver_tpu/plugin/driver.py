@@ -24,6 +24,7 @@ from k8s_dra_driver_tpu.kube.resourceslice_controller import (
     Slice,
 )
 from k8s_dra_driver_tpu.plugin.device_state import DeviceState, DeviceStateConfig
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 from k8s_dra_driver_tpu.utils.tracing import TRACER
 
@@ -88,6 +89,10 @@ class Driver:
 
     def publish_resources(self) -> None:
         devices = self.state.allocatable.get_devices()
+        JOURNAL.record(
+            "driver", "inventory.publish", correlation=self.config.node_name,
+            devices=len(devices),
+        )
         slices = [
             Slice(devices=devices[i : i + DEVICES_PER_SLICE])
             for i in range(0, len(devices), DEVICES_PER_SLICE)
@@ -120,6 +125,10 @@ class Driver:
                 self._selftest_run.cancel()
             for ref in claims:
                 ok = False
+                JOURNAL.record(
+                    "driver", "prepare.start", correlation=ref.uid,
+                    claim=f"{ref.namespace}/{ref.name}", node=self.config.node_name,
+                )
                 with TRACER.span(
                     "NodePrepareResources", claim=f"{ref.namespace}/{ref.name}"
                 ) as span:
@@ -128,12 +137,21 @@ class Driver:
                         ok = True
                     except Exception as exc:  # per-claim, not process-fatal
                         self._claim_errors.inc(op="prepare")
+                        JOURNAL.record(
+                            "driver", "prepare.fail", correlation=ref.uid,
+                            error=f"{type(exc).__name__}: {exc}",
+                        )
                         out[ref.uid] = ClaimResult(
                             error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
                         )
                 if ok:
                     # single timing source: the span's measurement
                     self._prepare_seconds.observe(span.duration_ms / 1000)
+                    JOURNAL.record(
+                        "driver", "prepare.ok", correlation=ref.uid,
+                        devices=[d.get("device_name", "") for d in out[ref.uid].devices],
+                        duration_ms=round(span.duration_ms, 3),
+                    )
         return out
 
     def node_unprepare_resources(self, claims: list[ClaimRef]) -> dict[str, ClaimResult]:
@@ -141,12 +159,21 @@ class Driver:
         with self._lock:
             for ref in claims:
                 start = time.perf_counter()
+                JOURNAL.record(
+                    "driver", "unprepare.start", correlation=ref.uid,
+                    claim=f"{ref.namespace}/{ref.name}", node=self.config.node_name,
+                )
                 try:
                     self.state.unprepare(ref.uid)
                     self._unprepare_seconds.observe(time.perf_counter() - start)
                     out[ref.uid] = ClaimResult()
+                    JOURNAL.record("driver", "unprepare.ok", correlation=ref.uid)
                 except Exception as exc:
                     self._claim_errors.inc(op="unprepare")
+                    JOURNAL.record(
+                        "driver", "unprepare.fail", correlation=ref.uid,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
                     out[ref.uid] = ClaimResult(
                         error=f"error unpreparing claim {ref.namespace}/{ref.name}: {exc}"
                     )
@@ -334,6 +361,11 @@ class Driver:
                         Deployment.KIND, dep.metadata.name, dep.metadata.namespace
                     )
                     cleaned["daemons"].append(dep.metadata.name)
+        if any(cleaned.values()):
+            JOURNAL.record(
+                "driver", "orphans.cleaned", correlation=self.config.node_name,
+                **{k: v for k, v in cleaned.items() if v},
+            )
         return cleaned
 
     def _prepare_one(self, ref: ClaimRef) -> list[dict]:

@@ -41,6 +41,9 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
 
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
 # A holder that never releases is reclaimed after this many quanta — the
 # cooperative analog of the reference's MPS readiness/backoff tolerances
 # (sharing.go:289-344): generous to jitter, fatal to the crashed.
@@ -186,6 +189,11 @@ class TopologyDaemonServer:
                 partition = matches[0]
                 record["partition"] = index
             self.state.consumers[consumer] = record
+            JOURNAL.record(
+                "topology-daemon", "consumer.register",
+                correlation=self.state.claim_uid, consumer=consumer,
+                partition=index,
+            )
             return {
                 "ok": True,
                 "partition": partition,
@@ -213,6 +221,11 @@ class TopologyDaemonServer:
                     return {"ok": True, "lease_ms": quantum_ms, "scope": scope}
                 remaining = deadline - now
                 if remaining <= 0:
+                    JOURNAL.record(
+                        "topology-daemon", "acquire.timeout",
+                        correlation=self.state.claim_uid, consumer=consumer,
+                        scope=scope, holder=lease.consumer,
+                    )
                     return {"ok": False, "error": "timeout", "holder": lease.consumer}
                 # Wake on release OR when the current lease would expire.
                 expiry = lease.granted_at + lease.quantum_ms * LEASE_GRACE_QUANTA / 1000.0
@@ -258,10 +271,26 @@ class TopologyDaemonServer:
         class Server(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             daemon_threads = True
             allow_reuse_address = True
+            guard = None  # armed by serve(); beaten from the poll loop
+
+            def service_actions(self):
+                # serve_forever calls this every poll_interval: the loop's
+                # natural heartbeat — a wedged selector stops beating and
+                # the watchdog dumps the daemon's stacks.
+                if self.guard is not None:
+                    self.guard.beat()
 
         self._server = Server(self.socket_path, Handler)
+        JOURNAL.record(
+            "topology-daemon", "serving", correlation=self.state.claim_uid,
+            socket=self.socket_path,
+        )
         try:
-            self._server.serve_forever(poll_interval=0.1)
+            with WATCHDOG.guard(
+                "topology-daemon.poll", correlation=self.state.claim_uid
+            ) as g:
+                self._server.guard = g
+                self._server.serve_forever(poll_interval=0.1)
         finally:
             path.unlink(missing_ok=True)
 

@@ -44,6 +44,7 @@ from k8s_dra_driver_tpu.kube.objects import (
     ResourceSlice,
 )
 from k8s_dra_driver_tpu.scheduler import cel
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 
 
 class AllocationError(Exception):
@@ -186,7 +187,14 @@ class Allocator:
         """
         if claim.status.allocation is not None:
             return claim  # already allocated (idempotent)
-        p = self.plan(claim, node_name, node_labels)
+        try:
+            p = self.plan(claim, node_name, node_labels)
+        except AllocationError as exc:
+            JOURNAL.record(
+                "allocator", "allocate.fail", correlation=claim.metadata.uid,
+                claim=claim.metadata.name, node=node_name, error=str(exc),
+            )
+            raise
         results = [
             DeviceRequestAllocationResult(
                 request=req_name, driver=c.driver, pool=c.pool, device=c.device.name
@@ -209,6 +217,11 @@ class Allocator:
             )
             if node_name
             else None,
+        )
+        JOURNAL.record(
+            "allocator", "allocate.ok", correlation=claim.metadata.uid,
+            claim=claim.metadata.name, node=node_name,
+            devices=[r.device for r in results],
         )
         return self._server.update(claim)
 
@@ -329,6 +342,10 @@ class Allocator:
                 f"{[r.name for r in claim.status.reserved_for]}"
             )
         claim.status.allocation = None
+        JOURNAL.record(
+            "allocator", "deallocate", correlation=claim.metadata.uid,
+            claim=claim.metadata.name,
+        )
         return self._server.update(claim)
 
     # -- consumer reservation (resource-claim controller semantics) --------
@@ -347,6 +364,10 @@ class Allocator:
             )
         claim.status.reserved_for.append(
             ResourceClaimConsumerReference(resource="pods", name=pod_name, uid=pod_uid)
+        )
+        JOURNAL.record(
+            "allocator", "reserve", correlation=claim.metadata.uid,
+            claim=claim.metadata.name, pod=pod_name,
         )
         return self._server.update(claim)
 

@@ -1,16 +1,31 @@
-"""HTTP diagnostics endpoint: /metrics, /healthz, /debug/state.
+"""HTTP diagnostics endpoint: /metrics, /healthz, /debug/*.
 
 Mirror of the controller's SetupHTTPEndpoint (cmd/nvidia-dra-controller/
 main.go:194-241, promhttp + pprof), extended to both binaries — the
-reference's plugin has no diagnostics at all (SURVEY.md §5)."""
+reference's plugin has no diagnostics at all (SURVEY.md §5).
+
+Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
+
+* ``/metrics``        — Prometheus text exposition of the process registry
+* ``/healthz``        — liveness: ``ok``
+* ``/debug/state``    — the owner's ``state_provider()`` snapshot (JSON)
+* ``/debug/traces``   — the tracer ring's recent spans (JSON)
+* ``/debug/journal``  — the flight recorder's tail (JSON); filters:
+  ``?limit=N&correlation=<id>&component=<name>``
+* ``/debug/stacks``   — every Python thread's stack (JSON) — what
+  tools/diag_bundle.py pulls to bundle a LIVE process without attaching
+  a debugger
+"""
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from k8s_dra_driver_tpu.utils.journal import JOURNAL, Journal
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY, Registry
 from k8s_dra_driver_tpu.utils.tracing import TRACER
 
@@ -22,25 +37,49 @@ class DiagnosticsServer:
         registry: Registry = REGISTRY,
         state_provider: Optional[Callable[[], dict]] = None,
         bind_host: str = "0.0.0.0",
+        journal: Journal = JOURNAL,
     ):
         """``bind_host`` defaults to all interfaces so in-cluster scrapes and
         kubelet probes (which hit the pod IP) can reach the endpoint."""
         registry_ref = registry
         state_ref = state_provider or (lambda: {})
+        journal_ref = journal
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path == "/metrics":
+                url = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(url.query)
+                if url.path == "/metrics":
                     body = registry_ref.render().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path == "/healthz":
+                elif url.path == "/healthz":
                     body = b"ok"
                     ctype = "text/plain"
-                elif self.path == "/debug/state":
+                elif url.path == "/debug/state":
                     body = json.dumps(state_ref(), indent=1, default=str).encode()
                     ctype = "application/json"
-                elif self.path == "/debug/traces":
+                elif url.path == "/debug/traces":
                     body = json.dumps(TRACER.recent(), indent=1, default=str).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/journal":
+                    try:
+                        limit = int(query.get("limit", ["200"])[0])
+                    except ValueError:
+                        limit = 200
+                    doc = {
+                        **journal_ref.stats(),
+                        "events": journal_ref.tail(
+                            limit=limit,
+                            correlation=query.get("correlation", [None])[0],
+                            component=query.get("component", [None])[0],
+                        ),
+                    }
+                    body = json.dumps(doc, indent=1, default=str).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/stacks":
+                    from k8s_dra_driver_tpu.utils.watchdog import thread_stacks
+
+                    body = json.dumps(thread_stacks(), indent=1).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -1,0 +1,109 @@
+"""Claim-lifecycle flight recorder.
+
+SURVEY.md §5: the reference driver has essentially no node-side
+observability, and BENCH_r05.json shows the cost — a 240s data-plane
+timeout diagnosed as ``"hung device link?"`` because no component kept a
+record of what it was doing when it stalled.  This module is the record:
+a bounded, thread-safe journal of timestamped lifecycle events, each
+carrying a **correlation id** (claim UID, device name, request id) so a
+single stall can be traced controller → allocator → node driver →
+serving from one artifact.
+
+Every claim-path component records here (controller/main.py,
+scheduler/allocator.py, kube/resourceslice_controller.py,
+plugin/driver.py, plugin/topology_daemon.py, models/serve.py); the tail
+is exported via ``/debug/journal`` on the diagnostics endpoint and
+embedded in every watchdog diag bundle (utils/watchdog.py).
+
+Overhead is one lock acquisition and one deque append per event — cheap
+enough for the claim path, deliberately NOT placed on per-token device
+loops (the serving engine journals admissions and completions, never
+individual decode steps).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+DEFAULT_CAPACITY = 2048
+
+
+@dataclass(frozen=True)
+class Event:
+    ts: float  # time.time() at record()
+    component: str  # "allocator", "driver", "serve", ...
+    event: str  # "prepare.start", "allocate.fail", ...
+    correlation: str = ""  # claim UID / device name / request id
+    attrs: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(self.ts))
+            + f".{int(self.ts % 1 * 1000):03d}Z",
+            "component": self.component,
+            "event": self.event,
+            **({"correlation": self.correlation} if self.correlation else {}),
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+
+class Journal:
+    """Bounded ring of lifecycle events; drop-oldest under pressure so a
+    chatty component can never block or OOM the process it observes."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._events: deque[Event] = deque(maxlen=capacity)
+        self._dropped = 0
+        self._recorded = 0
+
+    def record(self, component: str, event: str, correlation: str = "", **attrs) -> None:
+        e = Event(
+            ts=time.time(),
+            component=component,
+            event=event,
+            correlation=str(correlation),
+            attrs=attrs,
+        )
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._recorded += 1
+            self._events.append(e)
+
+    def tail(self, limit: int = 200, correlation: str | None = None,
+             component: str | None = None) -> list[dict]:
+        """Newest-last slice of the ring, optionally filtered — the shape
+        ``/debug/journal`` serves and diag bundles embed."""
+        with self._lock:
+            events = list(self._events)
+        if correlation is not None:
+            events = [e for e in events if e.correlation == str(correlation)]
+        if component is not None:
+            events = [e for e in events if e.component == component]
+        return [e.to_json() for e in events[-limit:]]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self._events.maxlen,
+                "buffered": len(self._events),
+                "recorded": self._recorded,
+                "dropped": self._dropped,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+            self._recorded = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+JOURNAL = Journal()

@@ -12,9 +12,16 @@ import logging
 import os
 import sys
 import time
+import traceback
 
 
 class JSONFormatter(logging.Formatter):
+    """JSON lines with full exception fidelity: ``logger.exception(...)``
+    must not lose its traceback in JSON mode (the whole point of the
+    format is machine-ingestible post-mortems), so ``exc_info`` is
+    serialized structured — type, message, and traceback frames — and
+    ``stack_info=True`` call-site stacks ride along as ``stack``."""
+
     def format(self, record: logging.LogRecord) -> str:
         doc = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
@@ -23,7 +30,21 @@ class JSONFormatter(logging.Formatter):
             "msg": record.getMessage(),
         }
         if record.exc_info:
-            doc["exc"] = self.formatException(record.exc_info)
+            etype, exc, tb = record.exc_info
+            doc["exc"] = {
+                "type": etype.__name__ if etype else "",
+                "message": str(exc),
+                "traceback": [
+                    ln.rstrip("\n")
+                    for ln in traceback.format_exception(etype, exc, tb)
+                ],
+            }
+        elif record.exc_text:
+            # A text-format handler on the same record caches the rendered
+            # traceback here; keep it rather than drop the exception.
+            doc["exc"] = {"type": "", "message": "", "traceback": record.exc_text.splitlines()}
+        if record.stack_info:
+            doc["stack"] = record.stack_info.splitlines()
         return json.dumps(doc)
 
 

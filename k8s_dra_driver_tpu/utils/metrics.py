@@ -22,10 +22,22 @@ def _label_key(labels: dict[str, str] | None) -> tuple:
     return tuple(sorted((labels or {}).items()))
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition escaping for label values: backslash,
+    double quote and newline must be escaped or the rendered line is
+    unparseable (and a crafted value could inject whole bogus samples)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _label_str(key: tuple) -> str:
     if not key:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+    return "{" + ",".join(f'{k}="{escape_label_value(v)}"' for k, v in key) + "}"
 
 
 @dataclass
@@ -46,6 +58,10 @@ class Counter:
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -70,6 +86,10 @@ class Gauge:
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -103,6 +123,12 @@ class Histogram:
     def count(self, **labels) -> int:
         with self._lock:
             return self._totals.get(_label_key(labels), 0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
 
     def quantile(self, q: float, **labels) -> float:
         """Approximate quantile from bucket counts (upper bound of the bucket
@@ -156,6 +182,19 @@ class Registry:
             if name not in self._metrics:
                 self._metrics[name] = factory()
             return self._metrics[name]
+
+    def reset(self) -> None:
+        """Zero every registered metric's recorded values, KEEPING the
+        metric objects: modules bind them at import time (e.g.
+        models/serve.py's ``_M_TOKENS``), so dropping the dict would
+        silently fork live metrics off the rendered ``/metrics`` output.
+        Tests reset the global REGISTRY between cases (autouse fixture in
+        tests/conftest.py) so asserts are absolute, not before/after
+        deltas against whatever earlier tests left behind."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()
 
     def render(self) -> str:
         with self._lock:

@@ -20,6 +20,21 @@ os.environ.setdefault("TPUINFO_FAKE_TOPOLOGY", "v5e-16")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """Fresh global observability state per test: metric asserts can be
+    absolute instead of before/after deltas against whatever earlier tests
+    left in the process-wide REGISTRY, and journal asserts can't match a
+    previous test's events.  Values reset, objects kept — modules bind
+    metrics at import time (see Registry.reset)."""
+    from k8s_dra_driver_tpu.utils.journal import JOURNAL
+    from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+    REGISTRY.reset()
+    JOURNAL.clear()
+    yield
+
+
 @pytest.fixture
 def api_server():
     from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer

@@ -1,0 +1,482 @@
+"""Flight recorder (utils/journal.py), stall watchdog + diag bundles
+(utils/watchdog.py), the /debug/journal + /debug/stacks endpoints, the
+tools/diag_bundle.py CLI, and the bench data-plane-timeout bundle path."""
+
+import json
+import logging
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+from k8s_dra_driver_tpu.utils.journal import JOURNAL, Journal
+from k8s_dra_driver_tpu.utils.logging import JSONFormatter
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, Registry
+from k8s_dra_driver_tpu.utils.tracing import TRACER
+from k8s_dra_driver_tpu.utils.watchdog import (
+    Watchdog,
+    dump_diag_bundle,
+    thread_stacks,
+)
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+class TestJournal:
+    def test_record_and_tail_newest_last(self):
+        j = Journal()
+        j.record("allocator", "allocate.ok", correlation="uid-1", node="h0")
+        j.record("driver", "prepare.ok", correlation="uid-1")
+        events = j.tail()
+        assert [e["event"] for e in events] == ["allocate.ok", "prepare.ok"]
+        assert events[0]["correlation"] == "uid-1"
+        assert events[0]["attrs"] == {"node": "h0"}
+        assert events[0]["ts"].endswith("Z")
+
+    def test_correlation_and_component_filters(self):
+        j = Journal()
+        j.record("allocator", "allocate.ok", correlation="uid-a")
+        j.record("allocator", "allocate.ok", correlation="uid-b")
+        j.record("driver", "prepare.ok", correlation="uid-a")
+        assert len(j.tail(correlation="uid-a")) == 2
+        assert [e["component"] for e in j.tail(correlation="uid-a")] == [
+            "allocator", "driver",
+        ]
+        assert len(j.tail(component="driver")) == 1
+        assert len(j.tail(correlation="uid-a", component="driver")) == 1
+        assert j.tail(correlation="nope") == []
+
+    def test_capacity_drops_oldest(self):
+        j = Journal(capacity=4)
+        for i in range(10):
+            j.record("c", f"e{i}")
+        assert len(j) == 4
+        events = j.tail()
+        assert [e["event"] for e in events] == ["e6", "e7", "e8", "e9"]
+        stats = j.stats()
+        assert stats == {"capacity": 4, "buffered": 4, "recorded": 10, "dropped": 6}
+
+    def test_limit_takes_newest(self):
+        j = Journal()
+        for i in range(5):
+            j.record("c", f"e{i}")
+        assert [e["event"] for e in j.tail(limit=2)] == ["e3", "e4"]
+
+    def test_clear(self):
+        j = Journal()
+        j.record("c", "e")
+        j.clear()
+        assert len(j) == 0
+        assert j.stats()["recorded"] == 0
+
+    def test_concurrent_recorders_drop_nothing_below_capacity(self):
+        j = Journal(capacity=10_000)
+        n_threads, per_thread = 8, 500
+
+        def pound(t):
+            for i in range(per_thread):
+                j.record("hammer", f"t{t}.e{i}", correlation=f"t{t}")
+
+        threads = [threading.Thread(target=pound, args=(t,)) for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = j.stats()
+        assert stats["recorded"] == n_threads * per_thread
+        assert stats["dropped"] == 0
+        for t in range(n_threads):
+            assert len(j.tail(limit=per_thread, correlation=f"t{t}")) == per_thread
+
+
+class TestWatchdog:
+    def test_beat_keeps_guard_healthy(self, tmp_path):
+        wd = Watchdog(bundle_dir=str(tmp_path))
+        with wd.guard("healthy", timeout_s=0.05) as g:
+            time.sleep(0.06)
+            g.beat()
+            assert wd.check_now() == []
+        assert wd.active() == []  # unregistered on exit
+
+    def test_stall_dumps_bundle_with_stacks_journal_and_spans(self, tmp_path):
+        with TRACER.span("prepare", claim="uid-stall"):
+            pass
+        JOURNAL.record("driver", "prepare.start", correlation="uid-stall")
+        wd = Watchdog(bundle_dir=str(tmp_path))
+        with wd.guard("serve.step", timeout_s=0.01, correlation="uid-stall"):
+            time.sleep(0.02)
+            written = wd.check_now()
+        assert len(written) == 1
+        bundle = json.loads(Path(written[0]).read_text())
+        assert bundle["kind"] == "tpu-dra-diag-bundle"
+        assert bundle["correlation"] == "uid-stall"
+        assert "serve.step" in bundle["reason"]
+        # Thread stacks: at least this (MainThread) test frame is present.
+        assert any("MainThread" in k for k in bundle["thread_stacks"])
+        stack_blob = "\n".join(
+            ln for frames in bundle["thread_stacks"].values() for ln in frames
+        )
+        assert "test_stall_dumps_bundle" in stack_blob
+        # Journal tail carries the stalled claim's correlation id...
+        assert any(
+            e.get("correlation") == "uid-stall" for e in bundle["journal_tail"]
+        )
+        # ...including the watchdog's own stall.detected event.
+        assert any(
+            e["event"] == "stall.detected" for e in bundle["journal_tail"]
+        )
+        # Recent spans ride along.
+        assert any(s["name"] == "prepare" for s in bundle["traces"])
+        # The armed guard's metadata is in the state section.
+        assert any(
+            g["name"] == "serve.step" for g in bundle["state"]["watchdog_guards"]
+        )
+        assert REGISTRY.counter("dra_watchdog_stalls_total").value(
+            section="serve.step"
+        ) == 1
+
+    def test_one_bundle_per_stall_verdict(self, tmp_path):
+        wd = Watchdog(bundle_dir=str(tmp_path))
+        with wd.guard("s", timeout_s=0.01) as g:
+            time.sleep(0.02)
+            assert len(wd.check_now()) == 1
+            assert wd.check_now() == []  # still stalled: no re-dump
+            g.beat()  # late beat = slow, not dead
+            assert wd.check_now() == []
+            time.sleep(0.02)  # stalls AGAIN: a fresh verdict, a fresh bundle
+            assert len(wd.check_now()) == 1
+        assert len(wd.bundles) == 2
+
+    def test_monitor_thread_detects_stall(self, tmp_path):
+        wd = Watchdog(bundle_dir=str(tmp_path), poll_interval_s=0.01)
+        try:
+            with wd.guard("bg", timeout_s=0.03):
+                deadline = time.time() + 5.0
+                while not wd.bundles and time.time() < deadline:
+                    time.sleep(0.01)
+            assert wd.bundles, "monitor thread never dumped the stall"
+        finally:
+            wd.stop()
+
+    def test_bundle_survives_failing_state_provider(self, tmp_path):
+        def bad_state():
+            raise RuntimeError("wedged lock")
+
+        path = dump_diag_bundle(str(tmp_path), reason="test", state=None)
+        bundle = json.loads(Path(path).read_text())
+        assert bundle["state"] == {}
+        wd = Watchdog(bundle_dir=str(tmp_path), state_provider=bad_state)
+        with wd.guard("s", timeout_s=0.01):
+            time.sleep(0.02)
+            written = wd.check_now()
+        assert written  # provider raised; the bundle still landed
+
+    def test_thread_stacks_names_threads(self):
+        stacks = thread_stacks()
+        assert any("MainThread" in k for k in stacks)
+        for frames in stacks.values():
+            assert isinstance(frames, list)
+
+
+class TestJournalEndpoint:
+    @pytest.fixture
+    def server(self):
+        j = Journal()
+        srv = DiagnosticsServer(port=0, bind_host="127.0.0.1", journal=j)
+        srv.start()
+        yield j, f"http://127.0.0.1:{srv.port}"
+        srv.stop()
+
+    def test_debug_journal_tail_and_filters(self, server):
+        j, base = server
+        j.record("allocator", "allocate.ok", correlation="uid-1")
+        j.record("driver", "prepare.ok", correlation="uid-1")
+        j.record("driver", "prepare.ok", correlation="uid-2")
+        doc = json.loads(urllib.request.urlopen(f"{base}/debug/journal").read())
+        assert doc["recorded"] == 3
+        assert len(doc["events"]) == 3
+        doc = json.loads(
+            urllib.request.urlopen(f"{base}/debug/journal?correlation=uid-1").read()
+        )
+        assert len(doc["events"]) == 2
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"{base}/debug/journal?component=driver&limit=1"
+            ).read()
+        )
+        assert len(doc["events"]) == 1
+        assert doc["events"][0]["correlation"] == "uid-2"
+        # Garbage limit degrades to the default instead of erroring.
+        doc = json.loads(
+            urllib.request.urlopen(f"{base}/debug/journal?limit=bogus").read()
+        )
+        assert len(doc["events"]) == 3
+
+    def test_debug_stacks_endpoint(self, server):
+        _, base = server
+        stacks = json.loads(urllib.request.urlopen(f"{base}/debug/stacks").read())
+        assert any("MainThread" in k for k in stacks)
+
+
+class TestDiagBundleCLI:
+    def test_snapshot_of_live_server(self, tmp_path, capsys):
+        import diag_bundle
+
+        JOURNAL.record("driver", "prepare.start", correlation="uid-cli")
+        with TRACER.span("cli-span"):
+            pass
+        REGISTRY.counter("dra_claim_errors_total", "x" ).inc(op="prepare")
+        srv = DiagnosticsServer(
+            port=0, bind_host="127.0.0.1",
+            state_provider=lambda: {"node": "tpu-host-0"},
+        )
+        srv.start()
+        try:
+            rc = diag_bundle.main(
+                ["--url", f"http://127.0.0.1:{srv.port}", "--out", str(tmp_path)]
+            )
+        finally:
+            srv.stop()
+        assert rc == 0
+        out_path = Path(capsys.readouterr().out.strip())
+        assert out_path.parent == tmp_path
+        bundle = json.loads(out_path.read_text())
+        assert bundle["kind"] == "tpu-dra-diag-bundle"
+        assert bundle["healthz"] == "ok"
+        assert "dra_claim_errors_total" in bundle["metrics"]
+        assert bundle["state"] == {"node": "tpu-host-0"}
+        assert any(
+            e.get("correlation") == "uid-cli" for e in bundle["journal"]["events"]
+        )
+        assert any(s["name"] == "cli-span" for s in bundle["traces"])
+        assert any("MainThread" in k for k in bundle["thread_stacks"])
+
+    def test_nothing_listening_exits_1(self, tmp_path, capsys):
+        import diag_bundle
+
+        # Port 1 is privileged and unbound: every endpoint refuses.
+        rc = diag_bundle.main(
+            ["--url", "http://127.0.0.1:1", "--out", str(tmp_path), "--timeout-s", "0.2"]
+        )
+        assert rc == 1
+        assert "nothing listening" in capsys.readouterr().err
+        assert list(tmp_path.iterdir()) == []
+
+    def test_half_wedged_process_still_bundles(self, tmp_path):
+        import diag_bundle
+
+        def bad_state():
+            raise RuntimeError("wedged")
+
+        srv = DiagnosticsServer(
+            port=0, bind_host="127.0.0.1", state_provider=bad_state
+        )
+        srv.start()
+        try:
+            bundle, answered = diag_bundle.build_bundle(
+                f"http://127.0.0.1:{srv.port}", timeout_s=5.0
+            )
+        finally:
+            srv.stop()
+        assert answered >= 5  # /debug/state 500s; everything else answers
+        assert str(bundle["state"]).startswith("error:")
+        assert bundle["healthz"] == "ok"
+
+
+class TestLifecycleJournalWiring:
+    def test_claim_path_events_share_the_claim_uid(self, tmp_path):
+        from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+        from k8s_dra_driver_tpu.plugin.driver import ClaimRef, Driver, DriverConfig
+
+        cluster = make_cluster(hosts=1, work_dir=str(tmp_path))
+        driver = Driver(
+            cluster.server,
+            DriverConfig(
+                node_name="tpu-host-0",
+                cdi_root=str(tmp_path / "cdi"),
+                checkpoint_path=str(tmp_path / "cp.json"),
+                topology_env={
+                    "TPUINFO_FAKE_TOPOLOGY": "v5e-16",
+                    "TPUINFO_FAKE_HOST_ID": "0",
+                },
+                publish=False,
+            ),
+        )
+        claim = cluster.server.create(simple_claim("m1"))
+        allocated = cluster.allocator.allocate(claim, node_name="tpu-host-0")
+        uid = allocated.metadata.uid
+        driver.node_prepare_resources(
+            [ClaimRef(uid=uid, name="m1", namespace="default")]
+        )
+        driver.node_unprepare_resources(
+            [ClaimRef(uid=uid, name="m1", namespace="default")]
+        )
+        events = [e["event"] for e in JOURNAL.tail(correlation=uid)]
+        # One correlation id traces scheduler -> kubelet-plugin lifecycle.
+        assert "allocate.ok" in events
+        assert "prepare.start" in events
+        assert "prepare.ok" in events
+        assert "unprepare.ok" in events
+        prepare_ok = next(
+            e for e in JOURNAL.tail(correlation=uid) if e["event"] == "prepare.ok"
+        )
+        assert prepare_ok["attrs"]["devices"]
+        assert prepare_ok["attrs"]["duration_ms"] >= 0
+
+    def test_allocate_failure_journaled(self, tmp_path):
+        from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+        from k8s_dra_driver_tpu.scheduler.allocator import AllocationError
+
+        cluster = make_cluster(hosts=1, work_dir=str(tmp_path))
+        # More chips than one fake host publishes: the plan must fail.
+        claim = cluster.server.create(simple_claim("greedy", count=1000))
+        with pytest.raises(AllocationError):
+            cluster.allocator.allocate(claim, node_name="tpu-host-0")
+        events = JOURNAL.tail(correlation=claim.metadata.uid)
+        assert any(e["event"] == "allocate.fail" for e in events)
+
+
+class TestServeJournal:
+    def test_submit_and_complete_events_carry_request_id(self):
+        jax = pytest.importorskip("jax")
+        from k8s_dra_driver_tpu.models.burnin import ModelConfig, init_params
+        from k8s_dra_driver_tpu.models.serve import ServeEngine
+
+        cfg = ModelConfig(
+            vocab_size=32, d_model=16, n_layers=1, n_heads=2, max_seq=32
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ServeEngine(params=params, cfg=cfg, n_slots=2, prompt_bucket=8)
+        rid = eng.submit([1, 2, 3], max_tokens=2)
+        eng.run_until_drained()
+        events = [e["event"] for e in JOURNAL.tail(correlation=f"req-{rid}")]
+        assert "request.submit" in events
+        assert "request.complete" in events
+
+
+class TestConcurrentScrape:
+    def test_hammered_registry_and_tracer_render_parseable(self):
+        r = Registry()
+        j = Journal()
+        srv = DiagnosticsServer(port=0, bind_host="127.0.0.1", registry=r, journal=j)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        stop = threading.Event()
+        errors: list = []
+
+        def pound(i):
+            c = r.counter("hammer_ops_total", "ops")
+            g = r.gauge("hammer_level", "level")
+            h = r.histogram("hammer_seconds", "lat")
+            n = 0
+            while not stop.is_set():
+                # Hostile label values exercise the escaping under load.
+                c.inc(worker=f'w"{i}\\', op="x\ny")
+                g.set(n, worker=str(i))
+                h.observe(0.01 * (n % 7))
+                with TRACER.span("hammer", worker=str(i)):
+                    pass
+                j.record("hammer", "tick", correlation=f"w{i}")
+                n += 1
+
+        workers = [
+            threading.Thread(target=pound, args=(i,), daemon=True) for i in range(4)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            for _ in range(20):  # scrape loop racing the writers
+                text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+                for line in text.splitlines():
+                    if not line or line.startswith("#"):
+                        continue
+                    # Every sample line must keep its "name{labels} value"
+                    # shape even mid-hammer; raw newlines would break this.
+                    assert " " in line, f"unparseable sample {line!r}"
+                    float(line.rsplit(" ", 1)[1])
+                json.loads(urllib.request.urlopen(f"{base}/debug/traces").read())
+                json.loads(urllib.request.urlopen(f"{base}/debug/journal").read())
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=5)
+            srv.stop()
+        assert not errors
+
+
+class TestJSONFormatterExceptions:
+    def _format(self, record):
+        return json.loads(JSONFormatter().format(record))
+
+    def test_exc_info_serialized_structured(self):
+        logger = logging.getLogger("fmt-test")
+        records = []
+        logger.addHandler(logging.NullHandler())
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            record = logger.makeRecord(
+                "fmt-test", logging.ERROR, __file__, 1, "it broke", (),
+                sys.exc_info(),
+            )
+        doc = self._format(record)
+        assert doc["msg"] == "it broke"
+        assert doc["exc"]["type"] == "ValueError"
+        assert doc["exc"]["message"] == "boom"
+        assert any("raise ValueError" in ln for ln in doc["exc"]["traceback"])
+        # The whole line stays one JSON object (no raw newlines).
+        assert "\n" not in JSONFormatter().format(record)
+
+    def test_cached_exc_text_kept(self):
+        record = logging.LogRecord(
+            "fmt-test", logging.ERROR, __file__, 1, "cached", (), None
+        )
+        record.exc_text = "Traceback (most recent call last):\n  boom"
+        doc = self._format(record)
+        assert doc["exc"]["traceback"] == [
+            "Traceback (most recent call last):", "  boom",
+        ]
+
+    def test_stack_info_serialized(self):
+        record = logging.LogRecord(
+            "fmt-test", logging.INFO, __file__, 1, "where", (), None
+        )
+        record.stack_info = "Stack (most recent call last):\n  File x"
+        doc = self._format(record)
+        assert doc["stack"] == ["Stack (most recent call last):", "  File x"]
+
+
+class TestBenchTimeoutBundle:
+    def test_data_plane_timeout_reports_bundle_path(self, tmp_path, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("TPU_DRA_DIAG_DIR", str(tmp_path))
+        release = threading.Event()
+
+        def hang(sink=None):
+            sink["partial_block"] = {"ok": True}  # salvage survives the hang
+            release.wait(10)
+
+        monkeypatch.setattr(bench, "run_data_plane", hang)
+        try:
+            result = bench._run_data_plane_guarded(timeout_s=0.2)
+        finally:
+            release.set()
+        assert result["partial_block"] == {"ok": True}
+        assert "timed out" in result["error"]
+        assert "diag bundle: " in result["error"]
+        bundle_path = result["error"].split("diag bundle: ", 1)[1]
+        bundle = json.loads(Path(bundle_path).read_text())
+        assert bundle["kind"] == "tpu-dra-diag-bundle"
+        # The wedged worker thread's stack is in the bundle — the evidence
+        # the bare "hung device link?" guess never had.
+        stack_blob = "\n".join(
+            ln for frames in bundle["thread_stacks"].values() for ln in frames
+        )
+        assert "hang" in stack_blob
+        assert bundle["state"]["salvaged_blocks"] == ["partial_block"]

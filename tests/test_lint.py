@@ -93,6 +93,53 @@ class TestChecks:
         assert findings_for(tmp_path, "def broken(:\n") == ["syntax"]
 
 
+class TestMetricHygiene:
+    def test_counter_without_total_flagged(self, tmp_path):
+        src = "r.counter('dra_allocations', 'help text')\n"
+        assert findings_for(tmp_path, src) == ["metric-hygiene"]
+
+    def test_counter_with_total_clean(self, tmp_path):
+        src = "r.counter('dra_allocations_total', 'help text')\n"
+        assert findings_for(tmp_path, src) == []
+
+    def test_gauge_claiming_total_flagged(self, tmp_path):
+        src = "r.gauge('dra_devices_total', 'help text')\n"
+        assert findings_for(tmp_path, src) == ["metric-hygiene"]
+
+    def test_histogram_needs_unit_suffix(self, tmp_path):
+        src = "r.histogram('dra_prepare_latency', 'help text')\n"
+        assert findings_for(tmp_path, src) == ["metric-hygiene"]
+        for ok in ("_seconds", "_bytes", "_tokens"):
+            src = f"r.histogram('dra_prepare{ok}', 'help text')\n"
+            assert findings_for(tmp_path, src) == []
+
+    def test_non_snake_case_flagged(self, tmp_path):
+        src = "r.counter('DraErrors_total', 'help text')\n"
+        assert findings_for(tmp_path, src) == ["metric-hygiene"]
+
+    def test_explicit_empty_help_flagged(self, tmp_path):
+        src = "r.counter('dra_errors_total', '')\n"
+        assert findings_for(tmp_path, src) == ["metric-hygiene"]
+
+    def test_omitted_help_is_lookup_idiom(self, tmp_path):
+        # No help argument = look up the existing metric; never flagged.
+        src = "r.counter('dra_errors_total')\n"
+        assert findings_for(tmp_path, src) == []
+
+    def test_help_keyword_checked(self, tmp_path):
+        src = "r.gauge('dra_devices', help='')\n"
+        assert findings_for(tmp_path, src) == ["metric-hygiene"]
+
+    def test_non_metric_calls_ignored(self, tmp_path):
+        # .counter() on arbitrary objects with non-string args is not ours.
+        src = "x = 1\nfoo.counter(x)\n"
+        assert findings_for(tmp_path, src) == []
+
+    def test_ignore_pragma_applies(self, tmp_path):
+        src = "r.counter('weird', 'h')  # lint: ignore[metric-hygiene]\n"
+        assert findings_for(tmp_path, src) == []
+
+
 class TestMain:
     def test_missing_target_fails_loudly(self, capsys):
         rc = lint.main(["lint", "no/such/dir"])

@@ -40,13 +40,51 @@ class TestRegistry:
 
     def test_same_name_returns_same_metric(self):
         r = Registry()
-        assert r.counter("x") is r.counter("x")
+        assert r.counter("x_total") is r.counter("x_total")
+
+    def test_label_escaping_round_trips(self):
+        r = Registry()
+        c = r.counter("escapes_total", "hostile label values")
+        hostile = 'quote:" backslash:\\ newline:\nend'
+        c.inc(claim=hostile)
+        text = r.render()
+        line = next(
+            ln for ln in text.splitlines() if ln.startswith("escapes_total{")
+        )
+        # Exposition lines are newline-delimited: a raw newline in a label
+        # would split the sample in two.
+        assert "\n" not in line
+        assert 'claim="quote:\\" backslash:\\\\ newline:\\nend"' in line
+        # Round trip: unescaping the rendered value recovers the original.
+        rendered = line.split('claim="', 1)[1].rsplit('"', 1)[0]
+        unescaped = (
+            rendered.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+        )
+        assert unescaped == hostile
+
+    def test_reset_keeps_metric_objects_but_zeroes_values(self):
+        r = Registry()
+        c = r.counter("resets_total", "reset test")
+        g = r.gauge("reset_level", "reset test")
+        h = r.histogram("reset_seconds", "reset test")
+        c.inc()
+        g.set(7)
+        h.observe(0.2)
+        r.reset()
+        # Same objects (modules bind metrics at import time)...
+        assert r.counter("resets_total") is c
+        # ...but every recorded value is gone.
+        assert c.value() == 0
+        assert g.value() == 0
+        assert h.count() == 0
+        c.inc()
+        assert "resets_total 1.0" in r.render()
 
 
 class TestDiagnosticsServer:
     def test_endpoints(self):
         r = Registry()
-        r.counter("hits_total", "").inc()
+        r.counter("hits_total", "endpoint test hits").inc()
         srv = DiagnosticsServer(port=0, registry=r, state_provider=lambda: {"ok": True})
         srv.start()
         try:
@@ -83,18 +121,19 @@ class TestDriverInstrumentation:
                 publish=False,
             ),
         )
+        # Absolute asserts: the autouse REGISTRY.reset() fixture
+        # (tests/conftest.py) guarantees a clean slate per test — no
+        # before/after deltas against whatever earlier tests left behind.
         h = REGISTRY.histogram("dra_node_prepare_seconds")
-        before = h.count()
         claim = cluster.server.create(simple_claim("m1"))
         allocated = cluster.allocator.allocate(claim, node_name="tpu-host-0")
         driver.node_prepare_resources(
             [ClaimRef(uid=allocated.metadata.uid, name="m1", namespace="default")]
         )
-        assert h.count() == before + 1
+        assert h.count() == 1
 
         errs = REGISTRY.counter("dra_claim_errors_total")
-        before_err = errs.value(op="prepare")
         driver.node_prepare_resources(
             [ClaimRef(uid="x", name="ghost", namespace="default")]
         )
-        assert errs.value(op="prepare") == before_err + 1
+        assert errs.value(op="prepare") == 1

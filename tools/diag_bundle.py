@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""One-shot diagnostics snapshot of a LIVE driver/controller process —
+the ``nvidia-bug-report.sh`` analogue for this driver.
+
+Pulls every diagnostics endpoint of a running DiagnosticsServer
+(utils/diagnostics.py) over HTTP and writes them into a single JSON
+bundle, shaped like the in-process bundles utils/watchdog.py dumps on a
+stall — one artifact to attach to a bug report either way:
+
+  /healthz        liveness
+  /metrics        Prometheus text exposition
+  /debug/state    the owner's state snapshot
+  /debug/traces   the tracer ring's recent spans
+  /debug/journal  the claim-lifecycle flight recorder's tail
+  /debug/stacks   every Python thread's stack
+
+Per-endpoint failures are recorded in the bundle as ``"error: ..."``
+strings rather than aborting: a half-wedged process is EXACTLY the one
+worth snapshotting, and whatever still answers must land in the bundle.
+
+Usage:
+    python tools/diag_bundle.py --url http://127.0.0.1:8080 [--out DIR]
+    python tools/diag_bundle.py --port 8080   # shorthand for localhost
+
+Prints the bundle path on success; exits 1 when NO endpoint answered
+(nothing listening is the one case with nothing to bundle).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+ENDPOINTS = {
+    "healthz": "/healthz",
+    "metrics": "/metrics",
+    "state": "/debug/state",
+    "traces": "/debug/traces",
+    "journal": "/debug/journal?limit=500",
+    "thread_stacks": "/debug/stacks",
+}
+
+TEXT_SECTIONS = {"healthz", "metrics"}  # not JSON on the wire
+
+
+def fetch(url: str, timeout_s: float):
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode()
+
+
+def collect(base_url: str, timeout_s: float = 5.0) -> tuple[dict, int]:
+    """Pull every endpoint; returns (sections, n_answered)."""
+    sections: dict = {}
+    answered = 0
+    for name, path in ENDPOINTS.items():
+        try:
+            body = fetch(base_url.rstrip("/") + path, timeout_s)
+            sections[name] = body if name in TEXT_SECTIONS else json.loads(body)
+            answered += 1
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            sections[name] = f"error: {type(exc).__name__}: {exc}"
+    return sections, answered
+
+
+def build_bundle(base_url: str, timeout_s: float = 5.0) -> tuple[dict, int]:
+    sections, answered = collect(base_url, timeout_s)
+    bundle = {
+        "kind": "tpu-dra-diag-bundle",
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "reason": f"diag_bundle.py snapshot of {base_url}",
+        "source": base_url,
+        **sections,
+    }
+    return bundle, answered
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="diag_bundle", description=__doc__)
+    parser.add_argument("--url", default="", help="base URL of the diagnostics server")
+    parser.add_argument(
+        "--port", type=int, default=0,
+        help="shorthand: snapshot http://127.0.0.1:PORT",
+    )
+    parser.add_argument(
+        "--out", default="",
+        help="output directory (default: $TPU_DRA_DIAG_DIR or $TMPDIR/tpu-dra-diag)",
+    )
+    parser.add_argument("--timeout-s", type=float, default=5.0)
+    args = parser.parse_args(argv)
+    if bool(args.url) == bool(args.port):
+        parser.error("exactly one of --url or --port is required")
+    base_url = args.url or f"http://127.0.0.1:{args.port}"
+
+    bundle, answered = build_bundle(base_url, args.timeout_s)
+    if answered == 0:
+        print(f"diag_bundle: nothing listening at {base_url}", file=sys.stderr)
+        return 1
+
+    out_dir = Path(
+        args.out
+        or os.environ.get("TPU_DRA_DIAG_DIR", "")
+        or Path(os.environ.get("TMPDIR", "/tmp")) / "tpu-dra-diag"
+    )
+    out_dir.mkdir(parents=True, exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    out = out_dir / f"diag-bundle-{stamp}-remote.json"
+    out.write_text(json.dumps(bundle, indent=1, default=str))
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,9 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
   nonascii-ident     asciicheck analog: non-ASCII identifiers
   duplicate-def      same name bound twice by def/class in one scope
   tab-indent         literal tabs in indentation (gofmt analog)
+  metric-hygiene     Prometheus naming: snake_case, counters end _total,
+                     histograms carry a unit suffix, gauges don't claim
+                     _total, declared help strings are non-empty
 
 Suppress a line with ``# lint: ignore[<check>]`` or a whole file with
 ``# lint: skip-file`` in its first five lines.
@@ -34,6 +37,42 @@ SKIP_FILE_RE = re.compile(r"#\s*lint:\s*skip-file")
 
 # Names whose import is a side effect or a re-export by convention.
 SIDE_EFFECT_IMPORTS = {"__future__"}
+
+# -- metric-hygiene (utils/metrics.py Registry call sites) -------------------
+METRIC_KINDS = {"counter", "gauge", "histogram"}
+METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# Histograms observe a measured quantity; the name must say its unit.
+HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_tokens", "_total")
+
+
+def _metric_findings(kind: str, name: str, help_node) -> list[tuple[str, str]]:
+    """Prometheus naming-convention verdicts for one registry call site.
+    Returns (check, message) pairs; pure so tests can drive it directly."""
+    out = []
+    if not METRIC_NAME_RE.match(name):
+        out.append(("metric-hygiene", f"metric name {name!r} is not snake_case"))
+    if kind == "counter" and not name.endswith("_total"):
+        out.append(("metric-hygiene", f"counter {name!r} must end in '_total'"))
+    if kind == "gauge" and name.endswith("_total"):
+        out.append((
+            "metric-hygiene",
+            f"gauge {name!r} must not end in '_total' (counters own that suffix)",
+        ))
+    if kind == "histogram" and not name.endswith(HISTOGRAM_SUFFIXES):
+        out.append((
+            "metric-hygiene",
+            f"histogram {name!r} needs a unit suffix "
+            f"({', '.join(HISTOGRAM_SUFFIXES)})",
+        ))
+    # Only an EXPLICIT empty literal is flagged: omitting help is the
+    # lookup-by-name idiom (Registry returns the existing metric).
+    if (
+        isinstance(help_node, ast.Constant)
+        and isinstance(help_node.value, str)
+        and not help_node.value.strip()
+    ):
+        out.append(("metric-hygiene", f"metric {name!r} declared with empty help"))
+    return out
 
 
 class Finding:
@@ -155,6 +194,21 @@ def check_file(path: Path) -> list[Finding]:
             if not any(isinstance(n, ast.FormattedValue) for n in ast.walk(node)):
                 add(node.lineno, "fstring-no-field", "f-string without placeholders")
             return
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in METRIC_KINDS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            help_node = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "help"), None
+            )
+            for check, message in _metric_findings(
+                node.func.attr, node.args[0].value, help_node
+            ):
+                add(node.lineno, check, message)
         if isinstance(node, ast.Compare):
             for op, comp in zip(node.ops, node.comparators):
                 if (
