@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench demo dryrun lint helm-template clean
+.PHONY: all native test asan-test bench demo dryrun lint perf-smoke helm-template clean
 
 all: native
 
@@ -44,6 +44,12 @@ lint:
 	$(PYTHON) tools/lint.py k8s_dra_driver_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) tools/helm_check.py
 	$(PYTHON) -m tools.helm_render deployments/helm/tpu-dra-driver >/dev/null
+
+# Hot-path perf budget guard (<30s; also runs inside `make test` via
+# tests/test_perf_smoke.py): fails if allocation stops being
+# O(changed pools) or prepare batches stop group-committing.
+perf-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/perf_smoke.py
 
 # Render the chart to stdout (helm template substitute).
 helm-template:

@@ -14,7 +14,16 @@ from pathlib import Path
 from typing import Any
 
 from k8s_dra_driver_tpu.utils.fileio import write_json_atomic
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 from k8s_dra_driver_tpu.version import __version__
+
+# Counted at the lowest level so every writer (per-claim immediate writes,
+# group-committed batches, orphan cleanup) is visible to the perf-smoke
+# budget: each write is an fsync on the kubelet-visible prepare path.
+_CHECKPOINT_WRITES = REGISTRY.counter(
+    "dra_checkpoint_writes_total",
+    "Durable (fsynced) checkpoint file writes",
+)
 
 SCHEMA_VERSION = "v2"
 # Versions this build can still read.  v1 (round 1/2 deployments) carried
@@ -68,3 +77,4 @@ class CheckpointFile:
             "writerVersion": __version__,
         }
         write_json_atomic(self.path, doc, indent=1)
+        _CHECKPOINT_WRITES.inc()

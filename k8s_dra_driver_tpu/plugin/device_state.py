@@ -115,9 +115,33 @@ class DeviceStateConfig:
     selftest_interval_s: float = 0.0
 
 
+@dataclass
+class _CheckpointBatch:
+    """Deferred durability for one NodePrepare/NodeUnprepareResources call.
+
+    While a batch is active, prepare/unprepare mutate in-memory state and
+    record enough here to make ONE checkpoint write at commit cover the
+    whole batch — and to unwind/restore everything if that write fails:
+
+    * ``prepared``: (claim uid, undo stack) per claim prepared in the batch
+      (the same compensable steps an immediate-write failure would run);
+    * ``unprepared``: (claim uid, PreparedClaim) per entry removed, so a
+      failed commit can put them back and a kubelet retry re-runs the
+      (idempotent) teardown.
+
+    A prepare and an unprepare of the SAME claim cannot share a batch:
+    batches are scoped to a single gRPC call, and prepare/unprepare arrive
+    in different calls.
+    """
+
+    prepared: list = field(default_factory=list)
+    unprepared: list = field(default_factory=list)
+
+
 class DeviceState:
     def __init__(self, server, config: DeviceStateConfig):
         self._lock = threading.Lock()
+        self._batch: Optional[_CheckpointBatch] = None
         self._server = server
         self.config = config
         # position -> reason; folded into every refresh() enumeration.
@@ -211,8 +235,15 @@ class DeviceState:
                 # below fails, a kubelet retry would otherwise hit the
                 # idempotence fast-path and report stale success.
                 undo.append(lambda: self.prepared.pop(uid, None))
-                with TRACER.span("Prepare.writeCheckpoint"):
-                    self._write_checkpoint()
+                if self._batch is not None:
+                    # Group commit: durability deferred to the batch commit,
+                    # which runs before the gRPC response is returned.  The
+                    # undo stack moves to the batch so a failed COMMIT can
+                    # still unwind this claim's side effects.
+                    self._batch.prepared.append((uid, list(undo)))
+                else:
+                    with TRACER.span("Prepare.writeCheckpoint"):
+                        self._write_checkpoint()
             except BaseException:
                 for fn in reversed(undo):
                     try:
@@ -237,6 +268,9 @@ class DeviceState:
                     )
             self.cdi.delete_claim_spec_file(claim_uid)
             del self.prepared[claim_uid]
+            if self._batch is not None:
+                self._batch.unprepared.append((claim_uid, prepared))
+                return
             try:
                 self._write_checkpoint()
             except BaseException:
@@ -245,6 +279,48 @@ class DeviceState:
                 # it would leave a phantom claim in the on-disk checkpoint
                 # that resurrects on restart.
                 self.prepared[claim_uid] = prepared
+                raise
+
+    # ------------------------------------------------------------------
+    # Checkpoint group commit
+    # ------------------------------------------------------------------
+
+    def begin_checkpoint_batch(self) -> None:
+        """Defer checkpoint durability for the prepare/unprepare calls that
+        follow, until commit_checkpoint_batch().  One batch per gRPC call;
+        the driver commits before building the response, preserving the
+        'checkpoint durable before kubelet sees success' invariant while
+        paying ONE fsync per call instead of one per claim."""
+        with self._lock:
+            if self._batch is not None:
+                raise RuntimeError("checkpoint batch already active")
+            self._batch = _CheckpointBatch()
+
+    def commit_checkpoint_batch(self) -> None:
+        """Flush the active batch with a single durable checkpoint write.
+
+        On write failure the batch is rolled back — every claim prepared in
+        it is unwound (CDI spec deleted, daemons stopped, in-memory entry
+        popped) and every entry unprepared in it is restored — so memory,
+        disk artifacts and the (old, still-intact) on-disk checkpoint agree
+        and a kubelet retry converges.  Re-raises the write error."""
+        with self._lock:
+            batch = self._batch
+            self._batch = None
+            if batch is None or (not batch.prepared and not batch.unprepared):
+                return  # nothing deferred; the old checkpoint is still true
+            try:
+                with TRACER.span("Prepare.commitCheckpointBatch"):
+                    self._write_checkpoint()
+            except BaseException:
+                for _uid, undo in reversed(batch.prepared):
+                    for fn in reversed(undo):
+                        try:
+                            fn()
+                        except Exception:
+                            pass  # best-effort unwind; original error wins
+                for uid, prepared in batch.unprepared:
+                    self.prepared[uid] = prepared
                 raise
 
     def prepared_claim_uids(self) -> list[str]:

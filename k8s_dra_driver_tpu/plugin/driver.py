@@ -123,60 +123,124 @@ class Driver:
             # discarded by the sweep.
             if self._selftest_run is not None:
                 self._selftest_run.cancel()
-            for ref in claims:
-                ok = False
-                JOURNAL.record(
-                    "driver", "prepare.start", correlation=ref.uid,
-                    claim=f"{ref.namespace}/{ref.name}", node=self.config.node_name,
-                )
-                with TRACER.span(
-                    "NodePrepareResources", claim=f"{ref.namespace}/{ref.name}"
-                ) as span:
-                    try:
-                        out[ref.uid] = ClaimResult(devices=self._prepare_one(ref))
-                        ok = True
-                    except Exception as exc:  # per-claim, not process-fatal
-                        self._claim_errors.inc(op="prepare")
-                        JOURNAL.record(
-                            "driver", "prepare.fail", correlation=ref.uid,
-                            error=f"{type(exc).__name__}: {exc}",
-                        )
-                        out[ref.uid] = ClaimResult(
-                            error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
-                        )
-                if ok:
-                    # single timing source: the span's measurement
-                    self._prepare_seconds.observe(span.duration_ms / 1000)
-                    JOURNAL.record(
-                        "driver", "prepare.ok", correlation=ref.uid,
-                        devices=[d.get("device_name", "") for d in out[ref.uid].devices],
-                        duration_ms=round(span.duration_ms, 3),
+            # Group commit: ONE durable checkpoint write for the whole batch,
+            # flushed below before this method returns — i.e. before the gRPC
+            # response is built — so kubelet never sees success for a claim
+            # the checkpoint doesn't cover.
+            self.state.begin_checkpoint_batch()
+            commit_error: Exception | None = None
+            try:
+                for ref in claims:
+                    ok = False
+                    JOURNAL.record_lazy(
+                        "driver", "prepare.start", correlation=ref.uid,
+                        attrs=lambda: dict(
+                            claim=f"{ref.namespace}/{ref.name}",
+                            node=self.config.node_name,
+                        ),
                     )
+                    with TRACER.span(
+                        "NodePrepareResources", claim=f"{ref.namespace}/{ref.name}"
+                    ) as span:
+                        try:
+                            out[ref.uid] = ClaimResult(devices=self._prepare_one(ref))
+                            ok = True
+                        except Exception as exc:  # per-claim, not process-fatal
+                            self._claim_errors.inc(op="prepare")
+                            JOURNAL.record(
+                                "driver", "prepare.fail", correlation=ref.uid,
+                                error=f"{type(exc).__name__}: {exc}",
+                            )
+                            out[ref.uid] = ClaimResult(
+                                error=f"error preparing claim {ref.namespace}/{ref.name}: {exc}"
+                            )
+                    if ok:
+                        # single timing source: the span's measurement
+                        self._prepare_seconds.observe(span.duration_ms / 1000)
+                        JOURNAL.record_lazy(
+                            "driver", "prepare.ok", correlation=ref.uid,
+                            attrs=lambda: dict(
+                                devices=[
+                                    d.get("device_name", "")
+                                    for d in out[ref.uid].devices
+                                ],
+                                duration_ms=round(span.duration_ms, 3),
+                            ),
+                        )
+            finally:
+                try:
+                    self.state.commit_checkpoint_batch()
+                except Exception as exc:
+                    commit_error = exc
+            if commit_error is not None:
+                # The batch rolled itself back: every claim prepared in it
+                # was unwound.  Tell kubelet so it retries them all — a
+                # success here would be success without durability.
+                JOURNAL.record(
+                    "driver", "prepare.commit_fail",
+                    correlation=self.config.node_name,
+                    error=f"{type(commit_error).__name__}: {commit_error}",
+                )
+                for ref in claims:
+                    res = out.get(ref.uid)
+                    if res is not None and not res.error:
+                        self._claim_errors.inc(op="prepare")
+                        out[ref.uid] = ClaimResult(
+                            error=f"error preparing claim {ref.namespace}/{ref.name}: "
+                            f"checkpoint commit failed: {commit_error}"
+                        )
         return out
 
     def node_unprepare_resources(self, claims: list[ClaimRef]) -> dict[str, ClaimResult]:
         out: dict[str, ClaimResult] = {}
         with self._lock:
-            for ref in claims:
-                start = time.perf_counter()
-                JOURNAL.record(
-                    "driver", "unprepare.start", correlation=ref.uid,
-                    claim=f"{ref.namespace}/{ref.name}", node=self.config.node_name,
-                )
+            self.state.begin_checkpoint_batch()
+            commit_error: Exception | None = None
+            try:
+                for ref in claims:
+                    start = time.perf_counter()
+                    JOURNAL.record_lazy(
+                        "driver", "unprepare.start", correlation=ref.uid,
+                        attrs=lambda: dict(
+                            claim=f"{ref.namespace}/{ref.name}",
+                            node=self.config.node_name,
+                        ),
+                    )
+                    try:
+                        self.state.unprepare(ref.uid)
+                        self._unprepare_seconds.observe(time.perf_counter() - start)
+                        out[ref.uid] = ClaimResult()
+                        JOURNAL.record_lazy("driver", "unprepare.ok", correlation=ref.uid)
+                    except Exception as exc:
+                        self._claim_errors.inc(op="unprepare")
+                        JOURNAL.record(
+                            "driver", "unprepare.fail", correlation=ref.uid,
+                            error=f"{type(exc).__name__}: {exc}",
+                        )
+                        out[ref.uid] = ClaimResult(
+                            error=f"error unpreparing claim {ref.namespace}/{ref.name}: {exc}"
+                        )
+            finally:
                 try:
-                    self.state.unprepare(ref.uid)
-                    self._unprepare_seconds.observe(time.perf_counter() - start)
-                    out[ref.uid] = ClaimResult()
-                    JOURNAL.record("driver", "unprepare.ok", correlation=ref.uid)
+                    self.state.commit_checkpoint_batch()
                 except Exception as exc:
-                    self._claim_errors.inc(op="unprepare")
-                    JOURNAL.record(
-                        "driver", "unprepare.fail", correlation=ref.uid,
-                        error=f"{type(exc).__name__}: {exc}",
-                    )
-                    out[ref.uid] = ClaimResult(
-                        error=f"error unpreparing claim {ref.namespace}/{ref.name}: {exc}"
-                    )
+                    commit_error = exc
+            if commit_error is not None:
+                # Batch rolled back: entries restored, so a kubelet retry
+                # re-runs the (idempotent) teardown and re-attempts the write.
+                JOURNAL.record(
+                    "driver", "unprepare.commit_fail",
+                    correlation=self.config.node_name,
+                    error=f"{type(commit_error).__name__}: {commit_error}",
+                )
+                for ref in claims:
+                    res = out.get(ref.uid)
+                    if res is not None and not res.error:
+                        self._claim_errors.inc(op="unprepare")
+                        out[ref.uid] = ClaimResult(
+                            error=f"error unpreparing claim {ref.namespace}/{ref.name}: "
+                            f"checkpoint commit failed: {commit_error}"
+                        )
         return out
 
     # -- health monitoring (neither reference binary has this) ---------------

@@ -25,7 +25,7 @@ exactly what geometry encoding the driver relies on:
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
@@ -34,17 +34,30 @@ from k8s_dra_driver_tpu.kube.objects import (
     Device,
     DeviceAllocationConfiguration,
     DeviceAllocationResult,
-    DeviceClass,
     DeviceRequestAllocationResult,
     NodeSelector,
     NodeSelectorRequirement,
     NodeSelectorTerm,
     ResourceClaim,
     ResourceClaimConsumerReference,
-    ResourceSlice,
 )
 from k8s_dra_driver_tpu.scheduler import cel
+from k8s_dra_driver_tpu.scheduler.index import AllocationIndex
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+_CEL_MEMO_HITS = REGISTRY.counter(
+    "dra_cel_memo_hits_total",
+    "Selector verdicts served from the per-candidate memo",
+)
+_CEL_MEMO_MISSES = REGISTRY.counter(
+    "dra_cel_memo_misses_total",
+    "Selector verdicts computed and stored in the per-candidate memo",
+)
+_CEL_EVALS = REGISTRY.counter(
+    "dra_cel_evals_total",
+    "CEL selector expressions actually evaluated against a device",
+)
 
 
 class AllocationError(Exception):
@@ -56,6 +69,12 @@ class _Candidate:
     driver: str
     pool: str
     device: Device
+    # Selector-verdict memo, keyed by CEL expression source.  The candidate
+    # object itself is the other half of the memo key: the allocation index
+    # rebuilds candidates whenever their slice's resourceVersion (and hence
+    # pool generation) changes, so an entry is implicitly scoped to
+    # (expression, device, inventory version) and never goes stale.
+    verdicts: dict = field(default_factory=dict, compare=False, repr=False)
 
     @property
     def key(self) -> tuple[str, str, str]:
@@ -103,15 +122,23 @@ def _device_env(c: _Candidate) -> dict:
 
 
 def _matches_selectors(c: _Candidate, selectors) -> bool:
-    env = c.env
     for sel in selectors or []:
         if sel.cel is None:
             continue
-        try:
-            if not cel.evaluate(sel.cel.expression, env) is True:
-                return False
-        except cel.CELError:
-            return False  # erroring selector == non-match
+        expr = sel.cel.expression
+        verdict = c.verdicts.get(expr)
+        if verdict is None:
+            _CEL_MEMO_MISSES.inc()
+            _CEL_EVALS.inc()
+            try:
+                verdict = cel.evaluate(expr, c.env) is True
+            except cel.CELError:
+                verdict = False  # erroring selector == non-match
+            c.verdicts[expr] = verdict
+        else:
+            _CEL_MEMO_HITS.inc()
+        if not verdict:
+            return False
     return True
 
 
@@ -142,6 +169,12 @@ class Plan:
     free: list
     classes: dict
     used_markers: frozenset
+    # Union of the node's visible candidates' markers, precomputed by the
+    # allocation index from per-slice marker unions.  Equivalent to the
+    # union over ``free``: an allocated device's markers are all in
+    # ``used_markers`` (the consumed set records every capacity of every
+    # allocated device), so the difference washes out in tightness().
+    node_markers: frozenset = frozenset()
 
     def tightness(self) -> float:
         """Bin-packing score in [0, 1]: fraction of the node's AVAILABLE
@@ -153,9 +186,14 @@ class Plan:
         geometry survives for whole-subslice claims (the same policy
         `_search` applies WITHIN a node, lifted to cross-node choice for
         the extender's prioritize)."""
-        available: set = set()
-        for c in self.free:
-            available.update(c.markers)  # (pool, marker) pairs
+        if self.node_markers:
+            available = set(self.node_markers)
+        else:
+            # Hand-built Plans (tests, older callers) may not carry the
+            # precomputed union; fall back to scanning free candidates.
+            available = set()
+            for c in self.free:
+                available.update(c.markers)  # (pool, marker) pairs
         available -= self.used_markers
         used: set = set()
         for _, c in self.chosen:
@@ -166,10 +204,22 @@ class Plan:
 
 
 class Allocator:
-    """Allocates pending ResourceClaims against published ResourceSlices."""
+    """Allocates pending ResourceClaims against published ResourceSlices.
+
+    Device visibility, the consumed set and the DeviceClass map are read
+    through an :class:`AllocationIndex` (scheduler/index.py): plan() cost
+    scales with the number of *changed* pools since the last plan, not with
+    the total inventory or the number of existing claims.
+    """
 
     def __init__(self, server: InMemoryAPIServer):
         self._server = server
+        self._index = AllocationIndex(server)
+
+    def close(self) -> None:
+        """Detach the allocation index's watches (long-lived processes that
+        create throwaway Allocators against one server should call this)."""
+        self._index.close()
 
     # -- public ------------------------------------------------------------
 
@@ -190,9 +240,11 @@ class Allocator:
         try:
             p = self.plan(claim, node_name, node_labels)
         except AllocationError as exc:
-            JOURNAL.record(
+            JOURNAL.record_lazy(
                 "allocator", "allocate.fail", correlation=claim.metadata.uid,
-                claim=claim.metadata.name, node=node_name, error=str(exc),
+                attrs=lambda: dict(
+                    claim=claim.metadata.name, node=node_name, error=str(exc),
+                ),
             )
             raise
         results = [
@@ -218,10 +270,12 @@ class Allocator:
             if node_name
             else None,
         )
-        JOURNAL.record(
+        JOURNAL.record_lazy(
             "allocator", "allocate.ok", correlation=claim.metadata.uid,
-            claim=claim.metadata.name, node=node_name,
-            devices=[r.device for r in results],
+            attrs=lambda: dict(
+                claim=claim.metadata.name, node=node_name,
+                devices=[r.device for r in results],
+            ),
         )
         return self._server.update(claim)
 
@@ -250,8 +304,13 @@ class Allocator:
         node_labels = dict(node_labels or {})
         node_labels.setdefault("kubernetes.io/hostname", node_name)
 
-        candidates = self._visible_devices(node_name, node_labels)
-        in_use, used_markers = self._consumed()
+        # One locked read against the allocation index: visible candidates
+        # (cached per pool generation / slice resourceVersion), the
+        # incrementally-maintained consumed set, and the DeviceClass map.
+        view = self._index.snapshot(node_name, node_labels)
+        candidates = view.candidates
+        in_use = view.in_use
+        used_markers = view.used_markers
         in_use |= set(exclude_devices)
         used_markers |= set(extra_markers)
 
@@ -261,7 +320,7 @@ class Allocator:
         if not requests:
             raise AllocationError("claim has no device requests")
 
-        classes = {dc.metadata.name: dc for dc in self._server.list(DeviceClass.KIND)}
+        classes = view.classes
 
         per_request: list[tuple[str, int, list[_Candidate]]] = []
         admin_results: list[DeviceRequestAllocationResult] = []
@@ -333,6 +392,7 @@ class Allocator:
             free=free,
             classes=classes,
             used_markers=frozenset(used_markers),
+            node_markers=view.node_markers,
         )
 
     def deallocate(self, claim: ResourceClaim) -> ResourceClaim:
@@ -378,47 +438,6 @@ class Allocator:
         return self._server.update(claim)
 
     # -- internals ---------------------------------------------------------
-
-    def _visible_devices(self, node_name: str, node_labels: dict[str, str]) -> list[_Candidate]:
-        slices = self._server.list(ResourceSlice.KIND)
-        # Per (driver, pool) keep only the highest generation.
-        max_gen: dict[tuple[str, str], int] = {}
-        for s in slices:
-            key = (s.spec.driver, s.spec.pool.name)
-            max_gen[key] = max(max_gen.get(key, -1), s.spec.pool.generation)
-        out = []
-        for s in slices:
-            if s.spec.pool.generation != max_gen[(s.spec.driver, s.spec.pool.name)]:
-                continue
-            if s.spec.node_name and s.spec.node_name != node_name:
-                continue
-            if s.spec.node_selector is not None and not s.spec.node_selector.matches(node_labels):
-                continue
-            for d in s.spec.devices:
-                out.append(_Candidate(driver=s.spec.driver, pool=s.spec.pool.name, device=d))
-        return out
-
-    def _consumed(self) -> tuple[set, set]:
-        """Devices and (pool, marker) pairs held by existing allocations."""
-        in_use: set = set()
-        used_markers: set = set()
-        device_index = {
-            (s.spec.driver, s.spec.pool.name, d.name): d
-            for s in self._server.list(ResourceSlice.KIND)
-            for d in s.spec.devices
-        }
-        for other in self._server.list(ResourceClaim.KIND):
-            if other.status.allocation is None:
-                continue
-            for r in other.status.allocation.devices.results:
-                if r.admin_access:
-                    continue  # admin access observes, never consumes
-                in_use.add((r.driver, r.pool, r.device))
-                dev = device_index.get((r.driver, r.pool, r.device))
-                if dev is not None:
-                    for cap in dev.basic.capacity:
-                        used_markers.add((r.pool, cap))
-        return in_use, used_markers
 
     def _search(self, per_request, constraints, used_markers, free):
         """Backtracking all-or-nothing assignment honoring markers +

@@ -24,6 +24,8 @@ selector as non-matching.
 from __future__ import annotations
 
 import re
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any
 
@@ -467,13 +469,29 @@ class CompiledExpr:
             raise CELError(f"evaluation error: {type(exc).__name__}: {exc}") from exc
 
 
-_cache: dict[str, CompiledExpr] = {}
+# Compile cache: bounded LRU.  Selector strings are user-authored (claim
+# specs) — an unbounded dict would let adversarial or generated selectors
+# grow allocator memory without limit.  1024 entries comfortably covers a
+# cluster's distinct DeviceClass + request selectors while capping worst
+# case at ~1k parsed ASTs.
+_CACHE_CAPACITY = 1024
+_cache: "OrderedDict[str, CompiledExpr]" = OrderedDict()
+_cache_lock = threading.Lock()
 
 
 def compile_expr(src: str) -> CompiledExpr:
-    if src not in _cache:
-        _cache[src] = CompiledExpr(src)
-    return _cache[src]
+    with _cache_lock:
+        compiled = _cache.get(src)
+        if compiled is not None:
+            _cache.move_to_end(src)
+            return compiled
+    compiled = CompiledExpr(src)  # parse outside the lock: may raise CELError
+    with _cache_lock:
+        _cache[src] = compiled
+        _cache.move_to_end(src)
+        while len(_cache) > _CACHE_CAPACITY:
+            _cache.popitem(last=False)
+    return compiled
 
 
 def evaluate(src: str, env: dict[str, Any]) -> Any:

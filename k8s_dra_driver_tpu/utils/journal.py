@@ -59,8 +59,36 @@ class Journal:
         self._events: deque[Event] = deque(maxlen=capacity)
         self._dropped = 0
         self._recorded = 0
+        self._enabled = True
+        self._sample_every = 1
+        self._sample_seq = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Turn the ring on/off.  While off, ``record``/``record_lazy`` are
+        near-free: hot paths keep their call sites, operators keep the
+        off switch."""
+        self._enabled = bool(enabled)
+
+    def set_sampling(self, every: int) -> None:
+        """Keep 1 of every ``every`` events (1 = keep all).  Applies only to
+        ``record_lazy`` hot-path sites; direct ``record`` calls (rare,
+        failure-path) are always kept."""
+        self._sample_every = max(1, int(every))
+
+    def _sampled_out(self) -> bool:
+        if self._sample_every == 1:
+            return False
+        with self._lock:
+            self._sample_seq += 1
+            return self._sample_seq % self._sample_every != 0
 
     def record(self, component: str, event: str, correlation: str = "", **attrs) -> None:
+        if not self._enabled:
+            return
         e = Event(
             ts=time.time(),
             component=component,
@@ -73,6 +101,17 @@ class Journal:
                 self._dropped += 1
             self._recorded += 1
             self._events.append(e)
+
+    def record_lazy(self, component: str, event: str, correlation: str = "",
+                    attrs=None) -> None:
+        """Hot-path variant: ``attrs`` is a zero-arg callable returning the
+        attrs dict, invoked ONLY when the event will actually be kept.  A
+        disabled or sampled-out journal never formats the payload — no
+        per-record dict/list/str allocation on the allocate/prepare path."""
+        if not self._enabled or self._sampled_out():
+            return
+        self.record(component, event, correlation,
+                    **(attrs() if attrs is not None else {}))
 
     def tail(self, limit: int = 200, correlation: str | None = None,
              component: str | None = None) -> list[dict]:

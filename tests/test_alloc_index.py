@@ -1,0 +1,198 @@
+"""Allocation-index cache coherence (scheduler/index.py).
+
+The snapshot cache must be exactly as fresh as the API server: a pool
+republished at a higher generation (inventory changed) or deleted outright
+must be re-read on the very next plan — stale candidates allocated from a
+cache would double-book hardware.  Also pins the exported hit/miss
+counters, consumed-set correctness across independent Allocator instances,
+and the Plan.tightness() reuse of the precomputed marker union."""
+
+import pytest
+
+from k8s_dra_driver_tpu.kube.resourceslice_controller import DriverResources
+from k8s_dra_driver_tpu.scheduler.allocator import AllocationError, Allocator, Plan
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+from tests.test_allocator import (
+    TPU_CLASS,
+    DeviceRequest,
+    ResourceSliceController,
+    install_classes,
+    make_claim,
+    publish_host,
+)
+from k8s_dra_driver_tpu import DRIVER_NAME
+
+
+def chip_request(name="tpu", count=1):
+    return DeviceRequest(name=name, device_class_name=TPU_CLASS, count=count)
+
+
+class TestGenerationBumpInvalidation:
+    def test_republish_with_changed_inventory_is_seen(self, api_server):
+        install_classes(api_server)
+        # v5e-16 host 0 publishes 4 local chips.
+        publish_host(api_server, spec="v5e-16", host_id=0, node="host0")
+        alloc = Allocator(api_server)
+        c1 = make_claim(api_server, "warm", [chip_request()])
+        alloc.allocate(c1, node_name="host0")  # populates the index
+        with pytest.raises(AllocationError):
+            # 8 chips cannot exist in the cached 4-chip inventory.
+            alloc.plan(
+                make_claim(api_server, "too-big", [chip_request(count=8)]),
+                node_name="host0",
+            )
+        alloc.deallocate(api_server.get("ResourceClaim", "warm", "default"))
+
+        # Same pool republished with DIFFERENT inventory (8 chips): the
+        # controller bumps the pool generation; the next plan must see the
+        # new devices, never the cached ones.
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        updated = alloc.allocate(
+            make_claim(api_server, "all-eight", [chip_request(count=8)]),
+            node_name="host0",
+        )
+        devices = {r.device for r in updated.status.allocation.devices.results}
+        assert len(devices) == 8
+        # tpu-4..7 exist only in the new inventory.
+        assert any(d.startswith("tpu-") and int(d.split("-")[1]) >= 4 for d in devices)
+
+    def test_deleted_pool_disappears(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        alloc = Allocator(api_server)
+        claim = make_claim(api_server, "pre-delete", [chip_request()])
+        alloc.allocate(claim, node_name="host0")
+        alloc.deallocate(api_server.get("ResourceClaim", "pre-delete", "default"))
+
+        # Withdraw the pool entirely (empty desired set deletes the slices).
+        ctrl = ResourceSliceController(api_server, DRIVER_NAME, "host0")
+        ctrl.update(DriverResources(pools={}))
+        with pytest.raises(AllocationError):
+            alloc.plan(
+                make_claim(api_server, "post-delete", [chip_request()]),
+                node_name="host0",
+            )
+
+
+class TestIndexCounters:
+    def test_steady_state_hits_without_misses(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        alloc = Allocator(api_server)
+        hits = REGISTRY.counter("dra_alloc_index_hits_total")
+        misses = REGISTRY.counter("dra_alloc_index_misses_total")
+        evals = REGISTRY.counter("dra_cel_evals_total")
+
+        alloc.allocate(
+            make_claim(api_server, "n1", [chip_request()]), node_name="host0"
+        )
+        h1, m1, e1 = hits.value(), misses.value(), evals.value()
+        assert m1 >= 1  # first plan built the pool snapshot
+        for i in range(5):
+            alloc.allocate(
+                make_claim(api_server, f"n{i + 2}", [chip_request()]),
+                node_name="host0",
+            )
+        assert misses.value() == m1  # unchanged inventory: zero rebuilds
+        assert hits.value() > h1
+        # Verdict memo: the SAME candidates answer the same selectors with
+        # zero further CEL evaluation — O(changed pools), not O(claims).
+        assert evals.value() == e1
+
+    def test_republish_costs_one_miss(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        alloc = Allocator(api_server)
+        alloc.allocate(
+            make_claim(api_server, "m1", [chip_request()]), node_name="host0"
+        )
+        misses = REGISTRY.counter("dra_alloc_index_misses_total")
+        m1 = misses.value()
+        publish_host(api_server, spec="v5e-16", host_id=0, node="host0")
+        with pytest.raises(AllocationError):
+            # the republished inventory has only 4 chips
+            alloc.plan(
+                make_claim(api_server, "m2", [chip_request(count=8)]),
+                node_name="host0",
+            )
+        assert misses.value() == m1 + 1  # exactly the changed pool rebuilt
+
+
+class TestConsumedAcrossAllocators:
+    def test_second_allocator_sees_existing_allocations(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        a = Allocator(api_server)
+        taken = set()
+        for i in range(2):
+            updated = a.allocate(
+                make_claim(api_server, f"a{i}", [chip_request()]), node_name="host0"
+            )
+            taken |= {r.device for r in updated.status.allocation.devices.results}
+        assert len(taken) == 2
+
+        b = Allocator(api_server)  # fresh index, same server
+        updated = b.allocate(
+            make_claim(api_server, "b-rest", [chip_request(count=6)]),
+            node_name="host0",
+        )
+        rest = {r.device for r in updated.status.allocation.devices.results}
+        assert len(rest) == 6
+        assert not (rest & taken)
+        with pytest.raises(AllocationError):
+            b.plan(
+                make_claim(api_server, "b-over", [chip_request()]),
+                node_name="host0",
+            )
+
+    def test_deallocation_frees_for_other_allocator(self, api_server):
+        install_classes(api_server)
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        a = Allocator(api_server)
+        b = Allocator(api_server)
+        a.allocate(
+            make_claim(api_server, "churn", [chip_request(count=8)]),
+            node_name="host0",
+        )
+        with pytest.raises(AllocationError):
+            b.plan(make_claim(api_server, "blocked", [chip_request()]), node_name="host0")
+        a.deallocate(api_server.get("ResourceClaim", "churn", "default"))
+        b.allocate(
+            make_claim(api_server, "after", [chip_request(count=8)]),
+            node_name="host0",
+        )
+
+
+class TestTightnessReuse:
+    def test_scores_pinned_and_legacy_equivalent(self, api_server):
+        install_classes(api_server)
+        # 8 chips, markers chip0..chip7: the tightness denominator is 8
+        # available markers before any allocation.
+        publish_host(api_server, spec="v5e-8", host_id=0, node="host0")
+        alloc = Allocator(api_server)
+        p1 = alloc.plan(
+            make_claim(api_server, "t1", [chip_request(count=2)]), node_name="host0"
+        )
+        assert p1.node_markers  # precomputed union flowed through
+        assert p1.tightness() == pytest.approx(2 / 8)
+
+        alloc.allocate(
+            api_server.get("ResourceClaim", "t1", "default"), node_name="host0"
+        )
+        p2 = alloc.plan(
+            make_claim(api_server, "t2", [chip_request(count=2)]), node_name="host0"
+        )
+        # 2 markers consumed: 6 available, this plan takes 2 of them.
+        assert p2.tightness() == pytest.approx(2 / 6)
+
+        # The precomputed-union fast path must agree exactly with the
+        # legacy free-scan fallback (hand-built Plans without node_markers).
+        for p in (p1, p2):
+            legacy = Plan(
+                chosen=p.chosen,
+                admin_results=p.admin_results,
+                free=p.free,
+                classes=p.classes,
+                used_markers=p.used_markers,
+            )
+            assert p.tightness() == pytest.approx(legacy.tightness())

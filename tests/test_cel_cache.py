@@ -1,0 +1,49 @@
+"""CEL compile-cache bounds (scheduler/cel.py).
+
+Selector strings are user-authored; the compile cache must be a bounded
+LRU so adversarial or generated expressions cannot grow allocator memory
+without limit.  (Lives outside test_cel.py on purpose: that module needs
+hypothesis, which this environment does not ship.)"""
+
+from k8s_dra_driver_tpu.scheduler import cel
+
+
+def _fresh_cache():
+    with cel._cache_lock:
+        cel._cache.clear()
+
+
+class TestCompileCacheLRU:
+    def test_hit_returns_same_object(self):
+        _fresh_cache()
+        a = cel.compile_expr("2 + 2")
+        b = cel.compile_expr("2 + 2")
+        assert a is b
+
+    def test_eviction_bounds_size(self):
+        _fresh_cache()
+        n = cel._CACHE_CAPACITY + 50
+        for i in range(n):
+            cel.compile_expr(f"{i} + 1")
+        assert len(cel._cache) == cel._CACHE_CAPACITY
+        # Newest survive, oldest were evicted.
+        assert f"{n - 1} + 1" in cel._cache
+        assert "0 + 1" not in cel._cache
+
+    def test_recency_protects_hot_entries(self):
+        _fresh_cache()
+        cel.compile_expr("1 + 1")
+        for i in range(cel._CACHE_CAPACITY - 1):  # fill to capacity
+            cel.compile_expr(f"{i} + 2")
+        cel.compile_expr("1 + 1")  # touch: most-recently-used again
+        cel.compile_expr("9 + 3")  # overflow evicts the LRU entry...
+        assert "1 + 1" in cel._cache  # ...which is no longer this one
+        assert "0 + 2" not in cel._cache
+
+    def test_evicted_entry_recompiles_correctly(self):
+        _fresh_cache()
+        assert cel.evaluate("3 * 7", {}) == 21
+        for i in range(cel._CACHE_CAPACITY + 1):
+            cel.compile_expr(f"{i} + 4")
+        assert "3 * 7" not in cel._cache
+        assert cel.evaluate("3 * 7", {}) == 21

@@ -94,6 +94,64 @@ class TestJournal:
             assert len(j.tail(limit=per_thread, correlation=f"t{t}")) == per_thread
 
 
+class TestJournalLazyRecord:
+    """record_lazy defers attr construction: a disabled (or sampled-out)
+    journal must not pay for building the payload dict on the hot path."""
+
+    def test_disabled_journal_never_builds_attrs(self):
+        j = Journal(capacity=8)
+        j.set_enabled(False)
+        calls = []
+
+        def attrs():
+            calls.append(1)
+            return {"big": "payload"}
+
+        j.record_lazy("allocator", "allocate.ok", correlation="u", attrs=attrs)
+        assert calls == []  # zero per-record payload allocation when off
+        assert len(j) == 0
+
+        j.set_enabled(True)
+        j.record_lazy("allocator", "allocate.ok", correlation="u", attrs=attrs)
+        assert calls == [1]
+        events = j.tail()
+        assert len(events) == 1
+        assert events[0]["attrs"] == {"big": "payload"}
+
+    def test_lazy_without_attrs(self):
+        j = Journal(capacity=8)
+        j.record_lazy("driver", "prepare.ok", correlation="u")
+        assert j.tail()[0]["event"] == "prepare.ok"
+        assert "attrs" not in j.tail()[0]  # empty attrs elided from JSON
+
+    def test_sampling_keeps_every_nth_and_skips_attrs(self):
+        j = Journal(capacity=32)
+        j.set_sampling(4)
+        calls = []
+
+        def attrs():
+            calls.append(1)
+            return {"k": "v"}
+
+        for _ in range(8):
+            j.record_lazy("allocator", "allocate.ok", attrs=attrs)
+        assert len(j) == 2  # every 4th of 8
+        assert len(calls) == 2  # attrs built only for kept events
+
+        # Direct record() ignores sampling: failure paths are never shed.
+        j.record("allocator", "allocate.fail")
+        assert len(j.tail(component="allocator")) == 3
+
+    def test_disabled_direct_record_is_dropped(self):
+        j = Journal(capacity=8)
+        j.set_enabled(False)
+        j.record("c", "e")
+        assert len(j) == 0
+        assert j.enabled is False
+        j.set_enabled(True)
+        assert j.enabled is True
+
+
 class TestWatchdog:
     def test_beat_keeps_guard_healthy(self, tmp_path):
         wd = Watchdog(bundle_dir=str(tmp_path))

@@ -1,0 +1,149 @@
+"""Group-committed checkpoint crash-recovery semantics (the PR-2 acceptance
+criterion): one durable write per NodePrepare/NodeUnprepareResources batch
+must preserve the invariant that kubelet never sees success for state the
+checkpoint does not cover.
+
+Covered crash windows:
+* process dies MID-BATCH (after prepares, before commit) — restart must
+  show zero phantom prepared entries, orphan CDI specs must be cleanable,
+  and a full re-prepare of every claim in the batch must succeed;
+* commit WRITE fails — the batch unwinds (memory + disk artifacts), every
+  claim reports an error so kubelet retries, and the retry converges;
+* unprepare commit fails — entries are restored so the retry re-runs the
+  idempotent teardown.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+from k8s_dra_driver_tpu.plugin.driver import ClaimRef, Driver, DriverConfig
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture
+def rig(tmp_path):
+    cluster = make_cluster(hosts=1, topology="v5e-8", work_dir=str(tmp_path))
+    cfg = DriverConfig(
+        node_name="tpu-host-0",
+        cdi_root=str(tmp_path / "gc-cdi"),
+        checkpoint_path=str(tmp_path / "gc-checkpoint.json"),
+        topology_env={"TPUINFO_FAKE_TOPOLOGY": "v5e-8", "TPUINFO_FAKE_HOST_ID": "0"},
+        publish=False,
+    )
+    return cluster, cfg, Driver(cluster.server, cfg)
+
+
+def allocate_refs(cluster, n, prefix="gc"):
+    refs = []
+    for i in range(n):
+        claim = cluster.server.create(simple_claim(f"{prefix}-{i}"))
+        allocated = cluster.allocator.allocate(
+            claim, node_name="tpu-host-0",
+            node_labels=cluster.node_labels("tpu-host-0"),
+        )
+        refs.append(
+            ClaimRef(uid=allocated.metadata.uid, name=claim.metadata.name,
+                     namespace="default")
+        )
+    return refs
+
+
+class TestCrashMidBatch:
+    def test_restart_recovers_cleanly_and_reprepares(self, rig):
+        cluster, cfg, driver = rig
+        refs = allocate_refs(cluster, 4)
+        # Batch begun, claims prepared, commit NEVER runs: the process
+        # "dies" between the last prepare and the durable write.
+        driver.state.begin_checkpoint_batch()
+        for ref in refs:
+            claim = cluster.server.get("ResourceClaim", ref.name, "default")
+            driver.state.prepare(claim)
+        assert len(driver.state.prepared) == 4
+        # CDI claim specs already hit disk (crash window artifact).
+        assert len(driver.state.cdi.list_claim_spec_uids()) == 4
+
+        restarted = Driver(cluster.server, cfg)  # restores from checkpoint
+        # No phantom prepared entries: the checkpoint never saw the batch.
+        assert restarted.state.prepared == {}
+        # The crash residue is exactly what cleanup_orphans exists for.
+        cleaned = restarted.cleanup_orphans()
+        assert sorted(cleaned["cdi_specs"]) == sorted(r.uid for r in refs)
+        assert restarted.state.cdi.list_claim_spec_uids() == []
+        # Kubelet retries the whole batch: every claim re-prepares cleanly.
+        out = restarted.node_prepare_resources(refs)
+        assert all(not r.error for r in out.values())
+        assert sorted(restarted.state.prepared) == sorted(r.uid for r in refs)
+        # And THIS time the state is durable.
+        rebooted = Driver(cluster.server, cfg)
+        assert sorted(rebooted.state.prepared) == sorted(r.uid for r in refs)
+
+    def test_committed_batch_survives_restart(self, rig):
+        cluster, cfg, driver = rig
+        refs = allocate_refs(cluster, 3)
+        writes = REGISTRY.counter("dra_checkpoint_writes_total")
+        w0 = writes.value()
+        out = driver.node_prepare_resources(refs)
+        assert all(not r.error for r in out.values())
+        assert writes.value() == w0 + 1  # ONE durable write for the batch
+        restarted = Driver(cluster.server, cfg)
+        assert sorted(restarted.state.prepared) == sorted(r.uid for r in refs)
+
+
+class TestCommitFailure:
+    def test_prepare_commit_failure_unwinds_and_errors_all(self, rig, monkeypatch):
+        cluster, cfg, driver = rig
+        refs = allocate_refs(cluster, 3)
+
+        def boom(prepared_claims):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(driver.state._checkpoint, "write", boom)
+        out = driver.node_prepare_resources(refs)
+        # Success without durability is forbidden: every claim errors.
+        assert all("checkpoint commit failed" in r.error for r in out.values())
+        # The batch unwound completely: no in-memory entries, no disk
+        # artifacts, no phantom state for a restart to resurrect.
+        assert driver.state.prepared == {}
+        assert driver.state.cdi.list_claim_spec_uids() == []
+        assert Driver(cluster.server, cfg).state.prepared == {}
+
+        monkeypatch.undo()  # disk recovers; the kubelet retry converges
+        out = driver.node_prepare_resources(refs)
+        assert all(not r.error for r in out.values())
+        assert sorted(driver.state.prepared) == sorted(r.uid for r in refs)
+
+    def test_unprepare_commit_failure_restores_entries(self, rig, monkeypatch):
+        cluster, cfg, driver = rig
+        refs = allocate_refs(cluster, 3)
+        out = driver.node_prepare_resources(refs)
+        assert all(not r.error for r in out.values())
+
+        def boom(prepared_claims):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(driver.state._checkpoint, "write", boom)
+        out = driver.node_unprepare_resources(refs)
+        assert all("checkpoint commit failed" in r.error for r in out.values())
+        # Entries restored: no lost prepared state, the on-disk checkpoint
+        # (still the pre-batch one) agrees with memory.
+        assert sorted(driver.state.prepared) == sorted(r.uid for r in refs)
+
+        monkeypatch.undo()
+        out = driver.node_unprepare_resources(refs)  # idempotent teardown
+        assert all(not r.error for r in out.values())
+        assert driver.state.prepared == {}
+        assert Driver(cluster.server, cfg).state.prepared == {}
+
+
+class TestDirectPathUnchanged:
+    def test_prepare_outside_batch_writes_immediately(self, rig):
+        """The harness/tests path (DeviceState.prepare with no batch) keeps
+        per-call durability — group commit is opt-in per gRPC call."""
+        cluster, cfg, driver = rig
+        refs = allocate_refs(cluster, 1)
+        writes = REGISTRY.counter("dra_checkpoint_writes_total")
+        w0 = writes.value()
+        claim = cluster.server.get("ResourceClaim", refs[0].name, "default")
+        driver.state.prepare(claim)
+        assert writes.value() == w0 + 1
+        assert Driver(cluster.server, cfg).state.prepared == {refs[0].uid: driver.state.prepared[refs[0].uid]}

@@ -1,0 +1,24 @@
+"""tools/perf_smoke.py wired into the test gate: the hot-path perf budgets
+(CEL evals memoized per inventory version, pool snapshots rebuilt only on
+change, one checkpoint write per prepare/unprepare batch) are enforced on
+every run, so a future PR cannot silently reintroduce
+O(claims x devices x selectors) work or per-claim fsyncs."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import perf_smoke  # noqa: E402
+
+
+def test_hot_path_stays_within_perf_budgets():
+    stats = perf_smoke.check()
+    # check() raises PerfBudgetError on any busted ceiling; pin the headline
+    # invariants here too so the test is self-describing.
+    assert stats["cel_evals"] <= stats["cel_eval_ceiling"]
+    assert stats["index_misses"] <= stats["index_miss_ceiling"]
+    # Group commit: a BATCH_SIZE-claim call costs ONE durable write each
+    # way, not one per claim.
+    assert stats["batched_checkpoint_writes"] == 2 * stats["batch_rounds"]

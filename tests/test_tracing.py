@@ -76,4 +76,7 @@ class TestTracer:
         assert prepare["attributes"]["claim"] == "default/traced"
         child_names = [c["name"] for c in prepare["children"]]
         assert "Prepare.resolveAndApplyConfigs" in child_names
-        assert "Prepare.writeCheckpoint" in child_names
+        # Group commit: the durable checkpoint write happens once per
+        # NodePrepareResources call, after the per-claim spans close.
+        assert "Prepare.writeCheckpoint" not in child_names
+        assert any(t["name"] == "Prepare.commitCheckpointBatch" for t in traces)

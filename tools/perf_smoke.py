@@ -1,0 +1,167 @@
+"""Hot-path perf budget guard (<30s, runs in tier-1 via tests/test_perf_smoke.py).
+
+Runs 200 allocate/prepare/unprepare/deallocate cycles plus a batched-prepare
+phase in-process against a fake v5e-8 host, then fails if the exported
+counters show the hot path regressed to per-call recomputation:
+
+* ``dra_cel_evals_total`` — with the allocation index + per-candidate
+  verdict memo, selector CEL evaluates once per device per inventory
+  version; 200 cycles against UNCHANGED inventory must stay near the
+  one-time warmup cost (O(changed pools)), nowhere near
+  O(cycles x devices x selectors) (~thousands before PR 2).
+* ``dra_alloc_index_misses_total`` — pool snapshots rebuild only when a
+  pool's slices change; an unchanged cluster allows only the initial build.
+* ``dra_checkpoint_writes_total`` — group commit pays ONE durable write per
+  NodePrepareResources/NodeUnprepareResources call, regardless of how many
+  claims the call carries.
+
+Exits non-zero (CLI) / raises PerfBudgetError (pytest wrapper) on any
+busted budget, so a future PR cannot silently reintroduce the quadratic.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+CYCLES = 200
+BATCH_ROUNDS = 5
+BATCH_SIZE = 8
+
+# One-time warmup evaluates each DeviceClass/request selector once per
+# candidate (a v5e-8 host publishes a few dozen devices across chip /
+# subslice / membership types); 400 is ~4x that warmup and ~10x below what
+# a single cycle-coupled regression would produce over 200 cycles.
+CEL_EVAL_CEILING = 400
+# Initial snapshot builds each pool once; unchanged inventory allows no
+# further rebuilds (small slack for claim-driven consumed-set rebuilds
+# that a refactor might reclassify as pool rebuilds).
+INDEX_MISS_CEILING = 4
+
+
+class PerfBudgetError(AssertionError):
+    pass
+
+
+def check(cycles: int = CYCLES) -> dict:
+    from k8s_dra_driver_tpu.e2e.harness import make_cluster, simple_claim
+    from k8s_dra_driver_tpu.plugin.driver import ClaimRef, Driver, DriverConfig
+    from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+    work = tempfile.mkdtemp(prefix="tpu-dra-perf-smoke-")
+    cluster = make_cluster(hosts=1, topology="v5e-8", work_dir=work)
+    node = "tpu-host-0"
+    labels = cluster.node_labels(node)
+    driver = Driver(
+        cluster.server,
+        DriverConfig(
+            node_name=node,
+            cdi_root=f"{work}/cdi",
+            checkpoint_path=f"{work}/checkpoint.json",
+            topology_env={"TPUINFO_FAKE_TOPOLOGY": "v5e-8", "TPUINFO_FAKE_HOST_ID": "0"},
+            publish=False,
+        ),
+    )
+    evals = REGISTRY.counter("dra_cel_evals_total")
+    writes = REGISTRY.counter("dra_checkpoint_writes_total")
+    misses = REGISTRY.counter("dra_alloc_index_misses_total")
+    hits = REGISTRY.counter("dra_alloc_index_hits_total")
+    evals0, writes0, misses0 = evals.value(), writes.value(), misses.value()
+
+    start = time.perf_counter()
+    for i in range(cycles):
+        name = f"smoke-{i}"
+        claim = cluster.server.create(simple_claim(name))
+        allocated = cluster.allocator.allocate(claim, node_name=node, node_labels=labels)
+        ref = ClaimRef(uid=allocated.metadata.uid, name=name, namespace="default")
+        res = driver.node_prepare_resources([ref])[allocated.metadata.uid]
+        if res.error:
+            raise RuntimeError(f"prepare failed: {res.error}")
+        driver.node_unprepare_resources([ref])
+        cluster.allocator.deallocate(
+            cluster.server.get("ResourceClaim", name, "default")
+        )
+        cluster.server.delete("ResourceClaim", name, "default")
+    single_claim_writes = int(writes.value() - writes0)
+
+    batch_writes0 = writes.value()
+    for r in range(BATCH_ROUNDS):
+        refs = []
+        for k in range(BATCH_SIZE):
+            name = f"smoke-batch-{r}-{k}"
+            claim = cluster.server.create(simple_claim(name))
+            allocated = cluster.allocator.allocate(
+                claim, node_name=node, node_labels=labels
+            )
+            refs.append(
+                ClaimRef(uid=allocated.metadata.uid, name=name, namespace="default")
+            )
+        out = driver.node_prepare_resources(refs)
+        errors = [x.error for x in out.values() if x.error]
+        if errors:
+            raise RuntimeError(f"batched prepare failed: {errors}")
+        driver.node_unprepare_resources(refs)
+        for ref in refs:
+            cluster.allocator.deallocate(
+                cluster.server.get("ResourceClaim", ref.name, "default")
+            )
+            cluster.server.delete("ResourceClaim", ref.name, "default")
+    elapsed = time.perf_counter() - start
+
+    stats = {
+        "cycles": cycles,
+        "batch_rounds": BATCH_ROUNDS,
+        "batch_size": BATCH_SIZE,
+        "elapsed_s": round(elapsed, 2),
+        "cel_evals": int(evals.value() - evals0),
+        "cel_eval_ceiling": CEL_EVAL_CEILING,
+        "index_misses": int(misses.value() - misses0),
+        "index_miss_ceiling": INDEX_MISS_CEILING,
+        "index_hits": int(hits.value()),
+        "single_claim_checkpoint_writes": single_claim_writes,
+        "batched_checkpoint_writes": int(writes.value() - batch_writes0),
+        "batched_checkpoint_write_ceiling": 2 * BATCH_ROUNDS,
+    }
+    if stats["cel_evals"] > CEL_EVAL_CEILING:
+        raise PerfBudgetError(
+            f"CEL evals {stats['cel_evals']} > ceiling {CEL_EVAL_CEILING}: "
+            f"selector evaluation is no longer memoized per inventory version"
+        )
+    if stats["index_misses"] > INDEX_MISS_CEILING:
+        raise PerfBudgetError(
+            f"index misses {stats['index_misses']} > ceiling {INDEX_MISS_CEILING}: "
+            f"pool snapshots are being rebuilt without inventory changes"
+        )
+    # 2 durable writes per single-claim cycle (one per gRPC-call batch of 1)
+    # is the contract; more means checkpoint writes crept onto a sub-step.
+    if single_claim_writes > 2 * cycles:
+        raise PerfBudgetError(
+            f"single-claim checkpoint writes {single_claim_writes} > {2 * cycles}: "
+            f"more than one durable write per prepare/unprepare call"
+        )
+    if stats["batched_checkpoint_writes"] > 2 * BATCH_ROUNDS:
+        raise PerfBudgetError(
+            f"batched checkpoint writes {stats['batched_checkpoint_writes']} > "
+            f"{2 * BATCH_ROUNDS}: group commit is not batching "
+            f"({BATCH_SIZE}-claim calls must cost one write each way)"
+        )
+    return stats
+
+
+def main() -> int:
+    try:
+        stats = check()
+    except PerfBudgetError as exc:
+        print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps({"perf_smoke": stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
