@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench demo dryrun lint perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos demo dryrun lint perf-smoke helm-template clean
 
 all: native
 
@@ -26,6 +26,13 @@ asan-test:
 # Headline benchmark (claim-to-running p50 + live data-plane proof).
 bench:
 	$(PYTHON) bench.py
+
+# Chaos suite (<10s): the allocator→prepare→unprepare loop under injected
+# API faults (utils/faults.py) — error storms, conflict storms, dropped
+# connections, watch outages — proving the retry/breaker layer converges
+# with zero lost claims.
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

@@ -131,17 +131,27 @@ class ClaimContext:
     ):
         """Connect to the claim's topology daemon (None when not shared).
 
-        Retries with a flat delay: the daemon Deployment may still be
-        starting when the consumer container does (the same race the
-        plugin's readiness backoff tolerates on the other side)."""
+        Retries on the shared backoff policy (utils/retry.py): the daemon
+        Deployment may still be starting when the consumer container does
+        (the same race the plugin's readiness backoff tolerates on the
+        other side).  ``retry_delay_s`` stays a flat schedule — the daemon
+        is node-local, there is no herd to de-synchronize."""
         if not self.daemon_socket:
             return None
-        import time
-
         from k8s_dra_driver_tpu.plugin.topology_daemon import TopologyDaemonClient
+        from k8s_dra_driver_tpu.utils.retry import Backoff, RetryPolicy
 
         name = consumer_id or self._consumer_id
         retries = max(1, retries)
+        backoff = Backoff(
+            RetryPolicy(
+                max_attempts=retries,
+                base_delay_s=retry_delay_s,
+                max_delay_s=retry_delay_s,
+                multiplier=1.0,
+                jitter=0.0,
+            )
+        )
         last: Exception = RuntimeError("unreachable")
         for attempt in range(retries):
             try:
@@ -149,7 +159,7 @@ class ClaimContext:
             except OSError as exc:
                 last = exc
                 if attempt + 1 < retries:
-                    time.sleep(retry_delay_s)
+                    backoff.sleep()
         raise ConnectionError(
             f"topology daemon at {self.daemon_socket} not reachable "
             f"after {retries} attempts: {last}"

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 
 from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.kube.fakeserver import APIError
 from k8s_dra_driver_tpu.kube.objects import (
     Node,
     NodeSelector,
@@ -37,7 +38,9 @@ from k8s_dra_driver_tpu.kube.resourceslice_controller import (
     Slice,
 )
 from k8s_dra_driver_tpu.plugin.deviceinfo import SliceMembershipInfo
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.logging import get_logger
+from k8s_dra_driver_tpu.utils.retry import Backoff, RetryPolicy
 
 log = get_logger("tpu-dra-controller.slice-manager")
 
@@ -98,7 +101,23 @@ class SliceManager:
         self._domains: dict[str, _Domain] = {}
         self._offsets: dict[str, list[int]] = {}  # domain -> reserved window starts
         self._retry: dict[str, float] = {}  # domain -> earliest retry time
-        self._retry_timeout = retry_timeout_s
+        # Shared parking policy (utils/retry.py) instead of the reference's
+        # flat RetryTimeout (imex.go:131-151): repeated transient failures
+        # back off exponentially up to the old flat timeout as cap.  jitter=0
+        # keeps the externally driven retry_pending() loop deterministic.
+        self._retry_policy = RetryPolicy(
+            max_attempts=0,
+            base_delay_s=min(1.0, retry_timeout_s),
+            max_delay_s=retry_timeout_s,
+            multiplier=2.0,
+            jitter=0.0,
+        )
+        self._domain_backoff: dict[str, Backoff] = {}
+        # Global republish parking: _controller.update() failures (API
+        # trouble, partial reconciles) park the WHOLE publish, retried by
+        # retry_pending(); the last good slices keep serving meanwhile.
+        self._publish_backoff = Backoff(self._retry_policy)
+        self._publish_retry_at: float | None = None
         self._clock = clock
         self._controller = ResourceSliceController(server, DRIVER_NAME, owner)
         self._watch = None
@@ -113,6 +132,9 @@ class SliceManager:
             self._domains.clear()
             self._offsets.clear()
             self._retry.clear()
+            self._domain_backoff.clear()
+            self._publish_backoff.reset()
+            self._publish_retry_at = None
         self._watch = self._server.watch(Node.KIND, self._on_node_event)
 
     def stop(self, delete_owned: bool = True) -> None:
@@ -125,15 +147,18 @@ class SliceManager:
         self._controller.stop(delete_owned=delete_owned)
 
     def retry_pending(self) -> None:
-        """Re-attempt domains parked on transient errors whose timeout has
+        """Re-attempt domains parked on transient errors whose backoff has
         elapsed (imex.go:131-151's RetryTimeout loop, driven externally for
-        determinism)."""
+        determinism), plus any whole-publish parked on API failure."""
         with self._lock:
             now = self._clock()
             due = [d for d, t in self._retry.items() if t <= now]
             for domain in due:
                 del self._retry[domain]
-            if due:
+            republish = (
+                self._publish_retry_at is not None and self._publish_retry_at <= now
+            )
+            if due or republish:
                 self._publish()
 
     # -- node informer (imex.go:207-295) -----------------------------------
@@ -195,6 +220,7 @@ class SliceManager:
                     del self._domains[domain]
                     self._offsets.pop(domain, None)
                     self._retry.pop(domain, None)
+                    self._domain_backoff.pop(domain, None)
         return changed
 
     # -- seat-window assignment (imex.go:319-351) ---------------------------
@@ -243,8 +269,12 @@ class SliceManager:
             try:
                 self._assign_offset(domain, seats=len(worker_ids))
             except TransientError:
-                self._retry[domain] = self._clock() + self._retry_timeout
+                bo = self._domain_backoff.setdefault(
+                    domain, Backoff(self._retry_policy)
+                )
+                self._retry[domain] = self._clock() + bo.next_delay()
                 continue
+            self._domain_backoff.pop(domain, None)  # admitted: reset its parking
             if len(worker_ids) != len(d.nodes):
                 log.warning(
                     "domain %s: duplicate slice-host-id labels across nodes %s; "
@@ -284,7 +314,22 @@ class SliceManager:
                 ),
             )
         self._publish_groups(pools)
-        self._controller.update(DriverResources(pools=pools))
+        try:
+            self._controller.update(DriverResources(pools=pools))
+        except (APIError, OSError) as exc:
+            # Partial/failed reconcile: the reconciler already applied what
+            # it could; park a full republish (declarative spec replays
+            # cleanly) instead of crashing the informer callback.
+            self._publish_retry_at = self._clock() + self._publish_backoff.next_delay()
+            JOURNAL.record(
+                "slice-manager", "publish.fail",
+                error=f"{type(exc).__name__}: {exc}",
+                retry_at=self._publish_retry_at,
+            )
+            log.warning("slice publish failed, parked for retry: %s", exc)
+        else:
+            self._publish_backoff.reset()
+            self._publish_retry_at = None
 
     def _publish_groups(self, pools: dict[str, Pool]) -> None:
         """Slice-GROUP seat pools: one pool per group of slice domains, one

@@ -91,6 +91,24 @@ class MockKubeAPI:
                 length = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(length)) if length else None
 
+            def _maybe_drop(self, verb: str, kind: str) -> bool:
+                """HTTP-layer fault: truncate the response mid-body.  The
+                Content-Length overshoots what we write and the connection
+                closes, so the client observes an ``IncompleteRead`` — a
+                retryable transport error.  Injected BEFORE the store op:
+                the request is lost in flight, never half-applied."""
+                faults = outer.server.faults
+                if faults is None or not faults.take_drop(verb, kind):
+                    return False
+                partial = b'{"kind":"Status"'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(partial) + 64))
+                self.end_headers()
+                self.wfile.write(partial)
+                self.close_connection = True
+                return True
+
             def do_GET(self):  # noqa: N802
                 if not self._authorized():
                     return self._deny(401, "bad token")
@@ -102,11 +120,20 @@ class MockKubeAPI:
                 kind, ns, name, query = route
                 try:
                     if name:
+                        if self._maybe_drop("GET", kind):
+                            return
                         obj = outer.server.get(kind, name, ns)
                         return self._send(objects.to_json(obj))
                     if query.get("watch", ["false"])[0] == "true":
+                        faults = outer.server.faults
+                        if faults is not None and faults.take_watch_gone(kind):
+                            # The apiserver's "resourceVersion too old": the
+                            # client must relist and rewatch.
+                            return self._deny(410, "watch gone (fault injected)")
                         rv = query.get("resourceVersion", ["0"])[0]
                         return self._stream_watch(kind, rv)
+                    if self._maybe_drop("LIST", kind):
+                        return
                     selector = _parse_selector(query)
                     items = outer.server.list(
                         kind, namespace=ns or None, label_selector=selector
@@ -135,6 +162,8 @@ class MockKubeAPI:
                 obj = objects.from_json(doc)
                 if ns:
                     obj.metadata.namespace = ns
+                if self._maybe_drop("POST", kind):
+                    return
                 try:
                     return self._send(objects.to_json(outer.server.create(obj)), 201)
                 except APIError as exc:
@@ -150,6 +179,8 @@ class MockKubeAPI:
                 doc = self._body()
                 doc.setdefault("kind", kind)
                 obj = objects.from_json(doc)
+                if self._maybe_drop("PUT", kind):
+                    return
                 try:
                     return self._send(objects.to_json(outer.server.update(obj)))
                 except APIError as exc:
@@ -162,6 +193,8 @@ class MockKubeAPI:
                 if route is None:
                     return self._deny(404, f"unknown path {self.path}")
                 kind, ns, name, _ = route
+                if self._maybe_drop("DELETE", kind):
+                    return
                 try:
                     outer.server.delete(kind, name, ns)
                     return self._send({"kind": "Status", "status": "Success"})
@@ -179,25 +212,47 @@ class MockKubeAPI:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                faults = outer.server.faults
+                if faults is not None:
+                    hang = faults.take_watch_hang(kind)
+                    if hang > 0:
+                        # Silent stall: headers sent, no frames.  The client's
+                        # watch read-timeout is what must detect this.
+                        outer._closing.wait(hang)
                 try:
                     while not outer._closing.is_set():
                         if watch.stopped:
                             break  # subscription revoked: end the stream like
                             # an apiserver closing an expired watch
+                        if faults is not None and faults.take_watch_error_frame(kind):
+                            self._write_frame(
+                                {
+                                    "type": "ERROR",
+                                    "object": {
+                                        "kind": "Status",
+                                        "code": 410,
+                                        "message": "fault injected error frame",
+                                    },
+                                }
+                            )
+                            break  # apiserver closes the stream after ERROR
                         try:
                             event = events.get(timeout=0.2)
                         except queue.Empty:
                             continue
-                        frame = json.dumps(
+                        self._write_frame(
                             {"type": event.type, "object": objects.to_json(event.object)}
-                        ).encode() + b"\n"
-                        self.wfile.write(f"{len(frame):x}\r\n".encode())
-                        self.wfile.write(frame + b"\r\n")
-                        self.wfile.flush()
+                        )
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 finally:
                     watch.stop()
+
+            def _write_frame(self, doc: dict) -> None:
+                frame = json.dumps(doc).encode() + b"\n"
+                self.wfile.write(f"{len(frame):x}\r\n".encode())
+                self.wfile.write(frame + b"\r\n")
+                self.wfile.flush()
 
         self._closing = threading.Event()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)

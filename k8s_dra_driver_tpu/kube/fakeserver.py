@@ -12,6 +12,7 @@ events).  It is the test/bench backbone the reference never built (SURVEY.md
 
 from __future__ import annotations
 
+import os
 import threading
 import uuid as uuidlib
 from dataclasses import dataclass
@@ -70,15 +71,29 @@ def _key(obj: Any) -> tuple[str, str, str]:
 class InMemoryAPIServer:
     """Thread-safe in-memory object store with the client surface we need."""
 
-    def __init__(self):
+    def __init__(self, fault_injector=None):
         self._lock = threading.RLock()
         self._objects: dict[tuple[str, str, str], Any] = {}
         self._rv = 0
         self._watches: list[Watch] = []
+        # Chaos hook (utils/faults.py): every verb consults it BEFORE
+        # touching the store, so an injected failure never half-applies.
+        # ``DRA_FAULTS`` arms it from the environment for manual chaos runs.
+        if fault_injector is None and os.environ.get("DRA_FAULTS"):
+            from k8s_dra_driver_tpu.utils.faults import FaultInjector
+
+            fault_injector = FaultInjector.from_env(os.environ["DRA_FAULTS"])
+        self.faults = fault_injector
+
+    def _maybe_fault(self, verb: str, kind: str) -> None:
+        # Outside the lock: injected latency must not serialize the server.
+        if self.faults is not None:
+            self.faults.before(verb, kind)
 
     # -- client surface ----------------------------------------------------
 
     def create(self, obj: Any) -> Any:
+        self._maybe_fault("POST", type(obj).KIND)
         with self._lock:
             meta = obj.metadata
             if not meta.name and meta.generate_name:
@@ -96,6 +111,7 @@ class InMemoryAPIServer:
             return objects.deepcopy(stored)
 
     def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        self._maybe_fault("GET", kind)
         with self._lock:
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
@@ -109,6 +125,7 @@ class InMemoryAPIServer:
         label_selector: Optional[dict[str, str]] = None,
         field_selector: Optional[Callable[[Any], bool]] = None,
     ) -> list[Any]:
+        self._maybe_fault("LIST", kind)
         with self._lock:
             out = []
             for (k, ns, _), obj in self._objects.items():
@@ -126,6 +143,7 @@ class InMemoryAPIServer:
             return out
 
     def update(self, obj: Any) -> Any:
+        self._maybe_fault("PUT", type(obj).KIND)
         with self._lock:
             key = _key(obj)
             current = self._objects.get(key)
@@ -148,6 +166,7 @@ class InMemoryAPIServer:
             return objects.deepcopy(stored)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._maybe_fault("DELETE", kind)
         with self._lock:
             obj = self._objects.pop((kind, namespace, name), None)
             if obj is None:

@@ -20,7 +20,14 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from k8s_dra_driver_tpu.kube import objects
+from k8s_dra_driver_tpu.kube.fakeserver import (
+    AlreadyExists,
+    APIError,
+    Conflict,
+    NotFound,
+)
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 from k8s_dra_driver_tpu.kube.objects import (
     Device,
     NodeSelector,
@@ -29,6 +36,35 @@ from k8s_dra_driver_tpu.kube.objects import (
     ResourceSlice,
     ResourceSliceSpec,
 )
+
+_SYNC_RETRIES = REGISTRY.counter(
+    "dra_slice_sync_retries_total",
+    "ResourceSlice writes retried after a 409 (re-get and reapply)",
+)
+_SYNC_ERRORS = REGISTRY.counter(
+    "dra_slice_sync_errors_total",
+    "ResourceSlice operations that failed a reconcile pass",
+)
+
+# Bounded re-get-and-retry per slice write: a 409 means a concurrent writer
+# (or an injected fault) bumped the resourceVersion under us; replaying the
+# PUT against a fresh read converges because our spec is declarative.
+CONFLICT_RETRIES = 4
+
+
+class SliceSyncError(APIError):
+    """Partial reconcile summary: some slice operations failed, the rest of
+    the pass completed.  Code 503 → retryable; the caller's next debounce
+    (or parked retry) heals the remainder."""
+
+    def __init__(self, failures: list[tuple[str, Exception]]):
+        self.failures = failures
+        name, exc = failures[0]
+        super().__init__(
+            503,
+            f"{len(failures)} resourceslice op(s) failed; "
+            f"first: {name}: {type(exc).__name__}: {exc}",
+        )
 
 
 @dataclass
@@ -85,7 +121,15 @@ class ResourceSliceController:
         ]
 
     def _sync(self) -> None:
-        existing = {s.metadata.name: s for s in self._owned()}
+        """One reconcile pass.  Per-slice failures are recorded and the pass
+        CONTINUES (a single sick object must not park every other pool);
+        at the end they surface as one retryable :class:`SliceSyncError`."""
+        try:
+            existing = {s.metadata.name: s for s in self._owned()}
+        except (APIError, OSError) as exc:
+            _SYNC_ERRORS.inc(op="list")
+            raise SliceSyncError([("list", exc)]) from exc
+        failures: list[tuple[str, Exception]] = []
         desired_names: set[str] = set()
 
         for pool_name, pool in self._resources.pools.items():
@@ -138,12 +182,10 @@ class ResourceSliceController:
             )
             for i, sl in enumerate(pool.slices):
                 want = build(i, sl, new_gen)
-                current = existing.get(want.metadata.name)
-                if current is None:
-                    self._server.create(want)
-                else:
-                    current.spec = want.spec
-                    self._server.update(current)
+                try:
+                    self._apply_slice(want, existing.get(want.metadata.name))
+                except (APIError, OSError) as exc:
+                    self._record_failure(failures, want.metadata.name, exc)
 
         for name in existing:
             if name not in desired_names:
@@ -151,4 +193,53 @@ class ResourceSliceController:
                     "resourceslices", "slice.delete", correlation=name,
                     owner=self._owner,
                 )
-                self._server.delete(ResourceSlice.KIND, name)
+                try:
+                    self._server.delete(ResourceSlice.KIND, name)
+                except NotFound:
+                    pass  # already gone: the desired state
+                except (APIError, OSError) as exc:
+                    self._record_failure(failures, name, exc)
+        if failures:
+            JOURNAL.record(
+                "resourceslices", "pool.sync_partial", correlation=self._owner,
+                failed=len(failures),
+                slices=[name for name, _ in failures],
+            )
+            raise SliceSyncError(failures)
+
+    def _record_failure(
+        self, failures: list, name: str, exc: Exception
+    ) -> None:
+        _SYNC_ERRORS.inc(op="apply")
+        JOURNAL.record(
+            "resourceslices", "slice.sync_fail", correlation=name,
+            owner=self._owner, error=f"{type(exc).__name__}: {exc}",
+        )
+        failures.append((name, exc))
+
+    def _apply_slice(self, want: ResourceSlice, current) -> None:
+        """Write one desired slice, healing optimistic-concurrency races:
+        on 409 re-get the live object and replay the spec onto its current
+        resourceVersion (pool-generation bumps by a concurrent writer land
+        in the re-read), bounded by CONFLICT_RETRIES."""
+        name = want.metadata.name
+        for attempt in range(CONFLICT_RETRIES + 1):
+            try:
+                if current is None:
+                    self._server.create(want)
+                else:
+                    current.spec = want.spec
+                    self._server.update(current)
+                return
+            except (Conflict, AlreadyExists) as exc:
+                if attempt == CONFLICT_RETRIES:
+                    raise
+                _SYNC_RETRIES.inc()
+                JOURNAL.record(
+                    "resourceslices", "slice.conflict_retry", correlation=name,
+                    attempt=attempt + 1, error=f"{type(exc).__name__}: {exc}",
+                )
+                try:
+                    current = self._server.get(ResourceSlice.KIND, name)
+                except NotFound:
+                    current = None  # deleted under us: recreate

@@ -22,6 +22,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import re
 import ssl
 import tempfile
 import threading
@@ -44,6 +45,28 @@ from k8s_dra_driver_tpu.kube.fakeserver import (
     Watch,
     WatchEvent,
 )
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+from k8s_dra_driver_tpu.utils.retry import (
+    DEFAULT_WATCH_POLICY,
+    Backoff,
+    CircuitBreaker,
+    RetryBudget,
+    RetryPolicy,
+    call_with_retry,
+)
+
+_RELIST_ERRORS = REGISTRY.counter(
+    "dra_watch_relist_errors_total",
+    "Reflector relist attempts that failed (watch stays up and retries)",
+)
+_RECONNECTS = REGISTRY.counter(
+    "dra_watch_reconnects_total", "Watch stream reconnect attempts, by kind"
+)
+
+# One-shot requests: a handful of attempts with sub-second backoff covers
+# API-server blips without masking real outages from the caller.
+DEFAULT_REQUEST_POLICY = RetryPolicy(max_attempts=4, base_delay_s=0.05, max_delay_s=2.0)
 
 # kind -> (api prefix, plural, namespaced)
 _RESOURCES = {
@@ -135,6 +158,19 @@ def _named(items: list, name: str) -> dict:
     return {}
 
 
+_ENDPOINT_RE = re.compile(
+    r"^/(?:api/v1|apis/[^/]+/[^/]+)(?:/namespaces/[^/]+)?/(?P<plural>[^/?]+)"
+)
+
+
+def _endpoint_class(url: str) -> str:
+    """Circuit-breaker partitioning key: the resource plural.  One sick
+    resource family (e.g. a webhook stalling resourceslices) must not trip
+    the breaker for unrelated traffic."""
+    m = _ENDPOINT_RE.match(urllib.parse.urlparse(url).path)
+    return m.group("plural") if m else "misc"
+
+
 class _RateLimiter:
     """Token bucket: qps refill, burst capacity (client-go flowcontrol)."""
 
@@ -159,10 +195,37 @@ class _RateLimiter:
 
 
 class RESTClient:
-    """Drop-in for InMemoryAPIServer against a real API server."""
+    """Drop-in for InMemoryAPIServer against a real API server.
 
-    def __init__(self, config: KubeClientConfig):
+    All traffic goes through the shared retry/backoff/circuit-breaker
+    layer (utils/retry.py): ``_request`` retries retryable failures
+    (429/5xx/transport) under ``retry_policy`` behind a per-endpoint-class
+    breaker, and ``_watch_loop`` reconnects on a jittered exponential
+    schedule (``watch_policy``) that resets on success."""
+
+    def __init__(
+        self,
+        config: KubeClientConfig,
+        retry_policy: RetryPolicy | None = None,
+        watch_policy: RetryPolicy | None = None,
+        watch_read_timeout_s: float = 300.0,
+        request_timeout_s: float = 30.0,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 15.0,
+    ):
         self.config = config
+        self._retry_policy = retry_policy or DEFAULT_REQUEST_POLICY
+        self._watch_policy = watch_policy or DEFAULT_WATCH_POLICY
+        # A quiet watch hitting the read timeout just reconnects — the same
+        # contract as apiserver-side watch timeouts; it also bounds how long
+        # a silently hung stream can stall an informer.
+        self._watch_read_timeout_s = watch_read_timeout_s
+        self._request_timeout_s = request_timeout_s
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breaker_lock = threading.Lock()
+        self._budget = RetryBudget()
         self._limiter = _RateLimiter(config.qps, config.burst)
         if config.server.startswith("https"):
             if config.insecure_skip_verify:
@@ -270,13 +333,17 @@ class RESTClient:
         return items, doc.get("metadata", {}).get("resourceVersion", "")
 
     def _watch_loop(self, w: Watch, kind: str, rv: str) -> None:
+        backoff = Backoff(self._watch_policy)
         while not w.stopped:
             url = self._collection_url(kind, "") + "?" + urllib.parse.urlencode(
                 {"watch": "true", "resourceVersion": rv}
             )
+            streamed = False
             try:
                 req = self._make_request("GET", url)
-                with urllib.request.urlopen(req, context=self._ssl) as resp:
+                with urllib.request.urlopen(
+                    req, context=self._ssl, timeout=self._watch_read_timeout_s
+                ) as resp:
                     for line in resp:
                         if w.stopped:
                             return
@@ -286,25 +353,55 @@ class RESTClient:
                         if frame.get("type") == "ERROR":
                             # Expired resourceVersion (410 Gone as a frame):
                             # re-establish the informer contract by re-listing.
-                            rv = self._relist(w, kind)
+                            rv, streamed = self._relist_guarded(w, kind, rv)
                             break
                         obj = objects.from_json(frame["object"])
                         rv = obj.metadata.resource_version or rv
                         self._deliver(w, WatchEvent(frame["type"], obj))
+                        streamed = True
             except urllib.error.HTTPError as exc:
                 if w.stopped:
                     return
                 if exc.code == 410:  # expired rv on connect
-                    try:
-                        rv = self._relist(w, kind)
+                    rv, relisted = self._relist_guarded(w, kind, rv)
+                    if relisted:
+                        backoff.reset()
                         continue
-                    except Exception:
-                        pass
-                time.sleep(1.0)
             except (urllib.error.URLError, OSError, json.JSONDecodeError, ValueError):
                 if w.stopped:
                     return
-                time.sleep(1.0)  # reconnect backoff
+            # EOF, decode error, failed relist or connect failure: reconnect
+            # on the shared jittered schedule; any streamed frame (or
+            # successful relist) resets it so one blip doesn't leave the
+            # watch permanently slow.
+            if streamed:
+                backoff.reset()
+            _RECONNECTS.inc(kind=kind)
+            self._watch_sleep(w, backoff.next_delay())
+
+    @staticmethod
+    def _watch_sleep(w: Watch, delay: float) -> None:
+        """Backoff sleep that notices ``stop()`` instead of oversleeping."""
+        deadline = time.monotonic() + delay
+        while not w.stopped:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(0.1, remaining))
+
+    def _relist_guarded(self, w: Watch, kind: str, rv: str) -> tuple[str, bool]:
+        """Relist, surfacing failures instead of swallowing them: the old
+        rv is kept (the next connect 410s again and re-enters here) and the
+        failure is journaled + counted so a flapping relist is visible."""
+        try:
+            return self._relist(w, kind), True
+        except Exception as exc:
+            _RELIST_ERRORS.inc(kind=kind)
+            JOURNAL.record(
+                "restclient", "watch.relist_fail", correlation=kind,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return rv, False
 
     def _relist(self, w: Watch, kind: str) -> str:
         """Reflector recovery (client-go Replace semantics): list again,
@@ -334,11 +431,35 @@ class RESTClient:
             req.add_header("Authorization", f"Bearer {self.config.token}")
         return req
 
+    def _breaker_for(self, url: str) -> CircuitBreaker:
+        endpoint = _endpoint_class(url)
+        with self._breaker_lock:
+            breaker = self._breakers.get(endpoint)
+            if breaker is None:
+                breaker = self._breakers[endpoint] = CircuitBreaker(
+                    endpoint=endpoint,
+                    failure_threshold=self._breaker_threshold,
+                    reset_timeout_s=self._breaker_reset_s,
+                )
+            return breaker
+
     def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
+        endpoint = _endpoint_class(url)
+        return call_with_retry(
+            lambda: self._request_once(method, url, body),
+            policy=self._retry_policy,
+            breaker=self._breaker_for(url),
+            budget=self._budget,
+            op=f"{method} {endpoint}",
+        )
+
+    def _request_once(self, method: str, url: str, body: Optional[dict]) -> dict:
         self._limiter.wait()
         req = self._make_request(method, url, body)
         try:
-            with urllib.request.urlopen(req, context=self._ssl) as resp:
+            with urllib.request.urlopen(
+                req, context=self._ssl, timeout=self._request_timeout_s
+            ) as resp:
                 payload = resp.read()
         except urllib.error.HTTPError as exc:
             message = exc.read().decode(errors="replace")[:500]

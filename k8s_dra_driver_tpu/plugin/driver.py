@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass, field
 
 from k8s_dra_driver_tpu import DRIVER_NAME
-from k8s_dra_driver_tpu.kube.fakeserver import NotFound
+from k8s_dra_driver_tpu.kube.fakeserver import APIError, NotFound
 from k8s_dra_driver_tpu.kube.objects import ResourceClaim
 from k8s_dra_driver_tpu.kube.resourceslice_controller import (
     DriverResources,
@@ -70,6 +70,15 @@ class Driver:
             "dra_claim_errors_total", "Per-claim prepare/unprepare failures"
         )
         self.state = DeviceState(server, config)
+        # 1 while the last publish attempt failed: the cluster may be
+        # scheduling against stale slices (we keep serving the last-published
+        # inventory rather than crashing; see publish_resources).
+        self._stale_gauge = REGISTRY.gauge(
+            "dra_inventory_stale",
+            "1 when the last ResourceSlice publish failed and the advertised "
+            "inventory may be stale",
+        )
+        self._stale_gauge.set(0, node=config.node_name)
         self._needs_publish = False
         self._last_selftest = 0.0
         self._selftest_thread: threading.Thread | None = None
@@ -87,7 +96,14 @@ class Driver:
 
     # -- inventory (driver.go:71-83) ---------------------------------------
 
-    def publish_resources(self) -> None:
+    def publish_resources(self) -> bool:
+        """Reconcile the node pool; returns True on success.
+
+        Degrades instead of crashing on API trouble: the cluster keeps
+        serving the LAST successfully published inventory, staleness is
+        marked (``dra_inventory_stale``) and ``_needs_publish`` stays set
+        so the next health sweep retries (transient errors heal without
+        operator action; persistent ones are visible on the gauge)."""
         devices = self.state.allocatable.get_devices()
         JOURNAL.record(
             "driver", "inventory.publish", correlation=self.config.node_name,
@@ -97,15 +113,28 @@ class Driver:
             Slice(devices=devices[i : i + DEVICES_PER_SLICE])
             for i in range(0, len(devices), DEVICES_PER_SLICE)
         ] or [Slice()]
-        self._slice_controller.update(
-            DriverResources(
-                pools={
-                    self.config.node_name: Pool(
-                        slices=slices, node_name=self.config.node_name
-                    )
-                }
+        try:
+            self._slice_controller.update(
+                DriverResources(
+                    pools={
+                        self.config.node_name: Pool(
+                            slices=slices, node_name=self.config.node_name
+                        )
+                    }
+                )
             )
-        )
+        except (APIError, OSError) as exc:
+            self._needs_publish = True
+            self._stale_gauge.set(1, node=self.config.node_name)
+            JOURNAL.record(
+                "driver", "inventory.publish_fail",
+                correlation=self.config.node_name,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return False
+        self._needs_publish = False
+        self._stale_gauge.set(0, node=self.config.node_name)
+        return True
 
     def shutdown(self, delete_slices: bool = False) -> None:
         """The node plugin normally leaves its slices published across
@@ -252,7 +281,8 @@ class Driver:
         Publish failures keep ``_needs_publish`` set so the NEXT sweep
         retries even though refresh() already committed the new topology —
         otherwise a transient API error would leave stale slices advertised
-        forever."""
+        forever.  The sweep itself never crashes on publish trouble: static
+        health, orphan cleanup and the selftest share this thread."""
         self._maybe_selftest()
         changed = self.state.refresh()
         unhealthy = sum(1 for c in self.state.topology.chips if not c.healthy)
@@ -265,8 +295,18 @@ class Driver:
             )
             self._needs_publish = True
         if self._needs_publish and self.config.publish:
-            self.publish_resources()  # raising keeps the flag set for retry
-            self._needs_publish = False
+            try:
+                self.publish_resources()  # manages _needs_publish + staleness
+            except Exception as exc:  # unexpected (transport errors are
+                # handled inside publish_resources): degrade, don't kill
+                # the sweep — retry next pass.
+                self._needs_publish = True
+                self._stale_gauge.set(1, node=self.config.node_name)
+                JOURNAL.record(
+                    "driver", "inventory.publish_fail",
+                    correlation=self.config.node_name,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
         return changed
 
     def _maybe_selftest(self) -> None:

@@ -23,7 +23,6 @@ from __future__ import annotations
 import hashlib
 import json
 import string
-import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -38,6 +37,7 @@ from k8s_dra_driver_tpu.plugin.topology_daemon import (
     claim_socket_path,
     host_socket_path,
 )
+from k8s_dra_driver_tpu.utils.retry import Backoff, RetryPolicy
 
 _TEMPLATE_PATH = Path(__file__).parent.parent.parent / "templates" / "topology-daemon.tmpl.yaml"
 
@@ -269,9 +269,18 @@ class SpatialPartitionManager:
         return edits, TopologyDaemon(name=name, namespace=self.namespace), plan.per_device_env
 
     def assert_ready(self, name: str) -> None:
-        """Poll the daemon Deployment's availability with exponential backoff
-        (sharing.go:289-344)."""
-        delay, cap, steps = self._backoff
+        """Poll the daemon Deployment's availability on the shared backoff
+        policy (sharing.go:289-344; schedule unchanged: initial*2^n capped)."""
+        initial, cap, steps = self._backoff
+        backoff = Backoff(
+            RetryPolicy(
+                max_attempts=steps,
+                base_delay_s=initial,
+                max_delay_s=cap,
+                multiplier=2.0,
+                jitter=0.0,
+            )
+        )
         for step in range(steps + 1):
             try:
                 dep = self._server.get(objects.Deployment.KIND, name, self.namespace)
@@ -281,8 +290,7 @@ class SpatialPartitionManager:
                 return
             if step == steps:
                 break  # final check failed: raise without a useless sleep
-            time.sleep(delay)
-            delay = min(delay * 2, cap)
+            backoff.sleep()
         raise SharingError(f"topology daemon {name!r} did not become ready")
 
     def stop(self, daemon: TopologyDaemon) -> None:

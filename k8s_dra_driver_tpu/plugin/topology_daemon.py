@@ -295,8 +295,16 @@ class TopologyDaemonServer:
             path.unlink(missing_ok=True)
 
     def start(self, ready_timeout: float = 5.0) -> None:
+        from k8s_dra_driver_tpu.utils.retry import Backoff, RetryPolicy
+
         self._thread = threading.Thread(target=self.serve, daemon=True)
         self._thread.start()
+        backoff = Backoff(
+            RetryPolicy(
+                max_attempts=0, base_delay_s=0.01, max_delay_s=0.05,
+                multiplier=1.5, jitter=0.0,
+            )
+        )
         deadline = time.time() + ready_timeout
         while time.time() < deadline:
             if Path(self.socket_path).exists():
@@ -306,7 +314,7 @@ class TopologyDaemonServer:
                     return
                 except OSError:
                     pass
-            time.sleep(0.01)
+            backoff.sleep()
         raise RuntimeError(f"daemon socket {self.socket_path} not accepting")
 
     def stop(self) -> None:

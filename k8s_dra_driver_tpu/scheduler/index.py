@@ -82,7 +82,7 @@ class PlanView:
 
 
 class AllocationIndex:
-    def __init__(self, server):
+    def __init__(self, server, live: bool | None = None):
         self._server = server
         self._lock = threading.RLock()
         self._slices: dict[str, object] = {}  # slice name -> ResourceSlice
@@ -99,7 +99,11 @@ class AllocationIndex:
         self._watches: list = []
         # Live (event-driven) mode requires synchronous in-process watch
         # delivery; any other client gets list-and-diff refresh per plan.
-        self._live = isinstance(server, InMemoryAPIServer)
+        # ``live=True`` opts a watch-capable client (e.g. RESTClient, whose
+        # reflector relists through 410s/ERROR frames) into event-driven
+        # mode — the chaos suite uses this to prove index convergence
+        # across watch outages.
+        self._live = isinstance(server, InMemoryAPIServer) if live is None else live
         if self._live:
             self._watches = [
                 server.watch(ResourceSlice.KIND, self._on_slice),

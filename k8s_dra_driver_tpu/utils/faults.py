@@ -1,0 +1,224 @@
+"""Fault injection for the API-server boundary — the chaos half of the
+robustness subsystem (utils/retry.py is the policy half).
+
+Nothing in the reference tree can *prove* its resilience claims: client-go
+is trusted to relist and rate-limit, and no test ever makes the API server
+misbehave.  This module makes misbehavior a first-class, deterministic test
+input.  A :class:`FaultInjector` is armed with :class:`FaultProfile`\\ s and
+hooked into two layers:
+
+* ``kube.fakeserver.InMemoryAPIServer`` consults :meth:`before` ahead of
+  every verb — injected 5xx/429 errors, 409 conflicts and added latency
+  reach both in-process harness traffic and (because ``e2e.mock_api``
+  routes through the same store) real HTTP traffic.
+* ``e2e.mock_api.MockKubeAPI`` consults the HTTP-only hooks — connection
+  drops (truncated response body → ``IncompleteRead`` client-side), 410 on
+  watch connect, ERROR frames mid-stream, and silent watch hangs.
+
+Faults are injected *before* the store mutates, so an injected failure
+never half-applies an operation: exactly the failure mode a client retry
+must heal.  Decisions are drawn from a seeded RNG — a chaos test that
+fails replays identically from its seed.
+
+Arming: programmatic (``injector.arm(FaultProfile(...))``) or via the
+``DRA_FAULTS`` env var (``error_rate=0.3,latency_ms=5,seed=7``), which
+``InMemoryAPIServer`` picks up automatically so any harness/bench run can
+be put under chaos without code changes.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+_INJECTED = REGISTRY.counter(
+    "dra_faults_injected_total", "Faults injected, by profile and fault type"
+)
+
+ENV_VAR = "DRA_FAULTS"
+
+
+@dataclass
+class FaultProfile:
+    """One armed fault source.  Rates are probabilities per matching
+    operation; ``watch_*`` counts are storm budgets consumed one per
+    injection; ``limit`` caps total injections from this profile
+    (0 = unlimited).  Empty ``verbs``/``kinds`` match everything."""
+
+    name: str = "fault"
+    error_rate: float = 0.0  # probability of an injected APIError
+    error_code: int = 500
+    conflict_rate: float = 0.0  # probability of an injected 409 Conflict
+    latency_s: float = 0.0  # added to every matching operation
+    drop_rate: float = 0.0  # probability of a truncated HTTP response
+    watch_gone: int = 0  # next N watch connects answer 410 Gone
+    watch_error_frames: int = 0  # next N streams get an ERROR frame
+    watch_hangs: int = 0  # next N streams stall silently...
+    watch_hang_s: float = 0.0  # ...for this long before resuming
+    verbs: tuple = ()  # e.g. ("PUT",); empty = all verbs
+    kinds: tuple = ()  # e.g. ("ResourceSlice",); empty = all kinds
+    limit: int = 0  # total-injection cap, 0 = unlimited
+    injected: int = field(default=0, compare=False)
+
+
+class FaultInjector:
+    """Deterministic, thread-safe fault source shared by the in-memory
+    store and the HTTP facade."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._profiles: list[FaultProfile] = []
+        self._counts: dict[str, int] = {}
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, profile: FaultProfile) -> FaultProfile:
+        with self._lock:
+            self._profiles.append(profile)
+        JOURNAL.record(
+            "faults", "profile.arm", correlation=profile.name,
+            error_rate=profile.error_rate, conflict_rate=profile.conflict_rate,
+            drop_rate=profile.drop_rate, watch_gone=profile.watch_gone,
+        )
+        return profile
+
+    def disarm(self, name: str | None = None) -> None:
+        with self._lock:
+            if name is None:
+                self._profiles.clear()
+            else:
+                self._profiles = [p for p in self._profiles if p.name != name]
+        JOURNAL.record("faults", "profile.disarm", correlation=name or "*")
+
+    # -- decision points ---------------------------------------------------
+
+    def before(self, verb: str, kind: str) -> None:
+        """Server-side hook, called ahead of every store operation.  May
+        sleep (latency) and may raise an injected APIError/Conflict."""
+        from k8s_dra_driver_tpu.kube.fakeserver import APIError, Conflict
+
+        for p in self._matching(verb, kind):
+            if p.latency_s > 0:
+                time.sleep(p.latency_s)
+            if p.conflict_rate and self._roll(p, p.conflict_rate, "conflict", verb, kind):
+                raise Conflict(f"fault injected by profile {p.name!r}")
+            if p.error_rate and self._roll(p, p.error_rate, "error", verb, kind):
+                raise APIError(p.error_code, f"fault injected by profile {p.name!r}")
+
+    def take_drop(self, verb: str, kind: str) -> bool:
+        """HTTP-only: should this response be truncated mid-body?"""
+        for p in self._matching(verb, kind):
+            if p.drop_rate and self._roll(p, p.drop_rate, "drop", verb, kind):
+                return True
+        return False
+
+    def take_watch_gone(self, kind: str) -> bool:
+        """HTTP-only: should this watch connect be answered 410 Gone?"""
+        return self._take_counted(kind, "watch_gone")
+
+    def take_watch_error_frame(self, kind: str) -> bool:
+        """HTTP-only: should this stream get an ERROR frame and close?"""
+        return self._take_counted(kind, "watch_error_frames")
+
+    def take_watch_hang(self, kind: str) -> float:
+        """HTTP-only: seconds this stream should stall silently (0 = none)."""
+        for p in self._matching("GET", kind):
+            with self._lock:
+                if p.watch_hangs > 0 and self._budget_ok(p):
+                    p.watch_hangs -= 1
+                    self._record(p, "watch_hang", "GET", kind)
+                    return p.watch_hang_s
+        return 0.0
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    # -- internals ---------------------------------------------------------
+
+    def _matching(self, verb: str, kind: str) -> list[FaultProfile]:
+        with self._lock:
+            return [
+                p
+                for p in self._profiles
+                if (not p.verbs or verb in p.verbs)
+                and (not p.kinds or kind in p.kinds)
+            ]
+
+    def _take_counted(self, kind: str, attr: str) -> bool:
+        for p in self._matching("GET", kind):
+            with self._lock:
+                if getattr(p, attr) > 0 and self._budget_ok(p):
+                    setattr(p, attr, getattr(p, attr) - 1)
+                    self._record(p, attr, "GET", kind)
+                    return True
+        return False
+
+    def _roll(self, p: FaultProfile, rate: float, fault: str, verb: str, kind: str) -> bool:
+        with self._lock:
+            if not self._budget_ok(p):
+                return False
+            if self._rng.random() >= rate:
+                return False
+            self._record(p, fault, verb, kind)
+            return True
+
+    def _budget_ok(self, p: FaultProfile) -> bool:
+        # called with the lock held
+        return p.limit <= 0 or p.injected < p.limit
+
+    def _record(self, p: FaultProfile, fault: str, verb: str, kind: str) -> None:
+        # called with the lock held
+        p.injected += 1
+        self._counts[fault] = self._counts.get(fault, 0) + 1
+        _INJECTED.inc(profile=p.name, fault=fault)
+        JOURNAL.record_lazy(
+            "faults", f"inject.{fault}", correlation=p.name,
+            attrs=lambda: dict(verb=verb, kind=kind),
+        )
+
+    # -- env arming --------------------------------------------------------
+
+    @staticmethod
+    def from_env(raw: str) -> "FaultInjector":
+        """Parse ``DRA_FAULTS`` (``error_rate=0.3,latency_ms=5,seed=7``)
+        into an armed injector.  Unknown keys fail loudly — a typo'd chaos
+        run that silently injects nothing proves the wrong thing."""
+        fields = {}
+        seed = 0
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            if key == "seed":
+                seed = int(value)
+            elif key == "latency_ms":
+                fields["latency_s"] = float(value) / 1000.0
+            elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
+                         "watch_hang_s"):
+                fields[key] = float(value)
+            elif key in ("error_code", "watch_gone", "watch_error_frames",
+                         "watch_hangs", "limit"):
+                fields[key] = int(value)
+            elif key == "verbs":
+                fields["verbs"] = tuple(value.split("+"))
+            elif key == "kinds":
+                fields["kinds"] = tuple(value.split("+"))
+            else:
+                raise ValueError(f"{ENV_VAR}: unknown fault key {key!r}")
+        injector = FaultInjector(seed=seed)
+        injector.arm(FaultProfile(name="env", **fields))
+        return injector

@@ -77,7 +77,10 @@ class TestHealthSweep:
 
     def test_publish_failure_retried_next_sweep(self, rig, monkeypatch):
         # refresh() commits the new topology before publish; a failed publish
-        # must be retried on the next sweep even though nothing changed again.
+        # must NOT crash the sweep — it marks the inventory stale and retries
+        # on the next sweep even though nothing changed again.
+        from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
         cluster, driver = rig
         driver.config.topology_env = fake_env(dead="1")
 
@@ -88,11 +91,12 @@ class TestHealthSweep:
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("transient API error")
-            real_publish()
+            return real_publish()
 
         monkeypatch.setattr(driver, "publish_resources", flaky)
-        with pytest.raises(RuntimeError):
-            driver.refresh_inventory()
+        assert driver.refresh_inventory() is True  # topology DID change
+        stale = REGISTRY.gauge("dra_inventory_stale")
+        assert stale.value(node="tpu-host-0") == 1.0
         # next sweep: no topology change, but the pending publish retries
         assert driver.refresh_inventory() is False
         assert calls["n"] == 2

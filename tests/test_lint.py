@@ -140,6 +140,77 @@ class TestMetricHygiene:
         assert findings_for(tmp_path, src) == []
 
 
+class TestSleepRetry:
+    RETRY_LOOP = (
+        "import time\n"
+        "while True:\n"
+        "    try:\n"
+        "        connect()\n"
+        "        break\n"
+        "    except OSError:\n"
+        "        time.sleep(1.0)\n"
+    )
+
+    def test_sleep_in_retry_loop_flagged(self, tmp_path):
+        assert findings_for(tmp_path, self.RETRY_LOOP) == ["sleep-retry"]
+
+    def test_for_loop_variant_flagged(self, tmp_path):
+        src = (
+            "import time\n"
+            "def dial(n):\n"
+            "    for _ in range(n):\n"
+            "        try:\n"
+            "            return connect()\n"
+            "        except OSError:\n"
+            "            time.sleep(0.5)\n"
+        )
+        assert findings_for(tmp_path, src) == ["sleep-retry"]
+
+    def test_sleep_without_exception_handling_clean(self, tmp_path):
+        # A poll/pace loop that handles no errors is not a retry loop.
+        src = (
+            "import time\n"
+            "while busy():\n"
+            "    time.sleep(0.1)\n"
+        )
+        assert findings_for(tmp_path, src) == []
+
+    def test_sleep_outside_loop_clean(self, tmp_path):
+        src = (
+            "import time\n"
+            "try:\n"
+            "    connect()\n"
+            "except OSError:\n"
+            "    time.sleep(1.0)\n"
+        )
+        assert findings_for(tmp_path, src) == []
+
+    def test_retry_module_exempt(self, tmp_path):
+        d = tmp_path / "utils"
+        d.mkdir()
+        f = d / "retry.py"
+        f.write_text(self.RETRY_LOOP)
+        assert [x.check for x in lint.check_file(f)] == []
+
+    def test_nested_loops_report_once(self, tmp_path):
+        src = (
+            "import time\n"
+            "while True:\n"
+            "    for _ in range(3):\n"
+            "        try:\n"
+            "            connect()\n"
+            "        except OSError:\n"
+            "            time.sleep(1.0)\n"
+        )
+        assert findings_for(tmp_path, src) == ["sleep-retry"]
+
+    def test_ignore_pragma_applies(self, tmp_path):
+        src = self.RETRY_LOOP.replace(
+            "time.sleep(1.0)", "time.sleep(1.0)  # lint: ignore[sleep-retry]"
+        )
+        assert findings_for(tmp_path, src) == []
+
+
 class TestMain:
     def test_missing_target_fails_loudly(self, capsys):
         rc = lint.main(["lint", "no/such/dir"])

@@ -159,6 +159,50 @@ class TestWatchRecovery:
         client._relist(w, "Node")
         assert [n for t, n in events if t == "DELETED"] == ["stale"]
 
+    def test_relist_failure_counted_not_swallowed(self, api, client, monkeypatch):
+        """A failed recovery relist must be observable (counter + journal)
+        and must not kill the watch thread: the old rv is kept so the next
+        connect 410s again and the relist is retried."""
+        from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+        from k8s_dra_driver_tpu.utils.journal import JOURNAL
+        from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+        client.create(Node(metadata=ObjectMeta(name="n0")))
+        events = []
+        w = client.watch("Node", lambda e: events.append((e.type, e.object.metadata.name)))
+        deadline = time.time() + 5
+        while not api.server._watches and time.time() < deadline:
+            time.sleep(0.02)
+
+        fail = {"on": True}
+        real_relist = RESTClient._relist
+
+        def flaky_relist(watch, kind):
+            if fail["on"]:
+                fail["on"] = False  # fail once, then heal
+                raise APIError(500, "relist blown")
+            return real_relist(client, watch, kind)
+
+        monkeypatch.setattr(client, "_relist", flaky_relist)
+        # Force a watch outage: the next two connects answer 410 Gone, so
+        # the client relists twice — first fails, second succeeds.
+        api.server.faults = FaultInjector()
+        api.server.faults.arm(FaultProfile(name="outage", watch_gone=2))
+        for sw in list(api.server._watches):
+            sw.stop()
+
+        while fail["on"] and time.time() < deadline:
+            time.sleep(0.02)
+        api.server.create(Node(metadata=ObjectMeta(name="after")))
+        while not any(n == "after" for _, n in events) and time.time() < deadline:
+            time.sleep(0.05)
+        w.stop()
+        assert any(n == "after" for _, n in events)  # watch survived
+        assert REGISTRY.counter("dra_watch_relist_errors_total").value(kind="Node") == 1
+        fails = [e for e in JOURNAL.tail(component="restclient")
+                 if e["event"] == "watch.relist_fail"]
+        assert len(fails) == 1
+
     def test_error_frame_triggers_relist(self, api, client):
         # An ERROR frame (expired rv) must not kill the watch thread: the
         # client re-lists and keeps streaming.

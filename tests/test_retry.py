@@ -1,0 +1,262 @@
+"""Unit coverage for the shared retry/backoff/circuit-breaker policy layer
+(utils/retry.py) — the machinery every API-facing loop in the tree rides."""
+
+import random
+import urllib.error
+
+import pytest
+
+from k8s_dra_driver_tpu.kube.fakeserver import APIError, Conflict, NotFound
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+from k8s_dra_driver_tpu.utils.retry import (
+    Backoff,
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudget,
+    RetryPolicy,
+    call_with_retry,
+    is_retryable,
+)
+
+
+class TestClassification:
+    def test_5xx_and_429_retry(self):
+        assert is_retryable(APIError(500, "boom"))
+        assert is_retryable(APIError(503, "unavailable"))
+        assert is_retryable(APIError(429, "slow down"))
+
+    def test_other_4xx_do_not(self):
+        assert not is_retryable(NotFound("gone"))
+        assert not is_retryable(Conflict("rv moved"))
+        assert not is_retryable(APIError(400, "bad request"))
+
+    def test_transport_errors_retry(self):
+        assert is_retryable(urllib.error.URLError("connection refused"))
+        assert is_retryable(ConnectionResetError("peer reset"))
+        assert is_retryable(TimeoutError("timed out"))
+        import http.client
+
+        assert is_retryable(http.client.IncompleteRead(b""))
+
+    def test_http_error_duck_types_on_code(self):
+        err = urllib.error.HTTPError("http://x", 502, "bad gateway", {}, None)
+        assert is_retryable(err)
+        err404 = urllib.error.HTTPError("http://x", 404, "nope", {}, None)
+        assert not is_retryable(err404)
+
+    def test_plain_exceptions_do_not(self):
+        assert not is_retryable(ValueError("logic bug"))
+        assert not is_retryable(KeyError("missing"))
+
+    def test_circuit_open_error_is_retryable_later(self):
+        # OSError + code 503: every transient-error guard in the tree
+        # already treats it right.
+        exc = CircuitOpenError("open")
+        assert isinstance(exc, OSError)
+        assert is_retryable(exc)
+
+
+class TestBackoff:
+    def test_exponential_growth_and_cap(self):
+        bo = Backoff(RetryPolicy(base_delay_s=0.1, max_delay_s=1.0,
+                                 multiplier=2.0, jitter=0.0))
+        assert [round(bo.next_delay(), 3) for _ in range(6)] == [
+            0.1, 0.2, 0.4, 0.8, 1.0, 1.0
+        ]
+
+    def test_jitter_stays_in_band(self):
+        policy = RetryPolicy(base_delay_s=1.0, max_delay_s=1.0, jitter=0.5)
+        bo = Backoff(policy, rng=random.Random(7))
+        for _ in range(50):
+            d = bo.next_delay()
+            assert 0.5 <= d <= 1.0
+
+    def test_reset_restarts_schedule(self):
+        bo = Backoff(RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, jitter=0.0))
+        bo.next_delay()
+        bo.next_delay()
+        assert bo.attempts == 2
+        bo.reset()
+        assert bo.attempts == 0
+        assert bo.next_delay() == pytest.approx(0.1)
+
+    def test_sleep_is_injectable(self):
+        slept = []
+        bo = Backoff(
+            RetryPolicy(base_delay_s=0.25, max_delay_s=1.0, jitter=0.0),
+            sleep=slept.append,
+        )
+        bo.sleep()
+        bo.sleep()
+        assert slept == [0.25, 0.5]
+
+
+class TestRetryBudget:
+    def test_drains_and_refills(self):
+        budget = RetryBudget(cap=2.0, refill_per_success=0.5)
+        assert budget.take()
+        assert budget.take()
+        assert not budget.take()  # drained
+        budget.on_success()
+        budget.on_success()  # +1.0 total
+        assert budget.take()
+        assert not budget.take()
+
+    def test_refill_caps(self):
+        budget = RetryBudget(cap=1.0, refill_per_success=5.0)
+        budget.on_success()
+        assert budget.remaining() == 1.0
+
+
+class TestCallWithRetry:
+    def test_success_after_transients(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise APIError(503, "unavailable")
+            return "ok"
+
+        slept = []
+        result = call_with_retry(
+            flaky,
+            policy=RetryPolicy(max_attempts=5, base_delay_s=0.01, jitter=0.0),
+            op="test-op",
+            sleep=slept.append,
+        )
+        assert result == "ok"
+        assert calls["n"] == 3
+        assert len(slept) == 2
+        retries = REGISTRY.counter("dra_api_retries_total")
+        assert retries.value(op="test-op", reason="503") == 2
+        events = [e for e in JOURNAL.tail(component="retry")
+                  if e["event"] == "call.retry"]
+        assert len(events) == 2
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def wrong():
+            calls["n"] += 1
+            raise NotFound("no such object")
+
+        with pytest.raises(NotFound):
+            call_with_retry(wrong, sleep=lambda _: None)
+        assert calls["n"] == 1
+
+    def test_max_attempts_exhausted(self):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise APIError(500, "down")
+
+        with pytest.raises(APIError):
+            call_with_retry(
+                always,
+                policy=RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0),
+                sleep=lambda _: None,
+            )
+        assert calls["n"] == 3
+
+    def test_budget_exhaustion_stops_retries(self):
+        budget = RetryBudget(cap=1.0, refill_per_success=0.0)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise APIError(500, "down")
+
+        with pytest.raises(APIError):
+            call_with_retry(
+                always,
+                policy=RetryPolicy(max_attempts=10, base_delay_s=0.0, jitter=0.0),
+                budget=budget,
+                sleep=lambda _: None,
+            )
+        # one retry allowed by the single token, then fail fast
+        assert calls["n"] == 2
+
+
+class TestCircuitBreaker:
+    def _clock(self):
+        state = {"t": 0.0}
+
+        def clock():
+            return state["t"]
+
+        return state, clock
+
+    def test_opens_after_threshold_and_fails_fast(self):
+        state, clock = self._clock()
+        br = CircuitBreaker("slices", failure_threshold=3, reset_timeout_s=10.0,
+                            clock=clock)
+        for _ in range(3):
+            assert br.allow()
+            br.on_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()  # cooling down: fail fast
+
+        def never_called():
+            raise AssertionError("breaker must short-circuit")
+
+        with pytest.raises(CircuitOpenError):
+            call_with_retry(never_called, breaker=br, sleep=lambda _: None)
+
+    def test_half_open_probe_closes_on_success(self):
+        state, clock = self._clock()
+        br = CircuitBreaker("slices", failure_threshold=1, reset_timeout_s=5.0,
+                            clock=clock)
+        br.on_failure()
+        assert br.state == CircuitBreaker.OPEN
+        state["t"] = 6.0
+        assert br.allow()  # the probe
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert not br.allow()  # second concurrent probe rejected
+        br.on_success()
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.allow()
+
+    def test_failed_probe_reopens(self):
+        state, clock = self._clock()
+        br = CircuitBreaker("slices", failure_threshold=1, reset_timeout_s=5.0,
+                            clock=clock)
+        br.on_failure()
+        state["t"] = 6.0
+        assert br.allow()
+        br.on_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+
+    def test_observability(self):
+        state, clock = self._clock()
+        br = CircuitBreaker("pods", failure_threshold=1, reset_timeout_s=5.0,
+                            clock=clock)
+        gauge = REGISTRY.gauge("dra_circuit_state")
+        assert gauge.value(endpoint="pods") == 0
+        br.on_failure()
+        assert gauge.value(endpoint="pods") == 2
+        state["t"] = 6.0
+        br.allow()
+        assert gauge.value(endpoint="pods") == 1
+        br.on_success()
+        assert gauge.value(endpoint="pods") == 0
+        transitions = REGISTRY.counter("dra_circuit_transitions_total")
+        assert transitions.value(endpoint="pods", to="open") == 1
+        assert transitions.value(endpoint="pods", to="closed") == 1
+        states = [e["event"] for e in JOURNAL.tail(component="retry")
+                  if e["event"].startswith("breaker.")]
+        assert states == ["breaker.open", "breaker.half_open", "breaker.closed"]
+
+    def test_only_retryable_failures_trip(self):
+        # call_with_retry feeds the breaker only retryable-class failures.
+        br = CircuitBreaker("claims", failure_threshold=1)
+
+        def wrong():
+            raise NotFound("missing")
+
+        with pytest.raises(NotFound):
+            call_with_retry(wrong, breaker=br, sleep=lambda _: None)
+        assert br.state == CircuitBreaker.CLOSED

@@ -17,6 +17,10 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
   metric-hygiene     Prometheus naming: snake_case, counters end _total,
                      histograms carry a unit suffix, gauges don't claim
                      _total, declared help strings are non-empty
+  sleep-retry        `time.sleep(...)` inside a loop that handles
+                     exceptions: an ad-hoc retry/reconnect loop.  Those
+                     must use utils/retry.py's Backoff (jittered, capped,
+                     reset-on-success); utils/retry.py itself is exempt
 
 Suppress a line with ``# lint: ignore[<check>]`` or a whole file with
 ``# lint: skip-file`` in its first five lines.
@@ -235,6 +239,35 @@ def check_file(path: Path) -> list[Finding]:
             walk(child)
 
     walk(tree)
+
+    # ---- sleep-retry ------------------------------------------------------
+    # A time.sleep inside a loop whose body also handles exceptions is the
+    # signature of a hand-rolled retry/reconnect loop — exactly what
+    # utils/retry.py's Backoff replaces (jitter, cap, reset-on-success,
+    # observability).  The policy module itself implements the primitive.
+    if not str(path).replace("\\", "/").endswith("utils/retry.py"):
+        flagged: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            if not any(isinstance(n, ast.ExceptHandler) for n in ast.walk(node)):
+                continue
+            for n in ast.walk(node):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "sleep"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "time"
+                    and n.lineno not in flagged
+                ):
+                    flagged.add(n.lineno)
+                    add(
+                        n.lineno,
+                        "sleep-retry",
+                        "time.sleep in a retry/reconnect loop; "
+                        "use utils.retry.Backoff",
+                    )
 
     # ---- token-level checks ----------------------------------------------
     try:
