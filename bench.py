@@ -294,8 +294,16 @@ def _data_plane_body(sink: dict | None = None) -> dict:
             )
         return (total - rtt) / steps * 1000, last_loss, p
 
-    step_ms, last_loss, params = time_train("blocks")
     out = sink if sink is not None else {}
+    # Decode-loop pipelining A/B (sync_interval=1 vs K on the same fixed
+    # workload): CPU-deterministic, cheap, backend-independent — it runs
+    # FIRST so the serving number is in the salvage sink before any
+    # hang-prone chip block, and the degraded CPU path reuses it as-is.
+    try:
+        out["serving_throughput"] = _serving_throughput_cpu()
+    except Exception as exc:  # noqa: BLE001
+        out["serving_throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    step_ms, last_loss, params = time_train("blocks")
     out.update({
         "backend": jax.default_backend(),
         "burnin_step_ms": round(step_ms, 2),
@@ -723,6 +731,120 @@ def _serving_benchmark(n_slots=8, block_size=128, n_requests=24) -> dict:
     return out
 
 
+def _serving_throughput_cpu(
+    n_slots=8, gen_tokens=64, sync_interval=16, trials=3
+) -> dict:
+    """Pipelined vs synchronous decode loop at FULL occupancy — the PR 4
+    tentpole priced: the same n_slots resident requests drained with
+    ``sync_interval=1`` (one host sync per token) and with the fused
+    K-step burst (models/serve.py ``step_burst``: on-device stop masks,
+    one dispatch + one readback per K tokens).
+
+    Deterministic and CPU-runnable by design (greedy sampling, fixed
+    prompts, tiny model): this block must complete inside the DEGRADED
+    data-plane budget, so the artifact carries a serving number even when
+    the chip link is down.  Admission runs OUTSIDE the timed window (the
+    submits' prefill syncs complete before the clock starts), so the A/B
+    isolates the decode loop — the thing the sync_interval knob changes.
+    Reports tokens/s, host syncs per 100 tokens for both legs, and the
+    bit-equality of the two legs' full token streams (the pipelining
+    contract: scheduling moves, streams don't)."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq=128,
+    )
+    params = burnin.init_params(jax.random.PRNGKey(11), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(
+            jax.random.PRNGKey(s), cfg, batch=1, seq=8
+        )[0]))
+        for s in range(n_slots)
+    ]
+
+    def leg(interval: int):
+        eng = serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=n_slots, prompt_bucket=16,
+            sync_interval=interval,
+        )
+        eng.pump([(prompts[0], 8)])  # compile admission + step off the clock
+        best, syncs_per_100, streams = 0.0, 0.0, None
+        for _ in range(trials):
+            for p in prompts:
+                eng.submit(p, max_tokens=gen_tokens)
+            eng.host_syncs = 0
+            start = time.perf_counter()
+            eng.run_until_drained()
+            wall = time.perf_counter() - start
+            done = eng.completions()
+            gen = sum(len(c.generated) for c in done)
+            if gen / wall > best:
+                best = gen / wall
+                syncs_per_100 = 100 * eng.host_syncs / gen
+            streams = sorted(tuple(c.tokens) for c in done)
+        return {
+            "tokens_per_s": round(best, 1),
+            "host_syncs_per_100_tokens": round(syncs_per_100, 1),
+        }, streams
+
+    sync, sync_streams = leg(1)
+    pipe, pipe_streams = leg(sync_interval)
+    return {
+        "engine": "ServeEngine",
+        "n_slots": n_slots,
+        "gen_tokens": gen_tokens,
+        "sync_interval": sync_interval,
+        "trials": trials,
+        "sync": sync,
+        "pipelined": pipe,
+        "speedup": _ratio(pipe, sync),
+        "bit_equal": sync_streams == pipe_streams,
+        "note": "best-of-trials drain windows, admission off the clock; "
+                "tests/test_pipelined_serve.py holds the bit-equality "
+                "contract across engines and features",
+    }
+
+
+def _data_plane_degraded(sink: dict | None = None) -> dict:
+    """Reduced data plane for the DEGRADED (backend-down, CPU-pinned)
+    path: the full body's 4096-chain matmul and 512-seq burn-in take
+    minutes on a 1-core CPU — far past the 240s degraded budget — so this
+    runs a small burn-in plus the serving-throughput A/B, and the
+    artifact records real numbers with ``"degraded": true`` instead of an
+    error blob."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin
+
+    out = sink if sink is not None else {}
+    out["backend"] = jax.default_backend()
+    try:
+        out["serving_throughput"] = _serving_throughput_cpu()
+    except Exception as exc:  # noqa: BLE001
+        out["serving_throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    cfg = burnin.ModelConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq=128,
+    )
+    tokens = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=cfg.max_seq)
+    fns = burnin.build_train_step(cfg, attention="dense", remat="blocks")
+    p, opt_state = fns.init(jax.random.PRNGKey(0))
+    p, opt_state, loss = fns.step(p, opt_state, tokens)
+    float(loss)  # sync the compile before the timer starts
+    start = time.perf_counter()
+    steps = 5
+    for _ in range(steps):
+        p, opt_state, loss = fns.step(p, opt_state, tokens)
+    last_loss = float(loss)
+    out["burnin_step_ms"] = round((time.perf_counter() - start) / steps * 1000, 2)
+    out["burnin_loss"] = round(last_loss, 4)
+    out["reduced"] = "degraded body: small burn-in + serving A/B only"
+    return out
+
+
 V5E_BF16_PEAK_TFLOPS = 197.0  # nominal single-chip bf16 peak
 
 
@@ -936,16 +1058,20 @@ def _wait_for_backend(max_wait_s: float) -> dict:
     return {"ok": False, "attempts": attempt, "waited_s": round(waited, 1)}
 
 
-def _run_data_plane_guarded(timeout_s: float = 600.0) -> dict:
+def _run_data_plane_guarded(timeout_s: float = 600.0, degraded: bool = False) -> dict:
     """Data plane behind a watchdog: a hung accelerator tunnel (jax backend
     init can block forever when the device link dies) must not stop the
     JSON line from printing.  Daemon thread: a stuck jax import cannot keep
-    the process alive at exit."""
+    the process alive at exit.  ``degraded`` runs the reduced CPU body
+    (:func:`_data_plane_degraded`) instead of the full chip suite."""
     result: dict = {}
 
     def worker():
         try:
-            run_data_plane(sink=result)  # fills result per block
+            if degraded:
+                _data_plane_degraded(sink=result)
+            else:
+                run_data_plane(sink=result)  # fills result per block
         except Exception as exc:  # noqa: BLE001 - report, don't die
             result["error"] = f"{type(exc).__name__}: {exc}"
 
@@ -1000,9 +1126,27 @@ def main() -> int:
         batched = {"error": f"{type(exc).__name__}: {exc}"}
     # The data-plane proof is best-effort reporting: a flaky accelerator
     # tunnel must not suppress the headline control-plane metric.
+    # 120s default probe budget: the old 900s wait overran the 240s
+    # backend-down data-plane budget by itself, timing out the whole
+    # artifact — the probe must always cost less than the body it gates.
     probe = _wait_for_backend(
-        max_wait_s=float(os.environ.get("BENCH_BACKEND_RETRY_S", "900"))
+        max_wait_s=float(os.environ.get("BENCH_BACKEND_RETRY_S", "120"))
     )
+    # attempts == 0 means the wait was DISABLED, not that the backend is
+    # down — only a probe that TRIED and never saw the backend degrades.
+    degraded = not probe["ok"] and probe["attempts"] > 0
+    if degraded:
+        # Pin jax to CPU before its backend initializes: an in-process
+        # init against the dead tunnel blocks forever (the exact hang the
+        # subprocess probe exists to avoid), and the reduced CPU body
+        # still records a real data-plane number for the artifact.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 - already initialized on CPU is fine
+            pass
     data = _run_data_plane_guarded(
         # 2400s: the attention block sweep adds ~3 compiles on a cold
         # chip, the speculative block compiles chained while_loops, the
@@ -1010,15 +1154,16 @@ def main() -> int:
         # tunnel, and round 5 added the int4-kernel A/B and remat-dots
         # timing (each a fresh compile); the sink salvages completed
         # blocks if the budget still runs out.
-        # When the bounded-backoff probe TRIED and never saw the backend,
-        # one short guarded attempt still runs (the probe can
-        # false-negative on a cold cache) but must not stall the artifact
-        # for half an hour.  attempts == 0 means the wait was DISABLED,
-        # not that the backend is down — keep the full timeout then.
+        # When the probe TRIED and never saw the backend, the reduced
+        # CPU body runs instead (small burn-in + serving A/B) — it fits
+        # the short budget by construction.
         timeout_s=float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S", "2400"))
-        if probe["ok"] or probe["attempts"] == 0
-        else float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S_DOWN", "240"))
+        if not degraded
+        else float(os.environ.get("BENCH_DATA_PLANE_TIMEOUT_S_DOWN", "240")),
+        degraded=degraded,
     )
+    if degraded:
+        data["degraded"] = True
     data["backend_probe"] = probe
     print(
         f"# control-plane: {len(samples)} cycles, p50={p50:.2f}ms "

@@ -244,6 +244,28 @@ def decode_step(
     return logits[:, 0], cache
 
 
+def advance_decode_state(next_tok, last, pos, active, stop_pos, eos_id):
+    """On-device serving-state advance — the stop-mask half of the engines'
+    pipelined decode loop (models/serve.py ``step_burst``).
+
+    Folds the host retirement checks into the jitted step so a burst of K
+    steps needs ONE device->host sync instead of K.  A row that just sampled
+    ``next_tok`` at depth ``pos`` advances to ``pos + 1`` and stays active
+    unless it hit ``eos_id`` or its precomputed ``stop_pos``
+    (``prompt_len + max_tokens - 1``: the depth of the LAST token the
+    request may commit, so ``new_pos >= stop_pos`` is exactly the host's
+    ``n_gen >= max_tokens`` under the engine invariant
+    ``pos == len(tokens) - 1``).  Inactive rows are frozen bit-for-bit.
+
+    ``eos_id`` is traced (pass -1 for "no eos": token ids are >= 0, so it
+    never matches).  Returns (new_last [B], new_pos [B], new_active [B]).
+    """
+    new_last = jnp.where(active, next_tok, last)
+    new_pos = jnp.where(active, pos + 1, pos)
+    done = active & ((next_tok == eos_id) | (new_pos >= stop_pos))
+    return new_last, new_pos, active & ~done
+
+
 def greedy_decode(
     params, prompt: jax.Array, steps: int, cfg: ModelConfig,
     cache_dtype=jnp.float32, batch_prefill: bool = False,

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import NamedTuple
 
 import jax
@@ -454,6 +455,38 @@ def _paged_step_all(
     return serve.sample_next(logits, pos, temps, keys, top_k=top_k), cache
 
 
+def _paged_pipelined_burst(
+    params, cache, table, tokens, pos, active, temps, keys, stop_pos,
+    adapters=None,
+    *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
+    eos_id: int, k: int,
+):
+    """K FUSED pipelined paged steps in ONE jitted scan:
+    :func:`_paged_step_all` plus the shared on-device stop-mask advance
+    (decode.advance_decode_state) per iteration — the paged twin of
+    serve._pipelined_burst, so ``step_burst`` pays one dispatch and one
+    readback per K tokens.  Rows the host left inactive (stalled or free)
+    stay frozen; rows that retire on device go inactive for the rest of
+    the burst and their writes divert to the null block.  Returns
+    (trace_tok [K,B], trace_active [K,B], cache, last, pos, active)."""
+
+    def body(carry, _):
+        cache, last, pos, active = carry
+        next_tok, cache = _paged_step_all(
+            params, cache, table, last, pos, active, temps, keys, adapters,
+            cfg=cfg, top_k=top_k, attn_impl=attn_impl, interpret=interpret,
+        )
+        new_last, new_pos, new_active = decode.advance_decode_state(
+            next_tok, last, pos, active, stop_pos, eos_id
+        )
+        return (cache, new_last, new_pos, new_active), (next_tok, active)
+
+    (cache, last, pos, active), (trace_tok, trace_act) = jax.lax.scan(
+        body, (cache, tokens, pos, active), None, length=k
+    )
+    return trace_tok, trace_act, cache, last, pos, active
+
+
 def _paged_first_token(
     params, cache, table, prompt, plen, slot, temp, key, adapters=None,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
@@ -585,6 +618,14 @@ class PagedServeEngine:
     top_k: int = 0
     attn_impl: str | None = None  # None = kernel on TPU, xla elsewhere
     interpret: bool = False
+    # Pipelined decode (the dense engine's sync_interval, over the pool):
+    # > 1 makes step_burst() dispatch up to K fused steps per host sync,
+    # growing each participating slot's blocks for the WHOLE burst up
+    # front (lookahead K-1).  Slots the pool cannot cover for a burst
+    # stall for the burst; if NOBODY can, the burst degrades to the
+    # one-step path so stall/preempt semantics match the sync loop.
+    # Streams bit-equal sync_interval=1 (tested).
+    sync_interval: int = 1
     # Block-level prefix caching: > 0 keeps up to this many FULL prompt
     # blocks in an LRU store and SHARES them (refcounted) across requests
     # whose prompts start with the same tokens — admission skips both the
@@ -667,6 +708,8 @@ class PagedServeEngine:
             )
         if self.attn_impl is None:
             self.attn_impl = default_attn_impl()
+        if self.sync_interval < 1:
+            raise ValueError(f"sync_interval must be >= 1, got {self.sync_interval}")
         if (
             self.attn_impl == "kernel"
             and not self.interpret
@@ -734,6 +777,7 @@ class PagedServeEngine:
             self._temps = jnp.zeros((self.n_slots,), jnp.float32)
             self._keys = jnp.stack([jax.random.PRNGKey(0)] * self.n_slots)
             self._adapter_ids = jnp.zeros((self.n_slots,), jnp.int32)
+            self._stop_pos = jnp.zeros((self.n_slots,), jnp.int32)
         else:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
@@ -755,12 +799,16 @@ class PagedServeEngine:
                     jnp.zeros((self.n_slots,), jnp.float32),
                     jnp.stack([jax.random.PRNGKey(0)] * self.n_slots),
                     jnp.zeros((self.n_slots,), jnp.int32),
+                    jnp.zeros((self.n_slots,), jnp.int32),
                 ),
-                out_shardings=(slot_s, slot_s, slot_s, slot_s, slot_s),
+                out_shardings=(
+                    slot_s, slot_s, slot_s, slot_s, slot_s, slot_s,
+                ),
             )
-            self._last, self._pos, self._temps, self._keys, self._adapter_ids = (
-                make()
-            )
+            (
+                self._last, self._pos, self._temps, self._keys,
+                self._adapter_ids, self._stop_pos,
+            ) = make()
             self.params = jax.device_put(
                 self.params, NamedSharding(self.mesh, P())
             )
@@ -785,6 +833,9 @@ class PagedServeEngine:
         # old cache surviving a failed call.  One pool copy per admission,
         # amortized over the request's whole token stream, buys that.
         self._chunk_fns: dict = {}  # mesh path: chunk_len -> compiled fn
+        self.host_syncs = 0  # decode-loop readbacks (admission syncs excluded)
+        self._pipe_kw = dict(**kw, eos_id=-1 if self.eos_id is None else self.eos_id)
+        self._pipe_fns: dict = {}  # static burst length -> compiled scan
         if self.mesh is None:
             self._step_fn = jax.jit(
                 functools.partial(_paged_step_all, **kw), donate_argnums=(1,)
@@ -1057,6 +1108,9 @@ class PagedServeEngine:
         self._pos = self._pos.at[slot].set(len(prompt))
         self._temps = self._temps.at[slot].set(temperature)
         self._keys = self._keys.at[slot].set(base_key)
+        self._stop_pos = self._stop_pos.at[slot].set(
+            len(prompt) + max_tokens - 1
+        )
         serve._M_REQUESTS.inc()
         serve._M_TOKENS.inc()  # the admission step's first generated token
         self._retire(slot)  # max_tokens=1 or eos on the first token
@@ -1131,6 +1185,10 @@ class PagedServeEngine:
         self._pos = self._pos.at[slot].set(adm["plen"])
         self._temps = self._temps.at[slot].set(adm["temp"])
         self._keys = self._keys.at[slot].set(adm["key"])
+        st = self._slots[slot]
+        self._stop_pos = self._stop_pos.at[slot].set(
+            st.prompt_len + st.max_tokens - 1
+        )
         serve._M_TOKENS.inc()
         self._retire(slot)
         self._update_gauges()
@@ -1138,13 +1196,22 @@ class PagedServeEngine:
     def _grow_active_slots(self, lookahead: int):
         """Ensure every resident, non-admitting slot owns blocks covering
         positions ``pos .. pos + lookahead`` (0 = the plain decode write;
-        spec_gamma = the verify window).  Slots the pool cannot serve STALL
-        for this step — they resume after a retirement frees blocks.
-        Returns (active mask, table_dirty)."""
+        spec_gamma = the verify window; burst length - 1 for a pipelined
+        burst).  Slots the pool cannot serve STALL for this step — they
+        resume after a retirement frees blocks.
+        Returns (active mask, table_dirty).
+
+        The row depth is derived HOST-SIDE from the engine invariant
+        ``pos[slot] == len(st.tokens) - 1`` (holds for every resident,
+        non-admitting slot at every host-consistent point: admission sets
+        both, each committed token appends one and advances pos by one —
+        spec clips only when it also retires — and readmit restores both).
+        Reading ``self._pos`` back from the device here would serialize
+        the loop against the device ONCE PER STEP — the exact per-token
+        sync the pipelined decode loop exists to remove."""
         admitting = {a["slot"] for a in self._admitting}
         active = np.zeros((self.n_slots,), bool)
         table_dirty = False
-        pos_np = self._readback(self._pos)
         # Scarcity order: high priority grows first (so a tight pool
         # stalls the LOW-priority slots), older request first within a
         # tier.  Deterministic for multi-controller lockstep.
@@ -1159,7 +1226,12 @@ class PagedServeEngine:
             st = self._slots[slot]
             if st is None or slot in admitting:
                 continue
-            needed = (int(pos_np[slot]) + lookahead) // self.block_size + 1
+            # Clamp to the slot's own remaining stream: a fixed-shape burst
+            # asks for lookahead K-1 even when the slot retires sooner, and
+            # blocks it will never write must not stall a tight pool.
+            remaining = st.prompt_len + st.max_tokens - len(st.tokens)
+            ahead = min(lookahead, max(remaining - 1, 0))
+            needed = (len(st.tokens) - 1 + ahead) // self.block_size + 1
             grew = True
             while len(self._owned[slot]) < needed:
                 try:
@@ -1292,6 +1364,11 @@ class PagedServeEngine:
             self._pos = self._pos.at[slot].set(len(tokens) - 1)
             self._temps = self._temps.at[slot].set(r["temp"])
             self._keys = self._keys.at[slot].set(r["key"])
+            # stop depth is a function of the ORIGINAL prompt_len and
+            # max_tokens — it survives preemption unchanged
+            self._stop_pos = self._stop_pos.at[slot].set(
+                st.prompt_len + st.max_tokens - 1
+            )
             self._update_gauges()
 
     def _grow_or_preempt(self, lookahead: int):
@@ -1352,6 +1429,8 @@ class PagedServeEngine:
         self._pos = self._pos + advance  # advance is already 0 when inactive
         tgt = self._readback(target)
         adv = self._readback(advance)
+        self.host_syncs += 1
+        serve._M_HOST_SYNCS.inc()
         committed = 0
         for slot, st in enumerate(self._slots):
             if st is None or not active[slot]:
@@ -1373,6 +1452,7 @@ class PagedServeEngine:
         admission-queue head by one prefill chunk, and re-admit preempted
         requests the pool can now hold); returns the number of slots
         stepped."""
+        t0 = time.perf_counter()
         self._readmit()
         self._advance_admission()
         if self.spec_gamma > 0:
@@ -1392,6 +1472,8 @@ class PagedServeEngine:
         toks = self._readback(next_tok).tolist()
         from k8s_dra_driver_tpu.models import serve
 
+        self.host_syncs += 1
+        serve._M_HOST_SYNCS.inc()
         serve._M_TOKENS.inc(int(active.sum()))
         for slot, st in enumerate(self._slots):
             if st is None or not active[slot]:
@@ -1399,25 +1481,149 @@ class PagedServeEngine:
             st.tokens.append(toks[slot])
             self._retire(slot)
         self._update_gauges()
+        serve._M_STEP_LATENCY.observe(time.perf_counter() - t0)
         return int(active.sum())
 
+    def step_burst(self) -> int:
+        """Advance every participating slot up to ``sync_interval`` tokens
+        with ONE device->host sync — the paged twin of
+        serve.ServeEngine.step_burst; returns #slots stepped.
+
+        Admission work (readmit, one prefill chunk) runs once per BURST
+        instead of once per step — a scheduling change only, streams are
+        unchanged.  Block growth covers the whole burst up front
+        (``lookahead = K - 1``, clamped per slot to its remaining stream);
+        a slot the pool cannot cover for K steps stalls for the burst, and
+        if NO slot can, the burst degrades to lookahead 0 with K = 1 so
+        the stall/preempt/wedge semantics are exactly the synchronous
+        loop's (liveness: whenever step() could progress, step_burst()
+        progresses).  K is otherwise always ``sync_interval`` — the burst
+        is ONE compiled scan (:func:`_paged_pipelined_burst`), and a fixed
+        shape keeps it one trace.  Rows that retire mid-burst go inactive
+        ON DEVICE (stop masks); their blocks free at the host replay —
+        held at most K - 1 extra steps."""
+        if self.sync_interval <= 1 or self.spec_gamma > 0:
+            return self.step()
+        t0 = time.perf_counter()
+        self._readmit()
+        self._advance_admission()
+        admitting = {a["slot"] for a in self._admitting}
+        if not any(
+            st is not None and slot not in admitting
+            for slot, st in enumerate(self._slots)
+        ):
+            return 0
+        k = self.sync_interval
+        active, table_dirty = self._grow_or_preempt(lookahead=k - 1)
+        if not active.any() and k > 1:
+            # tight pool: burst-length lookahead stalls everyone; take the
+            # sync loop's one-step growth instead of wedging
+            k = 1
+            active, dirty2 = self._grow_or_preempt(lookahead=0)
+            table_dirty = table_dirty or dirty2
+        if not active.any():
+            if table_dirty:
+                self._upload_table()
+            return 0
+        if table_dirty:
+            self._upload_table()
+        active_j = self._slot_device(active)
+        from k8s_dra_driver_tpu.models import serve
+        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
+        with WATCHDOG.guard("serve.paged_step_burst"):
+            (
+                trace_t, trace_a, self._cache,
+                self._last, self._pos, active_j,
+            ) = self._burst_fn(k)(
+                self.params, self._cache, self._table, self._last,
+                self._pos, active_j, self._temps, self._keys,
+                self._stop_pos, self._adapters(),
+            )
+            trace_t = self._readback(trace_t)
+            trace_a = self._readback(trace_a)
+        self.host_syncs += 1
+        serve._M_HOST_SYNCS.inc()
+        stepped = int(active.sum())
+        committed = 0
+        for j in range(trace_t.shape[0]):
+            for slot, st in enumerate(self._slots):
+                if st is None or not trace_a[j][slot]:
+                    continue
+                st.tokens.append(int(trace_t[j][slot]))
+                committed += 1
+                self._retire(slot)
+        serve._M_TOKENS.inc(committed)
+        self._update_gauges()
+        serve._M_STEP_LATENCY.observe(time.perf_counter() - t0)
+        return stepped
+
     def run_until_drained(self, max_steps: int = 10_000) -> None:
+        from k8s_dra_driver_tpu.models import serve
+
         for _ in range(max_steps):
             admitting = bool(self._admitting)  # a chunk advancing IS progress
-            if self.step() == 0 and not admitting:
+            if self.step_burst() == 0 and not admitting:
                 if self.free_slots() == self.n_slots and not self._preempted:
                     return
                 # every resident slot stalled, nothing preemptable, and
                 # nothing can retire to free a block: the pool is too
                 # small for this resident set
-                raise RuntimeError("engine wedged: resident slots, no progress")
-        raise RuntimeError("serving loop did not drain")
+                raise serve._wedge_error(
+                    self, "engine wedged: resident slots, no progress"
+                )
+        raise serve._wedge_error(self, "serving loop did not drain")
+
+    def pump(self, requests, max_steps: int = 100_000) -> list:
+        """Continuous-batching drive over the pool: admit ``requests`` as
+        slots AND blocks free, burst-stepping in between; returns the
+        completions.  Composes with chunked admission, prefix sharing,
+        speculative rounds, LoRA and preemption (see serve._pump)."""
+        from k8s_dra_driver_tpu.models import serve
+
+        return serve._pump(self, requests, max_steps)
 
     def completions(self) -> list:
         out, self._completions = self._completions, []
         return out
 
     # -- internals ---------------------------------------------------------
+    def _burst_fn(self, k: int):
+        """Compiled K-step fused burst, cached per distinct K.  Only two
+        lengths ever occur — the configured ``sync_interval`` and the
+        tight-pool K=1 fallback — so at most two traces live here."""
+        fn = self._pipe_fns.get(k)
+        if fn is not None:
+            return fn
+        if self.mesh is None:
+            fn = jax.jit(
+                functools.partial(_paged_pipelined_burst, **self._pipe_kw, k=k),
+                donate_argnums=(1,),
+            )
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            ax = self.slot_axis
+            cache_p = PagedKVCache(k=P(None, ax), v=P(None, ax))
+            row_p = P(ax)
+            trace_p = P(None, ax)  # [K, n_slots]: slots shard, steps don't
+            ad_p = (P(), P(ax)) if self.adapter_bank is not None else P()
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(
+                        _paged_pipelined_burst, **self._pipe_kw, k=k
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(P(), cache_p, row_p, row_p, row_p, row_p,
+                              row_p, row_p, row_p, ad_p),
+                    out_specs=(trace_p, trace_p, cache_p, row_p, row_p,
+                               row_p),
+                ),
+                donate_argnums=(1,),
+            )
+        self._pipe_fns[k] = fn
+        return fn
+
     def _group(self, slot: int) -> int:
         """Pool shard owning this slot (always 0 when unsharded) — the
         contiguous split NamedSharding applies to the slot axis."""

@@ -211,6 +211,59 @@ class TestSleepRetry:
         assert findings_for(tmp_path, src) == []
 
 
+class TestReadbackInLoop:
+    PER_SLOT_LOOP = (
+        "def drain(eng):\n"
+        "    for slot in range(eng.n_slots):\n"
+        "        tok = eng._readback(eng._last)[slot]\n"
+        "        handle(tok)\n"
+    )
+
+    def test_readback_in_loop_flagged(self, tmp_path):
+        assert findings_for(tmp_path, self.PER_SLOT_LOOP) == ["readback-in-loop"]
+
+    def test_device_get_in_while_flagged(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def watch(x):\n"
+            "    while running():\n"
+            "        val = jax.device_get(x)\n"
+            "        emit(val)\n"
+        )
+        assert findings_for(tmp_path, src) == ["readback-in-loop"]
+
+    def test_readback_outside_loop_clean(self, tmp_path):
+        src = (
+            "def snapshot(eng):\n"
+            "    trace = eng._readback(eng._last)\n"
+            "    return [trace[s] for s in range(eng.n_slots)]\n"
+        )
+        assert findings_for(tmp_path, src) == []
+
+    def test_engine_modules_exempt(self, tmp_path):
+        d = tmp_path / "models"
+        d.mkdir()
+        for name in ("serve.py", "paged.py"):
+            f = d / name
+            f.write_text(self.PER_SLOT_LOOP)
+            assert [x.check for x in lint.check_file(f)] == []
+
+    def test_ignore_pragma_applies(self, tmp_path):
+        src = self.PER_SLOT_LOOP.replace(
+            "[slot]", "[slot]  # lint: ignore[readback-in-loop]"
+        )
+        assert findings_for(tmp_path, src) == []
+
+    def test_nested_loops_report_once(self, tmp_path):
+        src = (
+            "def drain(eng):\n"
+            "    while pending(eng):\n"
+            "        for slot in range(eng.n_slots):\n"
+            "            handle(eng._readback(eng._last)[slot])\n"
+        )
+        assert findings_for(tmp_path, src) == ["readback-in-loop"]
+
+
 class TestMain:
     def test_missing_target_fails_loudly(self, capsys):
         rc = lint.main(["lint", "no/such/dir"])

@@ -22,3 +22,13 @@ def test_hot_path_stays_within_perf_budgets():
     # Group commit: a BATCH_SIZE-claim call costs ONE durable write each
     # way, not one per claim.
     assert stats["batched_checkpoint_writes"] == 2 * stats["batch_rounds"]
+
+
+def test_pipelined_decode_stays_within_perf_budgets():
+    stats = perf_smoke.check_pipelined_decode()
+    assert stats["requests"] == 8
+    assert stats["elapsed_s"] <= stats["budget_s"]
+    # The pipelined loop's reason to exist: host syncs amortize over
+    # sync_interval-token bursts instead of one readback per token.
+    assert stats["host_syncs"] <= stats["host_sync_ceiling"]
+    assert stats["host_syncs"] < stats["generated_tokens"] / 4

@@ -21,6 +21,13 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
                      exceptions: an ad-hoc retry/reconnect loop.  Those
                      must use utils/retry.py's Backoff (jittered, capped,
                      reset-on-success); utils/retry.py itself is exempt
+  readback-in-loop   `_readback(...)` / `device_get(...)` inside a loop:
+                     a per-iteration device->host sync serializes the
+                     host against the device once per token/slot — the
+                     exact stall the engines' pipelined step_burst
+                     exists to remove.  Only models/serve.py and
+                     models/paged.py (the two engines, where the batched
+                     readback lives) are exempt
 
 Suppress a line with ``# lint: ignore[<check>]`` or a whole file with
 ``# lint: skip-file`` in its first five lines.
@@ -267,6 +274,34 @@ def check_file(path: Path) -> list[Finding]:
                         "sleep-retry",
                         "time.sleep in a retry/reconnect loop; "
                         "use utils.retry.Backoff",
+                    )
+
+    # ---- readback-in-loop -------------------------------------------------
+    # A device->host readback inside a loop serializes host bookkeeping
+    # against the device once per iteration — per token or per slot, the
+    # stall the pipelined decode loop (models/serve.py step_burst) exists
+    # to remove.  The two engines own the batched readback and are exempt;
+    # everywhere else, hoist the readback out of the loop (read a stacked
+    # trace once) or go through an engine.
+    norm = str(path).replace("\\", "/")
+    if not norm.endswith(("models/serve.py", "models/paged.py")):
+        rb_flagged: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            for n in ast.walk(node):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("_readback", "device_get")
+                    and n.lineno not in rb_flagged
+                ):
+                    rb_flagged.add(n.lineno)
+                    add(
+                        n.lineno,
+                        "readback-in-loop",
+                        f"{n.func.attr}() inside a loop syncs device->host "
+                        "per iteration; batch the readback outside the loop",
                     )
 
     # ---- token-level checks ----------------------------------------------

@@ -15,6 +15,12 @@ counters show the hot path regressed to per-call recomputation:
   NodePrepareResources/NodeUnprepareResources call, regardless of how many
   claims the call carries.
 
+``check_pipelined_decode`` guards the DATA-plane hot loop the same way: a
+tiny-model burst engine (models/serve.py ``sync_interval`` > 1) must drain
+a fixed workload inside ``PIPELINED_DECODE_BUDGET_S`` on CPU and within
+the host-sync ceiling — one sync per token creeping back in busts the
+budget long before it shows up on a real chip.
+
 Exits non-zero (CLI) / raises PerfBudgetError (pytest wrapper) on any
 busted budget, so a future PR cannot silently reintroduce the quadratic.
 """
@@ -153,9 +159,73 @@ def check(cycles: int = CYCLES) -> dict:
     return stats
 
 
+# Timed window: 8 requests x 16 tokens through a 4-slot burst engine,
+# compiles excluded.  ~0.2s on an idle 1-core CPU runner; 1s absorbs
+# shared-runner noise while still catching a per-token host sync (which
+# multiplies the dispatch count by sync_interval) or a retrace per burst.
+PIPELINED_DECODE_BUDGET_S = 1.0
+# Ideal sync count for the workload is ~8 (two 4-slot waves x two bursts
+# per 16-token stream, plus drain tails); 24 leaves 3x slack and sits 5x
+# under the ~128 a one-sync-per-token regression would produce.
+PIPELINED_SYNC_CEILING = 24
+
+
+def check_pipelined_decode() -> dict:
+    """Budget guard for the pipelined decode loop (PR 4 tentpole): the
+    burst engine must stay compiled (no per-burst retrace) and must NOT
+    sync the host per token.  CPU-deterministic: greedy sampling, fixed
+    prompts, tiny model."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(8)
+    ]
+    eng = serve.ServeEngine(
+        params=params, cfg=cfg, n_slots=4, prompt_bucket=16, sync_interval=8
+    )
+    eng.pump([(prompts[0], 16)])  # compile admission + burst off the clock
+    eng.host_syncs = 0
+    start = time.perf_counter()
+    done = eng.pump([(p, 16) for p in prompts])
+    elapsed = time.perf_counter() - start
+    stats = {
+        "requests": len(done),
+        "generated_tokens": sum(len(c.generated) for c in done),
+        "elapsed_s": round(elapsed, 3),
+        "budget_s": PIPELINED_DECODE_BUDGET_S,
+        "host_syncs": eng.host_syncs,
+        "host_sync_ceiling": PIPELINED_SYNC_CEILING,
+    }
+    if len(done) != len(prompts):
+        raise PerfBudgetError(
+            f"pipelined pump drained {len(done)}/{len(prompts)} requests"
+        )
+    if elapsed > PIPELINED_DECODE_BUDGET_S:
+        raise PerfBudgetError(
+            f"pipelined decode took {elapsed:.2f}s > "
+            f"{PIPELINED_DECODE_BUDGET_S}s budget: the burst loop is "
+            f"retracing or syncing per token"
+        )
+    if eng.host_syncs > PIPELINED_SYNC_CEILING:
+        raise PerfBudgetError(
+            f"pipelined decode paid {eng.host_syncs} host syncs > ceiling "
+            f"{PIPELINED_SYNC_CEILING}: per-token readback crept back into "
+            f"the burst loop"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
+        stats["pipelined_decode"] = check_pipelined_decode()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
