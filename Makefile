@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos demo dryrun lint perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve demo dryrun lint perf-smoke helm-template clean
 
 all: native
 
@@ -33,6 +33,12 @@ bench:
 # with zero lost claims.
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q
+
+# Serving chaos suite (<10s, CPU, seeded): deadlines, load shedding,
+# poisoned-request quarantine with bit-equal survivor replay, and
+# drain/snapshot/restore — the SLO layer under injected engine faults.
+chaos-serve:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serve_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

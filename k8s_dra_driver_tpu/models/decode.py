@@ -266,6 +266,25 @@ def advance_decode_state(next_tok, last, pos, active, stop_pos, eos_id):
     return new_last, new_pos, active & ~done
 
 
+def poison_rows(logits, poison):
+    """Fault-injection hook for the serving engines: rows flagged in
+    ``poison`` [B] bool get all-NaN logits — the deterministic stand-in for
+    a numerically poisoned request (utils/faults.py ``nan_logits``).
+    ``poison=None`` is the no-injector fast path (identical trace to
+    before the hook existed)."""
+    if poison is None:
+        return logits
+    return jnp.where(poison[:, None], jnp.nan, logits)
+
+
+def finite_rows(logits):
+    """[B] bool: every logit in the row is finite.  The on-device half of
+    the poisoned-request quarantine detector — rows are independent in
+    every engine program, so a non-finite row indicts exactly one request
+    and the survivors' tokens in the same burst stay bit-equal."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)
+
+
 def greedy_decode(
     params, prompt: jax.Array, steps: int, cfg: ModelConfig,
     cache_dtype=jnp.float32, batch_prefill: bool = False,

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import NamedTuple
 
@@ -50,6 +51,7 @@ from k8s_dra_driver_tpu.models.burnin import (
 )
 from k8s_dra_driver_tpu.models.quant import matmul_last as _mm
 from k8s_dra_driver_tpu.ops import paged_attention
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 
 NULL_BLOCK = 0  # reserved: inactive rows scatter here; never allocated
@@ -441,23 +443,33 @@ def _paged_spec_round(
 
 def _paged_step_all(
     params, cache, table, tokens, pos, active, temps, keys, adapters=None,
+    poison=None,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
 ):
     """One paged decode step for every slot at its own position + the
     shared sampling tail (serve.sample_next — ONE sampling implementation
-    across backends, so the engines' bit-equality contract cannot drift)."""
+    across backends, so the engines' bit-equality contract cannot drift).
+
+    ``poison``/``bad`` mirror serve._step_all_slots: the optional
+    fault-injection NaN mask in, the non-finite-row quarantine verdict out
+    (decode.poison_rows / decode.finite_rows).  Rows stay independent —
+    each row gathers only through its OWN table row, and the attention
+    mask is select-based (jnp.where), so a NaN row never contaminates a
+    survivor.  Returns (next_token [B], bad [B], cache)."""
     from k8s_dra_driver_tpu.models import serve
 
     logits, cache = paged_decode_step(
         params, cache, table, tokens, pos, cfg=cfg, active=active,
         attn_impl=attn_impl, interpret=interpret, adapters=adapters,
     )
-    return serve.sample_next(logits, pos, temps, keys, top_k=top_k), cache
+    logits = decode.poison_rows(logits, poison)
+    bad = ~decode.finite_rows(logits)
+    return serve.sample_next(logits, pos, temps, keys, top_k=top_k), bad, cache
 
 
 def _paged_pipelined_burst(
     params, cache, table, tokens, pos, active, temps, keys, stop_pos,
-    adapters=None,
+    adapters=None, poison=None,
     *, cfg: ModelConfig, top_k: int, attn_impl: str, interpret: bool,
     eos_id: int, k: int,
 ):
@@ -468,23 +480,26 @@ def _paged_pipelined_burst(
     readback per K tokens.  Rows the host left inactive (stalled or free)
     stay frozen; rows that retire on device go inactive for the rest of
     the burst and their writes divert to the null block.  Returns
-    (trace_tok [K,B], trace_active [K,B], cache, last, pos, active)."""
+    (trace_tok [K,B], trace_active [K,B], trace_bad [K,B], cache, last,
+    pos, active); ``trace_bad``/``poison`` are the quarantine detector and
+    the injected-NaN mask, as in serve._pipelined_burst."""
 
     def body(carry, _):
         cache, last, pos, active = carry
-        next_tok, cache = _paged_step_all(
+        next_tok, bad, cache = _paged_step_all(
             params, cache, table, last, pos, active, temps, keys, adapters,
+            poison,
             cfg=cfg, top_k=top_k, attn_impl=attn_impl, interpret=interpret,
         )
         new_last, new_pos, new_active = decode.advance_decode_state(
             next_tok, last, pos, active, stop_pos, eos_id
         )
-        return (cache, new_last, new_pos, new_active), (next_tok, active)
+        return (cache, new_last, new_pos, new_active), (next_tok, active, bad)
 
-    (cache, last, pos, active), (trace_tok, trace_act) = jax.lax.scan(
+    (cache, last, pos, active), (trace_tok, trace_act, trace_bad) = jax.lax.scan(
         body, (cache, tokens, pos, active), None, length=k
     )
-    return trace_tok, trace_act, cache, last, pos, active
+    return trace_tok, trace_act, trace_bad, cache, last, pos, active
 
 
 def _paged_first_token(
@@ -498,7 +513,7 @@ def _paged_first_token(
     n_slots = table.shape[0]
     last_tok = prompt[0, plen - 1]
     pos = jnp.full((n_slots,), plen - 1, jnp.int32)
-    tok, cache = _paged_step_all(
+    tok, _, cache = _paged_step_all(
         params, cache, table,
         jnp.full((n_slots,), last_tok, jnp.int32),
         pos,
@@ -524,7 +539,7 @@ def _paged_first_token_local(
     local = table.shape[0]
     last_tok = prompt[0, plen - 1]
     pos = jnp.full((local,), plen - 1, jnp.int32)
-    tok, cache = _paged_step_all(
+    tok, _, cache = _paged_step_all(
         params, cache, table,
         jnp.full((local,), last_tok, jnp.int32),
         pos, onehot,
@@ -699,6 +714,14 @@ class PagedServeEngine:
     # multislice paged serving for free (tested).
     mesh: object | None = None
     slot_axis: str | tuple = "data"
+    # Data-plane fault injection (utils/faults.py engine hooks) — armed
+    # programmatically or from DRA_FAULTS, consulted pre-dispatch each
+    # step; None = no fault window.  The spec round does NOT consult the
+    # injector (greedy verify has its own acceptance contract).
+    fault_injector: object | None = None
+    # Distinct quarantined requests before the engine declares itself
+    # poisoned and wedges (serve._wedge_error).
+    quarantine_limit: int = 3
 
     def __post_init__(self):
         cfg = self.cfg
@@ -710,6 +733,24 @@ class PagedServeEngine:
             self.attn_impl = default_attn_impl()
         if self.sync_interval < 1:
             raise ValueError(f"sync_interval must be >= 1, got {self.sync_interval}")
+        if self.quarantine_limit < 1:
+            raise ValueError(
+                f"quarantine_limit must be >= 1, got {self.quarantine_limit}"
+            )
+        if self.fault_injector is None:
+            from k8s_dra_driver_tpu.utils import faults
+
+            raw = os.environ.get(faults.ENV_VAR, "")
+            if raw:
+                self.fault_injector = faults.FaultInjector.from_env(raw)
+        # robustness state shared with the dense engine's helpers
+        # (serve._early_retire / _quarantine_slot / _pump / _shed)
+        self.quarantined: list[int] = []
+        self.shed_count = 0
+        self.last_shed = None
+        self.pump_stats: dict = {}
+        self._step_no = 0
+        self._last_step_s = 0.0
         if (
             self.attn_impl == "kernel"
             and not self.interpret
@@ -837,11 +878,13 @@ class PagedServeEngine:
         self._pipe_kw = dict(**kw, eos_id=-1 if self.eos_id is None else self.eos_id)
         self._pipe_fns: dict = {}  # static burst length -> compiled scan
         if self.mesh is None:
-            self._step_fn = jax.jit(
-                functools.partial(_paged_step_all, **kw), donate_argnums=(1,)
+            from k8s_dra_driver_tpu.models import serve
+
+            self._step_fn = serve.shared_jit(
+                _paged_step_all, donate_argnums=(1,), **kw
             )
-            self._first_fn = jax.jit(functools.partial(_paged_first_token, **kw))
-            self._prefill_fn = jax.jit(functools.partial(paged_prefill, cfg=cfg))
+            self._first_fn = serve.shared_jit(_paged_first_token, **kw)
+            self._prefill_fn = serve.shared_jit(paged_prefill, cfg=cfg)
         else:
             from jax.sharding import PartitionSpec as P
 
@@ -860,8 +903,8 @@ class PagedServeEngine:
                     functools.partial(_paged_step_all, **kw),
                     mesh=self.mesh,
                     in_specs=(P(), cache_p, row_p, row_p, row_p, row_p,
-                              row_p, row_p, ad_p),
-                    out_specs=(row_p, cache_p),
+                              row_p, row_p, ad_p, row_p),
+                    out_specs=(row_p, row_p, cache_p),
                 ),
                 donate_argnums=(1,),
             )
@@ -912,15 +955,14 @@ class PagedServeEngine:
                 self.cache_dtype,
             )
             if self.mesh is None:
-                self._spec_fn = jax.jit(
-                    functools.partial(
-                        _paged_spec_round, cfg=cfg, gamma=self.spec_gamma,
-                        attn_impl=self.attn_impl, interpret=self.interpret,
-                    ),
-                    donate_argnums=(2, 3),  # pool + draft cache, like _step_fn
+                # pool + draft cache donate, like _step_fn
+                self._spec_fn = serve.shared_jit(
+                    _paged_spec_round, donate_argnums=(2, 3), cfg=cfg,
+                    gamma=self.spec_gamma, attn_impl=self.attn_impl,
+                    interpret=self.interpret,
                 )
-                self._draft_prefill_fn = jax.jit(
-                    functools.partial(serve._prefill_draft_row, cfg=cfg)
+                self._draft_prefill_fn = serve.shared_jit(
+                    serve._prefill_draft_row, cfg=cfg
                 )
             else:
                 from jax.sharding import NamedSharding
@@ -977,18 +1019,24 @@ class PagedServeEngine:
         seed: int | None = None,
         adapter: int = 0,
         priority: int = 0,
+        deadline: int | None = None,
     ) -> int:
         """Admit when a slot AND the prompt's blocks are available; raises
         RuntimeError otherwise (admission control is the caller's).
         ``adapter``: bank index for per-request LoRA (0 = the base).
         ``priority``: scarcity ranking (see the class docstring) — it
-        orders stalls, evictions and re-admissions, never token content."""
+        orders stalls, evictions and re-admissions, never token content.
+        ``deadline``: step budget — the request retires with status
+        ``deadline_exceeded`` after this many generated tokens if eos has
+        not landed first (the same stop-mask path as max_tokens, so a
+        deadline costs no extra sync; blocks refund at retirement)."""
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
         serve.check_submit(
             prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq,
             spec_gamma=self.spec_gamma, temperature=temperature,
+            deadline=deadline,
         )
         if adapter and self.adapter_bank is None:
             raise ValueError("adapter requested but the engine has no adapter_bank")
@@ -1060,7 +1108,7 @@ class PagedServeEngine:
             # count as already-done chunks).
             self._next_id += 1
             self._slots[slot] = _Slot(
-                request_id, list(prompt), len(prompt), max_tokens
+                request_id, list(prompt), len(prompt), max_tokens, deadline
             )
             self._admitting.append(
                 dict(
@@ -1101,15 +1149,17 @@ class PagedServeEngine:
             self._upload_table()
             raise
         self._next_id += 1
-        self._slots[slot] = _Slot(
-            request_id, list(prompt) + [int(first_tok)], len(prompt), max_tokens
+        st = _Slot(
+            request_id, list(prompt) + [int(first_tok)], len(prompt),
+            max_tokens, deadline,
         )
+        self._slots[slot] = st
         self._last = self._last.at[slot].set(first_tok)
         self._pos = self._pos.at[slot].set(len(prompt))
         self._temps = self._temps.at[slot].set(temperature)
         self._keys = self._keys.at[slot].set(base_key)
         self._stop_pos = self._stop_pos.at[slot].set(
-            len(prompt) + max_tokens - 1
+            len(prompt) + serve._slot_budget(st) - 1
         )
         serve._M_REQUESTS.inc()
         serve._M_TOKENS.inc()  # the admission step's first generated token
@@ -1187,7 +1237,7 @@ class PagedServeEngine:
         self._keys = self._keys.at[slot].set(adm["key"])
         st = self._slots[slot]
         self._stop_pos = self._stop_pos.at[slot].set(
-            st.prompt_len + st.max_tokens - 1
+            st.prompt_len + serve._slot_budget(st) - 1
         )
         serve._M_TOKENS.inc()
         self._retire(slot)
@@ -1209,6 +1259,8 @@ class PagedServeEngine:
         Reading ``self._pos`` back from the device here would serialize
         the loop against the device ONCE PER STEP — the exact per-token
         sync the pipelined decode loop exists to remove."""
+        from k8s_dra_driver_tpu.models import serve
+
         admitting = {a["slot"] for a in self._admitting}
         active = np.zeros((self.n_slots,), bool)
         table_dirty = False
@@ -1229,7 +1281,9 @@ class PagedServeEngine:
             # Clamp to the slot's own remaining stream: a fixed-shape burst
             # asks for lookahead K-1 even when the slot retires sooner, and
             # blocks it will never write must not stall a tight pool.
-            remaining = st.prompt_len + st.max_tokens - len(st.tokens)
+            # _slot_budget folds the deadline in — a deadline-bound slot
+            # never grows blocks past the step it retires at.
+            remaining = st.prompt_len + serve._slot_budget(st) - len(st.tokens)
             ahead = min(lookahead, max(remaining - 1, 0))
             needed = (len(st.tokens) - 1 + ahead) // self.block_size + 1
             grew = True
@@ -1365,9 +1419,10 @@ class PagedServeEngine:
             self._temps = self._temps.at[slot].set(r["temp"])
             self._keys = self._keys.at[slot].set(r["key"])
             # stop depth is a function of the ORIGINAL prompt_len and
-            # max_tokens — it survives preemption unchanged
+            # step budget (max_tokens clamped by any deadline) — it
+            # survives preemption unchanged
             self._stop_pos = self._stop_pos.at[slot].set(
-                st.prompt_len + st.max_tokens - 1
+                st.prompt_len + serve._slot_budget(st) - 1
             )
             self._update_gauges()
 
@@ -1440,7 +1495,7 @@ class PagedServeEngine:
                 committed += 1
                 n_gen = len(st.tokens) - st.prompt_len
                 hit_eos = self.eos_id is not None and st.tokens[-1] == self.eos_id
-                if n_gen >= st.max_tokens or hit_eos:
+                if n_gen >= serve._slot_budget(st) or hit_eos:
                     break
             self._retire(slot)
         serve._M_TOKENS.inc(committed)
@@ -1452,36 +1507,56 @@ class PagedServeEngine:
         admission-queue head by one prefill chunk, and re-admit preempted
         requests the pool can now hold); returns the number of slots
         stepped."""
+        from k8s_dra_driver_tpu.models import serve
+
         t0 = time.perf_counter()
         self._readmit()
         self._advance_admission()
         if self.spec_gamma > 0:
             return self._spec_step()
+        self._step_no += 1
+        poison, quarantined = serve._inject_step_faults(self)
         active, table_dirty = self._grow_or_preempt(lookahead=0)
         if not active.any():
-            return 0
+            if table_dirty:
+                self._upload_table()
+            # quarantining IS progress — the wedge detector must not
+            # mistake a fully quarantined step for a stall
+            return quarantined
         if table_dirty:
             self._upload_table()
         active_j = self._slot_device(active)
-        next_tok, self._cache = self._step_fn(
+        next_tok, bad, self._cache = self._step_fn(
             self.params, self._cache, self._table, self._last, self._pos,
             active_j, self._temps, self._keys, self._adapters(),
+            self._slot_device(poison),
         )
         self._last = jnp.where(active_j, next_tok, self._last)
         self._pos = jnp.where(active_j, self._pos + 1, self._pos)
         toks = self._readback(next_tok).tolist()
-        from k8s_dra_driver_tpu.models import serve
-
+        bads = self._readback(bad)
         self.host_syncs += 1
         serve._M_HOST_SYNCS.inc()
-        serve._M_TOKENS.inc(int(active.sum()))
+        committed = 0
         for slot, st in enumerate(self._slots):
             if st is None or not active[slot]:
                 continue
+            if bads[slot]:
+                # rows are independent: dropping the poisoned commit IS
+                # the replay — the survivors' tokens are already bit-equal
+                # to a step that never contained this row
+                serve._quarantine_slot(
+                    self, slot, "nan_logits",
+                    "non-finite logits in decode step",
+                )
+                continue
             st.tokens.append(toks[slot])
+            committed += 1
             self._retire(slot)
+        serve._M_TOKENS.inc(committed)
         self._update_gauges()
-        serve._M_STEP_LATENCY.observe(time.perf_counter() - t0)
+        self._last_step_s = time.perf_counter() - t0
+        serve._M_STEP_LATENCY.observe(self._last_step_s)
         return int(active.sum())
 
     def step_burst(self) -> int:
@@ -1504,15 +1579,20 @@ class PagedServeEngine:
         held at most K - 1 extra steps."""
         if self.sync_interval <= 1 or self.spec_gamma > 0:
             return self.step()
+        from k8s_dra_driver_tpu.models import serve
+        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
         t0 = time.perf_counter()
         self._readmit()
         self._advance_admission()
+        self._step_no += 1
+        poison, quarantined = serve._inject_step_faults(self)
         admitting = {a["slot"] for a in self._admitting}
         if not any(
             st is not None and slot not in admitting
             for slot, st in enumerate(self._slots)
         ):
-            return 0
+            return quarantined
         k = self.sync_interval
         active, table_dirty = self._grow_or_preempt(lookahead=k - 1)
         if not active.any() and k > 1:
@@ -1524,38 +1604,51 @@ class PagedServeEngine:
         if not active.any():
             if table_dirty:
                 self._upload_table()
-            return 0
+            return quarantined
         if table_dirty:
             self._upload_table()
         active_j = self._slot_device(active)
-        from k8s_dra_driver_tpu.models import serve
-        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
 
         with WATCHDOG.guard("serve.paged_step_burst"):
             (
-                trace_t, trace_a, self._cache,
+                trace_t, trace_a, trace_b, self._cache,
                 self._last, self._pos, active_j,
             ) = self._burst_fn(k)(
                 self.params, self._cache, self._table, self._last,
                 self._pos, active_j, self._temps, self._keys,
-                self._stop_pos, self._adapters(),
+                self._stop_pos, self._adapters(), self._slot_device(poison),
             )
             trace_t = self._readback(trace_t)
             trace_a = self._readback(trace_a)
+            trace_b = self._readback(trace_b)
         self.host_syncs += 1
         serve._M_HOST_SYNCS.inc()
         stepped = int(active.sum())
+        # first poisoned step per slot: tokens before it are sound, the
+        # slot quarantines at it, and the trace replay below simply never
+        # reads the poisoned row — survivors stay bit-equal by row
+        # independence (serve._first_bad_steps)
+        first_bad = serve._first_bad_steps(trace_a, trace_b)
         committed = 0
         for j in range(trace_t.shape[0]):
             for slot, st in enumerate(self._slots):
                 if st is None or not trace_a[j][slot]:
                     continue
+                if j >= first_bad.get(slot, k):
+                    continue
                 st.tokens.append(int(trace_t[j][slot]))
                 committed += 1
                 self._retire(slot)
+        for slot in sorted(first_bad):
+            if self._slots[slot] is not None:
+                serve._quarantine_slot(
+                    self, slot, "nan_logits",
+                    f"non-finite logits at burst step {first_bad[slot]}",
+                )
         serve._M_TOKENS.inc(committed)
         self._update_gauges()
-        serve._M_STEP_LATENCY.observe(time.perf_counter() - t0)
+        self._last_step_s = time.perf_counter() - t0
+        serve._M_STEP_LATENCY.observe(self._last_step_s)
         return stepped
 
     def run_until_drained(self, max_steps: int = 10_000) -> None:
@@ -1574,18 +1667,152 @@ class PagedServeEngine:
                 )
         raise serve._wedge_error(self, "serving loop did not drain")
 
-    def pump(self, requests, max_steps: int = 100_000) -> list:
+    def pump(
+        self, requests, max_steps: int = 100_000,
+        queue_limit: int | None = None,
+    ) -> list:
         """Continuous-batching drive over the pool: admit ``requests`` as
         slots AND blocks free, burst-stepping in between; returns the
         completions.  Composes with chunked admission, prefix sharing,
-        speculative rounds, LoRA and preemption (see serve._pump)."""
+        speculative rounds, LoRA and preemption (see serve._pump).
+        ``queue_limit`` bounds the host-side admission queue: overflow is
+        SHED newest-first as a typed Completion (status="shed") carrying
+        a retry-after — no device work is dispatched for a shed request."""
         from k8s_dra_driver_tpu.models import serve
 
-        return serve._pump(self, requests, max_steps)
+        return serve._pump(self, requests, max_steps, queue_limit)
 
     def completions(self) -> list:
         out, self._completions = self._completions, []
         return out
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel an in-flight request: resident slots retire immediately
+        (blocks refund, typed "cancelled" completion with the tokens so
+        far); a mid-admission request also drops its prefill-queue entry;
+        a PREEMPTED (parked) request just unparks — it holds no blocks.
+        Host-side between steps, like the dense engine.  Returns whether
+        the id was found."""
+        from k8s_dra_driver_tpu.models import serve
+
+        for slot, st in enumerate(self._slots):
+            if st is not None and st.request_id == request_id:
+                self._admitting = [
+                    a for a in self._admitting if a["slot"] != slot
+                ]
+                serve._early_retire(self, slot, "cancelled", "cancelled by caller")
+                return True
+        for i, r in enumerate(self._preempted):
+            st = r["st"]
+            if st.request_id == request_id:
+                self._preempted.pop(i)
+                self._completions.append(
+                    serve.Completion(
+                        request_id=st.request_id, tokens=list(st.tokens),
+                        generated=list(st.tokens[st.prompt_len:]),
+                        status="cancelled", error="cancelled by caller",
+                    )
+                )
+                return True
+        return False
+
+    def snapshot_active(self) -> dict:
+        """Graceful drain over the pool: capture every in-flight request —
+        resident slots, slots still mid-chunked-admission (their history
+        is just the prompt), and preempted/parked requests — as the same
+        JSON shape the dense engine emits (serve._snapshot_request), so a
+        snapshot restores into EITHER engine class.  Host-only: one
+        readback of the sampler vectors, zero decode dispatches, zero
+        block traffic."""
+        from k8s_dra_driver_tpu.models import serve
+
+        temps = self._readback(self._temps)
+        keys = self._readback(self._keys)
+        ads = self._readback(self._adapter_ids)
+        admitting = {a["slot"]: a for a in self._admitting}
+        reqs = []
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if slot in admitting:
+                # device sampler vectors are not set until activation —
+                # the queue entry is the source of truth mid-admission
+                adm = admitting[slot]
+                reqs.append(serve._snapshot_request(
+                    st, float(adm["temp"]), adm["key"],
+                    int(adm.get("adapter", 0)), self._prio[slot],
+                ))
+            else:
+                reqs.append(serve._snapshot_request(
+                    st, float(temps[slot]), keys[slot], int(ads[slot]),
+                    self._prio[slot],
+                ))
+        for r in self._preempted:
+            reqs.append(serve._snapshot_request(
+                r["st"], float(r["temp"]), r["key"],
+                int(r.get("adapter", 0)), int(r.get("priority", 0)),
+            ))
+        return {
+            "engine": type(self).__name__,
+            "next_id": self._next_id,
+            "requests": reqs,
+        }
+
+    def restore(self, snapshot: dict) -> list[int]:
+        """Rebuild a drained batch in THIS (fresh, idle) engine with
+        bit-equal continuation.  Every snapshot entry parks on the
+        re-admission queue and drains through :meth:`_readmit` — the SAME
+        re-prefill path preemption resume uses, already proven bit-exact
+        (tokens-so-far re-prefill as the prompt; the next step samples at
+        the original position with the original fold-by-position key).
+        Requests the pool cannot hold yet simply STAY parked and admit as
+        capacity frees — restore into a smaller pool degrades gracefully
+        instead of failing.  Histories grown past ``prompt_bucket`` cannot
+        re-prefill in one pass and are delivered as errored Completions
+        (the preemption resumability boundary).  Returns the request ids
+        accepted for restoration (parked or resident)."""
+        from k8s_dra_driver_tpu.models import serve
+        from k8s_dra_driver_tpu.models.serve import _Slot
+
+        if (self.n_slots - self.free_slots()) or self._admitting or self._preempted:
+            raise RuntimeError("restore() needs an idle engine")
+        restored: list[int] = []
+        for req in sorted(snapshot["requests"], key=lambda r: r["request_id"]):
+            tokens = [int(t) for t in req["tokens"]]
+            if len(tokens) > self.prompt_bucket:
+                serve._unrestorable(
+                    self, req,
+                    f"history {len(tokens)} exceeds prompt_bucket "
+                    f"{self.prompt_bucket}",
+                )
+                continue
+            st = _Slot(
+                int(req["request_id"]), tokens, int(req["prompt_len"]),
+                int(req["max_tokens"]), req.get("deadline"),
+            )
+            self._preempted.append(
+                dict(
+                    st=st, temp=float(req["temperature"]),
+                    key=np.asarray(req["key"], dtype=np.uint32),
+                    adapter=int(req.get("adapter", 0)),
+                    priority=int(req.get("priority", 0)),
+                )
+            )
+            restored.append(st.request_id)
+            JOURNAL.record(
+                "serve", "request.restore",
+                correlation=f"req-{st.request_id}", resumed_at=len(tokens),
+            )
+        self._preempted.sort(key=lambda r: -r.get("priority", 0))
+        self._next_id = max(
+            self._next_id,
+            int(snapshot.get("next_id", 0)),
+            max((int(r["request_id"]) for r in snapshot["requests"]),
+                default=-1) + 1,
+        )
+        self._readmit()  # admit what fits now; the rest drains via step()
+        self._update_gauges()
+        return restored
 
     # -- internals ---------------------------------------------------------
     def _burst_fn(self, k: int):
@@ -1596,9 +1823,11 @@ class PagedServeEngine:
         if fn is not None:
             return fn
         if self.mesh is None:
-            fn = jax.jit(
-                functools.partial(_paged_pipelined_burst, **self._pipe_kw, k=k),
-                donate_argnums=(1,),
+            from k8s_dra_driver_tpu.models import serve
+
+            fn = serve.shared_jit(
+                _paged_pipelined_burst, donate_argnums=(1,),
+                **self._pipe_kw, k=k,
             )
         else:
             from jax.sharding import PartitionSpec as P
@@ -1615,9 +1844,9 @@ class PagedServeEngine:
                     ),
                     mesh=self.mesh,
                     in_specs=(P(), cache_p, row_p, row_p, row_p, row_p,
-                              row_p, row_p, row_p, ad_p),
-                    out_specs=(trace_p, trace_p, cache_p, row_p, row_p,
-                               row_p),
+                              row_p, row_p, row_p, ad_p, row_p),
+                    out_specs=(trace_p, trace_p, trace_p, cache_p, row_p,
+                               row_p, row_p),
                 ),
                 donate_argnums=(1,),
             )

@@ -43,12 +43,30 @@ _INJECTED = REGISTRY.counter(
 ENV_VAR = "DRA_FAULTS"
 
 
+class StepFault(RuntimeError):
+    """Injected engine-step exception attributable to ONE slot — the
+    fault shape the serving quarantine path must heal (retire the slot,
+    replay the burst without it).  Raised by
+    :meth:`FaultInjector.maybe_raise_step` BEFORE the step dispatches, so
+    no engine state has mutated when it fires."""
+
+    def __init__(self, slot: int, message: str):
+        super().__init__(message)
+        self.slot = slot
+
+
 @dataclass
 class FaultProfile:
     """One armed fault source.  Rates are probabilities per matching
     operation; ``watch_*`` counts are storm budgets consumed one per
     injection; ``limit`` caps total injections from this profile
-    (0 = unlimited).  Empty ``verbs``/``kinds`` match everything."""
+    (0 = unlimited).  Empty ``verbs``/``kinds`` match everything.
+
+    The ``nan_logits_rate`` / ``step_raise_rate`` / ``step_latency_s``
+    fields are ENGINE-scoped (data plane): consulted by the serving
+    engines once per (slot, step) ahead of every decode dispatch — before
+    any device state mutates, so a quarantine replay stays safe.  They
+    scope by ``slots``/``steps`` instead of verbs/kinds."""
 
     name: str = "fault"
     error_rate: float = 0.0  # probability of an injected APIError
@@ -62,6 +80,12 @@ class FaultProfile:
     watch_hang_s: float = 0.0  # ...for this long before resuming
     verbs: tuple = ()  # e.g. ("PUT",); empty = all verbs
     kinds: tuple = ()  # e.g. ("ResourceSlice",); empty = all kinds
+    # engine-scoped (serving data plane) kinds:
+    nan_logits_rate: float = 0.0  # probability a slot's logits go NaN
+    step_raise_rate: float = 0.0  # probability of a StepFault pre-dispatch
+    step_latency_s: float = 0.0  # added to every matching engine step
+    slots: tuple = ()  # e.g. (1, 3); empty = all slots
+    steps: tuple = ()  # e.g. (5,); empty = all engine steps
     limit: int = 0  # total-injection cap, 0 = unlimited
     injected: int = field(default=0, compare=False)
 
@@ -136,6 +160,49 @@ class FaultInjector:
                     return p.watch_hang_s
         return 0.0
 
+    # -- engine decision points (serving data plane) -----------------------
+
+    def take_step_latency(self) -> float:
+        """Engine hook: added decode-step latency.  Sleeps HERE (the same
+        shape as :meth:`before`'s latency arm) and returns the seconds
+        slept, so engine code never carries its own sleep."""
+        total = 0.0
+        for p in self._matching_engine(None, None):
+            if p.step_latency_s > 0:
+                with self._lock:
+                    if not self._budget_ok(p):
+                        continue
+                    self._record(p, "step_latency", "STEP", "engine")
+                time.sleep(p.step_latency_s)
+                total += p.step_latency_s
+        return total
+
+    def take_nan_logits(self, slot: int, step: int) -> bool:
+        """Engine hook: should this (slot, step)'s logits be poisoned to
+        NaN?  Consulted pre-dispatch; the engine threads the verdict into
+        the jitted step as a poison mask (decode.poison_rows)."""
+        for p in self._matching_engine(slot, step):
+            if p.nan_logits_rate and self._roll(
+                p, p.nan_logits_rate, "nan_logits", f"slot-{slot}", f"step-{step}"
+            ):
+                return True
+        return False
+
+    def maybe_raise_step(self, slot: int, step: int) -> None:
+        """Engine hook: raise a :class:`StepFault` attributable to ``slot``
+        for this step.  Called BEFORE the step dispatches — no state has
+        mutated when it fires, so the engine can quarantine the slot and
+        re-dispatch without it."""
+        for p in self._matching_engine(slot, step):
+            if p.step_raise_rate and self._roll(
+                p, p.step_raise_rate, "step_raise", f"slot-{slot}", f"step-{step}"
+            ):
+                raise StepFault(
+                    slot,
+                    f"fault injected by profile {p.name!r} "
+                    f"(slot {slot}, step {step})",
+                )
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict[str, int]:
@@ -155,6 +222,18 @@ class FaultInjector:
                 for p in self._profiles
                 if (not p.verbs or verb in p.verbs)
                 and (not p.kinds or kind in p.kinds)
+            ]
+
+    def _matching_engine(self, slot: int | None, step: int | None) -> list[FaultProfile]:
+        """Profiles matching an engine (slot, step) decision point — the
+        data-plane twin of :meth:`_matching` (None matches everything,
+        used by the slot-agnostic latency hook)."""
+        with self._lock:
+            return [
+                p
+                for p in self._profiles
+                if (slot is None or not p.slots or slot in p.slots)
+                and (step is None or not p.steps or step in p.steps)
             ]
 
     def _take_counted(self, kind: str, attr: str) -> bool:
@@ -207,8 +286,11 @@ class FaultInjector:
                 seed = int(value)
             elif key == "latency_ms":
                 fields["latency_s"] = float(value) / 1000.0
+            elif key == "step_latency_ms":
+                fields["step_latency_s"] = float(value) / 1000.0
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
-                         "watch_hang_s"):
+                         "watch_hang_s", "nan_logits_rate", "step_raise_rate",
+                         "step_latency_s"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
                          "watch_hangs", "limit"):
@@ -217,6 +299,8 @@ class FaultInjector:
                 fields["verbs"] = tuple(value.split("+"))
             elif key == "kinds":
                 fields["kinds"] = tuple(value.split("+"))
+            elif key in ("slots", "steps"):
+                fields[key] = tuple(int(v) for v in value.split("+"))
             else:
                 raise ValueError(f"{ENV_VAR}: unknown fault key {key!r}")
         injector = FaultInjector(seed=seed)

@@ -32,3 +32,12 @@ def test_pipelined_decode_stays_within_perf_budgets():
     # sync_interval-token bursts instead of one readback per token.
     assert stats["host_syncs"] <= stats["host_sync_ceiling"]
     assert stats["host_syncs"] < stats["generated_tokens"] / 4
+
+
+def test_shed_fastpath_stays_within_perf_budgets():
+    stats = perf_smoke.check_shed_fastpath()
+    assert stats["served"] == 3 and stats["sheds"] == 5
+    # Shedding's contract: typed rejection without ANY device dispatch —
+    # the overloaded pump pays exactly the twin's host syncs.
+    assert stats["host_syncs"] == stats["twin_host_syncs"]
+    assert stats["elapsed_s"] <= stats["budget_s"]

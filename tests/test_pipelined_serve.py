@@ -228,6 +228,21 @@ class TestPump:
         ).value()
         assert eng.host_syncs < generated
 
+    def test_pump_heartbeats_and_queue_stats(self, params):
+        # Every pump iteration must heartbeat its watchdog guard and keep
+        # the queue-depth/shed tallies an operator reads after the fact.
+        eng = _dense(params, sync_interval=4)
+        done = eng.pump(
+            [(p, 6) for p in _prompts(6, rng=53)], queue_limit=2
+        )
+        stats = eng.pump_stats
+        assert set(stats) >= {"queue_depth", "sheds", "heartbeats"}
+        assert stats["heartbeats"] >= 1
+        assert stats["queue_depth"] == 0  # drained
+        assert stats["sheds"] == sum(1 for c in done if c.status == "shed")
+        assert stats["sheds"] == eng.shed_count == 1
+        assert REGISTRY.gauge("tpu_serve_queue_depth").value() == 0
+
 
 class TestWedgeDiagBundle:
     """run_until_drained exhaustion must leave a diag bundle carrying the
@@ -239,13 +254,21 @@ class TestWedgeDiagBundle:
 
         monkeypatch.setattr(WATCHDOG, "_bundle_dir", str(tmp_path))
 
+    def _bundles(self, tmp_path):
+        # the wedge path writes the drain snapshot NEXT TO the bundle;
+        # keep only actual diag bundles
+        return sorted(
+            p for p in tmp_path.glob("*.json")
+            if "drain-snapshot" not in p.name
+        )
+
     def test_dense_exhaustion_emits_bundle(self, params, tmp_path, monkeypatch):
         self._point_bundles_at(monkeypatch, tmp_path)
         eng = _dense(params, sync_interval=4)
         rid = eng.submit(_prompts(1)[0], max_tokens=60)
         with pytest.raises(RuntimeError, match="diag bundle") as exc:
             eng.run_until_drained(max_steps=2)
-        bundles = sorted(tmp_path.glob("*.json"))
+        bundles = self._bundles(tmp_path)
         assert bundles, "no diag bundle written"
         state = json.loads(bundles[-1].read_text())["state"]
         assert state["engine"] == "ServeEngine"
@@ -259,9 +282,41 @@ class TestWedgeDiagBundle:
         eng.submit(_prompts(1)[0], max_tokens=60)
         with pytest.raises(RuntimeError, match="diag bundle"):
             eng.run_until_drained(max_steps=2)
-        state = json.loads(sorted(tmp_path.glob("*.json"))[-1].read_text())["state"]
+        state = json.loads(self._bundles(tmp_path)[-1].read_text())["state"]
         assert state["engine"] == "PagedServeEngine"
         assert state["slots"] and state["free_blocks"] is not None
+
+    def test_wedge_embeds_admission_queue_and_snapshot(
+        self, params, tmp_path, monkeypatch
+    ):
+        # Wedge while a chunked prefill is mid-flight: the bundle must
+        # carry the admission-queue table AND a restorable drain snapshot.
+        self._point_bundles_at(monkeypatch, tmp_path)
+        eng = _paged(
+            params, block_size=4, n_blocks=24, prefill_chunk_blocks=1
+        )
+        eng.submit(_prompts(1, lo=11, hi=12)[0], max_tokens=20)
+        assert eng._admitting
+        with pytest.raises(RuntimeError, match="drain snapshot") as exc:
+            eng.run_until_drained(max_steps=1)
+        state = json.loads(self._bundles(tmp_path)[-1].read_text())["state"]
+        assert state["admission_queue"], "mid-admission row missing"
+        row = state["admission_queue"][0]
+        assert set(row) == {"slot", "prompt_len", "done_tokens"}
+        assert 0 < row["done_tokens"] < row["prompt_len"]
+        snap_path = state["drain_snapshot_path"]
+        assert snap_path and snap_path in str(exc.value)
+        snap = json.loads((tmp_path / snap_path.split("/")[-1]).read_text())
+        assert state["drain_snapshot_requests"] == len(snap["requests"]) == 1
+
+    def test_pump_wedge_embeds_queue_depth(self, params, tmp_path, monkeypatch):
+        self._point_bundles_at(monkeypatch, tmp_path)
+        eng = _dense(params)
+        with pytest.raises(RuntimeError, match="did not drain"):
+            eng.pump([(p, 30) for p in _prompts(5, rng=59)], max_steps=1)
+        state = json.loads(self._bundles(tmp_path)[-1].read_text())["state"]
+        assert state["pump_queue_depth"] >= 1  # overload forensics
+        assert state["shed_count"] == 0 and state["quarantined"] == []
 
 
 class TestServeMetrics:

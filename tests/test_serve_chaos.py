@@ -1,0 +1,403 @@
+"""Serving chaos suite: the data plane under injected engine faults.
+
+The serving twin of tests/test_chaos.py — utils/faults.py's ENGINE-scoped
+kinds (nan_logits, step_raise, step_latency, scoped per slot/step) make the
+decode loop misbehave, and these tests pin the four SLO-grade robustness
+properties on both engines:
+
+* deadline-exceeded retirement: a step-budgeted request retires through the
+  on-device stop-mask path with a typed status and its paged blocks refund;
+* load shedding: a bounded pump queue rejects newest with a typed ShedError
+  carrying retry-after, and a shed costs ZERO device dispatches;
+* poisoned-request quarantine: one slot's non-finite logits or attributable
+  step exception quarantines THAT slot only — the survivors' streams stay
+  bit-equal to a fault-free run — and the engine fails only after
+  quarantine_limit distinct requests;
+* drain & restore: snapshot_active() + restore() continue every in-flight
+  stream bit-equally, including from the wedge path's drain snapshot.
+
+Every fault draws from a seeded injector: a failure replays from its seed.
+Runs in `make chaos-serve` (<10s, CPU).
+"""
+
+import json
+import time
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, paged
+from k8s_dra_driver_tpu.models.serve import ServeEngine, ShedError
+from k8s_dra_driver_tpu.utils.faults import (
+    ENV_VAR,
+    FaultInjector,
+    FaultProfile,
+    StepFault,
+)
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+# Tiny model on purpose: every property here is scheduling/robustness, not
+# numerics — the whole suite must hold under the <10s chaos budget.
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 33)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _inj(spec: str) -> FaultInjector:
+    """Armed injector from a DRA_FAULTS spec string (seeded: rate-1.0
+    profiles are deterministic regardless, scoped ones replay exactly)."""
+    return FaultInjector.from_env(spec)
+
+
+REQS = [
+    {"prompt": [7, 8, 9], "max_tokens": 6, "seed": 5},
+    {"prompt": [3, 4], "max_tokens": 6, "temperature": 0.7, "seed": 9},
+    {"prompt": [11, 12, 13, 14], "max_tokens": 6, "seed": 21},
+]
+
+
+class TestEngineFaultHooks:
+    """Unit coverage of the faults.py engine-scoped kinds (the satellite's
+    test_retry.py-style layer): parsing, scoping, pre-dispatch contract."""
+
+    def test_from_env_parses_engine_kinds(self):
+        inj = _inj(
+            "nan_logits_rate=1.0,step_raise_rate=0.5,step_latency_ms=3,"
+            "slots=1+2,steps=4,seed=7"
+        )
+        (p,) = inj._profiles
+        assert p.nan_logits_rate == 1.0
+        assert p.step_raise_rate == 0.5
+        assert p.step_latency_s == pytest.approx(0.003)
+        assert p.slots == (1, 2)
+        assert p.steps == (4,)
+
+    def test_from_env_unknown_key_raises(self):
+        with pytest.raises(ValueError, match="unknown fault key"):
+            FaultInjector.from_env("nan_logit_rate=1.0")
+
+    def test_slot_and_step_scoping(self):
+        inj = _inj("nan_logits_rate=1.0,slots=1,steps=2")
+        assert not inj.take_nan_logits(0, 2)
+        assert not inj.take_nan_logits(1, 3)
+        assert inj.take_nan_logits(1, 2)
+
+    def test_step_fault_attributes_slot_pre_dispatch(self):
+        inj = _inj("step_raise_rate=1.0,slots=2")
+        inj.maybe_raise_step(0, 1)  # out of scope: silent
+        with pytest.raises(StepFault) as exc:
+            inj.maybe_raise_step(2, 1)
+        assert exc.value.slot == 2
+
+    def test_latency_hook_sleeps_in_injector_not_engine(self):
+        inj = FaultInjector(seed=0)
+        inj.arm(FaultProfile(name="lag", step_latency_s=0.005))
+        t0 = time.perf_counter()
+        slept = inj.take_step_latency()
+        assert slept == pytest.approx(0.005)
+        assert time.perf_counter() - t0 >= 0.004
+        assert inj.stats().get("step_latency") == 1
+
+    def test_injection_budget_caps_engine_kinds(self):
+        inj = FaultInjector(seed=0)
+        inj.arm(FaultProfile(name="once", nan_logits_rate=1.0, limit=1))
+        assert inj.take_nan_logits(0, 1)
+        assert not inj.take_nan_logits(0, 2)
+
+    def test_env_var_arms_both_engines(self, params, monkeypatch):
+        monkeypatch.setenv(ENV_VAR, "nan_logits_rate=1.0,steps=999")
+        for eng in (_dense(params), _paged(params)):
+            assert eng.fault_injector is not None
+            (p,) = eng.fault_injector._profiles
+            assert p.nan_logits_rate == 1.0
+
+
+class TestDeadlines:
+    def test_dense_deadline_typed_status(self, params):
+        out = {
+            c.request_id: c
+            for c in _dense(params).pump(
+                [
+                    {"prompt": [1, 2, 3], "max_tokens": 8},
+                    {"prompt": [4, 5], "max_tokens": 8, "deadline": 2},
+                ]
+            )
+        }
+        assert out[0].status == "ok" and len(out[0].generated) == 8
+        assert out[1].status == "deadline_exceeded"
+        assert len(out[1].generated) == 2
+        assert REGISTRY.counter("tpu_serve_deadline_exceeded_total").value() == 1
+
+    def test_paged_deadline_refunds_blocks(self, params):
+        eng = _paged(params)
+        before = eng.free_blocks
+        out = {
+            c.request_id: c
+            for c in eng.pump(
+                [
+                    {"prompt": [1, 2, 3], "max_tokens": 8, "deadline": 3},
+                    {"prompt": [4, 5], "max_tokens": 8},
+                ]
+            )
+        }
+        assert out[0].status == "deadline_exceeded"
+        assert len(out[0].generated) == 3
+        assert out[1].status == "ok"
+        assert eng.free_blocks == before
+        assert eng.free_slots() == eng.n_slots
+
+    def test_deadline_at_or_past_budget_is_just_ok(self, params):
+        # deadline >= max_tokens never fires: max_tokens retires first
+        (c,) = _dense(params).pump([{"prompt": [1, 2], "max_tokens": 3, "deadline": 8}])
+        assert c.status == "ok" and len(c.generated) == 3
+        assert REGISTRY.counter("tpu_serve_deadline_exceeded_total").value() == 0
+
+    def test_deadline_validation(self, params):
+        with pytest.raises(ValueError, match="deadline"):
+            _dense(params).submit([1, 2], max_tokens=4, deadline=0)
+
+    def test_cancel_is_typed_and_refunds(self, params):
+        for eng in (_dense(params), _paged(params)):
+            rid = eng.submit([5, 6, 7], max_tokens=10)
+            eng.step()
+            assert eng.cancel(rid) is True
+            assert eng.cancel(999) is False
+            (c,) = eng.completions()
+            assert c.status == "cancelled" and len(c.generated) >= 1
+            assert eng.free_slots() == eng.n_slots
+        assert eng.free_blocks == eng.n_blocks - eng._axis_size  # null block(s)
+
+
+class TestLoadShedding:
+    def test_shed_is_typed_with_retry_after(self, params):
+        eng = _dense(params)
+        out = eng.pump(
+            [{"prompt": [i + 1, i + 2], "max_tokens": 4} for i in range(8)],
+            queue_limit=1,
+        )
+        shed = [c for c in out if c.status == "shed"]
+        served = [c for c in out if c.status == "ok"]
+        assert shed and served
+        assert all(c.request_id == -1 for c in shed)
+        assert isinstance(eng.last_shed, ShedError)
+        assert eng.last_shed.retry_after_s > 0
+        assert eng.shed_count == len(shed)
+        assert REGISTRY.counter("tpu_serve_shed_total").value() == len(shed)
+        assert eng.pump_stats["sheds"] == len(shed)
+
+    def test_shed_rejects_newest_keeps_fifo(self, params):
+        # queue_limit=0 with 3 slots: requests 0-2 admit, 3-5 ALL shed —
+        # and the shed completions carry the newest prompts, proving the
+        # oldest waiters kept their position.
+        eng = _paged(params)
+        prompts = [[10 + i, 20 + i] for i in range(6)]
+        out = eng.pump(
+            [{"prompt": p, "max_tokens": 3} for p in prompts], queue_limit=0
+        )
+        shed_prompts = sorted(tuple(c.tokens) for c in out if c.status == "shed")
+        assert shed_prompts == sorted(tuple(p) for p in prompts[3:])
+        served = {c.request_id for c in out if c.status == "ok"}
+        assert served == {0, 1, 2}
+
+    def test_shed_costs_zero_device_dispatches(self, params):
+        # The acceptance property: the shed path never touches submit() or
+        # a step program, so host_syncs with 4 sheds equals a twin that
+        # only ever saw the admitted requests.
+        reqs = [{"prompt": [i + 1, i + 2], "max_tokens": 4} for i in range(6)]
+        shed_eng = _dense(params)
+        out = shed_eng.pump(list(reqs), queue_limit=0)
+        assert sum(c.status == "shed" for c in out) == 3
+        twin = _dense(params)
+        twin.pump(reqs[:3])
+        assert shed_eng.host_syncs == twin.host_syncs
+
+    def test_queue_depth_gauge_returns_to_zero(self, params):
+        eng = _dense(params)
+        eng.pump(
+            [{"prompt": [i + 1], "max_tokens": 3} for i in range(5)],
+            queue_limit=4,
+        )
+        assert REGISTRY.gauge("tpu_serve_queue_depth").value() == 0
+
+
+class TestQuarantine:
+    @pytest.fixture(scope="class")
+    def reference(self, params):
+        """Fault-free streams for REQS — the bit-equality baseline every
+        surviving slot must reproduce under a quarantine."""
+        return {
+            c.request_id: tuple(c.tokens) for c in _dense(params).pump(list(REQS))
+        }
+
+    def test_dense_sync_nan_quarantines_only_that_slot(self, params, reference):
+        eng = _dense(params, fault_injector=_inj("nan_logits_rate=1.0,slots=1,steps=2"))
+        out = {c.request_id: c for c in eng.pump(list(REQS))}
+        assert out[1].status == "quarantined"
+        assert "non-finite" in out[1].error
+        for rid in (0, 2):
+            assert out[rid].status == "ok"
+            assert tuple(out[rid].tokens) == reference[rid]
+        assert eng.quarantined == [1]
+        assert REGISTRY.counter("tpu_serve_quarantine_total").value(
+            kind="nan_logits"
+        ) == 1
+
+    def test_dense_burst_nan_survivors_bit_equal(self, params, reference):
+        eng = _dense(
+            params, sync_interval=4,
+            fault_injector=_inj("nan_logits_rate=1.0,slots=1,steps=2"),
+        )
+        out = {c.request_id: c for c in eng.pump(list(REQS))}
+        assert out[1].status == "quarantined"
+        for rid in (0, 2):
+            assert tuple(out[rid].tokens) == reference[rid]
+
+    def test_paged_step_raise_survivors_bit_equal(self, params, reference):
+        eng = _paged(params, fault_injector=_inj("step_raise_rate=1.0,slots=0,steps=3"))
+        before = eng.free_blocks
+        out = {c.request_id: c for c in eng.pump(list(REQS))}
+        assert out[0].status == "quarantined"
+        assert "slot 0" in out[0].error
+        for rid in (1, 2):
+            assert tuple(out[rid].tokens) == reference[rid]
+        assert eng.free_blocks == before  # quarantine refunds blocks
+        assert REGISTRY.counter("tpu_serve_quarantine_total").value(
+            kind="step_raise"
+        ) == 1
+
+    def test_paged_burst_nan_survivors_bit_equal(self, params, reference):
+        eng = _paged(
+            params, sync_interval=3,
+            fault_injector=_inj("nan_logits_rate=1.0,slots=1,steps=2"),
+        )
+        before = eng.free_blocks
+        out = {c.request_id: c for c in eng.pump(list(REQS))}
+        assert out[1].status == "quarantined"
+        for rid in (0, 2):
+            assert tuple(out[rid].tokens) == reference[rid]
+        assert eng.free_blocks == before
+
+    def test_engine_fails_only_after_k_quarantines(self, params, tmp_path, monkeypatch):
+        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
+        monkeypatch.setattr(WATCHDOG, "_bundle_dir", str(tmp_path))
+        # one poisoned slot stays under the limit...
+        eng = _dense(
+            params, quarantine_limit=2,
+            fault_injector=_inj("nan_logits_rate=1.0,slots=1,steps=1"),
+        )
+        out = {c.request_id: c for c in eng.pump(list(REQS))}
+        assert out[1].status == "quarantined"
+        assert len(eng.quarantined) == 1
+        # ...every slot poisoned crosses it: typed wedge with bundle +
+        # drain snapshot in the message
+        eng = _dense(
+            params, quarantine_limit=2,
+            fault_injector=_inj("nan_logits_rate=1.0,steps=1"),
+        )
+        with pytest.raises(RuntimeError, match="engine poisoned") as exc:
+            eng.pump(list(REQS))
+        assert "diag bundle" in str(exc.value)
+        assert "drain snapshot" in str(exc.value)
+        assert len(eng.quarantined) == 2
+
+
+class TestDrainRestore:
+    def _mid_flight(self, eng, steps=3):
+        eng.submit([5, 6, 7], max_tokens=10, temperature=0.7, seed=3)
+        eng.submit([9, 1], max_tokens=10, seed=11)
+        for _ in range(steps):
+            eng.step()
+        return eng.snapshot_active()
+
+    def _reference(self, params, make):
+        ref = make(params)
+        return {
+            c.request_id: tuple(c.tokens)
+            for c in ref.pump(
+                [
+                    {"prompt": [5, 6, 7], "max_tokens": 10, "temperature": 0.7, "seed": 3},
+                    {"prompt": [9, 1], "max_tokens": 10, "seed": 11},
+                ]
+            )
+        }
+
+    @pytest.mark.parametrize("make", [_dense, _paged], ids=["dense", "paged"])
+    def test_restore_continues_bit_equal_under_latency_faults(self, params, make):
+        # step-latency chaos on BOTH sides of the restart: latency must
+        # never change what is generated, only when
+        snap = self._mid_flight(
+            make(params, fault_injector=_inj("step_latency_ms=1"))
+        )
+        assert len(snap["requests"]) == 2
+        fresh = make(params, fault_injector=_inj("step_latency_ms=1"))
+        restored = fresh.restore(snap)
+        assert sorted(restored) == [0, 1]
+        fresh.run_until_drained()
+        out = {c.request_id: tuple(c.tokens) for c in fresh.completions()}
+        assert out == self._reference(params, make)
+        assert fresh._next_id == 2
+
+    def test_restore_requires_idle_engine(self, params):
+        eng = _dense(params)
+        snap = self._mid_flight(eng)
+        with pytest.raises(RuntimeError, match="idle"):
+            eng.restore(snap)
+
+    def test_wedge_snapshot_restores_in_fresh_engine(self, params, tmp_path, monkeypatch):
+        # The upgraded wedge path end to end: wedge -> bundle + drain
+        # snapshot on disk -> a fresh engine restores it and finishes
+        # every stream bit-equally.
+        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
+        monkeypatch.setattr(WATCHDOG, "_bundle_dir", str(tmp_path))
+        eng = _paged(params)
+        eng.submit([5, 6, 7], max_tokens=10, temperature=0.7, seed=3)
+        eng.submit([9, 1], max_tokens=10, seed=11)
+        for _ in range(2):
+            eng.step()
+        with pytest.raises(RuntimeError, match="drain snapshot"):
+            eng.run_until_drained(max_steps=1)
+        (bundle,) = [
+            p for p in tmp_path.glob("*.json") if "drain-snapshot" not in p.name
+        ]
+        state = json.loads(bundle.read_text())["state"]
+        assert state["drain_snapshot_requests"] == 2
+        with open(state["drain_snapshot_path"]) as fh:
+            snap = json.load(fh)
+        fresh = _paged(params)
+        assert sorted(fresh.restore(snap)) == [0, 1]
+        fresh.run_until_drained()
+        out = {c.request_id: tuple(c.tokens) for c in fresh.completions()}
+        assert out == self._reference(params, _paged)
+
+    def test_restore_crosses_engine_backends(self, params):
+        # The snapshot shape is engine-agnostic: a dense drain restores
+        # into a paged pool (and the streams still match, because both
+        # backends share sample_next and the fold-by-position keys).
+        snap = self._mid_flight(_dense(params))
+        fresh = _paged(params)
+        assert sorted(fresh.restore(snap)) == [0, 1]
+        fresh.run_until_drained()
+        out = {c.request_id: tuple(c.tokens) for c in fresh.completions()}
+        assert out == self._reference(params, _dense)

@@ -1,0 +1,286 @@
+"""Drain/restore and early-retire robustness across the serving matrix.
+
+tests/test_serve_chaos.py proves the four SLO properties under injected
+faults; this suite pins the REST of the robustness contract:
+
+* drain -> restore bit-equality across {dense, paged} x {greedy, sampled,
+  spec, LoRA, prefix-cache}: a mid-flight snapshot restored into a fresh
+  engine finishes every stream exactly as an uninterrupted engine would;
+* quarantine-replay bit-equality composes with per-request LoRA;
+* block-leak checks on EVERY early-retire path the robustness layer added
+  (deadline, cancel resident, cancel parked, quarantine, unrestorable);
+* the scrape/hygiene contract for the four new serving metrics.
+"""
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, lora, paged
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+from k8s_dra_driver_tpu.utils.faults import FaultInjector
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+LORA = lora.LoraConfig(rank=2, alpha=4.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def bank(params):
+    def trained(seed):
+        ad = lora.init_adapters(jax.random.PRNGKey(seed), CFG, LORA)
+        for li, blk in enumerate(ad["blocks"]):
+            for name, ab in blk.items():
+                tag = li * 1000 + sum(ord(c) for c in name)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), tag)
+                ab["b"] = 0.3 * jax.random.normal(
+                    key, ab["b"].shape, jax.numpy.float32
+                )
+        return ad
+
+    return lora.stack_adapters(CFG, LORA, [trained(1), trained(2)])
+
+
+# The restore matrix: every composing serving feature, each with requests
+# exercising it.  ``kw``/``paged_kw`` extend the engine config; ``reqs``
+# are submit kwargs (ids assign in submit order).
+FEATURES = {
+    "greedy": dict(
+        kw={}, paged_kw={},
+        reqs=[
+            {"prompt": [5, 6, 7], "max_tokens": 8},
+            {"prompt": [9, 1], "max_tokens": 8},
+        ],
+    ),
+    "sampled": dict(
+        kw={}, paged_kw={},
+        reqs=[
+            {"prompt": [5, 6, 7], "max_tokens": 8, "temperature": 0.7, "seed": 3},
+            {"prompt": [9, 1], "max_tokens": 8, "temperature": 1.1, "seed": 11},
+        ],
+    ),
+    "spec": dict(
+        kw=dict(spec_gamma=2), paged_kw=dict(spec_gamma=2),
+        reqs=[
+            {"prompt": [5, 6, 7], "max_tokens": 8},
+            {"prompt": [9, 1], "max_tokens": 8},
+        ],
+    ),
+    "lora": dict(
+        kw="bank", paged_kw="bank",
+        reqs=[
+            {"prompt": [5, 6, 7], "max_tokens": 8, "adapter": 1},
+            {"prompt": [9, 1], "max_tokens": 8, "adapter": 2},
+        ],
+    ),
+    "prefix": dict(
+        kw=dict(prefix_bucket=4), paged_kw=dict(prefix_cache_blocks=4),
+        # shared 4-token prefix: the second admission hits the store
+        reqs=[
+            {"prompt": [5, 6, 7, 8, 1], "max_tokens": 8},
+            {"prompt": [5, 6, 7, 8, 2], "max_tokens": 8},
+        ],
+    ),
+}
+
+
+def _engine(params, bank, kind, feature, **extra):
+    spec = FEATURES[feature]
+    kw = spec["kw" if kind == "dense" else "paged_kw"]
+    kw = dict(adapter_bank=bank) if kw == "bank" else dict(kw)
+    kw.update(extra)
+    if kind == "dense":
+        kw.setdefault("n_slots", 3)
+        kw.setdefault("prompt_bucket", 16)
+        return ServeEngine(params=params, cfg=CFG, **kw)
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 33)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+class TestRestoreMatrix:
+    @pytest.mark.parametrize("feature", sorted(FEATURES))
+    @pytest.mark.parametrize("kind", ["dense", "paged"])
+    def test_mid_flight_restore_bit_equal(self, params, bank, kind, feature):
+        reqs = FEATURES[feature]["reqs"]
+        ref = _engine(params, bank, kind, feature)
+        expected = {
+            c.request_id: tuple(c.tokens) for c in ref.pump([dict(r) for r in reqs])
+        }
+        eng = _engine(params, bank, kind, feature)
+        for r in reqs:
+            eng.submit(**dict(r))
+        # 2 steps keeps every request mid-flight even under spec_gamma=2
+        # (up to gamma+1 commits per step)
+        for _ in range(2):
+            eng.step()
+        snap = eng.snapshot_active()
+        assert snap["requests"], "nothing in flight to snapshot"
+        fresh = _engine(params, bank, kind, feature)
+        restored = fresh.restore(snap)
+        assert sorted(restored) == sorted(r["request_id"] for r in snap["requests"])
+        fresh.run_until_drained()
+        got = {c.request_id: tuple(c.tokens) for c in fresh.completions()}
+        # requests that finished BEFORE the snapshot drained on the old
+        # engine; everything in the snapshot must finish bit-equal
+        for rid, stream in got.items():
+            assert stream == expected[rid], (feature, kind, rid)
+        assert set(got) == {r["request_id"] for r in snap["requests"]}
+
+    def test_snapshot_is_json_round_trippable(self, params, bank):
+        import json
+
+        eng = _engine(params, bank, "paged", "sampled")
+        for r in FEATURES["sampled"]["reqs"]:
+            eng.submit(**dict(r))
+        eng.step()
+        snap = json.loads(json.dumps(eng.snapshot_active()))
+        fresh = _engine(params, bank, "paged", "sampled")
+        assert sorted(fresh.restore(snap)) == [0, 1]
+        fresh.run_until_drained()
+        assert len(fresh.completions()) == 2
+
+
+class TestQuarantineComposition:
+    def test_lora_survivor_bit_equal_under_quarantine(self, params, bank):
+        reqs = FEATURES["lora"]["reqs"]
+        ref = _engine(params, bank, "paged", "lora")
+        expected = {
+            c.request_id: tuple(c.tokens) for c in ref.pump([dict(r) for r in reqs])
+        }
+        eng = _engine(
+            params, bank, "paged", "lora",
+            fault_injector=FaultInjector.from_env(
+                "nan_logits_rate=1.0,slots=0,steps=2"
+            ),
+        )
+        out = {c.request_id: c for c in eng.pump([dict(r) for r in reqs])}
+        assert out[0].status == "quarantined"
+        assert out[1].status == "ok"
+        assert tuple(out[1].tokens) == expected[1]
+
+
+class TestBlockLeaks:
+    """free_blocks must return to the post-init baseline after EVERY
+    early-retire path — a leaked block is permanent capacity loss in a
+    long-lived pool."""
+
+    def _baseline(self, eng):
+        return eng.n_blocks - eng._axis_size  # each shard's null block
+
+    def test_deadline_path(self, params, bank):
+        eng = _engine(params, bank, "paged", "greedy")
+        eng.pump([{"prompt": [1, 2, 3], "max_tokens": 10, "deadline": 2}])
+        assert eng.free_blocks == self._baseline(eng)
+        assert eng.free_slots() == eng.n_slots
+
+    def test_cancel_resident_path(self, params, bank):
+        eng = _engine(params, bank, "paged", "greedy")
+        rid = eng.submit([1, 2, 3], max_tokens=10)
+        eng.step()
+        assert eng.cancel(rid)
+        assert eng.free_blocks == self._baseline(eng)
+
+    def test_cancel_parked_path(self, params, bank):
+        # Preempt a request under a tight pool, then cancel it while
+        # parked: it holds no blocks, and the cancel must not double-free.
+        # prompt_bucket must stay ABOVE the stall point: a victim grown
+        # past one-pass re-prefill is not resumable and cannot be evicted
+        eng = _engine(
+            params, bank, "paged", "greedy", n_blocks=9, block_size=4,
+            n_slots=2, prompt_bucket=32, preempt_on_stall=True,
+        )
+        eng.submit([1, 2, 3], max_tokens=20)
+        eng.submit([4, 5, 6], max_tokens=20)
+        # 8 usable blocks vs 2 x 6-block streams: growth MUST stall
+        # before either request finishes (23 tokens each)
+        for _ in range(40):
+            eng.step()
+            if eng._preempted:
+                break
+        assert eng._preempted, "pool never forced a preemption"
+        parked = eng._preempted[0]["st"].request_id
+        assert eng.cancel(parked)
+        (c,) = [x for x in eng.completions() if x.status == "cancelled"]
+        assert c.request_id == parked
+        eng.run_until_drained()
+        assert eng.free_blocks == self._baseline(eng)
+
+    def test_quarantine_path(self, params, bank):
+        eng = _engine(
+            params, bank, "paged", "greedy",
+            fault_injector=FaultInjector.from_env(
+                "step_raise_rate=1.0,slots=1,steps=2"
+            ),
+        )
+        eng.pump([
+            {"prompt": [1, 2], "max_tokens": 6},
+            {"prompt": [3, 4], "max_tokens": 6},
+        ])
+        assert eng.quarantined == [1]
+        assert eng.free_blocks == self._baseline(eng)
+
+    def test_unrestorable_path_touches_no_blocks(self, params, bank):
+        eng = _engine(params, bank, "paged", "greedy")
+        snap = {
+            "engine": "PagedServeEngine",
+            "next_id": 1,
+            "requests": [{
+                "request_id": 0,
+                "tokens": list(range(40)),  # > prompt_bucket: unrestorable
+                "prompt_len": 4, "max_tokens": 50, "deadline": None,
+                "temperature": 0.0, "key": [0, 0], "adapter": 0,
+                "priority": 0,
+            }],
+        }
+        assert eng.restore(snap) == []
+        (c,) = eng.completions()
+        assert c.status == "error" and "unrestorable" in c.error
+        assert eng.free_blocks == self._baseline(eng)
+
+
+class TestRobustnessMetrics:
+    def test_scrape_exposes_slo_metrics(self, params, bank):
+        eng = _engine(params, bank, "dense", "greedy")
+        eng.pump(
+            [
+                {"prompt": [i + 1, i + 2], "max_tokens": 4,
+                 **({"deadline": 2} if i == 0 else {})}
+                for i in range(6)
+            ],
+            queue_limit=1,
+        )
+        qeng = _engine(
+            params, bank, "paged", "greedy",
+            fault_injector=FaultInjector.from_env(
+                "nan_logits_rate=1.0,slots=0,steps=2"
+            ),
+        )
+        qeng.pump([{"prompt": [1, 2], "max_tokens": 6},
+                   {"prompt": [3, 4], "max_tokens": 6}])
+        assert REGISTRY.counter("tpu_serve_shed_total").value() >= 1
+        assert REGISTRY.counter("tpu_serve_deadline_exceeded_total").value() == 1
+        assert REGISTRY.counter("tpu_serve_quarantine_total").value(
+            kind="nan_logits"
+        ) == 1
+        assert REGISTRY.gauge("tpu_serve_queue_depth").value() == 0
+        text = REGISTRY.render()
+        for name, kind in (
+            ("tpu_serve_shed_total", "counter"),
+            ("tpu_serve_deadline_exceeded_total", "counter"),
+            ("tpu_serve_quarantine_total", "counter"),
+            ("tpu_serve_queue_depth", "gauge"),
+        ):
+            assert f"# TYPE {name} {kind}" in text
+            assert f"# HELP {name} " in text
+        # hygiene: counters end _total, the gauge must not
+        assert "tpu_serve_queue_depth_total" not in text

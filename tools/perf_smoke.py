@@ -222,10 +222,81 @@ def check_pipelined_decode() -> dict:
     return stats
 
 
+# Shedding is the overload escape hatch: it must stay a pure host-side
+# queue operation.  The whole overloaded pump (serve 3 + shed 5) gets the
+# same 1s window as the decode guard; the shed path itself adds only list
+# pops and Completion construction to it.
+SHED_FASTPATH_BUDGET_S = 1.0
+
+
+def check_shed_fastpath() -> dict:
+    """Budget guard for load shedding (PR 5 tentpole): rejecting overflow
+    must cost ZERO device dispatches — an overloaded pump pays exactly the
+    host syncs of a twin pumping only the admissible prefix, and the typed
+    rejections land inside the time budget.  A shed path that touches the
+    device (a stray block reservation, a prefill probe) turns the overload
+    escape hatch into more overload."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=6)[0]))
+        for s in range(8)
+    ]
+
+    def engine():
+        return serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=3, prompt_bucket=16, sync_interval=4
+        )
+
+    engine().pump([(prompts[0], 8)])  # compile off the clock (shared_jit)
+    twin = engine()
+    twin.pump([(p, 8) for p in prompts[:3]])
+
+    shed_eng = engine()
+    start = time.perf_counter()
+    done = shed_eng.pump([(p, 8) for p in prompts], queue_limit=0)
+    elapsed = time.perf_counter() - start
+    sheds = [c for c in done if c.status == "shed"]
+    served = [c for c in done if c.status == "ok"]
+    stats = {
+        "served": len(served),
+        "sheds": len(sheds),
+        "host_syncs": shed_eng.host_syncs,
+        "twin_host_syncs": twin.host_syncs,
+        "elapsed_s": round(elapsed, 3),
+        "budget_s": SHED_FASTPATH_BUDGET_S,
+    }
+    if len(served) != 3 or len(sheds) != len(prompts) - 3:
+        raise PerfBudgetError(
+            f"shed fastpath served {len(served)} / shed {len(sheds)}, "
+            f"expected 3 served + {len(prompts) - 3} shed"
+        )
+    if shed_eng.host_syncs != twin.host_syncs:
+        raise PerfBudgetError(
+            f"shedding paid device work: {shed_eng.host_syncs} host syncs "
+            f"vs {twin.host_syncs} for the admissible prefix alone — "
+            f"rejections must never dispatch"
+        )
+    if elapsed > SHED_FASTPATH_BUDGET_S:
+        raise PerfBudgetError(
+            f"overloaded pump took {elapsed:.2f}s > "
+            f"{SHED_FASTPATH_BUDGET_S}s budget: shedding is no longer a "
+            f"host-side fast path"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
         stats["pipelined_decode"] = check_pipelined_decode()
+        stats["shed_fastpath"] = check_shed_fastpath()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
