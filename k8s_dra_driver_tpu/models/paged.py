@@ -50,6 +50,7 @@ from k8s_dra_driver_tpu.models.burnin import (
     tied_logits,
 )
 from k8s_dra_driver_tpu.models.quant import matmul_last as _mm
+from k8s_dra_driver_tpu.models.telemetry import EngineTelemetry
 from k8s_dra_driver_tpu.ops import paged_attention
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
@@ -722,6 +723,11 @@ class PagedServeEngine:
     # Distinct quarantined requests before the engine declares itself
     # poisoned and wedges (serve._wedge_error).
     quarantine_limit: int = 3
+    # Request-lifecycle telemetry (models/telemetry.py): traces, SLO
+    # histograms, EngineStats.  Stamps only at the sync points the engine
+    # already pays for — perf_smoke check_telemetry_overhead pins zero
+    # added host syncs against a telemetry_enabled=False twin.
+    telemetry_enabled: bool = True
 
     def __post_init__(self):
         cfg = self.cfg
@@ -751,6 +757,7 @@ class PagedServeEngine:
         self.pump_stats: dict = {}
         self._step_no = 0
         self._last_step_s = 0.0
+        self.telemetry = EngineTelemetry(self, enabled=self.telemetry_enabled)
         if (
             self.attn_impl == "kernel"
             and not self.interpret
@@ -1020,6 +1027,7 @@ class PagedServeEngine:
         adapter: int = 0,
         priority: int = 0,
         deadline: int | None = None,
+        queued_at: float | None = None,
     ) -> int:
         """Admit when a slot AND the prompt's blocks are available; raises
         RuntimeError otherwise (admission control is the caller's).
@@ -1033,6 +1041,7 @@ class PagedServeEngine:
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
+        t_sub = self.telemetry.now()
         serve.check_submit(
             prompt, max_tokens, self.prompt_bucket, self.cfg.max_seq,
             spec_gamma=self.spec_gamma, temperature=temperature,
@@ -1120,6 +1129,13 @@ class PagedServeEngine:
             )
             # _M_REQUESTS counts at ACTIVATION (matching the non-chunked
             # path, which only counts successful admissions)
+            # trace minted in the "admitting" state — admitted_at /
+            # first_token_at stamp when the final chunk activates the slot
+            self.telemetry.on_admit(
+                request_id, prompt_len=len(prompt), max_tokens=max_tokens,
+                deadline=deadline, adapter=adapter, submitted_at=t_sub,
+                queued_at=queued_at, activated=False,
+            )
             self._update_gauges()
             return request_id
 
@@ -1163,6 +1179,13 @@ class PagedServeEngine:
         )
         serve._M_REQUESTS.inc()
         serve._M_TOKENS.inc()  # the admission step's first generated token
+        # activation == first token here (the _first_token sync above), so
+        # the trace's admission stamps piggyback on a sync already paid
+        self.telemetry.on_admit(
+            request_id, prompt_len=len(prompt), max_tokens=max_tokens,
+            deadline=deadline, adapter=adapter, submitted_at=t_sub,
+            queued_at=queued_at,
+        )
         self._retire(slot)  # max_tokens=1 or eos on the first token
         self._update_gauges()
         return request_id
@@ -1191,6 +1214,7 @@ class PagedServeEngine:
                     self.prefill_chunk_blocks * bs, slot, row_ad,
                 )
                 adm["done"] += self.prefill_chunk_blocks
+                self.telemetry.on_admission_chunk(self._slots[slot].request_id)
                 return
             # final chunk (may be narrower than a whole number of blocks),
             # then activation
@@ -1200,6 +1224,7 @@ class PagedServeEngine:
                     adm["padded"], prefill_row, adm["done"], chunk_len,
                     slot, row_ad,
                 )
+                self.telemetry.on_admission_chunk(self._slots[slot].request_id)
             if self.spec_gamma > 0:
                 self._run_draft_prefill(adm["padded"], adm["plen"], slot)
             first_tok = self._first_token(
@@ -1223,6 +1248,7 @@ class PagedServeEngine:
                     generated=[], error=f"{type(exc).__name__}: {exc}",
                 )
             )
+            self.telemetry.on_retire(st.request_id, "error", 0)
             raise
         self._admitting.pop(0)
         serve._M_REQUESTS.inc()  # successful admission, like the sync path
@@ -1240,6 +1266,9 @@ class PagedServeEngine:
             st.prompt_len + serve._slot_budget(st) - 1
         )
         serve._M_TOKENS.inc()
+        # the slot went live and its first token committed (the
+        # _first_token sync above): the chunked admission ends HERE
+        self.telemetry.on_activate(st.request_id)
         self._retire(slot)
         self._update_gauges()
 
@@ -1344,6 +1373,7 @@ class PagedServeEngine:
         # device transfer with the growth pass's own table_dirty
         self.preempted_count += 1
         _M_PREEMPTIONS.inc()
+        self.telemetry.on_event(victim.request_id, "preempt")
         return True
 
     def _readmit(self) -> None:
@@ -1411,6 +1441,9 @@ class PagedServeEngine:
                         error=f"{type(exc).__name__}: {exc}",
                     )
                 )
+                self.telemetry.on_retire(
+                    st.request_id, "error", len(st.tokens) - st.prompt_len
+                )
                 raise
             self._preempted.pop(0)
             self._slots[slot] = st
@@ -1424,6 +1457,7 @@ class PagedServeEngine:
             self._stop_pos = self._stop_pos.at[slot].set(
                 st.prompt_len + serve._slot_budget(st) - 1
             )
+            self.telemetry.on_event(st.request_id, "readmit")
             self._update_gauges()
 
     def _grow_or_preempt(self, lookahead: int):
@@ -1473,6 +1507,7 @@ class PagedServeEngine:
             return 0
         if table_dirty:
             self._upload_table()
+        self.telemetry.burst_begin(self.spec_gamma + 1, self._step_no)
         active_j = self._slot_device(active)
         target, advance, self._cache, self._d_cache = self._spec_fn(
             self.params, self.draft_params, self._cache, self._d_cache,
@@ -1490,6 +1525,7 @@ class PagedServeEngine:
         for slot, st in enumerate(self._slots):
             if st is None or not active[slot]:
                 continue
+            before = len(st.tokens)
             for j in range(int(adv[slot])):
                 st.tokens.append(int(tgt[slot, j]))
                 committed += 1
@@ -1497,7 +1533,9 @@ class PagedServeEngine:
                 hit_eos = self.eos_id is not None and st.tokens[-1] == self.eos_id
                 if n_gen >= serve._slot_budget(st) or hit_eos:
                     break
+            self.telemetry.on_commit(st.request_id, len(st.tokens) - before)
             self._retire(slot)
+        self.telemetry.burst_end(int(active.sum()))
         serve._M_TOKENS.inc(committed)
         self._update_gauges()
         return int(active.sum())
@@ -1525,6 +1563,7 @@ class PagedServeEngine:
             return quarantined
         if table_dirty:
             self._upload_table()
+        self.telemetry.burst_begin(1, self._step_no)
         active_j = self._slot_device(active)
         next_tok, bad, self._cache = self._step_fn(
             self.params, self._cache, self._table, self._last, self._pos,
@@ -1551,8 +1590,10 @@ class PagedServeEngine:
                 )
                 continue
             st.tokens.append(toks[slot])
+            self.telemetry.on_commit(st.request_id)
             committed += 1
             self._retire(slot)
+        self.telemetry.burst_end(int(active.sum()))
         serve._M_TOKENS.inc(committed)
         self._update_gauges()
         self._last_step_s = time.perf_counter() - t0
@@ -1609,6 +1650,7 @@ class PagedServeEngine:
             self._upload_table()
         active_j = self._slot_device(active)
 
+        self.telemetry.burst_begin(k, self._step_no)
         with WATCHDOG.guard("serve.paged_step_burst"):
             (
                 trace_t, trace_a, trace_b, self._cache,
@@ -1637,8 +1679,10 @@ class PagedServeEngine:
                 if j >= first_bad.get(slot, k):
                     continue
                 st.tokens.append(int(trace_t[j][slot]))
+                self.telemetry.on_commit(st.request_id)
                 committed += 1
                 self._retire(slot)
+        self.telemetry.burst_end(stepped)
         for slot in sorted(first_bad):
             if self._slots[slot] is not None:
                 serve._quarantine_slot(
@@ -1686,6 +1730,13 @@ class PagedServeEngine:
         out, self._completions = self._completions, []
         return out
 
+    def stats(self):
+        """The EngineStats load/latency snapshot (models/telemetry.py) —
+        the per-replica routing signal: queue depth, resident/free slots,
+        free pool blocks, rolling TTFT/TPOT quantiles, shed/quarantine
+        tallies."""
+        return self.telemetry.stats()
+
     def cancel(self, request_id: int) -> bool:
         """Cancel an in-flight request: resident slots retire immediately
         (blocks refund, typed "cancelled" completion with the tokens so
@@ -1712,6 +1763,9 @@ class PagedServeEngine:
                         generated=list(st.tokens[st.prompt_len:]),
                         status="cancelled", error="cancelled by caller",
                     )
+                )
+                self.telemetry.on_retire(
+                    st.request_id, "cancelled", len(st.tokens) - st.prompt_len
                 )
                 return True
         return False
@@ -1741,16 +1795,19 @@ class PagedServeEngine:
                 reqs.append(serve._snapshot_request(
                     st, float(adm["temp"]), adm["key"],
                     int(adm.get("adapter", 0)), self._prio[slot],
+                    trace=self.telemetry.export_trace(st.request_id),
                 ))
             else:
                 reqs.append(serve._snapshot_request(
                     st, float(temps[slot]), keys[slot], int(ads[slot]),
                     self._prio[slot],
+                    trace=self.telemetry.export_trace(st.request_id),
                 ))
         for r in self._preempted:
             reqs.append(serve._snapshot_request(
                 r["st"], float(r["temp"]), r["key"],
                 int(r.get("adapter", 0)), int(r.get("priority", 0)),
+                trace=self.telemetry.export_trace(r["st"].request_id),
             ))
         return {
             "engine": type(self).__name__,
@@ -1778,6 +1835,11 @@ class PagedServeEngine:
             raise RuntimeError("restore() needs an idle engine")
         restored: list[int] = []
         for req in sorted(snapshot["requests"], key=lambda r: r["request_id"]):
+            # rebuild the request's timeline FIRST: even an unrestorable
+            # entry retires against its original submit/first-token stamps
+            self.telemetry.import_trace(
+                int(req["request_id"]), req.get("trace")
+            )
             tokens = [int(t) for t in req["tokens"]]
             if len(tokens) > self.prompt_bucket:
                 serve._unrestorable(
@@ -1803,6 +1865,7 @@ class PagedServeEngine:
                 "serve", "request.restore",
                 correlation=f"req-{st.request_id}", resumed_at=len(tokens),
             )
+            self.telemetry.on_restore(st.request_id, resumed_at=len(tokens))
         self._preempted.sort(key=lambda r: -r.get("priority", 0))
         self._next_id = max(
             self._next_id,
@@ -2081,6 +2144,9 @@ class PagedServeEngine:
             self._owned[slot] = []
             self._table_np[slot, :] = NULL_BLOCK
             self._upload_table()
+            self.telemetry.on_retire(
+                done.request_id, done.status, len(done.generated)
+            )
 
     def _update_gauges(self) -> None:
         from k8s_dra_driver_tpu.models import serve

@@ -15,6 +15,11 @@ Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
 * ``/debug/stacks``   — every Python thread's stack (JSON) — what
   tools/diag_bundle.py pulls to bundle a LIVE process without attaching
   a debugger
+* ``/debug/serve``    — per-engine ``EngineStats`` snapshots plus recent
+  request traces from every live serving engine in the process (JSON);
+  filters: ``?request_id=N`` (full timeline for one correlation id) and
+  ``?limit=N`` (recent-trace ring depth).  This is the fleet
+  load-signal contract: a router scrapes it to weigh replicas.
 """
 
 from __future__ import annotations
@@ -80,6 +85,22 @@ class DiagnosticsServer:
                     from k8s_dra_driver_tpu.utils.watchdog import thread_stacks
 
                     body = json.dumps(thread_stacks(), indent=1).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/serve":
+                    # Imported lazily: diagnostics serves control-plane
+                    # binaries that never load the models package.
+                    from k8s_dra_driver_tpu.models.telemetry import debug_serve_doc
+
+                    try:
+                        rid = int(query.get("request_id", [""])[0])
+                    except ValueError:
+                        rid = None
+                    try:
+                        limit = int(query.get("limit", ["8"])[0])
+                    except ValueError:
+                        limit = 8
+                    doc = debug_serve_doc(request_id=rid, trace_limit=limit)
+                    body = json.dumps(doc, indent=1, default=str).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -10,6 +10,7 @@ in the Prometheus text exposition format.
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass, field
 
@@ -20,6 +21,21 @@ _DEFAULT_BUCKETS = (
 
 def _label_key(labels: dict[str, str] | None) -> tuple:
     return tuple(sorted((labels or {}).items()))
+
+
+def format_value(v: float) -> str:
+    """Render one sample value for the text exposition format.  repr() of
+    a Python float is the SHORTEST string that parses back to exactly the
+    same double (float(format_value(v)) == v — the precision round-trip
+    the parse-back tests pin), and the non-finite spellings are the ones
+    the Prometheus text format defines ("+Inf"/"-Inf"/"NaN", not Python's
+    "inf"/"nan", which scrapers reject)."""
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(v)
 
 
 def escape_label_value(value: str) -> str:
@@ -68,7 +84,7 @@ class Counter:
         with self._lock:
             items = sorted(self._values.items())
         for key, v in items:
-            out.append(f"{self.name}{_label_str(key)} {v}")
+            out.append(f"{self.name}{_label_str(key)} {format_value(v)}")
         return out
 
 
@@ -96,7 +112,7 @@ class Gauge:
         with self._lock:
             items = sorted(self._values.items())
         for key, v in items:
-            out.append(f"{self.name}{_label_str(key)} {v}")
+            out.append(f"{self.name}{_label_str(key)} {format_value(v)}")
         return out
 
 
@@ -109,6 +125,24 @@ class Histogram:
     _sums: dict[tuple, float] = field(default_factory=dict)
     _totals: dict[tuple, int] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def __post_init__(self):
+        # Normalize declared buckets once so observe/quantile/render agree:
+        # sorted, deduplicated, and with non-finite bounds DROPPED — the
+        # +Inf bucket is implicit in the exposition format (rendered from
+        # _totals), so an explicit float("inf") bound would emit a second,
+        # misspelled le="inf" line that scrapers reject.  Original bound
+        # objects are kept (not coerced to float) so an int bound 1 still
+        # renders le="1", not le="1.0".
+        seen: set[float] = set()
+        norm = []
+        for bound in sorted(self.buckets, key=float):
+            fb = float(bound)
+            if not math.isfinite(fb) or fb in seen:
+                continue
+            seen.add(fb)
+            norm.append(bound)
+        self.buckets = tuple(norm)
 
     def observe(self, value: float, **labels) -> None:
         key = _label_key(labels)
@@ -158,7 +192,7 @@ class Histogram:
                 out.append(f"{self.name}_bucket{_label_str(bucket_key)} {counts[key][i]}")
             inf_key = key + (("le", "+Inf"),)
             out.append(f"{self.name}_bucket{_label_str(inf_key)} {totals[key]}")
-            out.append(f"{self.name}_sum{_label_str(key)} {sums[key]}")
+            out.append(f"{self.name}_sum{_label_str(key)} {format_value(sums[key])}")
             out.append(f"{self.name}_count{_label_str(key)} {totals[key]}")
         return out
 
@@ -202,6 +236,70 @@ class Registry:
             for metric in self._metrics.values():
                 lines.extend(metric.render())
             return "\n".join(lines) + "\n"
+
+
+def _parse_value(text: str) -> float:
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    return float(text)
+
+
+def _parse_labels(raw: str) -> tuple:
+    """Inverse of _label_str's body: scan comma-separated k="v" pairs,
+    undoing escape_label_value's three escapes."""
+    labels = []
+    i, n = 0, len(raw)
+    while i < n:
+        while i < n and raw[i] in ", ":
+            i += 1
+        if i >= n:
+            break
+        eq = raw.index("=", i)
+        key = raw[i:eq]
+        if eq + 1 >= n or raw[eq + 1] != '"':
+            raise ValueError(f"malformed label pair at offset {i}: {raw!r}")
+        j = eq + 2
+        buf = []
+        while j < n and raw[j] != '"':
+            if raw[j] == "\\" and j + 1 < n:
+                nxt = raw[j + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, "\\" + nxt))
+                j += 2
+            else:
+                buf.append(raw[j])
+                j += 1
+        if j >= n:
+            raise ValueError(f"unterminated label value: {raw!r}")
+        labels.append((key, "".join(buf)))
+        i = j + 1
+    return tuple(sorted(labels))
+
+
+def parse_prom_text(text: str) -> dict[str, dict[tuple, float]]:
+    """Parse the text exposition format back into
+    ``{metric_name: {label_key: value}}`` — the inverse of
+    ``Registry.render()``.  Exists so tests can pin the round-trip
+    contract (``parse_prom_text(render())`` recovers every sample value
+    exactly, including ``le="+Inf"`` buckets and float sums to the last
+    ulp) instead of grepping rendered lines with brittle substrings."""
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            raw, value_part = rest.rsplit("}", 1)
+            labels = _parse_labels(raw)
+        else:
+            name, value_part = line.split(None, 1)
+            labels = ()
+        out.setdefault(name, {})[labels] = _parse_value(value_part.strip())
+    return out
 
 
 REGISTRY = Registry()

@@ -66,6 +66,14 @@ class Tracer:
                 with self._lock:
                     self._finished.append(s)
 
+    def add(self, span: Span) -> None:
+        """Record an externally-constructed root span.  For retroactive
+        timelines (e.g. a request's lifecycle assembled at retirement from
+        burst-boundary timestamps) where a ``with span():`` block around
+        the whole interval would force extra clock reads on the hot path."""
+        with self._lock:
+            self._finished.append(span)
+
     def recent(self, limit: int = 50) -> list[dict]:
         with self._lock:
             spans = list(self._finished)[-limit:]

@@ -264,6 +264,63 @@ class TestReadbackInLoop:
         assert findings_for(tmp_path, src) == ["readback-in-loop"]
 
 
+class TestMetricDocs:
+    """The cross-file metric-docs check: serving metrics declared in
+    models/ must carry help text somewhere and appear in ARCHITECTURE.md."""
+
+    def _models_file(self, tmp_path, source):
+        d = tmp_path / "models"
+        d.mkdir()
+        f = d / "case.py"
+        f.write_text(source)
+        return f
+
+    def test_undocumented_serving_metric_flagged(self, tmp_path):
+        f = self._models_file(
+            tmp_path,
+            'M = REGISTRY.counter("tpu_serve_bogus_total", "what it counts")\n',
+        )
+        findings = lint.check_metric_docs([f], arch_text="")
+        assert [x.check for x in findings] == ["metric-docs"]
+        assert "not documented" in findings[0].message
+
+    def test_helpless_serving_metric_flagged(self, tmp_path):
+        f = self._models_file(
+            tmp_path,
+            'M = REGISTRY.counter("tpu_serve_bogus_total")\n',
+        )
+        findings = lint.check_metric_docs(
+            [f], arch_text="`tpu_serve_bogus_total` documented here"
+        )
+        assert [x.check for x in findings] == ["metric-docs"]
+        assert "help text" in findings[0].message
+
+    def test_documented_metric_with_help_clean(self, tmp_path):
+        f = self._models_file(
+            tmp_path,
+            'M = REGISTRY.histogram("tpu_serve_bogus_seconds", "latency")\n'
+            'M2 = REGISTRY.histogram("tpu_serve_bogus_seconds")  # lookup\n',
+        )
+        assert lint.check_metric_docs(
+            [f], arch_text="| `tpu_serve_bogus_seconds` | histogram | latency |"
+        ) == []
+
+    def test_non_models_and_non_serving_names_exempt(self, tmp_path):
+        # outside models/: not part of the serving contract
+        outside = tmp_path / "other.py"
+        outside.write_text('M = REGISTRY.counter("tpu_serve_bogus_total")\n')
+        # inside models/ but not tpu_serve_*: control-plane namespace
+        inside = self._models_file(
+            tmp_path, 'M = REGISTRY.counter("dra_other_total")\n'
+        )
+        assert lint.check_metric_docs([outside, inside], arch_text="") == []
+
+    def test_repo_serving_metrics_are_documented(self):
+        models = sorted((REPO / "k8s_dra_driver_tpu" / "models").glob("*.py"))
+        arch = (REPO / "ARCHITECTURE.md").read_text()
+        assert lint.check_metric_docs(models, arch) == []
+
+
 class TestMain:
     def test_missing_target_fails_loudly(self, capsys):
         rc = lint.main(["lint", "no/such/dir"])

@@ -34,6 +34,15 @@ def test_pipelined_decode_stays_within_perf_budgets():
     assert stats["host_syncs"] < stats["generated_tokens"] / 4
 
 
+def test_telemetry_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_telemetry_overhead()
+    assert stats["requests"] == 8
+    # The telemetry layer's hard invariant: lifecycle timing piggybacks on
+    # burst-boundary readbacks the engine already pays for — the
+    # instrumented pump syncs EXACTLY as often as its telemetry-off twin.
+    assert stats["host_syncs_on"] == stats["host_syncs_off"]
+
+
 def test_shed_fastpath_stays_within_perf_budgets():
     stats = perf_smoke.check_shed_fastpath()
     assert stats["served"] == 3 and stats["sheds"] == 5

@@ -1,0 +1,494 @@
+"""Request-lifecycle telemetry (models/telemetry.py).
+
+Four layers of the PR's contract:
+
+* SLO math against a FAKE clock: queue-wait / TTFT / TPOT / e2e derive
+  exactly from the timeline anchors, bursts amortize K tokens per
+  timestamp pair, mid-burst retirees flush before their status stamps,
+  and migration merges two engines' halves into one contiguous timeline;
+* real-engine integration across {dense, paged} x {greedy, spec, LoRA}
+  plus the failure statuses (shed, deadline, quarantine): every pumped
+  request's trace is complete, its journal correlation resolves, and the
+  SLO histograms populate under the right ``status=`` label;
+* the /debug/serve contract: per-engine EngineStats + by-request-id
+  timeline over live HTTP, and the wedge bundle embedding;
+* scrape hygiene: the telemetry metrics pass the lint checks, and the
+  Prometheus text round-trip (render -> parse) is exact — including the
+  single ``le="+Inf"`` line and float-sum precision.
+"""
+
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, lora, paged
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+from k8s_dra_driver_tpu.models.telemetry import EngineTelemetry, debug_serve_doc
+from k8s_dra_driver_tpu.utils.faults import FaultInjector
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, Histogram, parse_prom_text
+
+REPO = Path(__file__).parent.parent
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+LORA = lora.LoraConfig(rank=2, alpha=4.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def bank(params):
+    ads = [lora.init_adapters(jax.random.PRNGKey(s), CFG, LORA) for s in (1, 2)]
+    return lora.stack_adapters(CFG, LORA, ads)
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 33)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _scrape():
+    return parse_prom_text(REGISTRY.render())
+
+
+def _status_key(status):
+    return (("status", status),)
+
+
+# ---------------------------------------------------------------------------
+# fake-clock unit layer: no jax, no engine — pure timeline math
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _HostA:
+    """Weakref-able engine stand-in (the real engines are dataclasses with
+    __eq__, which is why telemetry holds THEM by weakref, not a set)."""
+
+    n_slots = 4
+    sync_interval = 8
+    host_syncs = 0
+
+    def free_slots(self):
+        return 4
+
+
+class _HostB(_HostA):
+    pass
+
+
+class TestFakeClockSloMath:
+    def test_ok_timeline_derives_every_slo(self):
+        clk = FakeClock(100.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        clk.t = 100.5
+        tel.on_admit(7, prompt_len=3, max_tokens=9,
+                     submitted_at=tel.now(), queued_at=100.0)
+        clk.t = 101.0
+        tel.burst_begin(4, step_no=1)
+        tel.on_commit(7, 4)
+        clk.t = 101.8
+        tel.burst_end(occupancy=2)
+        clk.t = 102.5
+        tel.on_retire(7, "ok", 5)
+
+        tr = tel.trace(7)
+        assert tr["status"] == "ok" and tr["generated"] == 5
+        assert tr["queue_wait_s"] == pytest.approx(0.5)
+        assert tr["ttft_s"] == pytest.approx(0.5)      # arrival -> activation
+        assert tr["e2e_s"] == pytest.approx(2.5)
+        # 4 burst tokens over retired-first_token: (102.5-100.5)/(5-1)
+        assert tr["tpot_s"] == pytest.approx(0.5)
+        # the burst record carries the amortized pair, not per-token stamps
+        (burst,) = tr["bursts"]
+        assert burst["tokens"] == 4 and burst["t0"] == 101.0 and burst["t1"] == 101.8
+
+        doc = _scrape()
+        ok = _status_key("ok")
+        assert doc["tpu_serve_ttft_seconds_count"][ok] == 1
+        assert doc["tpu_serve_ttft_seconds_sum"][ok] == pytest.approx(0.5)
+        assert doc["tpu_serve_queue_wait_seconds_sum"][ok] == pytest.approx(0.5)
+        assert doc["tpu_serve_e2e_seconds_sum"][ok] == pytest.approx(2.5)
+        assert doc["tpu_serve_tpot_seconds_sum"][ok] == pytest.approx(0.5)
+        assert doc["tpu_serve_burst_committed_tokens_count"][()] == 1
+        assert doc["tpu_serve_batch_occupancy"][()] == 2
+
+    def test_direct_submit_has_zero_queue_wait(self):
+        clk = FakeClock(5.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        tel.on_admit(1, prompt_len=2, max_tokens=4, submitted_at=5.0)
+        clk.t = 6.0
+        tel.on_retire(1, "ok", 1)
+        tr = tel.trace(1)
+        assert tr["queued_at"] == tr["submitted_at"] == 5.0
+        assert tr["queue_wait_s"] == 0.0
+
+    def test_chunked_admission_stamps_ttft_at_final_chunk(self):
+        clk = FakeClock(10.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        tel.on_admit(1, prompt_len=32, max_tokens=4, submitted_at=10.0,
+                     queued_at=9.0, activated=False)
+        clk.t = 10.2
+        tel.on_admission_chunk(1)
+        clk.t = 10.4
+        tel.on_admission_chunk(1)
+        clk.t = 10.6
+        tel.on_activate(1)
+        tr = tel.trace(1)
+        assert tr["admission_chunks"] == 2
+        assert tr["admitted_at"] == tr["first_token_at"] == 10.6
+        assert tr["ttft_s"] == pytest.approx(1.6)
+        assert tr["generated"] == 1  # activation committed the first token
+
+    def test_single_token_request_has_no_tpot(self):
+        clk = FakeClock(0.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        tel.on_admit(1, prompt_len=2, max_tokens=1, submitted_at=0.0)
+        clk.t = 1.0
+        tel.on_retire(1, "ok", 1)
+        assert tel.trace(1)["tpot_s"] is None
+        # nothing observed into the TPOT histogram at all
+        assert "tpu_serve_tpot_seconds_count" not in _scrape()
+
+    def test_shed_observes_queue_wait_under_shed_status(self):
+        clk = FakeClock(50.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        clk.t = 51.5
+        tel.on_shed(queued_at=50.0)
+        doc = _scrape()
+        assert doc["tpu_serve_queue_wait_seconds_sum"][_status_key("shed")] == (
+            pytest.approx(1.5)
+        )
+        assert tel.stats().statuses == {"shed": 1}
+
+    def test_mid_burst_retiree_flushes_before_status(self):
+        clk = FakeClock(0.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        tel.on_admit(3, prompt_len=2, max_tokens=8, submitted_at=0.0)
+        clk.t = 1.0
+        tel.burst_begin(8)
+        tel.on_commit(3, 2)
+        clk.t = 1.5
+        tel.on_retire(3, "deadline_exceeded", 3)
+        tr = tel.trace(3)
+        assert tr["status"] == "deadline_exceeded" and tr["generated"] == 3
+        assert len(tr["bursts"]) == 1 and tr["bursts"][0]["tokens"] == 2
+        # the replay at burst close must not re-attribute the flushed rid
+        clk.t = 2.0
+        tel.burst_end(occupancy=0)
+        tr = tel.trace(3)
+        assert tr["generated"] == 3 and len(tr["bursts"]) == 1
+
+    def test_disabled_telemetry_is_inert(self):
+        tel = EngineTelemetry(_HostA(), enabled=False, clock=FakeClock())
+        assert tel.now() is None
+        tel.on_admit(1, prompt_len=2, max_tokens=4)
+        tel.burst_begin(4)
+        tel.on_commit(1, 4)
+        tel.burst_end(1)
+        tel.on_retire(1, "ok", 5)
+        assert tel.trace(1) is None
+        assert "tpu_serve_ttft_seconds_count" not in _scrape()
+
+    def test_migration_merges_one_contiguous_timeline(self):
+        clk = FakeClock(5.0)
+        tel_a = EngineTelemetry(_HostA(), clock=clk)
+        tel_a.on_admit(2, prompt_len=2, max_tokens=8,
+                       submitted_at=5.0, queued_at=4.0)
+        clk.t = 6.0
+        tel_a.burst_begin(4)
+        tel_a.on_commit(2, 4)
+        clk.t = 6.5
+        tel_a.burst_end(1)
+
+        # the trace rides the drain snapshot as plain JSON
+        doc = json.loads(json.dumps(tel_a.export_trace(2)))
+        tel_b = EngineTelemetry(_HostB(), clock=clk)
+        clk.t = 7.0
+        tel_b.import_trace(2, doc)
+        tel_b.on_restore(2, resumed_at=7)
+        clk.t = 8.0
+        tel_b.on_retire(2, "ok", 0)  # 0: keep the accumulated count
+
+        tr = tel_b.trace(2)
+        assert tr["migrations"] == 1
+        assert tr["engines"] == ["_HostA", "_HostB"]
+        # original anchors survive the hop: TTFT/e2e span BOTH engines
+        assert tr["queued_at"] == 4.0 and tr["submitted_at"] == 5.0
+        assert tr["ttft_s"] == pytest.approx(1.0)
+        assert tr["e2e_s"] == pytest.approx(4.0)
+        assert tr["generated"] == 5
+        names = [e["event"] for e in tr["events"]]
+        assert "migrate_in" in names and "restore" in names
+
+
+# ---------------------------------------------------------------------------
+# real-engine integration
+# ---------------------------------------------------------------------------
+
+FEATURES = {
+    "greedy": dict(kw={}),
+    "spec": dict(kw=dict(spec_gamma=2)),
+    "lora": dict(kw="bank"),
+}
+REQS = [
+    {"prompt": [5, 6, 7], "max_tokens": 8},
+    {"prompt": [9, 1], "max_tokens": 8},
+]
+
+
+def _engine(params, bank, kind, feature, **extra):
+    kw = FEATURES[feature]["kw"]
+    kw = dict(adapter_bank=bank) if kw == "bank" else dict(kw)
+    kw.update(extra)
+    return _dense(params, **kw) if kind == "dense" else _paged(params, **kw)
+
+
+class TestEngineTimelines:
+    @pytest.mark.parametrize("feature", sorted(FEATURES))
+    @pytest.mark.parametrize("kind", ["dense", "paged"])
+    def test_pumped_timeline_is_complete(self, params, bank, kind, feature):
+        eng = _engine(params, bank, kind, feature, sync_interval=4)
+        reqs = [dict(r) for r in REQS]
+        if feature == "lora":
+            for i, r in enumerate(reqs):
+                r["adapter"] = i + 1
+        done = eng.pump(reqs)
+        assert len(done) == len(reqs)
+        for c in done:
+            tr = eng.telemetry.trace(c.request_id)
+            assert tr is not None and tr["status"] == "ok"
+            # anchors exist and are ordered; first token == activation
+            assert (tr["queued_at"] <= tr["submitted_at"]
+                    <= tr["admitted_at"] <= tr["retired_at"])
+            assert tr["first_token_at"] == tr["admitted_at"]
+            assert tr["generated"] == len(c.generated)
+            # K tokens per timestamp pair: every generated token after the
+            # first is attributed to exactly one burst record
+            assert sum(b["tokens"] for b in tr["bursts"]) == tr["generated"] - 1
+            assert tr["ttft_s"] >= 0 and tr["e2e_s"] >= tr["ttft_s"]
+            assert tr["tpot_s"] is not None  # >= 2 tokens generated
+            # the journal correlation resolves the same retirement
+            events = JOURNAL.tail(correlation=f"req-{c.request_id}")
+            assert any(e["event"] == "request.timeline" for e in events)
+
+        doc = _scrape()
+        assert doc["tpu_serve_ttft_seconds_count"][_status_key("ok")] == len(done)
+        stats = eng.stats()
+        assert stats.completed == len(done) and stats.in_flight == 0
+        assert stats.statuses == {"ok": len(done)}
+        assert stats.bursts > 0 and stats.tokens_generated > 0
+        assert stats.ttft_p50_s >= 0 and stats.tpot_p50_s > 0
+
+    def test_shed_and_deadline_statuses(self, params):
+        eng = _dense(params, n_slots=1)
+        done = eng.pump(
+            [
+                {"prompt": [1, 2, 3], "max_tokens": 10, "deadline": 2},
+                {"prompt": [4, 5], "max_tokens": 4},
+                {"prompt": [6, 7], "max_tokens": 4},
+            ],
+            queue_limit=0,
+        )
+        by_status = {}
+        for c in done:
+            by_status.setdefault(c.status, []).append(c)
+        assert len(by_status["deadline_exceeded"]) == 1
+        assert len(by_status["shed"]) == 2
+        dl = by_status["deadline_exceeded"][0]
+        tr = eng.telemetry.trace(dl.request_id)
+        assert tr["status"] == "deadline_exceeded"
+        doc = _scrape()
+        assert doc["tpu_serve_ttft_seconds_count"][
+            _status_key("deadline_exceeded")] == 1
+        assert doc["tpu_serve_queue_wait_seconds_count"][_status_key("shed")] == 2
+        stats = eng.stats()
+        assert stats.statuses["deadline_exceeded"] == 1
+        assert stats.statuses["shed"] == 2
+
+    def test_quarantine_status_reaches_histograms(self, params, bank):
+        eng = _paged(
+            params,
+            adapter_bank=bank,
+            fault_injector=FaultInjector.from_env(
+                "nan_logits_rate=1.0,slots=0,steps=2"
+            ),
+        )
+        done = eng.pump([
+            {"prompt": [5, 6, 7], "max_tokens": 8, "adapter": 1},
+            {"prompt": [9, 1], "max_tokens": 8, "adapter": 2},
+        ])
+        quarantined = [c for c in done if c.status == "quarantined"]
+        assert quarantined
+        for c in quarantined:
+            tr = eng.telemetry.trace(c.request_id)
+            assert tr["status"] == "quarantined"
+        doc = _scrape()
+        assert doc["tpu_serve_e2e_seconds_count"][
+            _status_key("quarantined")] == len(quarantined)
+        assert eng.stats().statuses["quarantined"] == len(quarantined)
+
+    def test_cross_engine_restore_keeps_one_timeline(self, params):
+        src = _paged(params, sync_interval=2)
+        for r in REQS:
+            src.submit(**dict(r))
+        src.step()
+        snap = json.loads(json.dumps(src.snapshot_active()))
+        dst = _dense(params)
+        rids = sorted(dst.restore(snap))
+        assert rids == [0, 1]
+        dst.run_until_drained()
+        for rid in rids:
+            tr = dst.telemetry.trace(rid)
+            assert tr["status"] == "ok"
+            assert tr["migrations"] == 1
+            assert tr["engines"] == ["PagedServeEngine", "ServeEngine"]
+            assert any(e["event"] == "migrate_in" for e in tr["events"])
+            # the pre-migration anchors and bursts survived: one timeline
+            assert tr["queued_at"] is not None and tr["admitted_at"] is not None
+            assert tr["retired_at"] >= tr["admitted_at"]
+            assert tr["generated"] >= 2 and tr["bursts"]
+            # by-id lookup resolves to the request's NEW home
+            doc = debug_serve_doc(request_id=rid)
+            assert doc["engine"] == "ServeEngine"
+            assert doc["trace"]["migrations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the /debug/serve contract
+# ---------------------------------------------------------------------------
+
+class TestDebugServe:
+    def test_http_endpoint_serves_stats_and_timeline(self, params):
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        eng = _dense(params)
+        done = eng.pump([([1, 2, 3], 4)])
+        rid = done[0].request_id
+        srv = DiagnosticsServer(port=0, bind_host="127.0.0.1")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            doc = json.loads(urllib.request.urlopen(f"{base}/debug/serve").read())
+            ours = [e for e in doc["engines"]
+                    if e["engine_seq"] == eng.telemetry.engine_seq]
+            assert ours and ours[0]["completed"] == 1
+            assert ours[0]["statuses"] == {"ok": 1}
+            assert any(
+                s["request_id"] == rid and s["status"] == "ok"
+                for s in doc["recent_traces"]
+            )
+            one = json.loads(urllib.request.urlopen(
+                f"{base}/debug/serve?request_id={rid}").read())
+            tr = one["trace"]
+            assert tr["status"] == "ok"
+            assert tr["retired_at"] >= tr["admitted_at"]
+        finally:
+            srv.stop()
+
+    def test_wedge_bundle_embeds_stats_and_traces(
+        self, params, tmp_path, monkeypatch
+    ):
+        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
+        monkeypatch.setattr(WATCHDOG, "_bundle_dir", str(tmp_path))
+        eng = _dense(params, sync_interval=4)
+        eng.submit([1, 2, 3], max_tokens=60)
+        with pytest.raises(RuntimeError, match="diag bundle"):
+            eng.run_until_drained(max_steps=2)
+        bundles = sorted(
+            p for p in tmp_path.glob("*.json") if "drain-snapshot" not in p.name
+        )
+        state = json.loads(bundles[-1].read_text())["state"]
+        assert state["engine_stats"]["engine"] == "ServeEngine"
+        assert state["engine_stats"]["in_flight"] == 1
+        assert state["recent_traces"], "wedged request's trace missing"
+        assert state["recent_traces"][0]["status"] == "in-flight"
+
+
+# ---------------------------------------------------------------------------
+# scrape hygiene & the text-format round-trip
+# ---------------------------------------------------------------------------
+
+class TestScrapeHygiene:
+    def _lint(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            import lint
+        finally:
+            sys.path.pop(0)
+        return lint
+
+    def test_telemetry_metrics_pass_lint(self):
+        lint = self._lint()
+        path = REPO / "k8s_dra_driver_tpu" / "models" / "telemetry.py"
+        assert lint.check_file(path) == []
+
+    def test_metric_docs_contract_holds(self):
+        lint = self._lint()
+        models = sorted((REPO / "k8s_dra_driver_tpu" / "models").glob("*.py"))
+        arch = (REPO / "ARCHITECTURE.md").read_text()
+        assert lint.check_metric_docs(models, arch) == []
+
+    def test_explicit_inf_bucket_renders_one_inf_line(self):
+        h = Histogram("rt_seconds", "roundtrip", buckets=(0.1, 1, float("inf")))
+        h.observe(0.05, status="ok")
+        h.observe(9.0, status="ok")
+        text = "\n".join(h.render()) + "\n"
+        assert text.count('le="+Inf"') == 1
+        assert 'le="inf"' not in text
+        # finite-bound rendering unchanged: int bound 1 stays le="1"
+        assert 'le="1"' in text and 'le="1.0"' not in text
+
+    def test_render_parse_roundtrip_is_exact(self):
+        h = Histogram("rt_seconds", "roundtrip",
+                      buckets=(0.005, 0.1, 1, float("inf")))
+        values = (0.1 + 0.2, 1e-9, 3.5)  # 0.30000000000000004: repr territory
+        for v in values:
+            h.observe(v, status="ok")
+        doc = parse_prom_text("\n".join(h.render()) + "\n")
+        ok = _status_key("ok")
+        total = 0.0
+        for v in values:
+            total += v
+        assert doc["rt_seconds_sum"][ok] == total  # exact, not approx
+        assert doc["rt_seconds_count"][ok] == 3
+        assert doc["rt_seconds_bucket"][
+            tuple(sorted((("status", "ok"), ("le", "+Inf"))))] == 3
+
+    def test_registry_scrape_roundtrip_after_real_traffic(self, params):
+        eng = _dense(params)
+        eng.pump([([1, 2, 3], 6)])
+        text = REGISTRY.render()
+        doc = parse_prom_text(text)
+        # every _count in the scrape re-parses to the value the histogram
+        # reports through its API — the two views cannot drift
+        ttft = REGISTRY.histogram("tpu_serve_ttft_seconds")
+        assert doc["tpu_serve_ttft_seconds_count"][_status_key("ok")] == (
+            ttft.count(status="ok")
+        )

@@ -13,6 +13,9 @@ stall — one artifact to attach to a bug report either way:
   /debug/traces   the tracer ring's recent spans
   /debug/journal  the claim-lifecycle flight recorder's tail
   /debug/stacks   every Python thread's stack
+  /debug/serve    per-engine EngineStats + recent request traces (the
+                  serving load-signal contract; empty when the process
+                  hosts no serving engine)
 
 Per-endpoint failures are recorded in the bundle as ``"error: ..."``
 strings rather than aborting: a half-wedged process is EXACTLY the one
@@ -44,6 +47,7 @@ ENDPOINTS = {
     "traces": "/debug/traces",
     "journal": "/debug/journal?limit=500",
     "thread_stacks": "/debug/stacks",
+    "serve": "/debug/serve?limit=16",
 }
 
 TEXT_SECTIONS = {"healthz", "metrics"}  # not JSON on the wire

@@ -28,6 +28,12 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
                      exists to remove.  Only models/serve.py and
                      models/paged.py (the two engines, where the batched
                      readback lives) are exempt
+  metric-docs        cross-file: every `tpu_serve_*` metric declared in
+                     models/ must carry non-empty help text at some
+                     declaring site AND appear in ARCHITECTURE.md's
+                     metric inventory — the serving metrics are the
+                     fleet load-signal contract, and an undocumented
+                     signal is one routers can't rely on
 
 Suppress a line with ``# lint: ignore[<check>]`` or a whole file with
 ``# lint: skip-file`` in its first five lines.
@@ -316,6 +322,62 @@ def check_file(path: Path) -> list[Finding]:
     return findings
 
 
+def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
+    """Cross-file check: every ``tpu_serve_*`` metric declared in models/
+    must (a) carry non-empty help text at at least one declaring site and
+    (b) appear in ARCHITECTURE.md (the metric inventory / telemetry
+    section).  Pure over its inputs so tests can drive it with synthetic
+    trees and doc text."""
+    # metric name -> list of (path, line, has_help)
+    sites: dict[str, list[tuple[Path, int, bool]]] = {}
+    for path in paths:
+        norm = str(path).replace("\\", "/")
+        if "/models/" not in norm and not norm.startswith("models/"):
+            continue
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except (SyntaxError, OSError):
+            continue  # check_file already reports syntax findings
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_KINDS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("tpu_serve_")
+            ):
+                continue
+            help_node = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "help"), None
+            )
+            has_help = (
+                isinstance(help_node, ast.Constant)
+                and isinstance(help_node.value, str)
+                and bool(help_node.value.strip())
+            )
+            sites.setdefault(node.args[0].value, []).append(
+                (path, node.lineno, has_help)
+            )
+
+    findings: list[Finding] = []
+    for name in sorted(sites):
+        decls = sites[name]
+        first_path, first_line, _ = decls[0]
+        if not any(has_help for _, _, has_help in decls):
+            findings.append(Finding(
+                first_path, first_line, "metric-docs",
+                f"serving metric {name!r} has no declaring site with help text",
+            ))
+        if name not in arch_text:
+            findings.append(Finding(
+                first_path, first_line, "metric-docs",
+                f"serving metric {name!r} is not documented in ARCHITECTURE.md",
+            ))
+    return findings
+
+
 def main(argv: list[str]) -> int:
     targets: list[Path] = []
     for arg in argv[1:] or ["k8s_dra_driver_tpu", "tests"]:
@@ -332,6 +394,9 @@ def main(argv: list[str]) -> int:
     all_findings: list[Finding] = []
     for t in targets:
         all_findings.extend(check_file(t))
+    arch = Path(__file__).resolve().parent.parent / "ARCHITECTURE.md"
+    arch_text = arch.read_text() if arch.is_file() else ""
+    all_findings.extend(check_metric_docs(targets, arch_text))
     for f in all_findings:
         print(f)
     print(
