@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve demo dryrun lint perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet demo dryrun lint perf-smoke helm-template clean
 
 all: native
 
@@ -39,6 +39,13 @@ chaos:
 # drain/snapshot/restore — the SLO layer under injected engine faults.
 chaos-serve:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serve_chaos.py -q
+
+# Fleet chaos suite (<15s, CPU, seeded): replica crash/wedge/stale-stats
+# faults against a 3-replica FleetRouter — health-gated routing,
+# live-migration evacuation with bit-equal stream continuation, and
+# fleet-level admission/shedding.
+chaos-fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

@@ -1815,7 +1815,7 @@ class PagedServeEngine:
             "requests": reqs,
         }
 
-    def restore(self, snapshot: dict) -> list[int]:
+    def restore(self, snapshot: dict, merge: bool = False) -> list[int]:
         """Rebuild a drained batch in THIS (fresh, idle) engine with
         bit-equal continuation.  Every snapshot entry parks on the
         re-admission queue and drains through :meth:`_readmit` — the SAME
@@ -1827,11 +1827,21 @@ class PagedServeEngine:
         instead of failing.  Histories grown past ``prompt_bucket`` cannot
         re-prefill in one pass and are delivered as errored Completions
         (the preemption resumability boundary).  Returns the request ids
-        accepted for restoration (parked or resident)."""
+        accepted for restoration (parked or resident).
+
+        ``merge=True`` restores INTO a live engine (the fleet router's
+        evacuation target): entries join the re-admission queue behind
+        whatever is already parked and drain as the pool frees, while
+        resident streams keep decoding untouched — readmission is the
+        preemption-resume path, already proven bit-exact on a busy
+        pool."""
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
-        if (self.n_slots - self.free_slots()) or self._admitting or self._preempted:
+        serve.check_restorable(snapshot)
+        if not merge and (
+            (self.n_slots - self.free_slots()) or self._admitting or self._preempted
+        ):
             raise RuntimeError("restore() needs an idle engine")
         restored: list[int] = []
         for req in sorted(snapshot["requests"], key=lambda r: r["request_id"]):
@@ -1876,6 +1886,44 @@ class PagedServeEngine:
         self._readmit()  # admit what fits now; the rest drains via step()
         self._update_gauges()
         return restored
+
+    def release_active(self) -> int:
+        """Migration tail: free every resident slot, refund its pool
+        blocks, and drop parked/mid-admission entries WITHOUT delivering
+        completions — the streams were just captured by
+        ``snapshot_active()`` and now live in another engine, so retiring
+        them here would double-deliver every request (and the dead
+        replica's block accounting must still balance for leak audits).
+        Returns the number of requests released."""
+        released = 0
+        self._admitting = []
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            self._slots[slot] = None
+            self._alloc_for(slot).free(self._owned[slot])
+            self._owned[slot] = []
+            self._table_np[slot, :] = 0  # NULL_BLOCK scratch sink
+            self.telemetry.drop_trace(st.request_id)
+            JOURNAL.record(
+                "serve", "request.released",
+                correlation=f"req-{st.request_id}", slot=slot,
+                generated=len(st.tokens) - st.prompt_len,
+            )
+            released += 1
+        self._upload_table()
+        for r in self._preempted:  # parked entries hold no blocks
+            st = r["st"]
+            self.telemetry.drop_trace(st.request_id)
+            JOURNAL.record(
+                "serve", "request.released",
+                correlation=f"req-{st.request_id}", slot=-1,
+                generated=len(st.tokens) - st.prompt_len,
+            )
+            released += 1
+        self._preempted = []
+        self._update_gauges()
+        return released
 
     # -- internals ---------------------------------------------------------
     def _burst_fn(self, k: int):

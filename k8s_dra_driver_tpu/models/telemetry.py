@@ -253,6 +253,11 @@ class EngineStats:
     last_step_s: float
     sync_interval: int
     uptime_s: float
+    # seconds since this engine last made observable progress (admission
+    # or a burst replay) — the watchdog-heartbeat half of the fleet
+    # router's health verdict: a large age with resident slots means the
+    # engine is wedged, not idle.
+    heartbeat_age_s: float
     ttft_p50_s: float
     ttft_p90_s: float
     ttft_p99_s: float
@@ -283,6 +288,7 @@ class EngineTelemetry:
         self._engine_ref = weakref.ref(engine)
         self._engine_kind = type(engine).__name__
         self._created_at = clock()
+        self._last_beat = self._created_at
         self._traces: dict[int, RequestTrace] = {}
         self._done: deque[int] = deque()
         self._statuses: dict[str, int] = {}
@@ -321,6 +327,7 @@ class EngineTelemetry:
         if not self.enabled:
             return
         now = self.clock()
+        self._last_beat = now
         tr = self._traces.get(request_id)
         if tr is None:
             tr = RequestTrace(request_id)
@@ -388,6 +395,7 @@ class EngineTelemetry:
         if not self.enabled:
             return
         t1 = self.clock()
+        self._last_beat = t1
         total = 0
         for rid, n in self._burst_commits.items():
             total += n
@@ -432,6 +440,7 @@ class EngineTelemetry:
             return
         self._flush_pending(request_id)
         now = self.clock()
+        self._last_beat = now
         tr = self._traces.get(request_id)
         if tr is None:
             # e.g. an unrestorable snapshot entry from an engine that ran
@@ -527,6 +536,16 @@ class EngineTelemetry:
             tr.engines.append(self._engine_kind)
         self._traces[request_id] = tr
 
+    def drop_trace(self, request_id: int) -> None:
+        """Forget a request that migrated AWAY from this engine (the
+        router's release-after-evacuation path): no terminal status, no
+        SLO observation — the trace lives on in the target engine, and a
+        retirement here would double-count the request fleet-wide."""
+        if not self.enabled:
+            return
+        self._traces.pop(request_id, None)
+        self._burst_commits.pop(request_id, None)
+
     def on_restore(self, request_id: int, resumed_at: int) -> None:
         if not self.enabled:
             return
@@ -587,6 +606,7 @@ class EngineTelemetry:
             last_step_s=float(attr("_last_step_s", 0.0)),
             sync_interval=int(attr("sync_interval", 1)),
             uptime_s=self.clock() - self._created_at,
+            heartbeat_age_s=self.clock() - self._last_beat,
             ttft_p50_s=_quantile(list(self._ttft), 0.5),
             ttft_p90_s=_quantile(list(self._ttft), 0.9),
             ttft_p99_s=_quantile(list(self._ttft), 0.99),

@@ -20,6 +20,11 @@ Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
   filters: ``?request_id=N`` (full timeline for one correlation id) and
   ``?limit=N`` (recent-trace ring depth).  This is the fleet
   load-signal contract: a router scrapes it to weigh replicas.
+* ``/debug/fleet``    — every live :class:`~k8s_dra_driver_tpu.models.
+  fleet.FleetRouter`'s view: per-replica health state (healthy/suspect/
+  evacuating/drained), breaker state, last verdict and cached
+  ``EngineStats``, plus the fleet front-door queue depth and parked
+  evacuees (JSON).
 """
 
 from __future__ import annotations
@@ -101,6 +106,16 @@ class DiagnosticsServer:
                         limit = 8
                     doc = debug_serve_doc(request_id=rid, trace_limit=limit)
                     body = json.dumps(doc, indent=1, default=str).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/fleet":
+                    # Lazy for the same reason as /debug/serve; fleet.py
+                    # itself never imports jax, so this stays cheap even
+                    # in control-plane binaries.
+                    from k8s_dra_driver_tpu.models.fleet import debug_fleet_doc
+
+                    body = json.dumps(
+                        debug_fleet_doc(), indent=1, default=str
+                    ).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -55,6 +55,20 @@ class StepFault(RuntimeError):
         self.slot = slot
 
 
+class ReplicaCrash(RuntimeError):
+    """Injected whole-replica death attributable to ONE fleet replica —
+    the fault shape the fleet router's evacuation path must heal (trip
+    the replica's breaker, snapshot its batch, restore onto survivors).
+    Raised by :meth:`FaultInjector.maybe_crash_replica` BEFORE the
+    replica's burst dispatches, so the replica's host-side state is
+    still consistent when the router snapshots it — the same
+    pre-mutation discipline as :class:`StepFault`."""
+
+    def __init__(self, replica: int, message: str):
+        super().__init__(message)
+        self.replica = replica
+
+
 @dataclass
 class FaultProfile:
     """One armed fault source.  Rates are probabilities per matching
@@ -86,6 +100,14 @@ class FaultProfile:
     step_latency_s: float = 0.0  # added to every matching engine step
     slots: tuple = ()  # e.g. (1, 3); empty = all slots
     steps: tuple = ()  # e.g. (5,); empty = all engine steps
+    # replica-scoped (fleet router) kinds: consulted by the FleetRouter
+    # once per (replica, tick) ahead of driving that replica's burst —
+    # before any engine state mutates, so evacuation replay stays safe.
+    # They scope by ``replicas``/``steps`` (steps = router ticks).
+    replica_crash_rate: float = 0.0  # probability a replica dies (ReplicaCrash)
+    replica_wedge_rate: float = 0.0  # probability a replica hangs this tick
+    stats_stale_rate: float = 0.0  # probability stats() serves a frozen copy
+    replicas: tuple = ()  # e.g. (1,); empty = all replicas
     limit: int = 0  # total-injection cap, 0 = unlimited
     injected: int = field(default=0, compare=False)
 
@@ -203,6 +225,50 @@ class FaultInjector:
                     f"(slot {slot}, step {step})",
                 )
 
+    # -- replica decision points (fleet router) ----------------------------
+
+    def maybe_crash_replica(self, replica: int, tick: int) -> None:
+        """Router hook: raise a :class:`ReplicaCrash` attributable to
+        ``replica`` for this router tick.  Called BEFORE the replica's
+        burst dispatches — its engine state is consistent when the crash
+        fires, so the router can snapshot and evacuate it."""
+        for p in self._matching_replica(replica, tick):
+            if p.replica_crash_rate and self._roll(
+                p, p.replica_crash_rate, "replica_crash",
+                f"replica-{replica}", f"tick-{tick}",
+            ):
+                raise ReplicaCrash(
+                    replica,
+                    f"fault injected by profile {p.name!r} "
+                    f"(replica {replica}, tick {tick})",
+                )
+
+    def take_replica_wedge(self, replica: int, tick: int) -> bool:
+        """Router hook: should this replica hang (skip its burst) this
+        tick?  A wedged replica makes no progress while holding resident
+        streams — the health detector must notice and evacuate."""
+        for p in self._matching_replica(replica, tick):
+            if p.replica_wedge_rate and self._roll(
+                p, p.replica_wedge_rate, "replica_wedge",
+                f"replica-{replica}", f"tick-{tick}",
+            ):
+                return True
+        return False
+
+    def take_stats_stale(self, replica: int, tick: int) -> bool:
+        """Router hook: should this replica's ``stats()`` read be served
+        from the router's stale cache instead of the live engine?  A
+        frozen load signal must gate the replica (the router cannot
+        confirm health), not keep attracting traffic on rosy old
+        numbers."""
+        for p in self._matching_replica(replica, tick):
+            if p.stats_stale_rate and self._roll(
+                p, p.stats_stale_rate, "stats_stale",
+                f"replica-{replica}", f"tick-{tick}",
+            ):
+                return True
+        return False
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict[str, int]:
@@ -234,6 +300,18 @@ class FaultInjector:
                 for p in self._profiles
                 if (slot is None or not p.slots or slot in p.slots)
                 and (step is None or not p.steps or step in p.steps)
+            ]
+
+    def _matching_replica(self, replica: int, tick: int) -> list[FaultProfile]:
+        """Profiles matching a fleet (replica, tick) decision point — the
+        router twin of :meth:`_matching_engine` (``steps`` doubles as the
+        tick scope so one env spec drives both layers)."""
+        with self._lock:
+            return [
+                p
+                for p in self._profiles
+                if (not p.replicas or replica in p.replicas)
+                and (not p.steps or tick in p.steps)
             ]
 
     def _take_counted(self, kind: str, attr: str) -> bool:
@@ -290,7 +368,8 @@ class FaultInjector:
                 fields["step_latency_s"] = float(value) / 1000.0
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
                          "watch_hang_s", "nan_logits_rate", "step_raise_rate",
-                         "step_latency_s"):
+                         "step_latency_s", "replica_crash_rate",
+                         "replica_wedge_rate", "stats_stale_rate"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
                          "watch_hangs", "limit"):
@@ -299,7 +378,7 @@ class FaultInjector:
                 fields["verbs"] = tuple(value.split("+"))
             elif key == "kinds":
                 fields["kinds"] = tuple(value.split("+"))
-            elif key in ("slots", "steps"):
+            elif key in ("slots", "steps", "replicas"):
                 fields[key] = tuple(int(v) for v in value.split("+"))
             else:
                 raise ValueError(f"{ENV_VAR}: unknown fault key {key!r}")

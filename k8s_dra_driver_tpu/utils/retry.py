@@ -236,6 +236,19 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._transition(self.OPEN)
 
+    def trip(self) -> None:
+        """Force the breaker open immediately, bypassing the consecutive-
+        failure count.  For callers with DIRECT evidence the endpoint is
+        dead (the fleet router catching a replica crash) — counting to
+        ``failure_threshold`` would just route more traffic into the
+        corpse first."""
+        with self._lock:
+            self._failures = max(self._failures, self.failure_threshold)
+            self._probing = False
+            if self._state != self.OPEN:
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+
     def _transition(self, to: str) -> None:
         # called with the lock held
         self._state = to

@@ -1,0 +1,423 @@
+"""Fleet router unit suite: the Engine protocol conformance matrix and the
+router's three responsibilities exercised WITHOUT injected faults.
+
+* Engine protocol (models/fleet.py): both engine kinds satisfy it — not
+  just structurally (runtime_checkable only proves member presence) but
+  by signature (submit/restore/pump parameter surfaces), by Completion
+  status vocabulary (serve.TERMINAL_STATUSES), and by stats() field set
+  (telemetry.EngineStats) — so a replica kind cannot drift out of
+  interchangeability silently.
+* Health-gated routing: least-loaded placement, prefix/LoRA affinity
+  stickiness, suspect/breaker gating.
+* Live migration: planned drain() continues every stream bit-equally on
+  the surviving replica under ONE journal correlation, parks overflow,
+  and balances the source's accounting.
+* Fleet admission: bounded front-door queue with typed sheds carrying a
+  fleet-wide retry-after, and per-request admission deadline budgets.
+
+Fault-injected variants (crash/wedge/stale storms) live in
+tests/test_fleet_chaos.py (`make chaos-fleet`).
+"""
+
+import dataclasses
+import inspect
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, fleet, lora, paged, serve
+from k8s_dra_driver_tpu.models.fleet import (
+    DRAINED,
+    HEALTHY,
+    ID_STRIDE,
+    SUSPECT,
+    Engine,
+    FleetPolicy,
+    FleetRouter,
+    debug_fleet_doc,
+)
+from k8s_dra_driver_tpu.models.serve import Completion, ServeEngine, ShedError
+from k8s_dra_driver_tpu.models.telemetry import EngineStats
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 33)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+REQS = [
+    {"prompt": [7, 8, 9], "max_tokens": 6, "seed": 5},
+    {"prompt": [3, 4], "max_tokens": 6, "temperature": 0.7, "seed": 9},
+    {"prompt": [11, 12, 13, 14], "max_tokens": 6, "seed": 21},
+    {"prompt": [1, 2], "max_tokens": 5, "seed": 33},
+    {"prompt": [21, 22, 23], "max_tokens": 5, "seed": 44},
+]
+
+
+def _by_prompt(completions):
+    """prompt-tuple -> generated-tuple: replica-minted ids differ between a
+    fleet run and a single-engine reference, prompts don't."""
+    return {
+        tuple(c.tokens[: len(c.tokens) - len(c.generated)]): tuple(c.generated)
+        for c in completions
+        if c.status == "ok"
+    }
+
+
+class TestEngineProtocol:
+    """The conformance matrix: every replica kind against the formal
+    Engine contract."""
+
+    def test_both_engine_kinds_satisfy_protocol(self, params):
+        for eng in (_dense(params), _paged(params)):
+            assert isinstance(eng, Engine)
+
+    def test_plain_object_is_rejected_with_missing_members(self, params):
+        with pytest.raises(TypeError, match="Engine"):
+            FleetRouter([object()])
+
+    @pytest.mark.parametrize("make", [_dense, _paged], ids=["dense", "paged"])
+    def test_submit_signature_surface(self, params, make):
+        sig = inspect.signature(make(params).submit)
+        names = set(sig.parameters)
+        # The shared admission surface every router placement relies on.
+        assert {
+            "prompt", "max_tokens", "temperature", "seed", "adapter",
+            "deadline", "queued_at",
+        } <= names
+        # Everything beyond (prompt, max_tokens) must stay optional, so the
+        # router can route a minimal request to ANY replica kind.
+        for name, p in sig.parameters.items():
+            if name in ("prompt", "max_tokens"):
+                continue
+            assert p.default is not inspect.Parameter.empty, (
+                f"submit({name}=...) has no default: replica kinds are no "
+                f"longer interchangeable for minimal requests"
+            )
+
+    def test_paged_extends_dense_submit_surface(self, params):
+        dense_names = set(inspect.signature(_dense(params).submit).parameters)
+        paged_names = set(inspect.signature(_paged(params).submit).parameters)
+        assert dense_names <= paged_names
+        assert "priority" in paged_names - dense_names
+
+    @pytest.mark.parametrize("make", [_dense, _paged], ids=["dense", "paged"])
+    def test_restore_and_pump_signatures(self, params, make):
+        eng = make(params)
+        restore = inspect.signature(eng.restore)
+        assert restore.parameters["merge"].default is False
+        pump = inspect.signature(eng.pump)
+        assert pump.parameters["queue_limit"].default is None
+        assert pump.parameters["max_steps"].default == 100_000
+
+    def test_completion_status_vocabulary(self):
+        assert serve.TERMINAL_STATUSES == {
+            "ok", "deadline_exceeded", "cancelled", "quarantined", "shed",
+            "error",
+        }
+        assert Completion(request_id=0, tokens=[1], generated=[]).status == "ok"
+
+    @pytest.mark.parametrize("make", [_dense, _paged], ids=["dense", "paged"])
+    def test_stats_returns_engine_stats_contract(self, params, make):
+        st = make(params).stats()
+        assert isinstance(st, EngineStats)
+        fields = {f.name for f in dataclasses.fields(EngineStats)}
+        # The load-signal fields the router's health verdicts and placement
+        # scoring read; dropping one breaks fleets, not just dashboards.
+        assert {
+            "n_slots", "resident_slots", "admitting", "preempted",
+            "free_blocks", "quarantined", "bursts", "last_step_s",
+            "uptime_s", "heartbeat_age_s",
+        } <= fields
+        assert st.heartbeat_age_s >= 0.0
+
+
+class TestMembership:
+    def test_replicas_get_disjoint_id_ranges(self, params):
+        router = FleetRouter([_dense(params), _dense(params), _paged(params)])
+        for i, rep in enumerate(router.replicas):
+            assert rep.engine._next_id == i * ID_STRIDE
+        rids = [
+            router.submit([5 + i, 6 + i], max_tokens=2) for i in range(3)
+        ]
+        strides = {rid // ID_STRIDE for rid in rids}
+        assert len(rids) == len(set(rids))
+        assert len(strides) == 3  # least-loaded spread one per replica
+
+    def test_duplicate_replica_name_rejected(self, params):
+        router = FleetRouter([("a", _dense(params))])
+        with pytest.raises(ValueError, match="duplicate"):
+            router.add_replica(_dense(params), name="a")
+
+
+class TestRouting:
+    def test_least_loaded_spread(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        owners = [
+            router._owner[router.submit([9 + i, 1], max_tokens=2)].name
+            for i in range(4)
+        ]
+        # free-slot scoring alternates: r0 (tie, lowest index), then r1...
+        assert owners == ["r0", "r1", "r0", "r1"]
+
+    def test_prefix_affinity_beats_one_slot_imbalance(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        warm = list(range(1, 9))  # affinity_prefix-long prompt
+        rid = router.submit(warm, max_tokens=2)
+        assert router._owner[rid].name == "r0"
+        # r0 now one slot busier, so pure least-loaded would pick r1 —
+        # the warm prefix cache must out-score a single-slot imbalance.
+        rid2 = router.submit(list(warm), max_tokens=2)
+        assert router._owner[rid2].name == "r0"
+        # ...but a different prefix has no bonus and goes least-loaded.
+        rid3 = router.submit([31, 32], max_tokens=2)
+        assert router._owner[rid3].name == "r1"
+
+    def test_adapter_affinity_sticks(self, params):
+        cfg_lora = lora.LoraConfig(rank=2, alpha=4.0)
+        bank = lora.stack_adapters(CFG, cfg_lora, [
+            lora.init_adapters(jax.random.PRNGKey(s), CFG, cfg_lora)
+            for s in (1, 2)
+        ])
+        router = FleetRouter([_dense(params, adapter_bank=bank),
+                              _dense(params, adapter_bank=bank)])
+        rid = router.submit([5, 6], max_tokens=2, adapter=1)
+        home = router._owner[rid].name
+        rid2 = router.submit([41, 42], max_tokens=2, adapter=1)
+        assert router._owner[rid2].name == home
+
+    def test_affinity_history_is_bounded(self, params):
+        router = FleetRouter(
+            [_dense(params)], policy=FleetPolicy(max_affinity_entries=4)
+        )
+        for i in range(10):
+            router._remember(router._prefix_home, ("k", i), "r0")
+        assert len(router._prefix_home) == 4
+        assert ("k", 9) in router._prefix_home  # newest kept, oldest evicted
+
+    def test_suspect_replica_takes_no_admissions(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        router.replicas[0].state = SUSPECT
+        for i in range(3):
+            rid = router.submit([7 + i, 8], max_tokens=2)
+            assert router._owner[rid].name == "r1"
+
+    def test_open_breaker_gates_admission(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        router.replicas[0].breaker.trip()
+        rid = router.submit([7, 8], max_tokens=2)
+        assert router._owner[rid].name == "r1"
+
+    def test_submit_raises_when_fleet_is_full(self, params):
+        router = FleetRouter([_dense(params, n_slots=1)])
+        router.submit([5, 6], max_tokens=4)
+        with pytest.raises(RuntimeError):
+            router.submit([7, 8], max_tokens=4)
+
+    def test_cancel_routes_to_owning_replica(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        rid = router.submit([5, 6, 7], max_tokens=10)
+        router.replicas[0].engine.step()
+        assert router.cancel(rid) is True
+        assert router.cancel(rid) is False  # already retired
+        assert router.cancel(999_999_999) is False  # never admitted
+        (c,) = router.completions()
+        assert c.status == "cancelled" and c.request_id == rid
+
+
+class TestFleetPump:
+    def test_pump_matches_single_engine_bit_equal(self, params):
+        reference = _by_prompt(_dense(params).pump([dict(r) for r in REQS]))
+        router = FleetRouter([_dense(params), _paged(params)])
+        out = router.pump([dict(r) for r in REQS])
+        assert len(out) == len(REQS)
+        assert _by_prompt(out) == reference
+
+    def test_fleet_shed_is_typed_with_fleet_retry_after(self, params):
+        from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+        router = FleetRouter([_dense(params)])
+        out = router.pump(
+            [{"prompt": [i + 1, i + 2], "max_tokens": 3} for i in range(6)],
+            queue_limit=0,
+        )
+        shed = [c for c in out if c.status == "shed"]
+        served = [c for c in out if c.status == "ok"]
+        assert len(served) == 3 and len(shed) == 3
+        assert all(c.request_id == -1 for c in shed)
+        assert isinstance(router.last_shed, ShedError)
+        assert router.last_shed.retry_after_s > 0
+        assert router.shed_count == 3
+        assert REGISTRY.counter("tpu_fleet_shed_total").value() == 3
+
+    def test_shed_rejects_newest_keeps_fifo(self, params):
+        router = FleetRouter([_dense(params)])
+        prompts = [[10 + i, 20 + i] for i in range(6)]
+        out = router.pump(
+            [{"prompt": p, "max_tokens": 3} for p in prompts], queue_limit=0
+        )
+        shed_prompts = sorted(tuple(c.tokens) for c in out if c.status == "shed")
+        assert shed_prompts == sorted(tuple(p) for p in prompts[3:])
+
+    def test_admission_deadline_budget_sheds_stale_waiters(self, params):
+        router = FleetRouter([_dense(params)])
+        reqs = [{"prompt": [i + 1, i + 2], "max_tokens": 3} for i in range(3)]
+        reqs += [
+            {"prompt": [51, 52], "max_tokens": 3, "admission_deadline_s": 0.0},
+            {"prompt": [61, 62], "max_tokens": 3, "admission_deadline_s": 0.0},
+        ]
+        out = router.pump(reqs)
+        assert sum(c.status == "ok" for c in out) == 3
+        shed = [c for c in out if c.status == "shed"]
+        assert sorted(tuple(c.tokens) for c in shed) == [(51, 52), (61, 62)]
+        assert "deadline" in (shed[0].error or "")
+
+    def test_fleet_retry_after_divides_by_live_replicas(self, params):
+        # Same depth and step latency, twice the live replicas -> half the
+        # retry-after hint: the fleet drains its queue in parallel.
+        def hint(n_replicas):
+            router = FleetRouter([_dense(params) for _ in range(n_replicas)])
+            for rep in router.replicas:
+                rep.last_stats = dataclasses.replace(
+                    rep.engine.stats(), last_step_s=0.1
+                )
+            router._fleet_shed({"prompt": [1, 2]}, depth=10, why="test")
+            return router.last_shed.retry_after_s
+
+        assert hint(1) == pytest.approx(1.0)
+        assert hint(2) == pytest.approx(0.5)
+
+
+class TestDrainMigration:
+    def _mid_flight_router(self, params, second):
+        """Two streams decoding on r0 for three steps, r1 idle."""
+        router = FleetRouter([_dense(params)])
+        router.submit([5, 6, 7], max_tokens=10, temperature=0.7, seed=3)
+        router.submit([9, 1], max_tokens=10, seed=11)
+        for _ in range(3):
+            router.replicas[0].engine.step()
+        router.add_replica(second, name="r1")
+        return router
+
+    def _reference(self, params):
+        return _by_prompt(_dense(params).pump([
+            {"prompt": [5, 6, 7], "max_tokens": 10, "temperature": 0.7, "seed": 3},
+            {"prompt": [9, 1], "max_tokens": 10, "seed": 11},
+        ]))
+
+    @pytest.mark.parametrize("second", ["dense", "paged"])
+    def test_drain_continues_streams_bit_equal(self, params, second):
+        make = _dense if second == "dense" else _paged
+        router = self._mid_flight_router(params, make(params))
+        moved = router.drain("r0", reason="scale_down")
+        assert len(moved) == 2
+        assert router.replica("r0").state == DRAINED
+        assert router.replica("r0").engine.free_slots() == 3
+        out = router.pump([])
+        assert _by_prompt(out) == self._reference(params)
+        # ownership moved with the streams
+        assert not router._owner
+
+    def test_drain_journals_one_correlation_span(self, params):
+        router = self._mid_flight_router(params, _dense(params))
+        JOURNAL.clear()
+        router.drain("r0")
+        events = JOURNAL.tail(limit=100, component="fleet")
+        corrs = {e["correlation"] for e in events if e["event"].startswith(("replica.", "evac."))}
+        assert len(corrs) == 1, f"expected ONE evacuation correlation, got {corrs}"
+        kinds = [e["event"] for e in events]
+        for expected in (
+            "replica.suspect", "replica.evacuating", "evac.snapshot",
+            "evac.restore", "replica.drained", "evac.resumed",
+        ):
+            assert expected in kinds, f"missing {expected} in {kinds}"
+
+    def test_drain_parks_overflow_until_capacity_frees(self, params):
+        # Target has 1 slot for 2 evacuated streams: one restores now, one
+        # parks at the router and resumes when the slot frees mid-pump.
+        router = FleetRouter([_dense(params)])
+        router.submit([5, 6, 7], max_tokens=10, temperature=0.7, seed=3)
+        router.submit([9, 1], max_tokens=10, seed=11)
+        for _ in range(3):
+            router.replicas[0].engine.step()
+        router.add_replica(_dense(params, n_slots=1), name="r1")
+        moved = router.drain("r0")
+        assert len(moved) == 1 and len(router._parked) == 1
+        out = router.pump([])
+        assert _by_prompt(out) == self._reference(params)
+        assert not router._parked
+
+    def test_drain_with_no_survivors_parks_everything(self, params):
+        router = FleetRouter([_dense(params)])
+        router.submit([5, 6, 7], max_tokens=10, seed=3)
+        moved = router.drain("r0")
+        assert moved == [] and len(router._parked) == 1
+        # a fleet with zero live replicas and parked work is wedged, loudly
+        with pytest.raises(RuntimeError, match="every replica drained"):
+            router.pump([])
+
+    def test_drained_replica_is_reusable_after_readd(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        router.drain("r0", reason="rebalance")
+        assert router.replica("r0").state == DRAINED
+        out = router.pump([{"prompt": [4, 5], "max_tokens": 3}])
+        assert [c.status for c in out] == ["ok"]
+        assert router._owner == {}
+
+
+class TestObservability:
+    def test_stats_doc_shape(self, params):
+        router = FleetRouter([_dense(params), _paged(params)])
+        router.pump([dict(r) for r in REQS[:2]])
+        doc = router.stats()
+        assert doc["queue_depth"] == 0 and doc["parked"] == 0
+        assert [r["name"] for r in doc["replicas"]] == ["r0", "r1"]
+        for r in doc["replicas"]:
+            assert r["state"] == HEALTHY
+            assert r["breaker"] == "closed"
+            assert r["stats"]["n_slots"] == 3
+
+    def test_debug_fleet_doc_lists_live_routers(self, params):
+        router = FleetRouter([_dense(params)])
+        doc = debug_fleet_doc()
+        seqs = [f["router_seq"] for f in doc["fleets"]]
+        assert router.seq in seqs
+
+    def test_debug_fleet_endpoint_serves_router_state(self, params):
+        import json
+        import urllib.request
+
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        router = FleetRouter([_dense(params)])
+        srv = DiagnosticsServer(port=0)
+        srv.start()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/fleet").read())
+        finally:
+            srv.stop()
+        fleets = {f["router_seq"]: f for f in doc["fleets"]}
+        mine = fleets[router.seq]
+        assert mine["replicas"][0]["state"] == HEALTHY
+        assert "queue_depth" in mine

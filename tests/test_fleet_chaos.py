@@ -1,0 +1,299 @@
+"""Fleet chaos suite: replica failure domains under injected faults.
+
+The fleet twin of tests/test_serve_chaos.py — utils/faults.py's
+REPLICA-scoped kinds (replica_crash, replica_wedge, stats_stale, scoped
+per replica/tick) break one replica of a 3-replica FleetRouter
+mid-decode, and these tests pin the PR's acceptance property:
+
+    one replica killed mid-decode -> every in-flight stream completes
+    BIT-EQUAL on the survivors, zero lost or duplicated completions,
+    per-replica block accounting balanced (including the dead replica),
+    and the whole evacuation observable under ONE journal correlation
+    id spanning suspect -> snapshot -> restore -> resumed.
+
+Plus the slower failure shapes: a wedged replica caught by the stalled-
+burst detector, a frozen stats feed caught by the staleness detector,
+and a quarantine storm escaping to healthy replicas.  Every fault draws
+from a seeded injector: a failure replays from its seed.  Runs in
+`make chaos-fleet` (<15s, CPU).
+"""
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, paged
+from k8s_dra_driver_tpu.models.fleet import (
+    DRAINED,
+    HEALTHY,
+    FleetPolicy,
+    FleetRouter,
+)
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, ReplicaCrash
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 33)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _inj(spec: str) -> FaultInjector:
+    return FaultInjector.from_env(spec)
+
+
+# Explicit per-request seeds: replica-minted ids differ between a fleet
+# run and the single-engine reference, so sampling keys must come from
+# the request, never the id.
+REQS = [
+    {"prompt": [7, 8, 9], "max_tokens": 6, "seed": 5},
+    {"prompt": [3, 4], "max_tokens": 6, "temperature": 0.7, "seed": 9},
+    {"prompt": [11, 12, 13, 14], "max_tokens": 6, "seed": 21},
+    {"prompt": [1, 2], "max_tokens": 6, "seed": 33},
+    {"prompt": [21, 22, 23], "max_tokens": 6, "seed": 44},
+]
+
+
+def _by_prompt(completions, status="ok"):
+    return {
+        tuple(c.tokens[: len(c.tokens) - len(c.generated)]): tuple(c.generated)
+        for c in completions
+        if c.status == status
+    }
+
+
+@pytest.fixture(scope="module")
+def reference(params):
+    """Fault-free streams for REQS — the bit-equality baseline every
+    evacuated stream must reproduce on its new replica."""
+    return _by_prompt(_dense(params).pump([dict(r) for r in REQS]))
+
+
+class TestReplicaFaultHooks:
+    def test_from_env_parses_replica_kinds(self):
+        inj = _inj(
+            "replica_crash_rate=1.0,replica_wedge_rate=0.5,"
+            "stats_stale_rate=0.25,replicas=0+2,steps=3,seed=7"
+        )
+        (p,) = inj._profiles
+        assert p.replica_crash_rate == 1.0
+        assert p.replica_wedge_rate == 0.5
+        assert p.stats_stale_rate == 0.25
+        assert p.replicas == (0, 2)
+        assert p.steps == (3,)
+
+    def test_replica_and_tick_scoping(self):
+        inj = _inj("replica_crash_rate=1.0,replicas=1,steps=2")
+        inj.maybe_crash_replica(0, 2)  # out of scope: silent
+        inj.maybe_crash_replica(1, 3)
+        with pytest.raises(ReplicaCrash) as exc:
+            inj.maybe_crash_replica(1, 2)
+        assert exc.value.replica == 1
+
+    def test_wedge_and_stale_hooks_record_stats(self):
+        inj = _inj("replica_wedge_rate=1.0,stats_stale_rate=1.0,replicas=0")
+        assert inj.take_replica_wedge(0, 1)
+        assert not inj.take_replica_wedge(1, 1)
+        assert inj.take_stats_stale(0, 1)
+        assert inj.stats().get("replica_wedge") == 1
+        assert inj.stats().get("stats_stale") == 1
+
+    def test_injection_budget_caps_replica_kinds(self):
+        inj = FaultInjector(seed=0)
+        from k8s_dra_driver_tpu.utils.faults import FaultProfile
+
+        inj.arm(FaultProfile(name="once", replica_wedge_rate=1.0, limit=1))
+        assert inj.take_replica_wedge(0, 1)
+        assert not inj.take_replica_wedge(0, 2)
+
+
+class TestCrashEvacuation:
+    """The acceptance run: kill one of three replicas mid-decode."""
+
+    @pytest.fixture()
+    def crashed(self, params, reference):
+        """3 mixed-kind replicas, replica 1 (paged) dies on router tick 2
+        — after admission, mid-decode."""
+        router = FleetRouter(
+            [_dense(params), _paged(params), _dense(params)],
+            fault_injector=_inj("replica_crash_rate=1.0,replicas=1,steps=2"),
+        )
+        pool0 = router.replicas[1].engine.free_blocks
+        out = router.pump([dict(r) for r in REQS])
+        return router, out, pool0
+
+    def test_zero_lost_or_duplicated_streams(self, crashed, reference):
+        router, out, _ = crashed
+        assert len(out) == len(REQS)
+        assert [c.status for c in out].count("ok") == len(REQS)
+        rids = [c.request_id for c in out]
+        assert len(rids) == len(set(rids)), "duplicated completion ids"
+        # every stream bit-equal to the fault-free single-engine baseline
+        assert _by_prompt(out) == reference
+
+    def test_dead_replica_accounting_balances(self, crashed):
+        router, _, pool0 = crashed
+        dead = router.replicas[1]
+        assert dead.state == DRAINED
+        assert dead.engine.free_slots() == dead.engine.n_slots
+        assert dead.engine.free_blocks == pool0  # every block refunded
+        assert not dead.engine._preempted and not dead.engine._admitting
+        # survivors drained their (evacuated) work and stayed healthy
+        for rep in (router.replicas[0], router.replicas[2]):
+            assert rep.state == HEALTHY
+            assert rep.engine.free_slots() == rep.engine.n_slots
+        assert not router._parked and not router._owner
+
+    def test_breaker_tripped_open_immediately(self, crashed):
+        router, _, _ = crashed
+        assert router.replicas[1].breaker.state == "open"
+        assert router.replicas[1].last_verdict == "replica_crash"
+
+    def test_one_journal_correlation_spans_evacuation(self, params):
+        JOURNAL.clear()
+        router = FleetRouter(
+            [_dense(params), _paged(params), _dense(params)],
+            fault_injector=_inj("replica_crash_rate=1.0,replicas=1,steps=2"),
+        )
+        router.pump([dict(r) for r in REQS])
+        events = JOURNAL.tail(limit=400, component="fleet")
+        evac = [e for e in events if e["correlation"].startswith("evac-")]
+        corrs = {e["correlation"] for e in evac}
+        assert len(corrs) == 1, f"expected ONE evacuation correlation: {corrs}"
+        kinds = [e["event"] for e in evac]
+        # the full lifecycle under that single id
+        for expected in (
+            "replica.suspect", "replica.evacuating", "evac.snapshot",
+            "evac.restore", "replica.drained", "evac.resumed",
+        ):
+            assert expected in kinds, f"missing {expected} in {kinds}"
+        # ordering: suspect before snapshot before restore before resumed
+        order = [kinds.index(k) for k in (
+            "replica.suspect", "evac.snapshot", "evac.restore", "evac.resumed"
+        )]
+        assert order == sorted(order)
+
+    def test_fleet_metrics_account_the_evacuation(self, crashed):
+        router, _, _ = crashed
+        doc = parse_prom_text(REGISTRY.render())
+        states = doc["tpu_fleet_replicas"]
+        assert states[(("state", "healthy"),)] == 2
+        assert states[(("state", "drained"),)] == 1
+        assert states[(("state", "suspect"),)] == 0
+        assert doc["tpu_fleet_evacuations_total"][
+            (("reason", "replica_crash"),)
+        ] == 1
+        assert doc["tpu_fleet_queue_depth"][()] == 0
+
+    def test_crash_replays_from_seed(self, params):
+        # Determinism of the chaos itself: same spec, same tick, same victim.
+        for _ in range(2):
+            inj = _inj("replica_crash_rate=1.0,replicas=1,steps=2,seed=13")
+            with pytest.raises(ReplicaCrash) as exc:
+                inj.maybe_crash_replica(1, 2)
+            assert exc.value.replica == 1
+            assert inj.stats().get("replica_crash") == 1
+
+
+class TestWedgeEvacuation:
+    def test_wedged_replica_detected_and_evacuated(self, params, reference):
+        # Replica 0 hangs every tick (device never returns): the stalled-
+        # burst detector must mark it suspect after stall_suspect_ticks,
+        # open the breaker, and move its streams to the survivors.
+        router = FleetRouter(
+            [_dense(params), _dense(params)],
+            fault_injector=_inj("replica_wedge_rate=1.0,replicas=0"),
+        )
+        out = router.pump([dict(r) for r in REQS])
+        assert _by_prompt(out) == reference
+        assert len(out) == len(REQS)
+        assert router.replicas[0].state == DRAINED
+        assert router.replicas[0].last_verdict == "wedged"
+        doc = parse_prom_text(REGISTRY.render())
+        assert doc["tpu_fleet_evacuations_total"][(("reason", "wedged"),)] == 1
+
+    def test_wedge_policy_threshold_is_respected(self, params):
+        # A higher stall threshold tolerates more hung ticks before the
+        # verdict flips — the detector is policy, not hardcode.
+        router = FleetRouter(
+            [_dense(params), _dense(params)],
+            policy=FleetPolicy(stall_suspect_ticks=10_000),
+            fault_injector=_inj("replica_wedge_rate=1.0,replicas=0,limit=3"),
+        )
+        out = router.pump([dict(r) for r in REQS])
+        # the wedge budget (limit=3) expires before the verdict threshold,
+        # so the replica recovers and finishes its own streams
+        assert len(out) == len(REQS)
+        assert router.replicas[0].state == HEALTHY
+
+
+class TestStaleStatsEvacuation:
+    def test_frozen_stats_feed_gates_replica(self, params, reference):
+        # Replica 1's stats() reads come from the router's stale cache:
+        # uptime stops advancing, the staleness detector marks it suspect
+        # (the router cannot CONFIRM health — rosy old numbers must not
+        # keep attracting traffic), and its streams evacuate.
+        # longer streams than REQS: the staleness detector (3 ticks) plus
+        # the breaker (3 verdicts) need ~6 ticks of live decode to converge
+        reqs = [{**r, "max_tokens": 12} for r in REQS]
+        baseline = _by_prompt(_dense(params).pump([dict(r) for r in reqs]))
+        router = FleetRouter(
+            [_dense(params), _dense(params)],
+            fault_injector=_inj("stats_stale_rate=1.0,replicas=1"),
+        )
+        out = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(out) == baseline
+        assert router.replicas[1].state == DRAINED
+        assert router.replicas[1].last_verdict == "stats_stale"
+        doc = parse_prom_text(REGISTRY.render())
+        assert doc["tpu_fleet_evacuations_total"][
+            (("reason", "stats_stale"),)
+        ] == 1
+
+
+class TestQuarantineStormEscape:
+    def test_storm_evacuates_survivors(self, params, reference):
+        # Replica 0's ENGINE quarantines two poisoned slots (engine-scoped
+        # nan_logits) — under quarantine_suspect=2 the router reads the
+        # storm from EngineStats and evacuates the replica's HEALTHY
+        # streams before the engine hits its own poison limit.
+        router = FleetRouter(
+            [
+                _dense(
+                    params, quarantine_limit=3,
+                    fault_injector=_inj("nan_logits_rate=1.0,slots=0+1,steps=2"),
+                ),
+                _dense(params),
+            ],
+        )
+        out = router.pump([dict(r) for r in REQS])
+        assert len(out) == len(REQS)
+        quarantined = [c for c in out if c.status == "quarantined"]
+        assert len(quarantined) == 2
+        assert all("non-finite" in c.error for c in quarantined)
+        # every stream that was NOT poisoned finishes bit-equal
+        ok = _by_prompt(out)
+        assert ok == {p: g for p, g in reference.items() if p in ok}
+        assert len(ok) == len(REQS) - 2
+        assert router.replicas[0].state == DRAINED
+        assert router.replicas[0].last_verdict == "quarantine_storm"

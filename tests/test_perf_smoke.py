@@ -50,3 +50,12 @@ def test_shed_fastpath_stays_within_perf_budgets():
     # the overloaded pump pays exactly the twin's host syncs.
     assert stats["host_syncs"] == stats["twin_host_syncs"]
     assert stats["elapsed_s"] <= stats["budget_s"]
+
+
+def test_router_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_router_overhead()
+    assert stats["requests_routed"] == 8
+    # The fleet router's contract: placement is a host-side decision over
+    # stats() snapshots — a 1-replica fleet dispatches EXACTLY the device
+    # work of the bare engine (zero routing-added syncs).
+    assert stats["host_syncs_routed"] == stats["host_syncs_bare"]

@@ -15,7 +15,7 @@ faults; this suite pins the REST of the robustness contract:
 import jax
 import pytest
 
-from k8s_dra_driver_tpu.models import burnin, lora, paged
+from k8s_dra_driver_tpu.models import burnin, lora, paged, serve
 from k8s_dra_driver_tpu.models.serve import ServeEngine
 from k8s_dra_driver_tpu.utils.faults import FaultInjector
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
@@ -148,6 +148,58 @@ class TestRestoreMatrix:
         assert sorted(fresh.restore(snap)) == [0, 1]
         fresh.run_until_drained()
         assert len(fresh.completions()) == 2
+
+    @pytest.mark.parametrize("kind", ["dense", "paged"])
+    def test_terminal_snapshot_entry_rejected_typed(self, params, bank, kind):
+        # Regression: a snapshot entry that already carries a terminal
+        # status (e.g. a Completion-shaped dict that leaked into a
+        # hand-assembled snapshot) used to die with a KeyError on the
+        # missing sampler fields MID-restore, after slots had mutated.
+        # Now it's a typed SnapshotRestoreError raised before ANY
+        # mutation — restoring a finished stream would duplicate its
+        # delivery.
+        from k8s_dra_driver_tpu.models.serve import SnapshotRestoreError
+
+        eng = _engine(params, bank, kind, "greedy")
+        snap = {
+            "engine": type(eng).__name__,
+            "next_id": 8,
+            "requests": [
+                {
+                    "request_id": 3, "tokens": [5, 6, 7, 8], "prompt_len": 2,
+                    "max_tokens": 4, "deadline": None, "temperature": 0.0,
+                    "key": [0, 0], "adapter": 0, "priority": 0,
+                },
+                # terminal entry, Completion-shaped: no sampler fields at all
+                {"request_id": 7, "tokens": [1, 2, 3], "prompt_len": 2,
+                 "status": "ok"},
+            ],
+        }
+        for merge in (False, True):
+            with pytest.raises(SnapshotRestoreError) as exc:
+                eng.restore(dict(snap), merge=merge)
+            assert exc.value.request_id == 7
+            assert exc.value.status == "ok"
+            assert "duplicate" in str(exc.value)
+        # rejected before any mutation: no slots claimed, no ids burned,
+        # no completions minted — the good entry did NOT partially restore
+        assert eng.free_slots() == eng.n_slots
+        assert eng.completions() == []
+        assert eng._next_id == 0
+        if kind == "paged":
+            assert not eng._preempted and not eng._admitting
+
+    @pytest.mark.parametrize(
+        "status", sorted(serve.TERMINAL_STATUSES)
+    )
+    def test_every_terminal_status_is_unrestorable(self, params, bank, status):
+        eng = _engine(params, bank, "dense", "greedy")
+        snap = {"engine": "x", "next_id": 1, "requests": [
+            {"request_id": 0, "tokens": [1, 2], "prompt_len": 1,
+             "status": status},
+        ]}
+        with pytest.raises(serve.SnapshotRestoreError):
+            eng.restore(snap)
 
 
 class TestQuarantineComposition:

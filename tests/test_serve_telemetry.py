@@ -492,3 +492,50 @@ class TestScrapeHygiene:
         assert doc["tpu_serve_ttft_seconds_count"][_status_key("ok")] == (
             ttft.count(status="ok")
         )
+
+
+class TestFleetSignals:
+    """The fleet half of the load-signal contract (PR 7): the heartbeat
+    field the router's wedge verdict reads, and the tpu_fleet_* metric
+    inventory asserted through the exact render -> parse round-trip."""
+
+    def test_heartbeat_age_tracks_observable_progress(self):
+        clk = FakeClock(100.0)
+        tel = EngineTelemetry(_HostA(), clock=clk)
+        clk.t = 130.0  # idle engine: age grows from construction
+        assert tel.stats().heartbeat_age_s == pytest.approx(30.0)
+        tel.on_admit(1, prompt_len=2, max_tokens=8, submitted_at=130.0)
+        assert tel.stats().heartbeat_age_s == pytest.approx(0.0)
+        clk.t = 131.0
+        tel.burst_begin(4)
+        tel.on_commit(1, 4)
+        clk.t = 131.5
+        tel.burst_end(occupancy=1)  # burst boundary stamps the beat
+        assert tel.stats().heartbeat_age_s == pytest.approx(0.0)
+        clk.t = 140.0  # no progress since: the age is the stall evidence
+        assert tel.stats().heartbeat_age_s == pytest.approx(8.5)
+        tel.on_retire(1, "ok", 4)
+        assert tel.stats().heartbeat_age_s == pytest.approx(0.0)
+
+    def test_fleet_metrics_render_parse_roundtrip(self, params):
+        from k8s_dra_driver_tpu.models.fleet import FleetRouter
+
+        router = FleetRouter([_dense(params), _dense(params)])
+        out = router.pump(
+            [{"prompt": [i + 1, i + 2], "max_tokens": 3} for i in range(8)],
+            queue_limit=0,
+        )
+        sheds = sum(c.status == "shed" for c in out)
+        assert sheds > 0
+        router.drain("r0", reason="scale_down")
+        doc = parse_prom_text(REGISTRY.render())
+        states = doc["tpu_fleet_replicas"]
+        assert states[(("state", "healthy"),)] == 1
+        assert states[(("state", "drained"),)] == 1
+        assert states[(("state", "suspect"),)] == 0
+        assert states[(("state", "evacuating"),)] == 0
+        assert doc["tpu_fleet_evacuations_total"][
+            (("reason", "scale_down"),)
+        ] == 1
+        assert doc["tpu_fleet_shed_total"][()] == sheds
+        assert doc["tpu_fleet_queue_depth"][()] == 0

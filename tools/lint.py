@@ -323,11 +323,11 @@ def check_file(path: Path) -> list[Finding]:
 
 
 def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
-    """Cross-file check: every ``tpu_serve_*`` metric declared in models/
-    must (a) carry non-empty help text at at least one declaring site and
-    (b) appear in ARCHITECTURE.md (the metric inventory / telemetry
-    section).  Pure over its inputs so tests can drive it with synthetic
-    trees and doc text."""
+    """Cross-file check: every ``tpu_serve_*`` / ``tpu_fleet_*`` metric
+    declared in models/ must (a) carry non-empty help text at at least one
+    declaring site and (b) appear in ARCHITECTURE.md (the metric
+    inventory / telemetry section).  Pure over its inputs so tests can
+    drive it with synthetic trees and doc text."""
     # metric name -> list of (path, line, has_help)
     sites: dict[str, list[tuple[Path, int, bool]]] = {}
     for path in paths:
@@ -346,7 +346,7 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
                 and node.args
                 and isinstance(node.args[0], ast.Constant)
                 and isinstance(node.args[0].value, str)
-                and node.args[0].value.startswith("tpu_serve_")
+                and node.args[0].value.startswith(("tpu_serve_", "tpu_fleet_"))
             ):
                 continue
             help_node = node.args[1] if len(node.args) > 1 else next(

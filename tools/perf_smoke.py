@@ -372,12 +372,92 @@ def check_telemetry_overhead() -> dict:
     return stats
 
 
+# The router must be a pure host-side placement layer: a 1-replica fleet
+# pays EXACTLY the bare engine's host syncs (zero added device
+# dispatches — routing is dict/clock work over stats() snapshots), and
+# its per-tick bookkeeping (health verdicts, scoring, journal) stays
+# inside a small wall-clock envelope over the bare pump.
+ROUTER_OVERHEAD_FRAC = 0.10
+ROUTER_OVERHEAD_FLOOR_S = 0.10
+
+
+def check_router_overhead() -> dict:
+    """Budget guard for the fleet router (PR 7 tentpole): fronting ONE
+    replica through FleetRouter.pump() must dispatch exactly the device
+    work of the bare engine pumping the same requests, and the router's
+    host-side work (health ticks, candidate scoring, fleet queue) must
+    stay bounded."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, fleet, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(8)
+    ]
+
+    def engine():
+        return serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=4, prompt_bucket=16, sync_interval=8
+        )
+
+    reqs = [{"prompt": p, "max_tokens": 16} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    bare = engine()
+    start = time.perf_counter()
+    done_bare = bare.pump([dict(r) for r in reqs])
+    bare_wall = time.perf_counter() - start
+
+    routed_eng = engine()
+    router = fleet.FleetRouter([routed_eng])
+    start = time.perf_counter()
+    done_routed = router.pump([dict(r) for r in reqs])
+    routed_wall = time.perf_counter() - start
+
+    budget = bare_wall * (1 + ROUTER_OVERHEAD_FRAC) + ROUTER_OVERHEAD_FLOOR_S
+    stats = {
+        "requests_bare": len(done_bare),
+        "requests_routed": len(done_routed),
+        "host_syncs_bare": bare.host_syncs,
+        "host_syncs_routed": routed_eng.host_syncs,
+        "bare_s": round(bare_wall, 3),
+        "routed_s": round(routed_wall, 3),
+        "budget_frac": ROUTER_OVERHEAD_FRAC,
+        "floor_s": ROUTER_OVERHEAD_FLOOR_S,
+    }
+    if len(done_routed) != len(reqs) or len(done_bare) != len(reqs):
+        raise PerfBudgetError(
+            f"router overhead run drained {len(done_routed)}/{len(reqs)} "
+            f"routed vs {len(done_bare)} bare"
+        )
+    if routed_eng.host_syncs != bare.host_syncs:
+        raise PerfBudgetError(
+            f"fleet routing added device work: {routed_eng.host_syncs} host "
+            f"syncs through the router vs {bare.host_syncs} bare — placement "
+            f"must stay a host-side decision over stats() snapshots"
+        )
+    if routed_wall > budget:
+        raise PerfBudgetError(
+            f"routed pump took {routed_wall:.3f}s > {budget:.3f}s "
+            f"({bare_wall:.3f}s bare + {ROUTER_OVERHEAD_FRAC:.0%} + "
+            f"{ROUTER_OVERHEAD_FLOOR_S}s floor): per-tick router bookkeeping "
+            f"is no longer cheap host work"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
         stats["pipelined_decode"] = check_pipelined_decode()
         stats["shed_fastpath"] = check_shed_fastpath()
         stats["telemetry_overhead"] = check_telemetry_overhead()
+        stats["router_overhead"] = check_router_overhead()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
