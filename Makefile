@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet demo dryrun lint perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg demo dryrun lint perf-smoke helm-template clean
 
 all: native
 
@@ -46,6 +46,13 @@ chaos-serve:
 # fleet-level admission/shedding.
 chaos-fleet:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet_chaos.py -q
+
+# Disaggregation chaos suite (<15s, CPU, seeded): KV-handoff transfers
+# dropped/corrupted/past-deadline mid-flight between the prefill and
+# decode pools — zero lost or duplicated streams, bit-equal re-prefill
+# fallback, balanced per-pool block accounting.
+chaos-disagg:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_disagg_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

@@ -303,6 +303,13 @@ def _data_plane_body(sink: dict | None = None) -> dict:
         out["serving_throughput"] = _serving_throughput_cpu()
     except Exception as exc:  # noqa: BLE001
         out["serving_throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Disaggregated prefill/decode A/B (PR 8 headline): short-stream TTFT
+    # tails under a heavy long-prompt mix, unified pump vs DisaggRouter.
+    # Same salvage-first placement rationale as the serving A/B above.
+    try:
+        out["serving_disagg"] = _disagg_benchmark_cpu()
+    except Exception as exc:  # noqa: BLE001
+        out["serving_disagg"] = {"error": f"{type(exc).__name__}: {exc}"}
     step_ms, last_loss, params = time_train("blocks")
     out.update({
         "backend": jax.default_backend(),
@@ -808,6 +815,154 @@ def _serving_throughput_cpu(
     }
 
 
+def _disagg_benchmark_cpu(
+    n_long=6, n_short=8, long_prompt=48, long_tokens=200,
+    short_prompt=8, short_tokens=4,
+) -> dict:
+    """Disaggregated prefill/decode A/B — the PR 8 tentpole priced: a
+    heavy long-prompt mix (longs first, shorts queued behind them) drained
+    by a unified 4-slot pump vs a 2-prefill/2-decode DisaggRouter with the
+    same total slots, reporting SHORT-stream TTFT/e2e tails.
+
+    The mechanism under test: in the unified pump a slot is held from
+    admission to completion, so a short queued behind long-decode streams
+    waits out their full decode before its first token; the prefill pool
+    retires each request AT its first token (the stream finishes from the
+    decode pool via KV handoff), so prefill slots turn over at prefill
+    speed and short-stream TTFT decouples from decode occupancy.
+
+    Deterministic and CPU-runnable (greedy, fixed prompts, tiny model) so
+    the DEGRADED artifact carries the number too.  ``bit_equal`` is the
+    honesty field: the full token streams of both legs must match —
+    disaggregation moves scheduling, never tokens.  TTFT/e2e come from the
+    request traces (one contiguous timeline across the pool crossing)."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, disagg, paged
+
+    cfg = burnin.ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq=256,
+    )
+    params = burnin.init_params(jax.random.PRNGKey(11), cfg)
+
+    def tokens_for(seed: int, n: int) -> list:
+        return list(map(int, burnin.sample_tokens(
+            jax.random.PRNGKey(seed), cfg, batch=1, seq=n
+        )[0]))
+
+    longs = [tokens_for(100 + i, long_prompt) for i in range(n_long)]
+    shorts = [tokens_for(200 + i, short_prompt) for i in range(n_short)]
+    reqs = (
+        [{"prompt": p, "max_tokens": long_tokens} for p in longs]
+        + [{"prompt": p, "max_tokens": short_tokens} for p in shorts]
+    )
+    short_keys = {tuple(p) for p in shorts}
+
+    # Paged engines: long prompts stream in through CHUNKED prefill (the
+    # prefill pool's whole job), shorts admit in one chunk.
+    def engine(n_slots, n_blocks):
+        return paged.PagedServeEngine(
+            params=params, cfg=cfg, n_slots=n_slots, n_blocks=n_blocks,
+            block_size=4, prompt_bucket=64, attn_impl="xla",
+            sync_interval=4, prefill_chunk_blocks=2,
+        )
+
+    # compile every program shape off the clock: the unified 4-slot burst,
+    # the pool 2-slot burst, and the KV capture/inject programs a handoff
+    # exercises (shared_jit keeps them warm across engine instances)
+    engine(4, 253).pump([(longs[0], 4), (shorts[0], 4)])
+    disagg.DisaggRouter(
+        prefill=[engine(2, 33)], decode=[engine(2, 129)]
+    ).pump([{"prompt": longs[0], "max_tokens": 4},
+            {"prompt": shorts[0], "max_tokens": 4}])
+
+    def tails(samples: list) -> dict:
+        xs = sorted(samples)
+        if not xs:
+            return {"p50_ms": None, "p99_ms": None}
+        pick = lambda q: xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]  # noqa: E731
+        return {
+            "p50_ms": round(pick(0.50) * 1000, 2),
+            "p99_ms": round(pick(0.99) * 1000, 2),
+        }
+
+    def short_tails(engines, done):
+        """Pull short-stream TTFT/e2e out of the retired request traces of
+        ``engines`` (the engines requests RETIRE on — the trace's
+        queued_at/first_token_at anchors survive the pool crossing)."""
+        by_rid = {}
+        for eng in engines:
+            by_rid.update(eng.telemetry._traces)
+        ttft, e2e = [], []
+        for c in done:
+            prompt = tuple(c.tokens[: len(c.tokens) - len(c.generated)])
+            if prompt not in short_keys:
+                continue
+            tr = by_rid.get(c.request_id)
+            if tr is None:
+                continue
+            if tr.ttft_s() is not None:
+                ttft.append(tr.ttft_s())
+            if tr.e2e_s() is not None:
+                e2e.append(tr.e2e_s())
+        return {"short_ttft": tails(ttft), "short_e2e": tails(e2e)}
+
+    uni = engine(4, 253)
+    start = time.perf_counter()
+    done_uni = uni.pump([dict(r) for r in reqs])
+    uni_wall = time.perf_counter() - start
+    uni_stats = short_tails([uni], done_uni)
+
+    # Pool KV sizing is asymmetric BY DESIGN (the ParvaGPU-style split):
+    # a prefill slot only ever holds prompt-length KV (it retires at the
+    # first token), so its pool is provisioned for prompts; a decode slot
+    # must hold a FULL stream's KV to completion.  Two synchronized longs
+    # per decode replica need 2 x blocks(prompt+gen) with no retirement
+    # to breathe through — undersizing that pool is a deadlock, not a
+    # slowdown.
+    pre = [engine(2, 33), engine(2, 33)]
+    dec = [engine(2, 129), engine(2, 129)]
+    router = disagg.DisaggRouter(prefill=pre, decode=dec)
+    start = time.perf_counter()
+    done_dis = router.pump([dict(r) for r in reqs])
+    dis_wall = time.perf_counter() - start
+    dis_stats = short_tails(dec + pre, done_dis)
+
+    streams_uni = sorted(tuple(c.tokens) for c in done_uni)
+    streams_dis = sorted(tuple(c.tokens) for c in done_dis)
+    uni_p99 = uni_stats["short_ttft"]["p99_ms"]
+    dis_p99 = dis_stats["short_ttft"]["p99_ms"]
+    return {
+        "workload": {
+            "n_long": n_long, "long_prompt": long_prompt,
+            "long_tokens": long_tokens, "n_short": n_short,
+            "short_prompt": short_prompt, "short_tokens": short_tokens,
+        },
+        "unified": {
+            "engine": "PagedServeEngine", "n_slots": 4,
+            "wall_s": round(uni_wall, 3), **uni_stats,
+        },
+        "disagg": {
+            "pools": "2 prefill + 2 decode (same total slots; decode "
+                     "pools provision full-stream KV, prefill pools "
+                     "prompt-length KV)",
+            "wall_s": round(dis_wall, 3), **dis_stats,
+            "handoffs": router.handoffs,
+            "fallbacks": router.fallbacks,
+            "channel_outcomes": dict(router.channel.counts),
+        },
+        "short_ttft_p99_speedup": (
+            round(uni_p99 / dis_p99, 2)
+            if uni_p99 and dis_p99 else None
+        ),
+        "bit_equal": streams_uni == streams_dis,
+        "note": "greedy tiny-model CPU mix, longs queued ahead of shorts; "
+                "tests/test_disagg.py holds the bit-equality matrix across "
+                "engine kinds and sampling features",
+    }
+
+
 def _data_plane_degraded(sink: dict | None = None) -> dict:
     """Reduced data plane for the DEGRADED (backend-down, CPU-pinned)
     path: the full body's 4096-chain matmul and 512-seq burn-in take
@@ -825,6 +980,10 @@ def _data_plane_degraded(sink: dict | None = None) -> dict:
         out["serving_throughput"] = _serving_throughput_cpu()
     except Exception as exc:  # noqa: BLE001
         out["serving_throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        out["serving_disagg"] = _disagg_benchmark_cpu()
+    except Exception as exc:  # noqa: BLE001
+        out["serving_disagg"] = {"error": f"{type(exc).__name__}: {exc}"}
     cfg = burnin.ModelConfig(
         vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
         max_seq=128,

@@ -1,0 +1,546 @@
+"""Disaggregated prefill/decode pools with KV handoff over a claimed channel.
+
+Inside a unified replica, long prompts steal decode bursts: every chunked
+prefill admission preempts token cadence for the latency-sensitive streams
+already resident (ROADMAP item 2).  This module splits the fleet into two
+pools of Engine-protocol replicas behind one :class:`DisaggRouter`:
+
+* **Prefill pool** — runs chunked prefill ONLY.  Requests are submitted
+  with ``handoff=True``, so each retires at its first token and rides out
+  through ``take_handoffs()`` as a snapshot entry carrying its KV payload
+  (``serve.KVSlice`` — dense slice or gathered paged stripes, bit-identical
+  either way).  Slots/blocks free immediately; the pool never decodes.
+* **Decode pool** — admits exclusively via merge-restore
+  (``FleetRouter.place``), injecting the KV payload when geometry matches
+  and burst-decoding each stream to completion.  The pool never pays a
+  prompt-length prefill on the happy path.
+
+Between them sits the :class:`HandoffChannel`: the transfer path modeled
+as a first-class resource (the Kubernetes Network Driver Model, arxiv
+2506.23628) rather than an invisible side effect.  The channel is bound to
+a :class:`ChannelClaim` — the DRA-claimed interconnect device the topology
+daemon publishes in its ResourceSlice (``deviceinfo.InterconnectChannelInfo``)
+— so the scheduler sees transfer capacity like any other device.  The
+channel enforces **bounded in-flight bytes** (transfers beyond the claim's
+budget wait at the router, backpressure instead of oversubscription) and
+**per-transfer deadlines** (simulated latency = bytes/bandwidth + injected
+latency; a transfer whose latency exceeds the deadline is stale and is NOT
+delivered).  Latency is accounted, never slept — chaos suites stay fast.
+
+The fallback ladder, in order, each rung ending in a correct stream:
+
+1. **ok** — payload delivered, decode replica injects KV, zero re-compute.
+2. **engine fallback** — payload delivered but the decode replica cannot
+   inject (geometry mismatch, no block capacity): the engine re-prefills
+   from the entry's tokens (``tpu_disagg_fallback_total{reason=}``).
+3. **channel fallback** — the transfer drops, corrupts (checksum mismatch)
+   or goes stale (deadline): the payload is discarded and the entry is
+   delivered WITHOUT KV, so the decode replica re-prefills — through its
+   prefix cache when it has one, so a warm prefix still skips most of the
+   recompute.  Never a lost or duplicated stream: the entry either
+   delivers exactly once or parks at the decode router.
+
+Failure semantics compose with the fleet layer untouched: each pool is a
+full :class:`~k8s_dra_driver_tpu.models.fleet.FleetRouter` (health
+verdicts, breakers, evacuation, parking), driven via its externally-driven
+``tick()``/``place()`` surface while THIS router owns the cross-pool
+queue and the channel.
+
+Like fleet.py, this module stays importable without jax so
+``/debug/disagg`` can render from control-plane binaries.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import weakref
+from dataclasses import dataclass
+
+from k8s_dra_driver_tpu.models.fleet import FleetPolicy, FleetRouter
+from k8s_dra_driver_tpu.models.telemetry import EngineTelemetry
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+_M_TRANSFERS = REGISTRY.counter(
+    "tpu_disagg_transfers_total",
+    "KV handoff transfers, by outcome (ok/dropped/deadline/corrupt/no_capacity)",
+)
+_M_XFER_BYTES = REGISTRY.histogram(
+    "tpu_disagg_transfer_bytes",
+    "KV payload size per handoff transfer",
+    buckets=(
+        1024.0, 4096.0, 16384.0, 65536.0, 262144.0,
+        1048576.0, 4194304.0, 16777216.0, 67108864.0,
+    ),
+)
+_M_TTFT_BREAKDOWN = REGISTRY.histogram(
+    "tpu_disagg_ttft_breakdown_seconds",
+    "Time-to-first-token attribution, by stage (prefill/transfer/decode)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+)
+_M_INFLIGHT = REGISTRY.gauge(
+    "tpu_disagg_inflight_bytes",
+    "KV handoff bytes currently in flight on the channel",
+)
+# Declared (with help) in models/serve.py, where the engine-level fallback
+# arms live; looked up by name here so both layers share one counter.
+_M_FALLBACK = REGISTRY.counter("tpu_disagg_fallback_total")
+
+# Transfer outcomes — the channel's vocabulary.  Everything except ``ok``
+# ends in rung 3 of the fallback ladder.
+OK = "ok"
+DROPPED = "dropped"
+DEADLINE = "deadline"
+CORRUPT = "corrupt"
+NO_CAPACITY = "no_capacity"
+
+
+@dataclass(frozen=True)
+class ChannelClaim:
+    """The DRA-claimed interconnect resource a :class:`HandoffChannel` is
+    bound to — the channel's capacity parameters as the topology daemon
+    publishes them (``deviceinfo.InterconnectChannelInfo`` →
+    ResourceSlice device attributes), so pool-to-pool transfer capacity is
+    scheduled like any other device."""
+
+    name: str = "ici-0"
+    bandwidth_gbps: float = 100.0        # payload bandwidth, gigabits/s
+    max_in_flight_bytes: int = 64 * 1024 * 1024
+    transfer_deadline_s: float = 0.25    # per-transfer staleness bound
+    source: str = "static"               # "daemon" when claimed via topology
+
+    @staticmethod
+    def from_daemon_info(doc: dict) -> "ChannelClaim | None":
+        """Bind to the channel the topology daemon published in its info
+        doc (``topology_daemon.DaemonState.to_info()["channel"]``).
+        Returns None when the daemon publishes no channel — the caller
+        falls back to a static claim."""
+        ch = (doc or {}).get("channel")
+        if not ch:
+            return None
+        return ChannelClaim(
+            name=str(ch.get("name", "ici-0")),
+            bandwidth_gbps=float(ch.get("bandwidth_gbps", 100.0)),
+            max_in_flight_bytes=int(ch.get("max_in_flight_bytes", 64 * 1024 * 1024)),
+            transfer_deadline_s=float(ch.get("transfer_deadline_s", 0.25)),
+            source="daemon",
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "bandwidth_gbps": self.bandwidth_gbps,
+            "max_in_flight_bytes": self.max_in_flight_bytes,
+            "transfer_deadline_s": self.transfer_deadline_s,
+            "source": self.source,
+        }
+
+
+@dataclass
+class Transfer:
+    """One in-flight KV payload on the channel."""
+
+    request_id: int
+    nbytes: int
+    crc: int
+    started_at: float
+    latency_s: float = 0.0
+    outcome: str = ""
+
+
+class HandoffChannel:
+    """The pool-to-pool KV transfer path, bound to a :class:`ChannelClaim`.
+
+    Deliberately host-only and clock-free on the data path: transfer
+    latency is ACCOUNTED (``nbytes / bandwidth + injected latency``) into
+    the deadline check and the TTFT breakdown, never slept, so a chaos
+    suite exercising thousands of transfers still finishes in seconds.
+    Fault hooks (``handoff_drop`` / ``handoff_latency_ms`` /
+    ``handoff_corrupt``, armable via ``DRA_FAULTS``) fire between
+    :meth:`begin` and :meth:`complete` — before the payload reaches the
+    decode pool, so a faulted transfer never half-installs KV bytes."""
+
+    def __init__(
+        self,
+        claim: ChannelClaim | None = None,
+        *,
+        max_in_flight_bytes: int | None = None,
+        transfer_deadline_s: float | None = None,
+        bandwidth_gbps: float | None = None,
+        fault_injector=None,
+        clock=time.monotonic,
+    ):
+        self.claim = claim or ChannelClaim()
+        self.max_in_flight_bytes = int(
+            max_in_flight_bytes
+            if max_in_flight_bytes is not None
+            else self.claim.max_in_flight_bytes
+        )
+        self.transfer_deadline_s = float(
+            transfer_deadline_s
+            if transfer_deadline_s is not None
+            else self.claim.transfer_deadline_s
+        )
+        self.bandwidth_gbps = float(
+            bandwidth_gbps
+            if bandwidth_gbps is not None
+            else self.claim.bandwidth_gbps
+        )
+        self.fault_injector = fault_injector
+        self.clock = clock
+        self.in_flight_bytes = 0
+        self._in_flight: dict[int, Transfer] = {}
+        self.counts: dict[str, int] = {}
+        self.bytes_moved = 0
+
+    def fits(self, nbytes: int) -> bool:
+        """Can a payload of this size EVER transfer on this channel?  A
+        payload larger than the whole in-flight budget can't — the caller
+        must fall back immediately instead of retrying forever."""
+        return nbytes <= self.max_in_flight_bytes
+
+    def begin(self, request_id: int, nbytes: int, crc: int) -> Transfer | None:
+        """Reserve in-flight budget for one payload.  Returns None when
+        the budget is exhausted (transient backpressure — retry next tick
+        after other transfers complete)."""
+        if self.in_flight_bytes + nbytes > self.max_in_flight_bytes:
+            return None
+        t = Transfer(
+            request_id=request_id, nbytes=nbytes, crc=crc,
+            started_at=self.clock(),
+        )
+        self.in_flight_bytes += nbytes
+        self._in_flight[request_id] = t
+        _M_INFLIGHT.set(self.in_flight_bytes)
+        return t
+
+    def refuse(self, request_id: int, nbytes: int, why: str) -> None:
+        """Permanent refusal (payload exceeds the claim outright): counted
+        as a ``no_capacity`` transfer so the A/B dashboards see it."""
+        self._count(NO_CAPACITY)
+        JOURNAL.record(
+            "disagg", "transfer.refused", correlation=f"req-{request_id}",
+            nbytes=nbytes, reason=why, budget=self.max_in_flight_bytes,
+        )
+
+    def complete(self, transfer: Transfer, kv) -> str:
+        """Resolve one transfer: account latency, consult the fault hooks,
+        verify the checksum, release the in-flight budget.  Returns the
+        outcome string; the payload object itself is never mutated — on a
+        non-``ok`` outcome the ROUTER discards it, so corrupted/stale KV
+        bytes can never reach a decode replica."""
+        latency = transfer.nbytes / max(self.bandwidth_gbps * 1e9 / 8.0, 1.0)
+        inj = self.fault_injector
+        if inj is not None:
+            latency += inj.take_handoff_latency()
+        transfer.latency_s = latency
+        if inj is not None and inj.take_handoff_drop(transfer.request_id):
+            outcome = DROPPED
+        elif latency > self.transfer_deadline_s:
+            outcome = DEADLINE  # stale: the deadline bound says don't install
+        elif (
+            inj is not None and inj.take_handoff_corrupt(transfer.request_id)
+        ) or kv.checksum() != transfer.crc:
+            outcome = CORRUPT
+        else:
+            outcome = OK
+        transfer.outcome = outcome
+        self._in_flight.pop(transfer.request_id, None)
+        self.in_flight_bytes -= transfer.nbytes
+        _M_INFLIGHT.set(self.in_flight_bytes)
+        _M_XFER_BYTES.observe(float(transfer.nbytes))
+        self._count(outcome)
+        if outcome == OK:
+            self.bytes_moved += transfer.nbytes
+        JOURNAL.record_lazy(
+            "disagg", f"transfer.{outcome}",
+            correlation=f"req-{transfer.request_id}",
+            attrs=lambda: dict(
+                nbytes=transfer.nbytes,
+                latency_s=round(transfer.latency_s, 6),
+                channel=self.claim.name,
+            ),
+        )
+        return outcome
+
+    def _count(self, outcome: str) -> None:
+        _M_TRANSFERS.inc(outcome=outcome)
+        self.counts[outcome] = self.counts.get(outcome, 0) + 1
+
+    def stats(self) -> dict:
+        """The /debug/disagg channel view: the bound claim, the live
+        budget, and the per-outcome tally."""
+        return {
+            "claim": self.claim.to_json(),
+            "max_in_flight_bytes": self.max_in_flight_bytes,
+            "in_flight_bytes": self.in_flight_bytes,
+            "in_flight_transfers": len(self._in_flight),
+            "transfer_deadline_s": self.transfer_deadline_s,
+            "bandwidth_gbps": self.bandwidth_gbps,
+            "outcomes": dict(self.counts),
+            "bytes_moved": self.bytes_moved,
+        }
+
+
+class DisaggRouter:
+    """The disaggregated front door: one queue, two pools, one channel.
+
+    Driven like the engines and the fleet router — everything happens on
+    the caller's thread inside :meth:`pump` ticks.  Each tick: admit the
+    queue into the prefill pool (``handoff=True``), tick the prefill pool,
+    collect first-token handoffs, drive the channel (begin every staged
+    transfer that fits the budget, then complete them — so bounded
+    in-flight bytes gate how much KV moves per tick), deliver/fallback
+    into the decode pool via ``place()``, tick the decode pool, collect
+    completions from both."""
+
+    def __init__(
+        self,
+        prefill=(),
+        decode=(),
+        channel: HandoffChannel | None = None,
+        policy: FleetPolicy | None = None,
+        fault_injector=None,
+        clock=time.monotonic,
+    ):
+        self.clock = clock
+        if fault_injector is None:
+            from k8s_dra_driver_tpu.utils import faults
+
+            raw = os.environ.get(faults.ENV_VAR, "")
+            if raw:
+                fault_injector = faults.FaultInjector.from_env(raw)
+        self.fault_injector = fault_injector
+        # One injector shared by both pools and the channel: one DRA_FAULTS
+        # spec (and one budget) drives chaos across every layer.
+        self.prefill = (
+            prefill if isinstance(prefill, FleetRouter)
+            else FleetRouter(prefill, policy=policy,
+                             fault_injector=fault_injector, clock=clock)
+        )
+        self.decode = (
+            decode if isinstance(decode, FleetRouter)
+            else FleetRouter(decode, policy=policy,
+                             fault_injector=fault_injector, clock=clock)
+        )
+        self.channel = channel or HandoffChannel(
+            fault_injector=fault_injector, clock=clock
+        )
+        if self.channel.fault_injector is None:
+            self.channel.fault_injector = fault_injector
+        self.seq = self.prefill.seq
+        self._tick = 0
+        self._staged: list[dict] = []      # handoffs awaiting channel budget
+        self._t0: dict[int, float] = {}    # rid -> enqueue time (TTFT base)
+        self._awaiting: dict[int, float] = {}  # rid -> delivery time (decode stage)
+        self.handoffs = 0
+        self.fallbacks = 0
+        _LIVE_DISAGG.add(self)
+
+    # -- the disaggregated pump ---------------------------------------------
+
+    def pump(self, requests, max_steps: int = 100_000) -> list:
+        """Serve every request through prefill → handoff → decode; returns
+        every typed Completion.  Zero-loss invariant: each admitted stream
+        is at all times in exactly one of {prefill slot, staged transfer,
+        decode placement (resident or parked)} until its one Completion
+        delivers."""
+        queue = [self.prefill._normalize(r) for r in requests]
+        t_enq = self.clock()
+        for q in queue:
+            q.setdefault("_enqueued_at", t_enq)
+        out: list = []
+        stall = 0
+        for _ in range(max_steps):
+            self._tick += 1
+            admitted = self._admit(queue)
+            stepped = self.prefill.tick()
+            out.extend(self.prefill.completions())
+            collected = self._collect_handoffs()
+            moved = self._drive_channel()
+            stepped += self.decode.tick()
+            out.extend(self._collect_decode())
+            if (
+                not queue
+                and not self._staged
+                and self.prefill.idle()
+                and self.decode.idle()
+            ):
+                return out
+            if admitted or stepped or collected or moved:
+                stall = 0
+            else:
+                stall += 1
+                if stall >= 200:
+                    raise RuntimeError(
+                        f"disagg pump wedged: {len(queue)} queued, "
+                        f"{len(self._staged)} staged, no progress in "
+                        f"{stall} ticks"
+                    )
+        raise RuntimeError(f"disagg pump did not drain in {max_steps} ticks")
+
+    def _admit(self, queue: list) -> int:
+        """FIFO admission into the prefill pool, every request in handoff
+        mode (retire at first token, KV payload out through the channel)."""
+        admitted = 0
+        while queue:
+            req = dict(queue[0])
+            prompt = req.pop("prompt")
+            max_tokens = req.pop("max_tokens")
+            req.pop("handoff", None)  # admission mode is the router's call
+            try:
+                rid = self.prefill.submit(
+                    prompt, max_tokens, handoff=True, **req
+                )
+            except RuntimeError:
+                break  # prefill pool full: the head waits, FIFO holds
+            self._t0[rid] = req.get("_enqueued_at", self.clock())
+            queue.pop(0)
+            admitted += 1
+        return admitted
+
+    def _collect_handoffs(self) -> int:
+        """Drain every prefill replica's handoff queue into the staging
+        area.  The prefill router's ownership entry is released here —
+        the stream has left that pool and will complete from the decode
+        side."""
+        n = 0
+        for rep in self.prefill.replicas:
+            take = getattr(rep.engine, "take_handoffs", None)
+            if not callable(take):
+                continue
+            for entry in take():
+                rid = int(entry["request_id"])
+                self.prefill._owner.pop(rid, None)
+                now = self.clock()
+                t0 = self._t0.pop(rid, now)
+                _M_TTFT_BREAKDOWN.observe(max(0.0, now - t0), stage="prefill")
+                EngineTelemetry.annotate_trace_doc(
+                    entry.get("trace"), "handoff_begin", now,
+                    source=rep.name,
+                )
+                self._staged.append({"entry": entry, "staged_at": now})
+                self.handoffs += 1
+                n += 1
+        return n
+
+    def _drive_channel(self) -> int:
+        """Move staged KV payloads through the channel.  Two passes: begin
+        every transfer the in-flight budget admits this tick (the bound
+        gates bytes-per-tick), then complete each and deliver or fall
+        back.  Entries whose payload exceeds the whole budget fall back
+        immediately; entries squeezed out transiently retry next tick."""
+        begun: list[tuple[dict, Transfer]] = []
+        waiting: list[dict] = []
+        moved = 0
+        for item in self._staged:
+            entry = item["entry"]
+            kv = entry.get("kv")
+            if kv is None:
+                # Nothing to transfer (handoff of a KV-less entry) —
+                # deliver straight through; the decode pool re-prefills.
+                self._deliver(entry, transfer_s=0.0)
+                moved += 1
+                continue
+            rid = int(entry["request_id"])
+            nbytes = int(kv.nbytes)
+            if not self.channel.fits(nbytes):
+                self.channel.refuse(rid, nbytes, "exceeds channel budget")
+                self._fallback(entry, "too_large")
+                moved += 1
+                continue
+            t = self.channel.begin(rid, nbytes, kv.checksum())
+            if t is None:
+                waiting.append(item)  # backpressure: budget spent this tick
+                continue
+            begun.append((item, t))
+        for item, t in begun:
+            entry = item["entry"]
+            outcome = self.channel.complete(t, entry["kv"])
+            if outcome == OK:
+                _M_TTFT_BREAKDOWN.observe(t.latency_s, stage="transfer")
+                EngineTelemetry.annotate_trace_doc(
+                    entry.get("trace"), "handoff_transfer", self.clock(),
+                    nbytes=t.nbytes, latency_s=round(t.latency_s, 6),
+                )
+                self._deliver(entry, transfer_s=t.latency_s)
+            else:
+                self._fallback(entry, outcome)
+            moved += 1
+        self._staged = waiting
+        return moved
+
+    def _fallback(self, entry: dict, reason: str) -> None:
+        """Rung 3 of the ladder: discard the payload, deliver the entry
+        KV-less so the decode pool re-prefills (through its prefix cache
+        when warm).  The stream itself survives every channel fault."""
+        entry.pop("kv", None)
+        self.fallbacks += 1
+        _M_FALLBACK.inc(reason=reason)
+        EngineTelemetry.annotate_trace_doc(
+            entry.get("trace"), "handoff_fallback", self.clock(),
+            reason=reason,
+        )
+        JOURNAL.record(
+            "disagg", "handoff.fallback",
+            correlation=f"req-{entry['request_id']}", reason=reason,
+        )
+        self._deliver(entry, transfer_s=0.0)
+
+    def _deliver(self, entry: dict, transfer_s: float) -> None:
+        """Hand one entry to the decode pool.  ``place()`` merge-restores
+        onto a healthy replica or parks at that router — either way the
+        stream is owned downstream from here."""
+        rid = int(entry["request_id"])
+        now = self.clock()
+        self._awaiting[rid] = now
+        placed = self.decode.place([entry], correlation=f"handoff-req-{rid}")
+        if rid in placed:
+            self._observe_decode_stage(rid, now)
+
+    def _observe_decode_stage(self, rid: int, now: float) -> None:
+        t = self._awaiting.pop(rid, None)
+        if t is not None:
+            _M_TTFT_BREAKDOWN.observe(max(0.0, now - t), stage="decode")
+
+    def _collect_decode(self) -> list:
+        """Decode-pool completions, plus decode-stage latency for entries
+        that parked before a replica could take them."""
+        out = self.decode.completions()
+        now = self.clock()
+        if self._awaiting:
+            for rid in [r for r in self._awaiting if r in self.decode._owner]:
+                self._observe_decode_stage(rid, now)
+            for c in out:
+                self._observe_decode_stage(c.request_id, now)
+        return out
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /debug/disagg contract: pool membership (each pool is a
+        full fleet stats doc), the staging area, and the channel budget."""
+        return {
+            "router_seq": self.seq,
+            "tick": self._tick,
+            "handoffs": self.handoffs,
+            "fallbacks": self.fallbacks,
+            "staged": len(self._staged),
+            "prefill": self.prefill.stats(),
+            "decode": self.decode.stats(),
+            "channel": self.channel.stats(),
+        }
+
+
+_LIVE_DISAGG: "weakref.WeakSet[DisaggRouter]" = weakref.WeakSet()
+
+
+def live_disagg_routers() -> list[DisaggRouter]:
+    return sorted(list(_LIVE_DISAGG), key=lambda r: r.seq)
+
+
+def debug_disagg_doc() -> dict:
+    """The /debug/disagg payload: every live disagg router's pool
+    membership, in-flight transfers and channel budget."""
+    return {"disagg": [router.stats() for router in live_disagg_routers()]}

@@ -760,9 +760,24 @@ class FleetRouter:
             if cap <= 0:
                 continue
             batch, remaining = remaining[:cap], remaining[cap:]
-            restored = rep.engine.restore(
-                {"engine": "", "next_id": 0, "requests": batch}, merge=True
-            )
+            try:
+                restored = rep.engine.restore(
+                    {"engine": "", "next_id": 0, "requests": batch}, merge=True
+                )
+            except RuntimeError as exc:
+                # The replica refused the merge (e.g. it is itself draining
+                # and its engine raised "needs an idle engine" under a
+                # race): the entries are NOT lost — they go back to the
+                # router's parking lot and retry on another replica next
+                # tick.  Raising here would drop a whole evacuation batch.
+                JOURNAL.record(
+                    "fleet", "evac.restore_refused", correlation=corr,
+                    replica=rep.name, requests=len(batch),
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                rep.breaker.on_failure()
+                remaining = batch + remaining
+                continue
             JOURNAL.record(
                 "fleet", "evac.restore", correlation=corr, replica=rep.name,
                 requests=restored,
@@ -789,6 +804,34 @@ class FleetRouter:
             moved = self._place_entries([item["entry"]], item["corr"])
             placed += len(moved)
         return placed
+
+    # -- externally driven ticks (the disaggregated router's drive) -----------
+
+    def tick(self) -> int:
+        """ONE pump iteration without the front-door queue: health
+        verdicts, parked-entry replay, one burst per live replica.
+        Returns the number of slots stepped.  This is the drive surface
+        :class:`~k8s_dra_driver_tpu.models.disagg.DisaggRouter` composes —
+        it owns the cross-pool queue, this router owns its pool's health,
+        placement and stepping."""
+        self._tick += 1
+        self._health_tick()
+        self._replay_parked()
+        return self._step_replicas()
+
+    def place(self, entries: list, correlation: str = "") -> list[int]:
+        """Public entry placement: merge-restore snapshot entries (e.g. a
+        KV handoff batch) onto healthy replicas, parking what no replica
+        can hold yet — exactly the evacuation placement path, so zero-loss
+        parking and typed unrestorable errors come with it.  Returns the
+        request ids placed now (parked entries place on later ticks)."""
+        return self._place_entries(entries, correlation or f"place-{self.seq}")
+
+    def idle(self) -> bool:
+        """No queued, parked, resident or mid-admission work anywhere in
+        this router's live replicas."""
+        live = [r for r in self.replicas if r.state != DRAINED]
+        return not self._parked and all(r.idle() for r in live)
 
     # -- state/observability ---------------------------------------------------
 

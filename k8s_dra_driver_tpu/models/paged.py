@@ -945,6 +945,8 @@ class PagedServeEngine:
         # chunked-admission queue: FIFO of dicts, head advances one chunk
         # per step() (see prefill_chunk_blocks)
         self._admitting: list[dict] = []
+        # first-token retired entries (KV payloads) awaiting take_handoffs()
+        self._handoffs: list = []
         # Multi-controller serving: when the mesh spans OS processes,
         # host readbacks of sharded state must allgather (every process
         # runs this same scheduler in lockstep — the standard JAX
@@ -1028,6 +1030,7 @@ class PagedServeEngine:
         priority: int = 0,
         deadline: int | None = None,
         queued_at: float | None = None,
+        handoff: bool = False,
     ) -> int:
         """Admit when a slot AND the prompt's blocks are available; raises
         RuntimeError otherwise (admission control is the caller's).
@@ -1037,7 +1040,12 @@ class PagedServeEngine:
         ``deadline``: step budget — the request retires with status
         ``deadline_exceeded`` after this many generated tokens if eos has
         not landed first (the same stop-mask path as max_tokens, so a
-        deadline costs no extra sync; blocks refund at retirement)."""
+        deadline costs no extra sync; blocks refund at retirement).
+        ``handoff``: disaggregated-prefill mode — retire at first token
+        with the KV payload queued for :meth:`take_handoffs` (slot AND
+        blocks refund immediately; the decode-pool restorer delivers the
+        Completion).  Composes with chunked admission: a chunked submit
+        hands off when its final chunk activates."""
         from k8s_dra_driver_tpu.models import serve
         from k8s_dra_driver_tpu.models.serve import _Slot
 
@@ -1124,7 +1132,7 @@ class PagedServeEngine:
                     slot=slot, prompt=list(prompt), padded=padded,
                     plen=len(prompt), done=cached, storable=storable,
                     cached=cached, temp=temperature, key=base_key,
-                    adapter=adapter,
+                    adapter=adapter, handoff=handoff,
                 )
             )
             # _M_REQUESTS counts at ACTIVATION (matching the non-chunked
@@ -1186,6 +1194,9 @@ class PagedServeEngine:
             deadline=deadline, adapter=adapter, submitted_at=t_sub,
             queued_at=queued_at,
         )
+        if handoff:
+            self._handoff_retire(slot, temperature, base_key, adapter)
+            return request_id
         self._retire(slot)  # max_tokens=1 or eos on the first token
         self._update_gauges()
         return request_id
@@ -1269,6 +1280,11 @@ class PagedServeEngine:
         # the slot went live and its first token committed (the
         # _first_token sync above): the chunked admission ends HERE
         self.telemetry.on_activate(st.request_id)
+        if adm.get("handoff"):
+            self._handoff_retire(
+                slot, adm["temp"], adm["key"], adm.get("adapter", 0)
+            )
+            return
         self._retire(slot)
         self._update_gauges()
 
@@ -1770,14 +1786,148 @@ class PagedServeEngine:
                 return True
         return False
 
-    def snapshot_active(self) -> dict:
+    def _capture_kv(self, slot: int, valid_len: int):
+        """Host copy of this slot's live KV in the CANONICAL payload
+        layout [L, valid_len, Hkv, hd]: gather the owned block stripes
+        [L, nb, Hkv, hd, bs], move positions off the lane axis, flatten
+        and clip.  Bit-identical to a dense capture of the same stream by
+        the paged-prefill construction (dense prefill then block
+        scatter).  One counted device sync, like the dense twin."""
+        from k8s_dra_driver_tpu.models import serve
+
+        bs = self.block_size
+        nb = blocks_needed(valid_len, bs)
+        ids = np.asarray(self._owned[slot][:nb], np.int32)
+        kb = self._readback(self._cache.k[:, jnp.asarray(ids)])
+        vb = self._readback(self._cache.v[:, jnp.asarray(ids)])
+        self.host_syncs += 1
+        serve._M_HOST_SYNCS.inc()
+        cfg = self.cfg
+        l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        k = np.transpose(kb, (0, 1, 4, 2, 3)).reshape(l, nb * bs, hkv, hd)
+        v = np.transpose(vb, (0, 1, 4, 2, 3)).reshape(l, nb * bs, hkv, hd)
+        k = np.ascontiguousarray(k[:, :valid_len])
+        v = np.ascontiguousarray(v[:, :valid_len])
+        return serve.KVSlice(
+            k=k, v=v, valid_len=valid_len, n_layers=l, kv_heads=hkv,
+            head_dim=hd, dtype=str(k.dtype),
+        )
+
+    def _handoff_retire(self, slot: int, temp, key, adapter: int) -> None:
+        """First-token retire for the disaggregated prefill pool: capture
+        the entry + KV payload (prefill-written prompt positions), refund
+        the slot's blocks, and queue it for :meth:`take_handoffs` — no
+        Completion here, the decode-pool restorer delivers it.  The
+        refcounted free keeps prefix-shared blocks pooled (the payload
+        already copied their bytes out)."""
+        from k8s_dra_driver_tpu.models import serve
+
+        st = self._slots[slot]
+        entry = serve._snapshot_request(
+            st, float(temp), np.asarray(key), adapter, self._prio[slot],
+            trace=self.telemetry.export_trace(st.request_id),
+        )
+        entry["kv"] = self._capture_kv(slot, st.prompt_len)
+        self._slots[slot] = None
+        self._alloc_for(slot).free(self._owned[slot])
+        self._owned[slot] = []
+        self._table_np[slot, :] = NULL_BLOCK
+        self._upload_table()
+        self.telemetry.drop_trace(st.request_id)
+        self._handoffs.append(entry)
+        JOURNAL.record(
+            "serve", "request.handoff", correlation=f"req-{st.request_id}",
+            slot=slot, kv_bytes=entry["kv"].nbytes,
+        )
+        self._update_gauges()
+
+    def take_handoffs(self) -> list[dict]:
+        """Drain the handoff queue: snapshot entries (with KV payloads)
+        for requests that retired at first token under
+        ``submit(handoff=True)``."""
+        out, self._handoffs = self._handoffs, []
+        return out
+
+    def _restore_inject(self, req: dict, st, kv) -> bool:
+        """Direct KV inject for a snapshot entry carrying a compatible
+        payload: claim a slot + ALL-EXCLUSIVE blocks (storable=0 — a
+        shared prefix-store block must never be scatter-written), scatter
+        the payload block stripes, and install the slot exactly as
+        _readmit would after its re-prefill.  Returns False when no
+        capacity or the scatter cannot be used — the caller falls back to
+        the parked re-prefill path."""
+        from k8s_dra_driver_tpu.models import serve
+
+        tokens = st.tokens
+        bs = self.block_size
+        adapter = int(req.get("adapter", 0))
+        need = blocks_needed(len(tokens) + 1, bs)
+        picked = self._pick_slot(tokens, need, 0, adapter)
+        if picked is None:
+            return False
+        slot, ids, _cached = picked
+        cfg = self.cfg
+        l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        nb = blocks_needed(kv.valid_len, bs)
+        pad = nb * bs
+        k_p = np.zeros((l, pad, hkv, hd), kv.k.dtype)
+        v_p = np.zeros((l, pad, hkv, hd), kv.v.dtype)
+        k_p[:, : kv.valid_len] = kv.k
+        v_p[:, : kv.valid_len] = kv.v
+        # inverse of the capture gather: [L, nb*bs, Hkv, hd] -> block
+        # stripes [L, nb, Hkv, hd, bs] (positions back onto the lane axis)
+        kb = np.transpose(k_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+        vb = np.transpose(v_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+        ids_j = jnp.asarray(np.asarray(ids[:nb], np.int32))
+        self._cache = PagedKVCache(
+            k=self._cache.k.at[:, ids_j].set(
+                jnp.asarray(kb, self._cache.k.dtype)
+            ),
+            v=self._cache.v.at[:, ids_j].set(
+                jnp.asarray(vb, self._cache.v.dtype)
+            ),
+        )
+        self._owned[slot] = ids
+        self._table_np[slot, :] = NULL_BLOCK
+        self._table_np[slot, :need] = ids
+        self._upload_table()
+        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+        self._prio[slot] = int(req.get("priority", 0))
+        if self.spec_gamma > 0:
+            # the draft cache never rides a handoff — its layers re-prefill
+            # (any draft state verifies to the same greedy target stream)
+            padded = np.zeros((1, self.prompt_bucket), np.int32)
+            padded[0, : len(tokens)] = tokens
+            self._run_draft_prefill(padded, len(tokens), slot)
+        self._slots[slot] = st
+        self._last = self._last.at[slot].set(tokens[-1])
+        self._pos = self._pos.at[slot].set(len(tokens) - 1)
+        self._temps = self._temps.at[slot].set(float(req["temperature"]))
+        self._keys = self._keys.at[slot].set(
+            jnp.asarray(np.asarray(req["key"], dtype=np.uint32))
+        )
+        self._stop_pos = self._stop_pos.at[slot].set(
+            st.prompt_len + serve._slot_budget(st) - 1
+        )
+        self._retire(slot)  # history may already sit at its budget
+        self._update_gauges()
+        return True
+
+    def snapshot_active(self, include_kv: bool = False) -> dict:
         """Graceful drain over the pool: capture every in-flight request —
         resident slots, slots still mid-chunked-admission (their history
         is just the prompt), and preempted/parked requests — as the same
         JSON shape the dense engine emits (serve._snapshot_request), so a
         snapshot restores into EITHER engine class.  Host-only: one
         readback of the sampler vectors, zero decode dispatches, zero
-        block traffic."""
+        block traffic.
+
+        ``include_kv=True`` attaches resident (activated) slots' live
+        cache blocks as canonical-layout payloads under ``"kv"``
+        (serve.KVSlice); mid-admission and parked entries carry none —
+        they re-prefill at restore like today.  KV-bearing snapshots are
+        NOT JSON (the default keeps the wedge-bundle json.dumps path
+        intact)."""
         from k8s_dra_driver_tpu.models import serve
 
         temps = self._readback(self._temps)
@@ -1798,11 +1948,14 @@ class PagedServeEngine:
                     trace=self.telemetry.export_trace(st.request_id),
                 ))
             else:
-                reqs.append(serve._snapshot_request(
+                req = serve._snapshot_request(
                     st, float(temps[slot]), keys[slot], int(ads[slot]),
                     self._prio[slot],
                     trace=self.telemetry.export_trace(st.request_id),
-                ))
+                )
+                if include_kv and len(st.tokens) > 1:
+                    req["kv"] = self._capture_kv(slot, len(st.tokens) - 1)
+                reqs.append(req)
         for r in self._preempted:
             reqs.append(serve._snapshot_request(
                 r["st"], float(r["temp"]), r["key"],
@@ -1862,6 +2015,25 @@ class PagedServeEngine:
                 int(req["request_id"]), tokens, int(req["prompt_len"]),
                 int(req["max_tokens"]), req.get("deadline"),
             )
+            kv = req.get("kv")
+            if kv is not None and serve._kv_geometry_ok(self, kv, len(tokens)):
+                if self._restore_inject(req, st, kv):
+                    restored.append(st.request_id)
+                    JOURNAL.record(
+                        "serve", "request.restore",
+                        correlation=f"req-{st.request_id}",
+                        resumed_at=len(tokens), kv_inject=True,
+                    )
+                    self.telemetry.on_restore(
+                        st.request_id, resumed_at=len(tokens)
+                    )
+                    continue
+                # no slot/blocks right now: park WITHOUT the payload — by
+                # the time capacity frees the blocks could be long gone,
+                # so the proven re-prefill path takes over
+                serve._M_DISAGG_FALLBACK.inc(reason="no_capacity")
+            elif kv is not None:
+                serve._M_DISAGG_FALLBACK.inc(reason="incompatible")
             self._preempted.append(
                 dict(
                     st=st, temp=float(req["temperature"]),

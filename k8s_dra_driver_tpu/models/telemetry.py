@@ -350,6 +350,12 @@ class EngineTelemetry:
     def on_admission_chunk(self, request_id: int) -> None:
         if not self.enabled:
             return
+        # A prefill chunk is a device dispatch: it beats the heart and
+        # counts toward ``bursts`` so the fleet stall detector reads
+        # chunked admission as progress, not a wedge (an engine whose only
+        # residents are mid-admission dispatches no decode bursts at all).
+        self._last_beat = self.clock()
+        self._bursts += 1
         tr = self._traces.get(request_id)
         if tr is None:
             return
@@ -535,6 +541,19 @@ class EngineTelemetry:
         if not tr.engines or tr.engines[-1] != self._engine_kind:
             tr.engines.append(self._engine_kind)
         self._traces[request_id] = tr
+
+    @staticmethod
+    def annotate_trace_doc(doc: dict | None, name: str, t: float, **attrs) -> None:
+        """Append an event to an EXPORTED trace doc (the dict riding in a
+        snapshot entry) while the request is between engines — the disagg
+        router uses this to stamp handoff begin/complete/fallback onto the
+        timeline so TTFT attribution survives the pool crossing.  No-op on
+        ``None`` docs (telemetry disabled at the source engine)."""
+        if doc is None:
+            return
+        events = doc.setdefault("events", [])
+        if len(events) < MAX_EVENTS_PER_TRACE:
+            events.append({"event": name, "t": t, **attrs})
 
     def drop_trace(self, request_id: int) -> None:
         """Forget a request that migrated AWAY from this engine (the

@@ -24,6 +24,7 @@ DEVICE_TYPE_CHIP = "tpu"
 DEVICE_TYPE_SUBSLICE = "subslice"
 DEVICE_TYPE_MEMBERSHIP = "membership"
 DEVICE_TYPE_GROUP_SEAT = "slicegroup"
+DEVICE_TYPE_CHANNEL = "interconnect"
 
 _PRODUCT_NAMES = {
     "v4": "tpu-v4",
@@ -231,6 +232,51 @@ class SliceGroupSeatInfo:
         return Device(name=self.name, basic=BasicDevice(attributes=attrs))
 
 
+@dataclass
+class InterconnectChannelInfo:
+    """One KV-handoff interconnect channel — the transfer path between a
+    prefill pool and a decode pool published as a first-class claimable
+    device (the Kubernetes Network Driver Model pattern: network/transfer
+    capacity modeled like any other DRA resource).  The serving layer
+    binds a ``models.disagg.HandoffChannel`` to the claim
+    (``ChannelClaim.from_daemon_info``), so the scheduler sizes transfer
+    capacity exactly like chips and subslices."""
+
+    channel_name: str = "ici-0"
+    bandwidth_gbps: float = 100.0
+    max_in_flight_bytes: int = 64 * 1024 * 1024
+    transfer_deadline_ms: int = 250
+
+    @property
+    def name(self) -> str:
+        return f"channel-{self.channel_name}"
+
+    @property
+    def uuid(self) -> str:
+        return f"interconnect/{self.channel_name}"
+
+    def get_device(self) -> Device:
+        attrs = {
+            "type": DeviceAttribute.of(DEVICE_TYPE_CHANNEL),
+            "uuid": DeviceAttribute.of(self.uuid),
+            "channelName": DeviceAttribute.of(self.channel_name),
+            "bandwidthGbps": DeviceAttribute.of(int(self.bandwidth_gbps)),
+            "transferDeadlineMs": DeviceAttribute.of(self.transfer_deadline_ms),
+        }
+        capacity = {"inFlightBytes": format_bytes(self.max_in_flight_bytes)}
+        return Device(name=self.name, basic=BasicDevice(attributes=attrs, capacity=capacity))
+
+    def to_info(self) -> dict:
+        """The topology daemon's info-doc form — the dict
+        ``models.disagg.ChannelClaim.from_daemon_info`` consumes."""
+        return {
+            "name": self.channel_name,
+            "bandwidth_gbps": self.bandwidth_gbps,
+            "max_in_flight_bytes": self.max_in_flight_bytes,
+            "transfer_deadline_s": self.transfer_deadline_ms / 1000.0,
+        }
+
+
 def _semverish(version: str) -> str:
     """Coerce free-form driver versions into the semver the `version`
     attribute type requires (deviceinfo.go stamps driverVersion similarly)."""
@@ -249,6 +295,7 @@ class AllocatableDevice:
     subslice: TpuSubsliceInfo | None = None
     membership: SliceMembershipInfo | None = None
     group_seat: SliceGroupSeatInfo | None = None
+    channel: InterconnectChannelInfo | None = None
 
     @property
     def kind(self) -> str:
@@ -260,11 +307,16 @@ class AllocatableDevice:
             return DEVICE_TYPE_MEMBERSHIP
         if self.group_seat is not None:
             return DEVICE_TYPE_GROUP_SEAT
+        if self.channel is not None:
+            return DEVICE_TYPE_CHANNEL
         raise ValueError("empty AllocatableDevice")
 
     @property
     def impl(self):
-        return self.chip or self.subslice or self.membership or self.group_seat
+        return (
+            self.chip or self.subslice or self.membership
+            or self.group_seat or self.channel
+        )
 
     @property
     def name(self) -> str:

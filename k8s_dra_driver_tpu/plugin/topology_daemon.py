@@ -88,6 +88,11 @@ class DaemonState:
     # contend (the reference's timeslice is likewise per-GPU,
     # nvlib.go:521-539).
     leases: dict[str, Lease] = field(default_factory=dict)
+    # The KV-handoff interconnect channel this host publishes (the DRA
+    # claim models/disagg.py binds its HandoffChannel to) — the
+    # ``deviceinfo.InterconnectChannelInfo.to_info()`` dict, or empty when
+    # the host publishes no channel.
+    channel: dict = field(default_factory=dict)
 
 
 class TopologyDaemonServer:
@@ -106,6 +111,7 @@ class TopologyDaemonServer:
         partitions: Optional[list[dict]] = None,
         hbm_limits: Optional[dict[str, str]] = None,
         quantum_ms: int = DEFAULT_QUANTUM_MS,
+        channel: Optional[dict] = None,
     ):
         self.socket_path = socket_path
         self.state = DaemonState(
@@ -114,6 +120,7 @@ class TopologyDaemonServer:
             partitions=partitions or [],
             hbm_limits=hbm_limits or {},
             quantum_ms=quantum_ms,
+            channel=channel or {},
         )
         self._cond = threading.Condition()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -131,6 +138,13 @@ class TopologyDaemonServer:
         raw = environ.get("TPU_HBM_LIMITS", "")
         if raw:
             hbm_limits = dict(kv.split("=", 1) for kv in raw.split(",") if "=" in kv)
+        channel: dict = {}
+        raw = environ.get("TPU_HANDOFF_CHANNEL", "")
+        if raw:
+            # The interconnect-channel claim this host publishes, JSON
+            # (deviceinfo.InterconnectChannelInfo.to_info() shape) —
+            # injected by the template alongside TPU_PARTITIONS.
+            channel = json.loads(raw)
         return cls(
             socket_path,
             claim_uid=claim_uid,
@@ -138,6 +152,7 @@ class TopologyDaemonServer:
             partitions=partitions,
             hbm_limits=hbm_limits,
             quantum_ms=int(environ.get("TPU_QUEUE_QUANTUM_MS", DEFAULT_QUANTUM_MS)),
+            channel=channel,
         )
 
     # -- request handling ---------------------------------------------------
@@ -163,6 +178,7 @@ class TopologyDaemonServer:
                 "partitions": self.state.partitions,
                 "hbm_limits": self.state.hbm_limits,
                 "quantum_ms": self.state.quantum_ms,
+                "channel": self.state.channel,
                 "consumers": sorted(self.state.consumers),
                 "lease_holders": {
                     scope: lease.consumer

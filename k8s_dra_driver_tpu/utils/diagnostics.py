@@ -25,6 +25,10 @@ Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
   evacuating/drained), breaker state, last verdict and cached
   ``EngineStats``, plus the fleet front-door queue depth and parked
   evacuees (JSON).
+* ``/debug/disagg``   — every live :class:`~k8s_dra_driver_tpu.models.
+  disagg.DisaggRouter`'s view: prefill/decode pool membership (full
+  fleet stats per pool), staged handoffs, in-flight transfers and the
+  channel's claim/budget/outcome tally (JSON).
 """
 
 from __future__ import annotations
@@ -115,6 +119,15 @@ class DiagnosticsServer:
 
                     body = json.dumps(
                         debug_fleet_doc(), indent=1, default=str
+                    ).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/disagg":
+                    # Lazy for the same reason as /debug/fleet; disagg.py
+                    # is jax-free, so this stays control-plane safe.
+                    from k8s_dra_driver_tpu.models.disagg import debug_disagg_doc
+
+                    body = json.dumps(
+                        debug_disagg_doc(), indent=1, default=str
                     ).encode()
                     ctype = "application/json"
                 else:

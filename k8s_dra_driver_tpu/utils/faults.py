@@ -108,6 +108,13 @@ class FaultProfile:
     replica_wedge_rate: float = 0.0  # probability a replica hangs this tick
     stats_stale_rate: float = 0.0  # probability stats() serves a frozen copy
     replicas: tuple = ()  # e.g. (1,); empty = all replicas
+    # channel-scoped (disaggregated KV handoff) kinds: consulted by the
+    # HandoffChannel once per transfer, BEFORE the payload is delivered to
+    # the decode pool — a dropped or corrupted transfer therefore never
+    # half-installs KV bytes; the router falls back to re-prefill.
+    handoff_drop_rate: float = 0.0  # probability a transfer is dropped in flight
+    handoff_latency_s: float = 0.0  # simulated seconds added per transfer
+    handoff_corrupt_rate: float = 0.0  # probability payload bytes arrive corrupted
     limit: int = 0  # total-injection cap, 0 = unlimited
     injected: int = field(default=0, compare=False)
 
@@ -269,6 +276,48 @@ class FaultInjector:
                 return True
         return False
 
+    # -- channel decision points (disaggregated KV handoff) ----------------
+
+    def take_handoff_drop(self, request_id: int) -> bool:
+        """Channel hook: should this KV transfer be dropped in flight?  A
+        dropped transfer must surface as a fallback re-prefill on the
+        decode pool — never a lost or duplicated stream."""
+        for p in self._matching_engine(None, None):
+            if p.handoff_drop_rate and self._roll(
+                p, p.handoff_drop_rate, "handoff_drop",
+                f"request-{request_id}", "channel",
+            ):
+                return True
+        return False
+
+    def take_handoff_latency(self) -> float:
+        """Channel hook: simulated seconds added to this transfer.  Unlike
+        :meth:`take_step_latency` it does NOT sleep — handoff latency is
+        accounted into the transfer's deadline arithmetic, so chaos runs
+        stay fast while still exercising the deadline path."""
+        total = 0.0
+        for p in self._matching_engine(None, None):
+            if p.handoff_latency_s > 0:
+                with self._lock:
+                    if not self._budget_ok(p):
+                        continue
+                    self._record(p, "handoff_latency", "TRANSFER", "channel")
+                total += p.handoff_latency_s
+        return total
+
+    def take_handoff_corrupt(self, request_id: int) -> bool:
+        """Channel hook: should this transfer's payload arrive corrupted?
+        The channel detects it via checksum mismatch and the router treats
+        it exactly like a drop (fallback re-prefill) — corrupted KV bytes
+        must never be injected into a decode replica."""
+        for p in self._matching_engine(None, None):
+            if p.handoff_corrupt_rate and self._roll(
+                p, p.handoff_corrupt_rate, "handoff_corrupt",
+                f"request-{request_id}", "channel",
+            ):
+                return True
+        return False
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict[str, int]:
@@ -366,10 +415,18 @@ class FaultInjector:
                 fields["latency_s"] = float(value) / 1000.0
             elif key == "step_latency_ms":
                 fields["step_latency_s"] = float(value) / 1000.0
+            elif key == "handoff_latency_ms":
+                fields["handoff_latency_s"] = float(value) / 1000.0
+            elif key == "handoff_drop":
+                fields["handoff_drop_rate"] = float(value)
+            elif key == "handoff_corrupt":
+                fields["handoff_corrupt_rate"] = float(value)
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
                          "watch_hang_s", "nan_logits_rate", "step_raise_rate",
                          "step_latency_s", "replica_crash_rate",
-                         "replica_wedge_rate", "stats_stale_rate"):
+                         "replica_wedge_rate", "stats_stale_rate",
+                         "handoff_drop_rate", "handoff_latency_s",
+                         "handoff_corrupt_rate"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
                          "watch_hangs", "limit"):

@@ -1,0 +1,458 @@
+"""Disaggregated prefill/decode suite (models/disagg.py): the tentpole's
+correctness contracts, fault-free.
+
+* The bit-equality handoff matrix: every pool combination {dense, paged}
+  prefill x {dense, paged} decode, under every stream-shaping feature
+  {greedy, sampled, LoRA, prefix-cache, spec}, produces token streams
+  identical to a unified reference engine — disaggregation moves
+  scheduling and KV bytes, never tokens.
+* The KV payload keystone: a prompt's captured KV bytes are bit-identical
+  across engine kinds (canonical [L, valid_len, Hkv, hd] layout), which is
+  what makes cross-kind injection exact rather than approximate.
+* Block-leak accounting: paged pools return to their initial free-block
+  level after success, forced-drop and forced-refusal paths alike.
+* The channel as a claimed resource: bounded in-flight budget, deadline
+  staleness, checksum integrity; ChannelClaim binds from the topology
+  daemon's published info doc (TPU_HANDOFF_CHANNEL -> ResourceSlice ->
+  claim), with a static fallback.
+* /debug/disagg and the tpu_disagg_* metric surface.
+
+Fault-injected storm variants live in tests/test_disagg_chaos.py
+(`make chaos-disagg`).
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, lora, paged
+from k8s_dra_driver_tpu.models.disagg import (
+    ChannelClaim,
+    DisaggRouter,
+    HandoffChannel,
+    debug_disagg_doc,
+)
+from k8s_dra_driver_tpu.models.serve import KVSlice, ServeEngine
+from k8s_dra_driver_tpu.plugin.deviceinfo import (
+    DEVICE_TYPE_CHANNEL,
+    AllocatableDevice,
+    InterconnectChannelInfo,
+)
+from k8s_dra_driver_tpu.plugin.topology_daemon import TopologyDaemonServer
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+LORA = lora.LoraConfig(rank=4, alpha=8.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def bank():
+    def trained(seed):
+        ad = lora.init_adapters(jax.random.PRNGKey(seed), CFG, LORA)
+        for li, blk in enumerate(ad["blocks"]):
+            for name, ab in blk.items():
+                tag = li * 1000 + sum(ord(c) for c in name)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), tag)
+                ab["b"] = 0.3 * jax.random.normal(
+                    key, ab["b"].shape, jax.numpy.float32
+                )
+        return ad
+
+    return lora.stack_adapters(CFG, LORA, [trained(1), trained(2)])
+
+
+def _prompts(n, rng=7, lo=3, hi=12):
+    r = np.random.RandomState(rng)
+    return [
+        r.randint(0, CFG.vocab_size, size=r.randint(lo, hi)).tolist()
+        for _ in range(n)
+    ]
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 41)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+KINDS = {"dense": _dense, "paged": _paged}
+COMBOS = [(a, b) for a in KINDS for b in KINDS]
+
+_SYS = list(range(40, 48))  # shared 8-token system prompt (prefix feature)
+
+# feature -> (requests builder, per-kind engine kwargs).  Prefix-cache
+# kwargs differ by kind (prefix_bucket vs prefix_cache_blocks); the LoRA
+# bank is injected by the test (fixture-built).
+FEATURES = {
+    "greedy": dict(
+        reqs=lambda: [{"prompt": p, "max_tokens": 5} for p in _prompts(3)],
+        dense={}, paged={},
+    ),
+    "sampled": dict(
+        reqs=lambda: [
+            {"prompt": p, "max_tokens": 5, "temperature": 0.8, "seed": 50 + i}
+            for i, p in enumerate(_prompts(3, rng=11))
+        ],
+        dense={}, paged={},
+    ),
+    "lora": dict(
+        reqs=lambda: [
+            {"prompt": p, "max_tokens": 5, "adapter": i % 3}
+            for i, p in enumerate(_prompts(3, rng=13))
+        ],
+        dense=dict(adapter_bank="BANK"), paged=dict(adapter_bank="BANK"),
+    ),
+    "prefix": dict(
+        reqs=lambda: [
+            {"prompt": _SYS + p, "max_tokens": 5}
+            for p in _prompts(3, rng=17, lo=2, hi=8)
+        ],
+        dense=dict(prefix_bucket=8), paged=dict(prefix_cache_blocks=2),
+    ),
+    "spec": dict(
+        reqs=lambda: [{"prompt": p, "max_tokens": 5} for p in _prompts(3, rng=19)],
+        dense=dict(spec_gamma=2), paged=dict(spec_gamma=2),
+    ),
+}
+
+
+def _engine(kind, params, feature, bank):
+    kw = dict(FEATURES[feature][kind])
+    if kw.get("adapter_bank") == "BANK":
+        kw["adapter_bank"] = bank
+    return KINDS[kind](params, **kw)
+
+
+def _by_prompt(completions):
+    """prompt-tuple -> generated-tuple: router-minted ids differ from the
+    single-engine reference, prompts don't."""
+    out = {}
+    for c in completions:
+        assert c.status == "ok", (c.request_id, c.status, c.error)
+        out[tuple(c.tokens[: len(c.tokens) - len(c.generated)])] = tuple(
+            c.generated
+        )
+    return out
+
+
+_REF_CACHE: dict = {}
+
+
+def _reference(params, feature, bank):
+    """Unified-engine streams for a feature (memoized: the repo pins
+    dense == paged and prefix/spec stream-invariance elsewhere, so one
+    dense reference anchors every pool combination)."""
+    if feature not in _REF_CACHE:
+        eng = _engine("dense", params, feature, bank)
+        _REF_CACHE[feature] = _by_prompt(
+            eng.pump([dict(r) for r in FEATURES[feature]["reqs"]()])
+        )
+    return _REF_CACHE[feature]
+
+
+class TestHandoffMatrix:
+    """The acceptance matrix: 4 pool combinations x 5 features, every
+    stream bit-equal to the unified reference, every transfer delivered
+    (fault-free channel => zero fallbacks), paged pools leak-free."""
+
+    @pytest.mark.parametrize("feature", list(FEATURES))
+    @pytest.mark.parametrize(
+        "pre_kind,dec_kind", COMBOS, ids=[f"{a}_to_{b}" for a, b in COMBOS]
+    )
+    def test_streams_bit_equal_and_zero_fallbacks(
+        self, params, bank, pre_kind, dec_kind, feature
+    ):
+        reqs = FEATURES[feature]["reqs"]()
+        pre = _engine(pre_kind, params, feature, bank)
+        dec = _engine(dec_kind, params, feature, bank)
+        free0 = {
+            id(e): e.free_blocks
+            for e in (pre, dec) if hasattr(e, "free_blocks")
+        }
+        router = DisaggRouter(prefill=[pre], decode=[dec])
+        done = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(done) == _reference(params, feature, bank)
+        # one Completion per request — never a lost or duplicated stream
+        assert len(done) == len(reqs)
+        assert router.handoffs == len(reqs)
+        assert router.fallbacks == 0
+        assert router.channel.counts == {"ok": len(reqs)}
+        for e in (pre, dec):
+            if not hasattr(e, "free_blocks"):
+                continue
+            if feature == "prefix":
+                # the prefix store retains shared blocks BY DESIGN —
+                # bounded by its configured capacity, not a leak
+                assert e.free_blocks >= free0[id(e)] - e.prefix_cache_blocks
+            else:
+                assert e.free_blocks == free0[id(e)]
+
+
+class TestKVPayload:
+    """The keystone under the matrix: canonical KV capture is bit-identical
+    across engine kinds, so cross-kind injection is exact."""
+
+    def test_capture_bytes_bit_identical_across_kinds(self, params):
+        (p,) = _prompts(1, rng=23, lo=9, hi=10)
+        slices = []
+        for make in (_dense, _paged):
+            eng = make(params)
+            eng.submit(p, max_tokens=5, handoff=True)
+            eng.run_until_drained()
+            (entry,) = eng.take_handoffs()
+            slices.append(entry["kv"])
+        a, b = slices
+        assert isinstance(a, KVSlice) and isinstance(b, KVSlice)
+        assert a.valid_len == b.valid_len == len(p)  # first-token handoff
+        assert a.k.shape == b.k.shape == (
+            CFG.n_layers, len(p), CFG.kv_heads, CFG.head_dim
+        )
+        assert np.array_equal(a.k, b.k) and np.array_equal(a.v, b.v)
+        assert a.checksum() == b.checksum()
+
+    def test_handoff_mode_is_optional_on_both_kinds(self, params):
+        import inspect
+
+        for make in (_dense, _paged):
+            eng = make(params)
+            assert inspect.signature(eng.submit).parameters[
+                "handoff"
+            ].default is False
+            assert inspect.signature(eng.snapshot_active).parameters[
+                "include_kv"
+            ].default is False
+            assert callable(eng.take_handoffs)
+
+
+def _kv(fill=1.0):
+    k = np.full((1, 2, 1, 2), fill, np.float32)
+    return KVSlice(
+        k=k, v=k + 1, valid_len=2, n_layers=1, kv_heads=1, head_dim=2,
+        dtype="float32",
+    )
+
+
+class TestHandoffChannel:
+    """The transfer path as a claimed resource: bounded in-flight bytes,
+    per-transfer deadlines, end-to-end checksums — latency accounted,
+    never slept."""
+
+    def test_in_flight_budget_backpressures_then_releases(self):
+        ch = HandoffChannel(max_in_flight_bytes=100)
+        kv = _kv()
+        t1 = ch.begin(1, 60, kv.checksum())
+        assert t1 is not None and ch.in_flight_bytes == 60
+        assert ch.begin(2, 60, kv.checksum()) is None  # budget spent
+        assert ch.complete(t1, kv) == "ok"
+        assert ch.in_flight_bytes == 0
+        assert ch.begin(2, 60, kv.checksum()) is not None  # budget back
+
+    def test_payload_past_whole_budget_never_fits(self):
+        ch = HandoffChannel(max_in_flight_bytes=8)
+        assert not ch.fits(32)
+        ch.refuse(7, 32, "exceeds channel budget")
+        assert ch.counts == {"no_capacity": 1}
+
+    def test_deadline_marks_slow_transfer_stale_without_sleeping(self):
+        import time
+
+        # 1 Gbps over 1 MiB => ~8.4ms modeled latency vs a 1ms deadline
+        ch = HandoffChannel(
+            bandwidth_gbps=1.0, transfer_deadline_s=0.001,
+            max_in_flight_bytes=1 << 30,
+        )
+        kv = _kv()
+        t = ch.begin(3, 1 << 20, kv.checksum())
+        t0 = time.perf_counter()
+        assert ch.complete(t, kv) == "deadline"
+        assert time.perf_counter() - t0 < 0.05  # accounted, not slept
+        assert t.latency_s > ch.transfer_deadline_s
+        assert ch.in_flight_bytes == 0  # stale transfers release budget too
+
+    def test_checksum_mismatch_is_corrupt(self):
+        ch = HandoffChannel()
+        kv = _kv()
+        t = ch.begin(4, kv.nbytes, kv.checksum() ^ 0xDEAD)
+        assert ch.complete(t, kv) == "corrupt"
+
+
+class TestChannelClaim:
+    """DRA binding: the channel's capacity parameters come from the
+    interconnect device the topology daemon publishes."""
+
+    def test_claim_binds_from_daemon_info(self, tmp_path):
+        info = InterconnectChannelInfo(
+            channel_name="ici-3", bandwidth_gbps=42.0,
+            max_in_flight_bytes=1 << 20, transfer_deadline_ms=75,
+        )
+        srv = TopologyDaemonServer(
+            str(tmp_path / "claim.sock"), claim_uid="uid-1",
+            channel=info.to_info(),
+        )
+        doc = srv.handle_request({"op": "info"})
+        claim = ChannelClaim.from_daemon_info(doc)
+        assert claim is not None and claim.source == "daemon"
+        assert claim.name == "ici-3"
+        assert claim.bandwidth_gbps == 42.0
+        assert claim.max_in_flight_bytes == 1 << 20
+        assert claim.transfer_deadline_s == pytest.approx(0.075)
+        ch = HandoffChannel(claim)
+        assert ch.max_in_flight_bytes == 1 << 20
+        assert ch.transfer_deadline_s == pytest.approx(0.075)
+        assert ch.bandwidth_gbps == 42.0
+
+    def test_daemon_parses_channel_from_env(self, tmp_path):
+        env = {
+            "TPU_HANDOFF_CHANNEL": json.dumps(
+                InterconnectChannelInfo(channel_name="ici-9").to_info()
+            ),
+        }
+        srv = TopologyDaemonServer.from_env(
+            str(tmp_path / "c.sock"), "uid-2", environ=env
+        )
+        claim = ChannelClaim.from_daemon_info(srv.handle_request({"op": "info"}))
+        assert claim.name == "ici-9" and claim.source == "daemon"
+
+    def test_no_published_channel_falls_back_to_static(self, tmp_path):
+        srv = TopologyDaemonServer(str(tmp_path / "c.sock"), claim_uid="u")
+        assert ChannelClaim.from_daemon_info(
+            srv.handle_request({"op": "info"})
+        ) is None
+        assert HandoffChannel().claim.source == "static"
+
+    def test_channel_device_in_resourceslice_inventory(self):
+        info = InterconnectChannelInfo(channel_name="ici-0")
+        dev = AllocatableDevice(channel=info)
+        assert dev.kind == DEVICE_TYPE_CHANNEL
+        rendered = info.get_device()
+        attrs = rendered.basic.attributes
+        assert attrs["type"].string == DEVICE_TYPE_CHANNEL
+        assert attrs["channelName"].string == "ici-0"
+        assert "inFlightBytes" in rendered.basic.capacity
+
+
+class TestFallbackLadder:
+    """Channel faults cost compute, never correctness: forced drops and
+    outright refusals both re-prefill to bit-equal streams with balanced
+    block accounting."""
+
+    def test_forced_drops_fall_back_bit_equal_no_block_leak(self, params, bank):
+        reqs = FEATURES["greedy"]["reqs"]()
+        inj = FaultInjector(seed=5)
+        inj.arm(FaultProfile(name="drop", handoff_drop_rate=1.0, limit=2))
+        pre, dec = _paged(params), _paged(params)
+        free0 = (pre.free_blocks, dec.free_blocks)
+        router = DisaggRouter(
+            prefill=[pre], decode=[dec], fault_injector=inj
+        )
+        done = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(done) == _reference(params, "greedy", bank)
+        assert len(done) == len(reqs)
+        assert router.fallbacks == 2
+        assert router.channel.counts["dropped"] == 2
+        assert router.channel.counts["ok"] == len(reqs) - 2
+        assert (pre.free_blocks, dec.free_blocks) == free0
+        assert REGISTRY.counter("tpu_disagg_fallback_total").value(
+            reason="dropped"
+        ) == 2
+
+    def test_oversized_payload_refused_and_reprefilled(self, params, bank):
+        reqs = FEATURES["greedy"]["reqs"]()
+        pre, dec = _paged(params), _paged(params)
+        free0 = (pre.free_blocks, dec.free_blocks)
+        router = DisaggRouter(
+            prefill=[pre], decode=[dec],
+            channel=HandoffChannel(max_in_flight_bytes=8),
+        )
+        done = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(done) == _reference(params, "greedy", bank)
+        assert router.fallbacks == len(reqs)
+        assert router.channel.counts == {"no_capacity": len(reqs)}
+        assert (pre.free_blocks, dec.free_blocks) == free0
+        assert REGISTRY.counter("tpu_disagg_fallback_total").value(
+            reason="too_large"
+        ) == len(reqs)
+
+
+class TestObservability:
+    """/debug/disagg and the documented tpu_disagg_* metric surface."""
+
+    def test_metrics_surface_after_a_clean_pump(self, params):
+        reqs = FEATURES["greedy"]["reqs"]()
+        router = DisaggRouter(
+            prefill=[_dense(params)], decode=[_dense(params)]
+        )
+        router.pump([dict(r) for r in reqs])
+        n = len(reqs)
+        assert REGISTRY.counter("tpu_disagg_transfers_total").value(
+            outcome="ok"
+        ) == n
+        assert REGISTRY.histogram("tpu_disagg_transfer_bytes").count() == n
+        ttft = REGISTRY.histogram("tpu_disagg_ttft_breakdown_seconds")
+        assert ttft.count(stage="prefill") == n
+        assert ttft.count(stage="transfer") == n
+        assert ttft.count(stage="decode") == n
+        assert REGISTRY.gauge("tpu_disagg_inflight_bytes").value() == 0
+        text = REGISTRY.render()
+        for name in (
+            "tpu_disagg_transfers_total",
+            "tpu_disagg_transfer_bytes",
+            "tpu_disagg_fallback_total",
+            "tpu_disagg_ttft_breakdown_seconds",
+            "tpu_disagg_inflight_bytes",
+        ):
+            assert f"# HELP {name} " in text, name
+
+    def test_debug_disagg_doc_and_endpoint(self, params):
+        import urllib.request
+
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        router = DisaggRouter(
+            prefill=[_dense(params)], decode=[_dense(params)]
+        )
+        router.pump([{"prompt": [5, 6, 7], "max_tokens": 3}])
+        doc = debug_disagg_doc()
+        mine = {d["router_seq"]: d for d in doc["disagg"]}[router.seq]
+        assert mine["handoffs"] == 1 and mine["fallbacks"] == 0
+        assert mine["channel"]["outcomes"] == {"ok": 1}
+        assert mine["prefill"]["replicas"][0]["state"] == "healthy"
+        srv = DiagnosticsServer(port=0)
+        srv.start()
+        try:
+            served = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/disagg").read())
+        finally:
+            srv.stop()
+        assert router.seq in {d["router_seq"] for d in served["disagg"]}
+
+    def test_trace_carries_handoff_events_across_pools(self, params):
+        pre, dec = _dense(params), _dense(params)
+        router = DisaggRouter(prefill=[pre], decode=[dec])
+        (c,) = router.pump([{"prompt": [9, 10, 11], "max_tokens": 4}])
+        tr = dec.telemetry._traces[c.request_id]
+        names = [e["event"] for e in tr.events]
+        assert "handoff_begin" in names
+        assert "handoff_transfer" in names
+        # one contiguous timeline: TTFT anchored at the PREFILL pool's
+        # first token, e2e spans both pools
+        assert tr.ttft_s() is not None and tr.e2e_s() is not None
+        assert tr.e2e_s() >= tr.ttft_s()

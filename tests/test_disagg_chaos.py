@@ -1,0 +1,237 @@
+"""Disaggregation chaos suite: the KV-handoff channel under injected
+transfer faults (`make chaos-disagg`, <15s, CPU, seeded).
+
+The channel twin of tests/test_fleet_chaos.py — utils/faults.py's
+CHANNEL-scoped kinds (handoff_drop, handoff_latency_ms, handoff_corrupt)
+break transfers mid-flight between a prefill pool and a decode pool, and
+these tests pin the PR's acceptance property:
+
+    a transfer dropped / corrupted / past-deadline mid-flight -> the
+    stream still completes BIT-EQUAL via re-prefill fallback on the
+    decode pool, zero lost or duplicated completions, per-pool block
+    accounting balanced, and corrupted or stale KV bytes NEVER injected
+    into a decode replica.
+
+Latency faults are ACCOUNTED into deadline arithmetic, never slept — a
+60-simulated-second transfer storm finishes in wall-milliseconds.  Every
+fault draws from a seeded injector: a failure replays from its seed, and
+the whole suite is armable from the environment via DRA_FAULTS.
+"""
+
+import time
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, paged
+from k8s_dra_driver_tpu.models.disagg import DisaggRouter
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+from k8s_dra_driver_tpu.utils.faults import ENV_VAR, FaultInjector
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 41)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _inj(spec: str) -> FaultInjector:
+    return FaultInjector.from_env(spec)
+
+
+# Explicit per-request seeds: router-minted ids differ from the unified
+# reference, so sampling keys must come from the request, never the id.
+REQS = [
+    {"prompt": [7, 8, 9], "max_tokens": 6, "seed": 5},
+    {"prompt": [3, 4], "max_tokens": 6, "temperature": 0.7, "seed": 9},
+    {"prompt": [11, 12, 13, 14], "max_tokens": 6, "seed": 21},
+    {"prompt": [1, 2], "max_tokens": 6, "seed": 33},
+    {"prompt": [21, 22, 23], "max_tokens": 6, "seed": 44},
+]
+
+
+def _by_prompt(completions):
+    out = {}
+    for c in completions:
+        out[tuple(c.tokens[: len(c.tokens) - len(c.generated)])] = tuple(
+            c.generated
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def reference(params):
+    """Fault-free streams for REQS — the bit-equality baseline every
+    fallback re-prefill must reproduce on the decode pool."""
+    return _by_prompt(_dense(params).pump([dict(r) for r in REQS]))
+
+
+def _storm(params, spec_or_injector, *, channel=None, kind=_paged):
+    inj = (
+        spec_or_injector
+        if isinstance(spec_or_injector, FaultInjector)
+        else _inj(spec_or_injector)
+    )
+    pre, dec = kind(params), kind(params)
+    free0 = tuple(
+        e.free_blocks for e in (pre, dec) if hasattr(e, "free_blocks")
+    )
+    router = DisaggRouter(
+        prefill=[pre], decode=[dec], channel=channel, fault_injector=inj
+    )
+    done = router.pump([dict(r) for r in REQS])
+    free1 = tuple(
+        e.free_blocks for e in (pre, dec) if hasattr(e, "free_blocks")
+    )
+    return router, done, free0, free1
+
+
+def _assert_no_lost_or_dup(done, reference):
+    assert len(done) == len(REQS)
+    assert [c.status for c in done].count("ok") == len(REQS)
+    rids = [c.request_id for c in done]
+    assert len(rids) == len(set(rids)), "duplicated completion ids"
+    assert _by_prompt(done) == reference
+
+
+class TestChannelFaultHooks:
+    def test_from_env_parses_channel_kinds(self):
+        inj = _inj(
+            "handoff_drop=1.0,handoff_latency_ms=250,handoff_corrupt=0.5,"
+            "limit=2,seed=7"
+        )
+        (p,) = inj._profiles
+        assert p.handoff_drop_rate == 1.0
+        assert p.handoff_latency_s == pytest.approx(0.25)
+        assert p.handoff_corrupt_rate == 0.5
+        assert p.limit == 2
+
+    def test_injection_budget_caps_channel_kinds(self):
+        inj = _inj("handoff_drop=1.0,limit=1")
+        assert inj.take_handoff_drop(0)
+        assert not inj.take_handoff_drop(1)  # budget spent
+
+    def test_latency_hook_accounts_without_sleeping(self):
+        inj = _inj("handoff_latency_ms=60000")
+        t0 = time.perf_counter()
+        assert inj.take_handoff_latency() == pytest.approx(60.0)
+        assert time.perf_counter() - t0 < 0.05
+
+
+class TestDropStorm:
+    """The acceptance run: transfers dropped mid-flight between the
+    pools."""
+
+    def test_zero_lost_streams_bit_equal_fallback(self, params, reference):
+        JOURNAL.clear()
+        router, done, free0, free1 = _storm(
+            params, "handoff_drop=1.0,limit=2,seed=3"
+        )
+        _assert_no_lost_or_dup(done, reference)
+        assert router.handoffs == len(REQS)
+        assert router.fallbacks == 2
+        assert router.channel.counts["dropped"] == 2
+        assert router.channel.counts["ok"] == len(REQS) - 2
+        assert free1 == free0, "block accounting unbalanced after drops"
+        # dropped payload bytes never count as moved
+        events = JOURNAL.tail(limit=400, component="disagg")
+        kinds = [e["event"] for e in events]
+        assert kinds.count("transfer.dropped") == 2
+        assert kinds.count("handoff.fallback") == 2
+        assert REGISTRY.counter("tpu_disagg_fallback_total").value(
+            reason="dropped"
+        ) == 2
+
+    def test_total_drop_storm_every_stream_survives(self, params, reference):
+        # 100% drop, no budget: the channel NEVER delivers a payload and
+        # the whole workload still completes via re-prefill.
+        router, done, free0, free1 = _storm(params, "handoff_drop=1.0,seed=3")
+        _assert_no_lost_or_dup(done, reference)
+        assert router.fallbacks == len(REQS)
+        assert router.channel.counts == {"dropped": len(REQS)}
+        assert router.channel.bytes_moved == 0
+        assert free1 == free0
+
+    def test_storm_replays_from_seed(self, params):
+        # Determinism of the chaos itself: same spec, same outcomes.
+        spec = "handoff_drop=0.5,seed=11"
+        a = _storm(params, spec, kind=_dense)[0].channel.counts
+        b = _storm(params, spec, kind=_dense)[0].channel.counts
+        assert a == b
+        assert a.get("dropped", 0) >= 1
+
+
+class TestCorruptStorm:
+    def test_corrupt_payload_never_injected(self, params, reference):
+        router, done, free0, free1 = _storm(
+            params, "handoff_corrupt=1.0,limit=2,seed=5"
+        )
+        # bit-equality IS the proof: had corrupted KV reached a decode
+        # slot, the streams would diverge from the reference
+        _assert_no_lost_or_dup(done, reference)
+        assert router.channel.counts["corrupt"] == 2
+        assert router.fallbacks == 2
+        assert free1 == free0
+
+
+class TestLatencyStorm:
+    def test_past_deadline_transfers_fall_back_fast(self, params, reference):
+        # 60 SIMULATED seconds per transfer vs a 250ms deadline: every
+        # transfer is stale.  Wall time stays in milliseconds because
+        # channel latency is accounted, never slept.
+        t0 = time.perf_counter()
+        router, done, free0, free1 = _storm(
+            params, "handoff_latency_ms=60000,seed=5", kind=_dense
+        )
+        wall = time.perf_counter() - t0
+        _assert_no_lost_or_dup(done, reference)
+        assert router.channel.counts == {"deadline": len(REQS)}
+        assert router.fallbacks == len(REQS)
+        assert wall < 60.0, "simulated latency leaked into wall clock"
+
+
+class TestMixedStormFromEnv:
+    def test_env_armed_mixed_storm(self, params, reference, monkeypatch):
+        # The DRA_FAULTS path end to end: the router arms itself from the
+        # environment (no injector plumbed) and shares ONE budget across
+        # drop + corrupt + latency kinds.
+        monkeypatch.setenv(
+            ENV_VAR,
+            "handoff_drop=0.4,handoff_corrupt=0.4,handoff_latency_ms=500,"
+            "seed=17",
+        )
+        pre, dec = _paged(params), _paged(params)
+        free0 = (pre.free_blocks, dec.free_blocks)
+        router = DisaggRouter(prefill=[pre], decode=[dec])
+        assert router.fault_injector is not None
+        done = router.pump([dict(r) for r in REQS])
+        _assert_no_lost_or_dup(done, reference)
+        assert (pre.free_blocks, dec.free_blocks) == free0
+        # with a 500ms injected latency vs the 250ms default deadline,
+        # any transfer that dodges drop/corrupt still goes stale: the
+        # channel delivers NOTHING and every stream re-prefills
+        assert router.fallbacks == len(REQS)
+        assert router.channel.counts.get("ok", 0) == 0
+        outcomes = set(router.channel.counts)
+        assert outcomes <= {"dropped", "corrupt", "deadline"}
+        assert router.fault_injector.stats(), "no faults recorded"

@@ -367,6 +367,40 @@ class TestDrainMigration:
         assert _by_prompt(out) == self._reference(params)
         assert not router._parked
 
+    def test_restore_refusal_parks_instead_of_raising(self, params):
+        # Regression (disagg PR): a decode replica that is ITSELF draining
+        # can refuse restore(merge=True) ("needs an idle engine") under a
+        # race.  The router used to let that RuntimeError escape — the
+        # whole evacuation batch was lost.  Now the refused entries go
+        # back to the parking lot and retry on the next tick.
+        class RefusesOnce(ServeEngine):
+            refusals = 0
+
+            def restore(self, snap, merge=False):
+                # refuse the first REAL batch (add_replica's id-stride
+                # alignment restore carries no requests — let it through)
+                if merge and snap["requests"] and RefusesOnce.refusals == 0:
+                    RefusesOnce.refusals += 1
+                    raise RuntimeError(
+                        "restore(merge=True) needs an idle engine"
+                    )
+                return super().restore(snap, merge=merge)
+
+        router = self._mid_flight_router(
+            params,
+            RefusesOnce(params=params, cfg=CFG, n_slots=3, prompt_bucket=16),
+        )
+        JOURNAL.clear()
+        moved = router.drain("r0", reason="scale_down")
+        assert moved == [] and len(router._parked) == 2
+        kinds = [e["event"] for e in JOURNAL.tail(limit=100, component="fleet")]
+        assert "evac.restore_refused" in kinds
+        assert "evac.parked" in kinds
+        out = router.pump([])
+        assert RefusesOnce.refusals == 1
+        assert _by_prompt(out) == self._reference(params)
+        assert not router._parked and not router._owner
+
     def test_drain_with_no_survivors_parks_everything(self, params):
         router = FleetRouter([_dense(params)])
         router.submit([5, 6, 7], max_tokens=10, seed=3)

@@ -59,3 +59,14 @@ def test_router_overhead_stays_within_perf_budgets():
     # stats() snapshots — a 1-replica fleet dispatches EXACTLY the device
     # work of the bare engine (zero routing-added syncs).
     assert stats["host_syncs_routed"] == stats["host_syncs_bare"]
+
+
+def test_handoff_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_handoff_overhead()
+    assert stats["requests_disagg"] == 8
+    # The disaggregation contract: the 1-prefill/1-decode pair pays at
+    # most the unified engine's host syncs PLUS one KV-capture sync per
+    # request (= one transfer per request), and every transfer delivers
+    # on a fault-free channel.
+    assert stats["host_syncs_disagg"] <= stats["host_sync_ceiling"]
+    assert stats["transfers_ok"] == stats["requests_disagg"]

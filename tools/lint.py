@@ -28,7 +28,8 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
                      exists to remove.  Only models/serve.py and
                      models/paged.py (the two engines, where the batched
                      readback lives) are exempt
-  metric-docs        cross-file: every `tpu_serve_*` metric declared in
+  metric-docs        cross-file: every `tpu_serve_*` / `tpu_fleet_*` /
+                     `tpu_disagg_*` metric declared in
                      models/ must carry non-empty help text at some
                      declaring site AND appear in ARCHITECTURE.md's
                      metric inventory — the serving metrics are the
@@ -323,11 +324,11 @@ def check_file(path: Path) -> list[Finding]:
 
 
 def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
-    """Cross-file check: every ``tpu_serve_*`` / ``tpu_fleet_*`` metric
-    declared in models/ must (a) carry non-empty help text at at least one
-    declaring site and (b) appear in ARCHITECTURE.md (the metric
-    inventory / telemetry section).  Pure over its inputs so tests can
-    drive it with synthetic trees and doc text."""
+    """Cross-file check: every ``tpu_serve_*`` / ``tpu_fleet_*`` /
+    ``tpu_disagg_*`` metric declared in models/ must (a) carry non-empty
+    help text at at least one declaring site and (b) appear in
+    ARCHITECTURE.md (the metric inventory / telemetry section).  Pure over
+    its inputs so tests can drive it with synthetic trees and doc text."""
     # metric name -> list of (path, line, has_help)
     sites: dict[str, list[tuple[Path, int, bool]]] = {}
     for path in paths:
@@ -346,7 +347,9 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
                 and node.args
                 and isinstance(node.args[0], ast.Constant)
                 and isinstance(node.args[0].value, str)
-                and node.args[0].value.startswith(("tpu_serve_", "tpu_fleet_"))
+                and node.args[0].value.startswith(
+                    ("tpu_serve_", "tpu_fleet_", "tpu_disagg_")
+                )
             ):
                 continue
             help_node = node.args[1] if len(node.args) > 1 else next(

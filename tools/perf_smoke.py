@@ -451,6 +451,95 @@ def check_router_overhead() -> dict:
     return stats
 
 
+# Disaggregation's whole bet is that the handoff is cheap: a 1-prefill/
+# 1-decode pair may pay AT MOST the unified engine's host syncs plus one
+# KV capture per request (the single device->host readback that forms the
+# transfer payload).  Anything above that means the handoff path grew
+# per-token syncs — the overhead that erases the TTFT win.
+DISAGG_OVERHEAD_FRAC = 0.50
+DISAGG_OVERHEAD_FLOOR_S = 0.25
+
+
+def check_handoff_overhead() -> dict:
+    """Budget guard for the disaggregated handoff (PR 8 tentpole): a
+    1-prefill/1-decode DisaggRouter pays no more host syncs per token
+    than the unified engine PLUS exactly one transfer (= one KV capture
+    sync) per request, and the host-side channel/router bookkeeping stays
+    inside a wall-clock envelope over the unified pump."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, disagg, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(8)
+    ]
+
+    def engine():
+        return serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=4, prompt_bucket=16, sync_interval=8
+        )
+
+    reqs = [{"prompt": p, "max_tokens": 16} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    unified = engine()
+    start = time.perf_counter()
+    done_unified = unified.pump([dict(r) for r in reqs])
+    unified_wall = time.perf_counter() - start
+
+    pre, dec = engine(), engine()
+    router = disagg.DisaggRouter(prefill=[pre], decode=[dec])
+    start = time.perf_counter()
+    done_disagg = router.pump([dict(r) for r in reqs])
+    disagg_wall = time.perf_counter() - start
+
+    disagg_syncs = pre.host_syncs + dec.host_syncs
+    sync_ceiling = unified.host_syncs + len(reqs)
+    budget = unified_wall * (1 + DISAGG_OVERHEAD_FRAC) + DISAGG_OVERHEAD_FLOOR_S
+    stats = {
+        "requests_unified": len(done_unified),
+        "requests_disagg": len(done_disagg),
+        "host_syncs_unified": unified.host_syncs,
+        "host_syncs_disagg": disagg_syncs,
+        "host_sync_ceiling": sync_ceiling,
+        "transfers_ok": router.channel.counts.get(disagg.OK, 0),
+        "unified_s": round(unified_wall, 3),
+        "disagg_s": round(disagg_wall, 3),
+        "budget_frac": DISAGG_OVERHEAD_FRAC,
+        "floor_s": DISAGG_OVERHEAD_FLOOR_S,
+    }
+    if len(done_disagg) != len(reqs) or len(done_unified) != len(reqs):
+        raise PerfBudgetError(
+            f"handoff overhead run drained {len(done_disagg)}/{len(reqs)} "
+            f"disagg vs {len(done_unified)} unified"
+        )
+    if router.fallbacks:
+        raise PerfBudgetError(
+            f"handoff overhead run fell back {router.fallbacks} times on a "
+            f"fault-free channel — every transfer must deliver"
+        )
+    if disagg_syncs > sync_ceiling:
+        raise PerfBudgetError(
+            f"disaggregation added device work: {disagg_syncs} host syncs "
+            f"across the pair vs ceiling {sync_ceiling} (unified "
+            f"{unified.host_syncs} + one KV capture per request) — the "
+            f"handoff path is syncing beyond the one capture per transfer"
+        )
+    if disagg_wall > budget:
+        raise PerfBudgetError(
+            f"disagg pump took {disagg_wall:.3f}s > {budget:.3f}s "
+            f"({unified_wall:.3f}s unified + {DISAGG_OVERHEAD_FRAC:.0%} + "
+            f"{DISAGG_OVERHEAD_FLOOR_S}s floor): channel/router bookkeeping "
+            f"is no longer cheap host work"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
@@ -458,6 +547,7 @@ def main() -> int:
         stats["shed_fastpath"] = check_shed_fastpath()
         stats["telemetry_overhead"] = check_telemetry_overhead()
         stats["router_overhead"] = check_router_overhead()
+        stats["handoff_overhead"] = check_handoff_overhead()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
