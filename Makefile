@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg demo dryrun lint perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -71,6 +71,13 @@ lint:
 	$(PYTHON) tools/lint.py k8s_dra_driver_tpu tests bench.py __graft_entry__.py tools
 	$(PYTHON) tools/helm_check.py
 	$(PYTHON) -m tools.helm_render deployments/helm/tpu-dra-driver >/dev/null
+
+# Whole-program invariant analyzer (tools/analysis): lock-discipline,
+# jit-purity, terminal-funnel, block-accounting over a shared module index.
+# Exits non-zero on NEW findings; tools/analysis/baseline.json suppresses
+# (visibly) inherited ones.  Also enforced in tier-1 via tests/test_lint.py.
+analyze:
+	$(PYTHON) tools/lint.py --analyze k8s_dra_driver_tpu tools
 
 # Hot-path perf budget guard (<30s; also runs inside `make test` via
 # tests/test_perf_smoke.py): fails if allocation stops being

@@ -70,7 +70,7 @@ import weakref
 from dataclasses import dataclass
 from typing import Protocol, runtime_checkable
 
-from k8s_dra_driver_tpu.models.telemetry import EngineStats
+from k8s_dra_driver_tpu.models.telemetry import EngineStats, terminal_retirer
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 from k8s_dra_driver_tpu.utils.retry import CircuitBreaker
@@ -504,6 +504,7 @@ class FleetRouter:
                     f"admission deadline {budget}s exceeded",
                 )
 
+    @terminal_retirer
     def _fleet_shed(self, req: dict, depth: int, why: str) -> None:
         """Typed fleet-level shed: the Completion carries a FLEET-wide
         retry-after — queue depth times the mean live-replica step

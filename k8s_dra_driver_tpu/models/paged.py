@@ -1106,17 +1106,25 @@ class PagedServeEngine:
                 f"across {self._axis_size} shard(s))"
             )
         slot, ids, cached = picked
-        self.prefix_hits += cached
-        if self.prefix_cache_blocks > 0 and storable > 0:
-            serve._M_PREFIX.inc(outcome="hit" if cached else "miss")
-        # ids set BEFORE the prefill: the admission tail's first-token step
-        # already runs with this slot's adapter
-        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
-        self._prio[slot] = priority
-        self._owned[slot] = ids
-        self._table_np[slot, :] = NULL_BLOCK
-        self._table_np[slot, :need] = ids
-        self._upload_table()
+        try:
+            self.prefix_hits += cached
+            if self.prefix_cache_blocks > 0 and storable > 0:
+                serve._M_PREFIX.inc(outcome="hit" if cached else "miss")
+            # ids set BEFORE the prefill: the admission tail's first-token
+            # step already runs with this slot's adapter
+            self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+            self._prio[slot] = priority
+            self._owned[slot] = ids
+            self._table_np[slot, :] = NULL_BLOCK
+            self._table_np[slot, :need] = ids
+            self._upload_table()
+        except BaseException:
+            # the reservation half-landed (adapter upload / table upload can
+            # raise): refund the picked blocks or nothing else ever will
+            self._alloc_for(slot).free(ids)
+            self._owned[slot] = []
+            self._table_np[slot, :] = NULL_BLOCK
+            raise
 
         if self.prefill_chunk_blocks > 0:
             # Chunked admission: reserve the slot now, prefill at most
@@ -1253,13 +1261,9 @@ class PagedServeEngine:
             self._owned[slot] = []
             self._table_np[slot, :] = NULL_BLOCK
             self._upload_table()
-            self._completions.append(
-                serve.Completion(
-                    request_id=st.request_id, tokens=list(st.tokens),
-                    generated=[], error=f"{type(exc).__name__}: {exc}",
-                )
+            serve._retire_parked(
+                self, st, "error", f"{type(exc).__name__}: {exc}"
             )
-            self.telemetry.on_retire(st.request_id, "error", 0)
             raise
         self._admitting.pop(0)
         serve._M_REQUESTS.inc()  # successful admission, like the sync path
@@ -1420,17 +1424,17 @@ class PagedServeEngine:
             if picked is None:
                 return  # stays parked (FIFO head blocks the queue)
             slot, ids, cached = picked
-            self._owned[slot] = ids
-            self._table_np[slot, :] = NULL_BLOCK
-            self._table_np[slot, :need] = ids
-            self._upload_table()
-            padded = np.zeros((1, self.prompt_bucket), np.int32)
-            padded[0, : len(tokens)] = tokens
-            prefill_row = self._table_np[slot : slot + 1, : self._mbp].copy()
-            self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
-            self._prio[slot] = r.get("priority", 0)
-            row_ad = self._row_adapters(adapter)
             try:
+                self._owned[slot] = ids
+                self._table_np[slot, :] = NULL_BLOCK
+                self._table_np[slot, :need] = ids
+                self._upload_table()
+                padded = np.zeros((1, self.prompt_bucket), np.int32)
+                padded[0, : len(tokens)] = tokens
+                prefill_row = self._table_np[slot : slot + 1, : self._mbp].copy()
+                self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+                self._prio[slot] = r.get("priority", 0)
+                row_ad = self._row_adapters(adapter)
                 if cached:
                     self._run_prefill_suffix(
                         padded, prefill_row, cached, slot, row_ad
@@ -1440,25 +1444,18 @@ class PagedServeEngine:
                 if self.spec_gamma > 0:
                     self._run_draft_prefill(padded, len(tokens), slot)
             except BaseException as exc:
-                # failed re-admission: release the reservation AND surface
-                # an errored Completion — the caller holds the request id,
-                # and a silently re-parked request is indistinguishable
-                # from one still streaming (same contract as the chunked-
-                # admission failure path)
+                # failed re-admission (table/adapter upload or re-prefill):
+                # release the reservation AND surface an errored Completion —
+                # the caller holds the request id, and a silently re-parked
+                # request is indistinguishable from one still streaming (same
+                # contract as the chunked-admission failure path)
                 self._alloc_for(slot).free(ids)
                 self._owned[slot] = []
                 self._table_np[slot, :] = NULL_BLOCK
                 self._upload_table()
                 self._preempted.pop(0)
-                self._completions.append(
-                    serve.Completion(
-                        request_id=st.request_id, tokens=list(st.tokens),
-                        generated=list(st.tokens[st.prompt_len :]),
-                        error=f"{type(exc).__name__}: {exc}",
-                    )
-                )
-                self.telemetry.on_retire(
-                    st.request_id, "error", len(st.tokens) - st.prompt_len
+                serve._retire_parked(
+                    self, st, "error", f"{type(exc).__name__}: {exc}"
                 )
                 raise
             self._preempted.pop(0)
@@ -1773,16 +1770,7 @@ class PagedServeEngine:
             st = r["st"]
             if st.request_id == request_id:
                 self._preempted.pop(i)
-                self._completions.append(
-                    serve.Completion(
-                        request_id=st.request_id, tokens=list(st.tokens),
-                        generated=list(st.tokens[st.prompt_len:]),
-                        status="cancelled", error="cancelled by caller",
-                    )
-                )
-                self.telemetry.on_retire(
-                    st.request_id, "cancelled", len(st.tokens) - st.prompt_len
-                )
+                serve._retire_parked(self, st, "cancelled", "cancelled by caller")
                 return True
         return False
 
@@ -1866,39 +1854,49 @@ class PagedServeEngine:
         if picked is None:
             return False
         slot, ids, _cached = picked
-        cfg = self.cfg
-        l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
-        nb = blocks_needed(kv.valid_len, bs)
-        pad = nb * bs
-        k_p = np.zeros((l, pad, hkv, hd), kv.k.dtype)
-        v_p = np.zeros((l, pad, hkv, hd), kv.v.dtype)
-        k_p[:, : kv.valid_len] = kv.k
-        v_p[:, : kv.valid_len] = kv.v
-        # inverse of the capture gather: [L, nb*bs, Hkv, hd] -> block
-        # stripes [L, nb, Hkv, hd, bs] (positions back onto the lane axis)
-        kb = np.transpose(k_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
-        vb = np.transpose(v_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
-        ids_j = jnp.asarray(np.asarray(ids[:nb], np.int32))
-        self._cache = PagedKVCache(
-            k=self._cache.k.at[:, ids_j].set(
-                jnp.asarray(kb, self._cache.k.dtype)
-            ),
-            v=self._cache.v.at[:, ids_j].set(
-                jnp.asarray(vb, self._cache.v.dtype)
-            ),
-        )
-        self._owned[slot] = ids
-        self._table_np[slot, :] = NULL_BLOCK
-        self._table_np[slot, :need] = ids
-        self._upload_table()
-        self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
-        self._prio[slot] = int(req.get("priority", 0))
-        if self.spec_gamma > 0:
-            # the draft cache never rides a handoff — its layers re-prefill
-            # (any draft state verifies to the same greedy target stream)
-            padded = np.zeros((1, self.prompt_bucket), np.int32)
-            padded[0, : len(tokens)] = tokens
-            self._run_draft_prefill(padded, len(tokens), slot)
+        try:
+            cfg = self.cfg
+            l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+            nb = blocks_needed(kv.valid_len, bs)
+            pad = nb * bs
+            k_p = np.zeros((l, pad, hkv, hd), kv.k.dtype)
+            v_p = np.zeros((l, pad, hkv, hd), kv.v.dtype)
+            k_p[:, : kv.valid_len] = kv.k
+            v_p[:, : kv.valid_len] = kv.v
+            # inverse of the capture gather: [L, nb*bs, Hkv, hd] -> block
+            # stripes [L, nb, Hkv, hd, bs] (positions back onto the lane axis)
+            kb = np.transpose(k_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+            vb = np.transpose(v_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+            ids_j = jnp.asarray(np.asarray(ids[:nb], np.int32))
+            self._cache = PagedKVCache(
+                k=self._cache.k.at[:, ids_j].set(
+                    jnp.asarray(kb, self._cache.k.dtype)
+                ),
+                v=self._cache.v.at[:, ids_j].set(
+                    jnp.asarray(vb, self._cache.v.dtype)
+                ),
+            )
+            self._owned[slot] = ids
+            self._table_np[slot, :] = NULL_BLOCK
+            self._table_np[slot, :need] = ids
+            self._upload_table()
+            self._adapter_ids = self._adapter_ids.at[slot].set(adapter)
+            self._prio[slot] = int(req.get("priority", 0))
+            if self.spec_gamma > 0:
+                # the draft cache never rides a handoff — its layers
+                # re-prefill (any draft state verifies to the same greedy
+                # target stream)
+                padded = np.zeros((1, self.prompt_bucket), np.int32)
+                padded[0, : len(tokens)] = tokens
+                self._run_draft_prefill(padded, len(tokens), slot)
+        except BaseException:
+            # a failed inject (device OOM mid-scatter, draft prefill death)
+            # must refund — the slot never became resident, so no retire
+            # path will ever free these blocks
+            self._alloc_for(slot).free(ids)
+            self._owned[slot] = []
+            self._table_np[slot, :] = NULL_BLOCK
+            raise
         self._slots[slot] = st
         self._last = self._last.at[slot].set(tokens[-1])
         self._pos = self._pos.at[slot].set(len(tokens) - 1)

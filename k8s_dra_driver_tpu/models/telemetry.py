@@ -128,6 +128,19 @@ def _next_seq() -> int:
         return _SEQ
 
 
+def terminal_retirer(fn):
+    """Marks ``fn`` as a legal constructor of terminal Completions
+    (status deadline_exceeded/cancelled/quarantined/shed/error).  The
+    decorator IS the registration: the terminal-status-funnel pass in
+    tools/analysis keys on it statically, so a terminal Completion built
+    anywhere else is a lint finding — the way stray inline retirements
+    historically dropped journal records and telemetry.  Lives here (not
+    serve.py) because the fleet router must stay importable without jax.
+    Runtime cost is one attribute."""
+    fn.__terminal_retirer__ = True
+    return fn
+
+
 def _quantile(samples: list[float], q: float) -> float:
     if not samples:
         return 0.0

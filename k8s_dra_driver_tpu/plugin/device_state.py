@@ -147,7 +147,7 @@ class DeviceState:
         # position -> reason; folded into every refresh() enumeration.
         self._health_overlay: dict[int, str] = {}
         self.topology: TopologyInfo = enumerate_topology(env=config.topology_env or None)
-        self._layout = self._load_layout()
+        self._layout = self._load_layout(int(self.topology.host_id))
         self._visible = _parse_visible_chips(
             config.visible_chips, len(self.topology.chips)
         )
@@ -340,7 +340,9 @@ class DeviceState:
         NodePrepareResources for the duration (the sweep exists precisely
         for sick nodes)."""
         new_topology = enumerate_topology(env=self.config.topology_env or None)
-        new_layout = self._load_layout()
+        # the NEW enumeration's host id, not self.topology's: reading the
+        # lock-guarded field outside the lock was both racy and stale
+        new_layout = self._load_layout(int(new_topology.host_id))
         with self._lock:
             # Runtime-health overlay (selftest failures): applied after
             # enumeration so a chip that ENUMERATES fine but fails compute
@@ -404,16 +406,18 @@ class DeviceState:
             self._health_overlay = dict(overlay)
         return changed
 
-    def _load_layout(self):
+    def _load_layout(self, host_id: int):
         """This host's applied subslice layout; a corrupt state file keeps
-        everything published (never brick enumeration on a bad push)."""
+        everything published (never brick enumeration on a bad push).
+        ``host_id`` is passed in so the caller decides WHICH enumeration's
+        host it means — this runs outside the state lock."""
         from k8s_dra_driver_tpu.plugin import parted
 
         if not self.config.parted_state_path:
             return parted.ALL_SHAPES
         try:
             return parted.load_applied_layout(
-                self.config.parted_state_path, int(self.topology.host_id)
+                self.config.parted_state_path, host_id
             )
         except parted.PartedError:
             import logging

@@ -88,7 +88,7 @@ def load() -> ctypes.CDLL:
             lib.tpuinfo_free.argtypes = [ctypes.c_char_p]
             lib.tpuinfo_version.restype = ctypes.c_char_p
             _lib = lib
-    return _lib
+        return _lib
 
 
 def library_version() -> str:

@@ -282,8 +282,9 @@ class Watchdog:
             self.check_now()
 
     def stop(self) -> None:
-        self._stop.set()
-        monitor = self._monitor
+        self._stop.set()  # Event is self-synchronized; no lock needed
+        with self._lock:  # _monitor is written under the lock in _ensure_monitor
+            monitor = self._monitor
         if monitor is not None:
             monitor.join(timeout=5)
 

@@ -11,6 +11,8 @@ REPO = Path(__file__).parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 import lint  # noqa: E402
+from analysis import findings as afindings  # noqa: E402
+from analysis import runner as arunner  # noqa: E402
 
 
 def findings_for(tmp_path, source):
@@ -321,6 +323,483 @@ class TestMetricDocs:
         assert lint.check_metric_docs(models, arch) == []
 
 
+class TestMetricLabels:
+    """Closed label-key vocabulary + bounded-cardinality values for the
+    serving/control-plane metric namespaces."""
+
+    def _file(self, tmp_path, body):
+        f = tmp_path / "m.py"
+        f.write_text(body)
+        return f
+
+    def checks(self, tmp_path, body):
+        return [x.check for x in lint.check_metric_labels([self._file(tmp_path, body)])]
+
+    def test_vocabulary_key_clean(self, tmp_path):
+        src = 'M = REGISTRY.counter("tpu_serve_x_total", "h")\nM.inc(status="ok")\n'
+        assert self.checks(tmp_path, src) == []
+
+    def test_unknown_key_flagged(self, tmp_path):
+        src = 'M = REGISTRY.counter("tpu_serve_x_total", "h")\nM.inc(flavor="a")\n'
+        assert self.checks(tmp_path, src) == ["metric-labels"]
+
+    def test_fstring_value_flagged(self, tmp_path):
+        src = (
+            'M = REGISTRY.counter("tpu_fleet_x_total", "h")\n'
+            'rid = 7\nM.inc(reason=f"req-{rid}")\n'
+        )
+        assert self.checks(tmp_path, src) == ["metric-labels"]
+
+    def test_format_value_flagged(self, tmp_path):
+        src = (
+            'M = REGISTRY.counter("dra_x_total", "h")\n'
+            'M.inc(reason="req-{}".format(7))\n'
+        )
+        assert self.checks(tmp_path, src) == ["metric-labels"]
+
+    def test_kwargs_expansion_flagged(self, tmp_path):
+        src = (
+            'M = REGISTRY.counter("tpu_disagg_x_total", "h")\n'
+            'labels = {"status": "ok"}\nM.inc(**labels)\n'
+        )
+        assert self.checks(tmp_path, src) == ["metric-labels"]
+
+    def test_amount_positional_kwarg_not_a_label(self, tmp_path):
+        src = 'M = REGISTRY.counter("tpu_serve_x_total", "h")\nM.inc(amount=3)\n'
+        assert self.checks(tmp_path, src) == []
+
+    def test_non_namespace_metric_exempt(self, tmp_path):
+        src = 'M = REGISTRY.counter("other_x_total", "h")\nM.inc(flavor="a")\n'
+        assert self.checks(tmp_path, src) == []
+
+    def test_attribute_base_call_site_resolved(self, tmp_path):
+        # serve._M_X.inc(...) resolves through the attribute name
+        src = (
+            '_M_X = REGISTRY.counter("tpu_serve_x_total", "h")\n'
+            'def f(serve):\n    serve._M_X.inc(flavor="a")\n'
+        )
+        assert self.checks(tmp_path, src) == ["metric-labels"]
+
+    def test_ignore_pragma_applies(self, tmp_path):
+        src = (
+            'M = REGISTRY.counter("tpu_serve_x_total", "h")\n'
+            'M.inc(flavor="a")  # lint: ignore[metric-labels]\n'
+        )
+        assert self.checks(tmp_path, src) == []
+
+
+def analyze(tmp_path, source, name="models/paged.py", checks=None, baseline=None):
+    """Write one fixture module and run the whole-program analyzer on it."""
+    f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(source)
+    return arunner.run_analysis(
+        [tmp_path], baseline_path=baseline, checks=checks, root=tmp_path
+    )
+
+
+def new_checks(report):
+    return [f.check for f in report.result.new]
+
+
+class TestLockDiscipline:
+    GUARDED_READ = (
+        "import threading\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "    def put(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items.append(x)\n"
+        "    def peek(self):\n"
+        "        return self._items[-1]\n"
+    )
+
+    def test_unguarded_read_flagged(self, tmp_path):
+        report = analyze(tmp_path, self.GUARDED_READ, checks=["lock-discipline"])
+        assert new_checks(report) == ["lock-discipline"]
+        assert report.result.new[0].symbol == "Pool.peek"
+
+    def test_read_under_lock_clean(self, tmp_path):
+        src = self.GUARDED_READ.replace(
+            "    def peek(self):\n        return self._items[-1]\n",
+            "    def peek(self):\n        with self._lock:\n"
+            "            return self._items[-1]\n",
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["lock-discipline"])) == []
+
+    def test_lock_held_only_helper_clean(self, tmp_path):
+        # _drop touches the guarded field without a `with`, but its only
+        # call site holds the lock — the fixpoint marks it lock-held-only.
+        src = self.GUARDED_READ.replace(
+            "    def peek(self):\n        return self._items[-1]\n",
+            "    def _drop(self):\n        self._items.pop()\n"
+            "    def trim(self):\n        with self._lock:\n"
+            "            self._drop()\n",
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["lock-discipline"])) == []
+
+    def test_init_writes_exempt(self, tmp_path):
+        # __init__ assigns the guarded field unlocked — never a finding.
+        report = analyze(tmp_path, self.GUARDED_READ, checks=["lock-discipline"])
+        assert all(f.symbol != "Pool.__init__" for f in report.result.new)
+
+    def test_event_clear_is_not_a_guarded_write(self, tmp_path):
+        # .clear() on a threading.Event is a thread-safe method call, not
+        # container mutation — _stop must not join the guarded set.
+        src = (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._stop = threading.Event()\n"
+            "    def start(self):\n"
+            "        with self._lock:\n"
+            "            self._stop.clear()\n"
+            "    def stop(self):\n"
+            "        self._stop.set()\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["lock-discipline"])) == []
+
+    def test_module_global_reader_flagged(self, tmp_path):
+        src = (
+            "import threading\n"
+            "_LOCK = threading.Lock()\n"
+            "_SEQ = 0\n"
+            "def bump():\n"
+            "    global _SEQ\n"
+            "    with _LOCK:\n"
+            "        _SEQ += 1\n"
+            "def peek():\n"
+            "    return _SEQ\n"
+        )
+        report = analyze(tmp_path, src, checks=["lock-discipline"])
+        assert new_checks(report) == ["lock-discipline"]
+        assert report.result.new[0].symbol == "peek"
+
+    def test_local_shadow_not_flagged(self, tmp_path):
+        src = (
+            "import threading\n"
+            "_LOCK = threading.Lock()\n"
+            "_SEQ = 0\n"
+            "def bump():\n"
+            "    global _SEQ\n"
+            "    with _LOCK:\n"
+            "        _SEQ += 1\n"
+            "def other():\n"
+            "    _SEQ = 9\n"
+            "    return _SEQ\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["lock-discipline"])) == []
+
+    def test_ignore_pragma_applies(self, tmp_path):
+        src = self.GUARDED_READ.replace(
+            "return self._items[-1]",
+            "return self._items[-1]  # lint: ignore[lock-discipline]",
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["lock-discipline"])) == []
+
+
+class TestJitPurity:
+    def test_time_in_jitted_function_flagged(self, tmp_path):
+        src = (
+            "import jax\nimport time\n"
+            "def step(x):\n"
+            "    time.time()\n"
+            "    return x\n"
+            "f = jax.jit(step)\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["jit-purity"])) == ["jit-purity"]
+
+    def test_decorated_and_transitive(self, tmp_path):
+        # impurity lives in a helper CALLED from the traced function
+        src = (
+            "import jax\n"
+            "def helper(x):\n"
+            "    print(x)\n"
+            "    return x\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return helper(x)\n"
+        )
+        report = analyze(tmp_path, src, checks=["jit-purity"])
+        assert new_checks(report) == ["jit-purity"]
+        assert "print" in report.result.new[0].message
+
+    def test_metric_inc_in_scan_body_flagged(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "_M_STEPS = REGISTRY.counter('x_total', 'h')\n"
+            "def body(c, x):\n"
+            "    _M_STEPS.inc()\n"
+            "    return c, x\n"
+            "def run(xs):\n"
+            "    return lax.scan(body, 0, xs)\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["jit-purity"])) == ["jit-purity"]
+
+    def test_closed_over_subscript_store_flagged(self, tmp_path):
+        src = (
+            "import jax\n"
+            "CACHE = {}\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    CACHE[x] = 1\n"
+            "    return x\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["jit-purity"])) == ["jit-purity"]
+
+    def test_functional_optax_update_clean(self, tmp_path):
+        # result is consumed -> the functional idiom, not mutation
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def train(opt, g, s):\n"
+            "    updates, s2 = opt.update(g, s)\n"
+            "    return updates, s2\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["jit-purity"])) == []
+
+    def test_at_set_and_local_mutation_clean(self, tmp_path):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def write(buf, i, v):\n"
+            "    out = []\n"
+            "    out.append(v)\n"
+            "    return buf.at[i].set(v), out\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["jit-purity"])) == []
+
+    def test_untraced_function_free_to_be_impure(self, tmp_path):
+        src = "import time\ndef host_step(x):\n    time.time()\n    return x\n"
+        assert new_checks(analyze(tmp_path, src, checks=["jit-purity"])) == []
+
+
+class TestTerminalFunnel:
+    def test_terminal_status_outside_funnel_flagged(self, tmp_path):
+        src = (
+            "def bad(engine, st):\n"
+            "    engine._completions.append(Completion(\n"
+            "        request_id=1, tokens=[], generated=[], status='cancelled'))\n"
+        )
+        report = analyze(tmp_path, src, checks=["terminal-funnel"])
+        assert new_checks(report) == ["terminal-funnel"]
+        assert report.result.new[0].symbol == "bad"
+
+    def test_error_without_status_flagged(self, tmp_path):
+        src = (
+            "def bad(engine):\n"
+            "    return Completion(request_id=1, tokens=[], generated=[],\n"
+            "                      error='boom')\n"
+        )
+        report = analyze(tmp_path, src, checks=["terminal-funnel"])
+        assert new_checks(report) == ["terminal-funnel"]
+        assert "defaults to 'ok'" in report.result.new[0].message
+
+    def test_dynamic_status_outside_funnel_flagged(self, tmp_path):
+        src = (
+            "def bad(st, status):\n"
+            "    return Completion(request_id=1, tokens=[], generated=[],\n"
+            "                      status=status)\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["terminal-funnel"])) == [
+            "terminal-funnel"
+        ]
+
+    def test_ok_status_anywhere_clean(self, tmp_path):
+        src = (
+            "def fine(st):\n"
+            "    return Completion(request_id=1, tokens=[], generated=[],\n"
+            "                      status='ok')\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["terminal-funnel"])) == []
+
+    def test_decorated_retirer_clean(self, tmp_path):
+        src = (
+            "@terminal_retirer\n"
+            "def _retire(st, status, error):\n"
+            "    return Completion(request_id=1, tokens=[], generated=[],\n"
+            "                      status=status, error=error)\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["terminal-funnel"])) == []
+
+    def test_early_retire_itself_clean(self, tmp_path):
+        src = (
+            "def _early_retire(engine, slot, status, error):\n"
+            "    return Completion(request_id=1, tokens=[], generated=[],\n"
+            "                      status=status, error=error)\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["terminal-funnel"])) == []
+
+
+class TestBlockAccounting:
+    def test_discarded_alloc_result_flagged(self, tmp_path):
+        src = "class E:\n    def bad(self, n):\n        self._alloc.alloc(n)\n"
+        report = analyze(tmp_path, src, checks=["block-accounting"])
+        assert new_checks(report) == ["block-accounting"]
+        assert "discarded" in report.result.new[0].message
+
+    def test_risky_call_before_sink_flagged(self, tmp_path):
+        src = (
+            "class E:\n"
+            "    def bad(self, n):\n"
+            "        ids = self._alloc.alloc(n)\n"
+            "        self._prefill(n)\n"
+            "        self._owned[0] = ids\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["block-accounting"])) == [
+            "block-accounting"
+        ]
+
+    def test_early_return_leak_flagged(self, tmp_path):
+        src = (
+            "class E:\n"
+            "    def bad(self, n, flag):\n"
+            "        ids = self._alloc.alloc(n)\n"
+            "        if flag:\n"
+            "            return None\n"
+            "        self._owned[0] = ids\n"
+        )
+        report = analyze(tmp_path, src, checks=["block-accounting"])
+        assert new_checks(report) == ["block-accounting"]
+        assert "early return" in report.result.new[0].message
+
+    def test_fallthrough_never_released_flagged(self, tmp_path):
+        src = (
+            "class E:\n"
+            "    def bad(self, n):\n"
+            "        ids = self._alloc.alloc(n)\n"
+            "        n2 = n + 1\n"
+        )
+        report = analyze(tmp_path, src, checks=["block-accounting"])
+        assert new_checks(report) == ["block-accounting"]
+        assert "never released" in report.result.new[0].message
+
+    def test_try_with_freeing_handler_clean(self, tmp_path):
+        src = (
+            "class E:\n"
+            "    def ok(self, n):\n"
+            "        ids = self._alloc.alloc(n)\n"
+            "        try:\n"
+            "            self._prefill(n)\n"
+            "        except Exception:\n"
+            "            self._alloc.free(ids)\n"
+            "            raise\n"
+            "        self._owned[0] = ids\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["block-accounting"])) == []
+
+    def test_share_then_alloc_idiom_clean(self, tmp_path):
+        # _pick_slot's shape: the except handler of the acquiring try frees
+        # the share hits — `ids` was never bound on that path.
+        src = (
+            "class E:\n"
+            "    def pick(self, need, k):\n"
+            "        hits = self._alloc.share(k)\n"
+            "        try:\n"
+            "            ids = hits + self._alloc.alloc(need - len(hits))\n"
+            "        except Exception:\n"
+            "            self._alloc.free(hits)\n"
+            "            return None\n"
+            "        return ids\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["block-accounting"])) == []
+
+    def test_blockfn_tuple_unpack_and_failure_branch_clean(self, tmp_path):
+        # cross-function: _pick returns (slot, ids); the caller's token rides
+        # the unpack, and the `if picked is None` branch holds no blocks.
+        src = (
+            "class E:\n"
+            "    def admit(self, n):\n"
+            "        picked = self._pick(n)\n"
+            "        if picked is None:\n"
+            "            return None\n"
+            "        slot, ids = picked\n"
+            "        self._owned[slot] = ids\n"
+            "        return slot\n"
+            "    def _pick(self, n):\n"
+            "        ids = self._alloc.alloc(n)\n"
+            "        return 0, ids\n"
+        )
+        assert new_checks(analyze(tmp_path, src, checks=["block-accounting"])) == []
+
+    def test_out_of_scope_module_not_scanned(self, tmp_path):
+        src = "class E:\n    def bad(self, n):\n        self._alloc.alloc(n)\n"
+        report = analyze(
+            tmp_path, src, name="models/other.py", checks=["block-accounting"]
+        )
+        assert new_checks(report) == []
+
+
+class TestAnalysisBaseline:
+    LEAK = "class E:\n    def bad(self, n):\n        self._alloc.alloc(n)\n"
+
+    def test_baseline_suppresses_but_reports(self, tmp_path):
+        first = analyze(tmp_path, self.LEAK, checks=["block-accounting"])
+        assert len(first.result.new) == 1
+        bl = tmp_path / "baseline.json"
+        afindings.write_baseline(first.result.new, bl)
+        second = analyze(tmp_path, self.LEAK, checks=["block-accounting"], baseline=bl)
+        assert second.result.new == []
+        assert not second.failed
+        assert [f.check for f in second.result.baselined] == ["block-accounting"]
+        assert "[baseline]" in second.result.baselined[0].render(baselined=True)
+
+    def test_stale_entries_reported(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(
+            '{"version": 1, "entries": [{"check": "block-accounting", '
+            '"path": "models/gone.py", "symbol": "E.bad"}]}'
+        )
+        report = analyze(tmp_path, "x = 1\n", checks=["block-accounting"], baseline=bl)
+        assert report.result.stale == ["block-accounting::models/gone.py::E.bad"]
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert afindings.load_baseline(tmp_path / "nope.json") == []
+
+    def test_skip_file_and_pragma_filter_findings(self, tmp_path):
+        src = "# lint: skip-file\n" + self.LEAK
+        assert new_checks(analyze(tmp_path, src, checks=["block-accounting"])) == []
+
+
+class TestAnalyzeCli:
+    def test_json_round_trip(self, tmp_path, capsys):
+        d = tmp_path / "models"
+        d.mkdir()
+        (d / "paged.py").write_text(TestAnalysisBaseline.LEAK)
+        rc = lint.main(["lint", "--analyze", "--json", str(tmp_path)])
+        out = capsys.readouterr().out
+        import json
+
+        doc = json.loads(out)
+        assert rc == 1
+        assert set(doc) == {
+            "version", "files", "checks", "findings", "baselined",
+            "stale_baseline_keys",
+        }
+        assert doc["checks"] == sorted(arunner.PASSES)
+        (finding,) = doc["findings"]
+        assert set(finding) == {"path", "line", "check", "symbol", "message"}
+        assert finding["check"] == "block-accounting"
+
+    def test_analyze_clean_dir_rc0(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        assert lint.main(["lint", "--analyze", str(tmp_path)]) == 0
+        capsys.readouterr()
+
+    def test_unknown_flag_rejected(self, capsys):
+        assert lint.main(["lint", "--bogus"]) == 2
+        assert "unknown flag" in capsys.readouterr().err
+
+    def test_changed_files_shape(self):
+        changed = lint.changed_files(REPO)
+        assert changed is None or all(
+            p.suffix == ".py" and p.is_file() for p in changed
+        )
+
+
 class TestMain:
     def test_missing_target_fails_loudly(self, capsys):
         rc = lint.main(["lint", "no/such/dir"])
@@ -339,6 +818,26 @@ class TestRepoIsClean:
         ]
         rc = lint.main(["lint", *map(str, targets)])
         assert rc == 0, "repo has lint findings (see stdout)"
+
+    def test_repo_analyzes_clean(self):
+        """The `make analyze` gate: all four whole-program passes over the
+        driver AND the analyzer itself, against the checked-in baseline."""
+        report = arunner.run_analysis(
+            [REPO / "k8s_dra_driver_tpu", REPO / "tools"],
+            baseline_path=arunner.DEFAULT_BASELINE,
+            root=REPO,
+        )
+        assert [f.render() for f in report.result.new] == []
+        assert list(report.result.stale) == []
+
+    def test_lock_and_terminal_baselines_empty(self):
+        # The real findings were FIXED, not suppressed — keep it that way.
+        keys = afindings.load_baseline(arunner.DEFAULT_BASELINE)
+        burned = [
+            k for k in keys
+            if k.startswith(("lock-discipline::", "terminal-funnel::"))
+        ]
+        assert burned == []
 
 
 class TestHelmCheck:

@@ -401,3 +401,97 @@ class TestDrainRestore:
         fresh.run_until_drained()
         out = {c.request_id: tuple(c.tokens) for c in fresh.completions()}
         assert out == self._reference(params, _dense)
+
+
+class TestTerminalRetirementRegressions:
+    """Regressions for the real bugs the whole-program analyzer
+    (tools/analysis, PR 9) caught: failed chunked admissions and
+    readmissions appended Completions whose status DEFAULTED to "ok"
+    while the error text said otherwise, cancelling a parked request
+    bypassed the retirement funnel, and two reservation windows (submit's
+    table setup, restore's KV inject) could leak pool blocks on a raise."""
+
+    @staticmethod
+    def _boom(*_a, **_k):
+        raise RuntimeError("injected admission fault")
+
+    def _parked_engine(self, params):
+        """Starved pool + preempt_on_stall until one request parks."""
+        eng = paged.PagedServeEngine(
+            params=params, cfg=CFG, n_slots=2, n_blocks=8, block_size=4,
+            prompt_bucket=32, preempt_on_stall=True, attn_impl="xla",
+        )
+        eng.submit([7, 8, 9], 20)
+        eng.submit([3, 4], 20)
+        for _ in range(200):
+            eng.step()
+            if eng.preempted_count:
+                break
+        assert eng.preempted_count >= 1 and eng._preempted
+        return eng
+
+    def test_failed_chunked_admission_is_typed_error(self, params, monkeypatch):
+        eng = _paged(params, prefill_chunk_blocks=1)
+        before = eng.free_blocks
+        monkeypatch.setattr(eng, "_first_token", self._boom)
+        rid = eng.submit([7, 8, 9], max_tokens=4)
+        with pytest.raises(RuntimeError, match="injected admission fault"):
+            for _ in range(50):
+                eng.step()
+        (c,) = eng.completions()
+        assert c.request_id == rid
+        assert c.status == "error"  # defaulted to "ok" before the fix
+        assert "injected admission fault" in c.error
+        assert eng.free_blocks == before
+        assert eng.free_slots() == eng.n_slots
+
+    def test_failed_readmission_is_typed_error(self, params, monkeypatch):
+        eng = self._parked_engine(params)
+        rid = eng._preempted[0]["st"].request_id
+        parked_len = len(eng._preempted[0]["st"].tokens)
+        monkeypatch.setattr(eng, "_run_prefill", self._boom)
+        monkeypatch.setattr(eng, "_run_prefill_suffix", self._boom)
+        with pytest.raises(RuntimeError, match="injected admission fault"):
+            for _ in range(400):
+                eng.step()
+        done = {c.request_id: c for c in eng.completions()}
+        c = done[rid]
+        assert c.status == "error"  # defaulted to "ok" before the fix
+        assert "injected admission fault" in c.error
+        assert len(c.tokens) == parked_len  # tokens-so-far preserved
+
+    def test_cancel_parked_request_is_funneled(self, params):
+        eng = self._parked_engine(params)
+        rid = eng._preempted[0]["st"].request_id
+        assert eng.cancel(rid) is True
+        assert not eng._preempted
+        eng.run_until_drained()  # the survivor drains normally
+        done = {c.request_id: c for c in eng.completions()}
+        assert done[rid].status == "cancelled"
+        assert len(done[rid].generated) >= 1  # partial stream delivered
+        other = next(c for k, c in done.items() if k != rid)
+        assert other.status == "ok"
+        assert eng.free_blocks == eng.n_blocks - eng._axis_size  # null block(s)
+
+    def test_failed_submit_reservation_refunds_blocks(self, params, monkeypatch):
+        eng = _paged(params)
+        before = eng.free_blocks
+        monkeypatch.setattr(eng, "_upload_table", self._boom)
+        with pytest.raises(RuntimeError, match="injected admission fault"):
+            eng.submit([1, 2, 3], max_tokens=4)
+        assert eng.free_blocks == before
+        assert all(not ids for ids in eng._owned)
+        assert eng.free_slots() == eng.n_slots
+
+    def test_failed_kv_inject_refunds_blocks(self, params, monkeypatch):
+        eng = _paged(params)
+        eng.submit([1, 2, 3], max_tokens=6)
+        eng.step()
+        snap = eng.snapshot_active(include_kv=True)
+        eng2 = _paged(params)
+        before = eng2.free_blocks
+        monkeypatch.setattr(eng2, "_upload_table", self._boom)
+        with pytest.raises(RuntimeError, match="injected admission fault"):
+            eng2.restore(snap)
+        assert eng2.free_blocks == before
+        assert all(not ids for ids in eng2._owned)

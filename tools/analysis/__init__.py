@@ -1,0 +1,48 @@
+"""Whole-program invariant analyzer — the cross-function half of the
+golangci-lint slot (tools/lint.py keeps the per-file checks and stays
+the CLI front door: ``python tools/lint.py --analyze``).
+
+Four class-aware passes run over one shared module index
+(:mod:`index`):
+
+  lock-discipline    per class, the fields WRITTEN inside ``with
+                     self._lock:`` (any ``self.*_lock``) blocks form the
+                     guarded set; reading or writing a guarded field from
+                     a method outside the lock — unless every call site
+                     of that method already holds the lock — is a data
+                     race in waiting.  Module-level ``_LOCK``-guarded
+                     globals get the same treatment.
+  jit-purity         any function handed to ``jax.jit`` /
+                     ``serve.shared_jit`` / ``lax.scan`` (resolved
+                     through assignments and decorators, transitively
+                     through local calls) must not call ``time.*`` /
+                     ``random.*`` / ``print``, touch the journal or a
+                     metric, or mutate a closed-over container — the
+                     traced-side-effect bugs that break retrace caching
+                     and bit-equality.
+  terminal-funnel    constructing a ``Completion`` whose status is
+                     terminal (deadline_exceeded/cancelled/quarantined/
+                     shed/error) is only legal inside
+                     ``serve._early_retire`` and functions carrying the
+                     ``@terminal_retirer`` decorator; an error-text
+                     Completion left at the default "ok" status is
+                     flagged anywhere.
+  block-accounting   in models/paged.py and models/disagg.py, blocks
+                     acquired from a ``BlockAllocator`` (``.alloc`` /
+                     ``.share``) must reach a ``.free`` or an ownership
+                     sink on every raise/early-return edge of a
+                     lightweight per-function CFG — the static twin of
+                     the chaos suites' leak assertions.
+
+Suppress one line with ``# lint: ignore[<pass>]``.  Pre-existing findings
+live in ``tools/analysis/baseline.json`` (visible but not fatal until
+burned down); anything NOT in the baseline fails the run.
+
+Importable both as ``tools.analysis`` (repo root on sys.path) and as
+``analysis`` (tools/ on sys.path, the tests' idiom) — submodules use
+relative imports only.
+"""
+
+from .findings import Finding, load_baseline, apply_baseline  # noqa: F401
+from .index import ModuleIndex  # noqa: F401
+from .runner import PASSES, run_analysis  # noqa: F401

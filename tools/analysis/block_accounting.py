@@ -1,0 +1,430 @@
+"""block-accounting pass.
+
+The static twin of the chaos suites' leak assertions: in the paged /
+disagg engines (``*paged.py`` / ``*disagg.py``), KV blocks come from a
+refcounted ``BlockAllocator`` and every acquisition (``.alloc(...)`` /
+``.share(...)`` — or a call to a same-module function that *returns*
+allocated blocks, e.g. ``_pick_slot``) must reach a release or an
+ownership sink on **every** exit edge.
+
+Abstract interpretation over a lightweight per-function CFG (document-
+order statement stream with try/if structure):
+
+* an ``Assign`` from an acquiring call mints a *token* bound to the
+  assigned names; tuple-unpacking a block-returning call's result
+  transfers the token to exactly the block-carrying tuple elements
+  (derived from that function's ``return`` statement);
+* a token *resolves* when a bound name is passed to any call
+  (``.free(ids)``, ``self._finish(ids)``, ``list(ids)``…), stored into
+  an attribute/subscript (``self._owned[slot] = ids``), or returned;
+* between mint and resolution, any statement that can raise (contains a
+  call) or exit early (``return`` / ``raise``) is a leaking edge —
+  unless it sits in a ``try`` whose handlers/finally contain ``.free(``,
+  or in an ``if <token> is None`` failure branch (no blocks on that
+  path);
+* except-handlers of the ``try`` that minted the token are exempt: when
+  the acquiring statement itself raised, the token was never bound
+  (``try: ids = a.alloc(n) except OutOfBlocks: a.free(hits)`` is the
+  share-then-alloc idiom, not a leak);
+* an acquiring call whose result is discarded outright is flagged
+  immediately.
+
+One finding per token, at the first leaking edge.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+from .index import FuncNode, Module, ModuleIndex, dotted
+
+CHECK = "block-accounting"
+
+_SCOPE_SUFFIXES = ("paged.py", "disagg.py")
+_ACQUIRE_ATTRS = {"alloc", "share"}
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.iter_modules():
+        if not mod.path.endswith(_SCOPE_SUFFIXES):
+            continue
+        blockfns = _alloc_returning(mod)
+        for rec in mod.all_functions:
+            findings.extend(_check_function(mod, rec.node, rec.qualname, blockfns))
+    return findings
+
+
+# ----------------------------------------------------------- stream building
+
+
+def _stmt_stream(fn: ast.AST) -> List[Tuple[ast.stmt, Sequence[ast.AST]]]:
+    """Simple statements + compound-statement headers, in document order.
+
+    Nested function/class bodies are excluded (they execute later, under
+    their own record)."""
+    out: List[Tuple[ast.stmt, Sequence[ast.AST]]] = []
+
+    def visit(stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            if isinstance(s, ast.If):
+                out.append((s, [s.test]))
+                visit(s.body)
+                visit(s.orelse)
+            elif isinstance(s, ast.While):
+                out.append((s, [s.test]))
+                visit(s.body)
+                visit(s.orelse)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                out.append((s, [s.iter]))
+                visit(s.body)
+                visit(s.orelse)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                out.append((s, [item.context_expr for item in s.items]))
+                visit(s.body)
+            elif isinstance(s, ast.Try):
+                visit(s.body)
+                for handler in s.handlers:
+                    visit(handler.body)
+                visit(s.orelse)
+                visit(s.finalbody)
+            elif isinstance(s, FuncNode + (ast.ClassDef,)):
+                continue
+            else:
+                out.append((s, [s]))
+
+    visit(fn.body)
+    return out
+
+
+# -------------------------------------------------------------------- tokens
+
+
+@dataclass
+class _Token:
+    names: Set[str]
+    line: int
+    origin: str
+    # For `picked = self._pick_slot(...)`: which tuple indices carry blocks
+    # once `picked` is unpacked (None = the bound names carry blocks as-is).
+    pending_indices: Optional[Set[int]] = None
+
+
+def _acquire_call(node: ast.AST) -> Optional[ast.Call]:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _ACQUIRE_ATTRS
+        ):
+            return sub
+    return None
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    if isinstance(target, ast.Name):
+        names.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            names.update(_target_names(elt))
+    elif isinstance(target, ast.Starred):
+        names.update(_target_names(target.value))
+    return names
+
+
+def _alloc_returning(mod: Module) -> Dict[str, Optional[Set[int]]]:
+    """name -> block-carrying return-tuple indices (None = whole value)."""
+    result: Dict[str, Optional[Set[int]]] = {}
+    for rec in mod.all_functions:
+        token_names: Set[str] = set()
+        for node in ast.walk(rec.node):
+            if isinstance(node, ast.Assign) and _acquire_call(node.value) is not None:
+                for tgt in node.targets:
+                    token_names.update(_target_names(tgt))
+        if not token_names:
+            continue
+        for node in ast.walk(rec.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            value = node.value
+            if isinstance(value, ast.Tuple):
+                indices = {
+                    i
+                    for i, elt in enumerate(value.elts)
+                    if any(
+                        isinstance(sub, ast.Name) and sub.id in token_names
+                        for sub in ast.walk(elt)
+                    )
+                }
+                if indices:
+                    result[rec.name] = indices
+            elif any(
+                isinstance(sub, ast.Name) and sub.id in token_names
+                for sub in ast.walk(value)
+            ):
+                result[rec.name] = None
+    return result
+
+
+# ----------------------------------------------------------- per-stmt checks
+
+
+def _names_in(node: ast.AST, names: Set[str]) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names for sub in ast.walk(node))
+
+
+def _direct_call_arg(node: ast.AST, names: Set[str]) -> bool:
+    """Token name passed as a bare argument to any call inside ``node``."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        for arg in sub.args:
+            if isinstance(arg, ast.Starred):
+                arg = arg.value
+            if isinstance(arg, ast.Name) and arg.id in names:
+                return True
+        for kw in sub.keywords:
+            if isinstance(kw.value, ast.Name) and kw.value.id in names:
+                return True
+    return False
+
+
+def _resolves(stmt: ast.stmt, exprs: Sequence[ast.AST], names: Set[str]) -> bool:
+    if isinstance(stmt, ast.Return):
+        return stmt.value is not None and _names_in(stmt.value, names)
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        if stmt.value is not None and _names_in(stmt.value, names):
+            for tgt in targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    return True
+    for expr in exprs:
+        if _direct_call_arg(expr, names):
+            return True
+    return False
+
+
+def _is_risky(stmt: ast.stmt, exprs: Sequence[ast.AST]) -> bool:
+    if isinstance(stmt, (ast.Return, ast.Raise)):
+        return True
+    return any(isinstance(sub, ast.Call) for expr in exprs for sub in ast.walk(expr))
+
+
+def _protected(stmt: ast.stmt, fn: ast.AST) -> bool:
+    """Inside a try-body whose except/finally blocks release blocks."""
+    cur: Optional[ast.AST] = stmt
+    while cur is not None and cur is not fn:
+        parent = getattr(cur, "parent", None)
+        if isinstance(parent, ast.Try) and cur in parent.body:
+            cleanup = list(parent.finalbody)
+            for handler in parent.handlers:
+                cleanup.extend(handler.body)
+            for c in cleanup:
+                for sub in ast.walk(c):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "free"
+                    ):
+                        return True
+        cur = parent
+    return False
+
+
+def _acquire_trys(stmt: ast.stmt, fn: ast.AST) -> List[ast.Try]:
+    """Every ``try`` whose body (transitively) contains the acquire."""
+    trys: List[ast.Try] = []
+    cur: Optional[ast.AST] = stmt
+    while cur is not None and cur is not fn:
+        parent = getattr(cur, "parent", None)
+        if isinstance(parent, ast.Try) and cur in parent.body:
+            trys.append(parent)
+        cur = parent
+    return trys
+
+
+def _in_handler_of(stmt: ast.stmt, trys: List[ast.Try]) -> bool:
+    cur: Optional[ast.AST] = stmt
+    while cur is not None:
+        parent = getattr(cur, "parent", None)
+        if isinstance(parent, ast.ExceptHandler) and getattr(parent, "parent", None) in trys:
+            return True
+        cur = parent
+    return False
+
+
+def _in_failure_branch(stmt: ast.stmt, fn: ast.AST, names: Set[str]) -> bool:
+    """Inside ``if <token> is None:`` / ``if not <token>:`` — no blocks held."""
+    cur: Optional[ast.AST] = stmt
+    while cur is not None and cur is not fn:
+        parent = getattr(cur, "parent", None)
+        if isinstance(parent, ast.If) and _is_failure_test(parent.test, names):
+            body_contains = any(cur is s or _contains(s, cur) for s in parent.body)
+            if body_contains:
+                return True
+        cur = parent
+    return False
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(sub is target for sub in ast.walk(root))
+
+
+def _is_failure_test(test: ast.AST, names: Set[str]) -> bool:
+    if (
+        isinstance(test, ast.Compare)
+        and isinstance(test.left, ast.Name)
+        and test.left.id in names
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Is)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        return True
+    if (
+        isinstance(test, ast.UnaryOp)
+        and isinstance(test.op, ast.Not)
+        and isinstance(test.operand, ast.Name)
+        and test.operand.id in names
+    ):
+        return True
+    return False
+
+
+# --------------------------------------------------------------- main driver
+
+
+def _check_function(
+    mod: Module,
+    fn: ast.AST,
+    symbol: str,
+    blockfns: Dict[str, Optional[Set[int]]],
+) -> List[Finding]:
+    stream = _stmt_stream(fn)
+    findings: List[Finding] = []
+
+    # Collect acquisition events (stream position -> token).
+    acquires: List[Tuple[int, _Token]] = []
+    for i, (stmt, _exprs) in enumerate(stream):
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) and call.func.attr in _ACQUIRE_ATTRS:
+                findings.append(
+                    Finding(
+                        path=mod.path,
+                        line=stmt.lineno,
+                        check=CHECK,
+                        symbol=symbol,
+                        message=(
+                            f".{call.func.attr}(...) result discarded — acquired "
+                            "blocks are unreachable and can never be freed"
+                        ),
+                    )
+                )
+            continue
+        if not isinstance(stmt, ast.Assign):
+            continue
+        # Ownership sink right at the acquire: self.x = ....alloc(n)
+        direct = _acquire_call(stmt.value)
+        sink = all(isinstance(t, (ast.Attribute, ast.Subscript)) for t in stmt.targets)
+        if direct is not None and not sink:
+            names: Set[str] = set()
+            for tgt in stmt.targets:
+                names.update(_target_names(tgt))
+            if names:
+                acquires.append(
+                    (i, _Token(names=names, line=stmt.lineno, origin=f".{direct.func.attr}(...)"))
+                )
+            continue
+        # Call to a same-module block-returning function.
+        if isinstance(stmt.value, ast.Call):
+            callee = dotted(stmt.value.func)
+            short = callee.split(".")[-1] if callee else None
+            if short in blockfns and not sink:
+                names = set()
+                for tgt in stmt.targets:
+                    names.update(_target_names(tgt))
+                if names:
+                    acquires.append(
+                        (
+                            i,
+                            _Token(
+                                names=names,
+                                line=stmt.lineno,
+                                origin=f"{short}(...)",
+                                pending_indices=blockfns[short],
+                            ),
+                        )
+                    )
+
+    for start, token in acquires:
+        _trace_token(mod, fn, symbol, stream, start, token, findings)
+    return findings
+
+
+def _trace_token(
+    mod: Module,
+    fn: ast.AST,
+    symbol: str,
+    stream: List[Tuple[ast.stmt, Sequence[ast.AST]]],
+    start: int,
+    token: _Token,
+    findings: List[Finding],
+) -> None:
+    def leak(line: int, msg: str) -> None:
+        findings.append(
+            Finding(
+                path=mod.path,
+                line=line,
+                check=CHECK,
+                symbol=symbol,
+                message=f"{msg} (blocks acquired via {token.origin} on line {token.line})",
+            )
+        )
+
+    acquire_trys = _acquire_trys(stream[start][0], fn)
+    for j in range(start + 1, len(stream)):
+        stmt, exprs = stream[j]
+        if _in_failure_branch(stmt, fn, token.names):
+            continue
+        if acquire_trys and _in_handler_of(stmt, acquire_trys):
+            continue  # handler ran => the acquire raised => token never bound
+        # Tuple-unpack of a block-returning call's result transfers the token.
+        if (
+            token.pending_indices is not None
+            and isinstance(stmt, ast.Assign)
+            and isinstance(stmt.value, ast.Name)
+            and stmt.value.id in token.names
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], (ast.Tuple, ast.List))
+        ):
+            elts = stmt.targets[0].elts
+            carried: Set[str] = set()
+            for idx in token.pending_indices:
+                if idx < len(elts):
+                    carried.update(_target_names(elts[idx]))
+            if carried:
+                token.names = carried
+                token.pending_indices = None
+                continue
+        if _resolves(stmt, exprs, token.names):
+            return
+        if _is_risky(stmt, exprs):
+            if _protected(stmt, fn):
+                continue
+            if isinstance(stmt, ast.Return):
+                leak(stmt.lineno, "early return leaks acquired blocks")
+            elif isinstance(stmt, ast.Raise):
+                leak(stmt.lineno, "raise leaks acquired blocks")
+            else:
+                leak(
+                    stmt.lineno,
+                    "statement can raise while acquired blocks are unresolved "
+                    "and no enclosing handler frees them",
+                )
+            return
+    leak(token.line, "acquired blocks are never released, stored, or returned")

@@ -1,0 +1,95 @@
+"""Finding record + baseline workflow for the whole-program analyzer.
+
+A baseline entry is keyed on ``(check, path, symbol)`` — *not* on line
+numbers — so unrelated edits that shift lines don't invalidate it.  A
+key suppresses every current finding that matches it (those are still
+printed, marked ``[baseline]``, but don't fail the run); a key that no
+longer matches anything is *stale* and reported so it can be deleted.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Tuple
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    check: str
+    symbol: str  # dotted enclosing scope, e.g. "PagedEngine.submit"
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.check}::{self.path}::{self.symbol}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "check": self.check,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+    def render(self, baselined: bool = False) -> str:
+        tag = " [baseline]" if baselined else ""
+        return f"{self.path}:{self.line}: [{self.check}]{tag} {self.symbol}: {self.message}"
+
+
+@dataclass
+class BaselineResult:
+    """Partition of a run's findings against the checked-in baseline."""
+
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale: List[str] = field(default_factory=list)  # keys with no live finding
+
+
+def load_baseline(path: Path) -> List[str]:
+    """Read baseline keys from ``path`` (missing file == empty baseline).
+
+    Schema: ``{"version": 1, "entries": [{"check":…, "path":…, "symbol":…,
+    "reason":…?}, …]}``.  ``reason`` is for humans and ignored here.
+    """
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    keys: List[str] = []
+    for entry in data.get("entries", []):
+        keys.append(f"{entry['check']}::{entry['path']}::{entry['symbol']}")
+    return keys
+
+
+def apply_baseline(findings: Iterable[Finding], keys: Iterable[str]) -> BaselineResult:
+    keyset = set(keys)
+    result = BaselineResult()
+    seen: set = set()
+    for f in sorted(findings):
+        if f.key in keyset:
+            result.baselined.append(f)
+            seen.add(f.key)
+        else:
+            result.new.append(f)
+    result.stale = sorted(keyset - seen)
+    return result
+
+
+def write_baseline(findings: Iterable[Finding], path: Path) -> None:
+    """Serialize current findings as a fresh baseline (``--write-baseline``)."""
+    seen: set = set()
+    entries = []
+    for f in sorted(findings):
+        parts: Tuple[str, str, str] = (f.check, f.path, f.symbol)
+        if parts in seen:
+            continue
+        seen.add(parts)
+        entries.append({"check": f.check, "path": f.path, "symbol": f.symbol})
+    path.write_text(
+        json.dumps({"version": 1, "entries": entries}, indent=2) + "\n",
+        encoding="utf-8",
+    )

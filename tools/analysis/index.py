@@ -1,0 +1,174 @@
+"""Shared module index for the whole-program passes.
+
+One parse of every target file, annotated with:
+
+* parent links (``node.parent``) so passes can ask "am I inside a
+  ``try`` that frees?" without re-walking,
+* per-line ``# lint: ignore[check]`` pragmas and ``# lint: skip-file``,
+* a symbol table of classes / methods / module functions with dotted
+  qualnames (the stable half of a baseline key).
+
+Passes receive the index and return ``Finding`` lists; they never read
+files themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+IGNORE_RE = re.compile(r"#\s*lint:\s*ignore\[([a-z-]+(?:\s*,\s*[a-z-]+)*)\]")
+SKIP_FILE_RE = re.compile(r"#\s*lint:\s*skip-file")
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, None for anything else.
+
+    Subscript bases (``x.at[i].set``) intentionally resolve to None —
+    that is what exempts jnp's functional ``.at[].set()`` updates from
+    the mutation checks.
+    """
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base is not None else None
+    return None
+
+
+@dataclass
+class FunctionRec:
+    name: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str] = None
+
+
+@dataclass
+class ClassRec:
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, FunctionRec] = field(default_factory=dict)
+
+
+@dataclass
+class Module:
+    path: str
+    tree: ast.Module
+    source: str
+    skip: bool = False
+    ignores: Dict[int, Set[str]] = field(default_factory=dict)
+    functions: Dict[str, FunctionRec] = field(default_factory=dict)
+    classes: Dict[str, ClassRec] = field(default_factory=dict)
+    all_functions: List[FunctionRec] = field(default_factory=list)
+
+    def ignored(self, line: int, check: str) -> bool:
+        return check in self.ignores.get(line, set())
+
+    def symbol_for(self, node: ast.AST) -> str:
+        """Dotted enclosing scope (``Class.method`` / ``func`` / ``<module>``)."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, FuncNode + (ast.ClassDef,)):
+                parts.append(cur.name)
+            cur = getattr(cur, "parent", None)
+        return ".".join(reversed(parts)) or "<module>"
+
+
+class ModuleIndex:
+    def __init__(self) -> None:
+        self.modules: Dict[str, Module] = {}
+
+    @classmethod
+    def build(cls, files: List[Path], root: Optional[Path] = None) -> "ModuleIndex":
+        idx = cls()
+        for fp in files:
+            rel = fp
+            if root is not None:
+                try:
+                    rel = fp.resolve().relative_to(root.resolve())
+                except ValueError:
+                    rel = fp
+            try:
+                source = fp.read_text(encoding="utf-8")
+                tree = ast.parse(source)
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                continue  # compileall owns syntax errors; nothing for us here
+            mod = Module(path=rel.as_posix(), tree=tree, source=source)
+            _annotate(mod)
+            idx.modules[mod.path] = mod
+        return idx
+
+    def module_endswith(self, suffix: str) -> Optional[Module]:
+        for path, mod in self.modules.items():
+            if path.endswith(suffix):
+                return mod
+        return None
+
+    def iter_modules(self) -> Iterator[Module]:
+        yield from self.modules.values()
+
+
+def _annotate(mod: Module) -> None:
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+    for i, raw in enumerate(mod.source.splitlines(), start=1):
+        m = IGNORE_RE.search(raw)
+        if m:
+            checks = {c.strip() for c in m.group(1).split(",")}
+            mod.ignores.setdefault(i, set()).update(checks)
+        if i <= 5 and SKIP_FILE_RE.search(raw):
+            mod.skip = True
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, FuncNode):
+            qual = mod.symbol_for(node)
+            cls_name = None
+            parent = getattr(node, "parent", None)
+            if isinstance(parent, ast.ClassDef):
+                cls_name = parent.name
+            rec = FunctionRec(name=node.name, qualname=qual, node=node, class_name=cls_name)
+            mod.all_functions.append(rec)
+            if isinstance(parent, ast.Module):
+                mod.functions[node.name] = rec
+        elif isinstance(node, ast.ClassDef) and isinstance(getattr(node, "parent", None), ast.Module):
+            mod.classes[node.name] = ClassRec(name=node.name, node=node)
+
+    for cls_rec in mod.classes.values():
+        for stmt in cls_rec.node.body:
+            if isinstance(stmt, FuncNode):
+                cls_rec.methods[stmt.name] = FunctionRec(
+                    name=stmt.name,
+                    qualname=f"{cls_rec.name}.{stmt.name}",
+                    node=stmt,
+                    class_name=cls_rec.name,
+                )
+
+
+def enclosing(node: ast.AST, kinds: Tuple[type, ...]) -> Iterator[ast.AST]:
+    """Yield ancestors of ``node`` (nearest first) that match ``kinds``."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            yield cur
+        cur = getattr(cur, "parent", None)
+
+
+def contains_call_attr(node: ast.AST, attrs: Set[str]) -> bool:
+    """True if any ``X.attr(...)`` call with attr in ``attrs`` occurs in node."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in attrs
+        ):
+            return True
+    return False

@@ -1,0 +1,251 @@
+"""jit-purity pass.
+
+Functions that get traced — passed to ``jax.jit`` / ``serve.shared_jit``
+/ ``lax.scan`` (resolved through assignments and decorators, then
+transitively through same-module calls) — run once at trace time, and
+any side effect there silently detaches from execution: clocks and RNG
+freeze into the compiled artifact, metrics/journal record once per
+*compile* instead of per call, and mutating a closed-over container
+desynchronizes host state from device state.  Exactly the retrace /
+bit-equality bug class PRs 4–5 hit at runtime; this pass catches it at
+lint time.
+
+Flagged inside a traced function:
+
+* calls to ``time.*``, ``random.*``, ``np.random.*``, ``print``
+* journal / metrics / registry effects (``JOURNAL.*``, ``*.inc`` /
+  ``*.observe``, ``.set``/``.labels`` on an ALL_CAPS global, ``REGISTRY.*``)
+* mutation of a closed-over or global container (``xs.append(...)``,
+  ``cache[k] = v`` where the base is not a local) — jnp's functional
+  ``.at[i].set()`` is naturally exempt because its base is a Subscript.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .findings import Finding
+from .index import FuncNode, Module, ModuleIndex, dotted
+
+CHECK = "jit-purity"
+
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "shared_jit",
+    "serve.shared_jit",
+    "jax.lax.scan",
+    "lax.scan",
+    "scan",
+    "jax.checkpoint",
+}
+
+_MUTATORS = {
+    "append",
+    "extend",
+    "add",
+    "update",
+    "insert",
+    "remove",
+    "discard",
+    "clear",
+    "pop",
+    "popleft",
+    "appendleft",
+    "setdefault",
+}
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.iter_modules():
+        findings.extend(_check_module(mod))
+    return findings
+
+
+def _decorated_jit(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted(dec)
+        if name in _JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            if dotted(dec.func) in _JIT_WRAPPERS:
+                return True
+            if dotted(dec.func) in {"partial", "functools.partial"} and any(
+                dotted(a) in _JIT_WRAPPERS for a in dec.args
+            ):
+                return True
+    return False
+
+
+def _resolve_local(name: str, at: ast.AST, mod: Module) -> Optional[ast.AST]:
+    """Resolve ``name`` to a FunctionDef/Lambda visible from ``at``.
+
+    Walks enclosing scopes outward; at each scope follows direct
+    ``def name`` children and one level of ``name = other`` aliasing.
+    """
+    seen: Set[str] = set()
+    for _ in range(6):  # bounded alias chase
+        if name in seen:
+            return None
+        seen.add(name)
+        alias: Optional[str] = None
+        scope: Optional[ast.AST] = at
+        while scope is not None:
+            if isinstance(scope, FuncNode) or isinstance(scope, ast.Module):
+                for stmt in scope.body:
+                    if isinstance(stmt, FuncNode) and stmt.name == name:
+                        return stmt
+                    if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+                    ):
+                        if isinstance(stmt.value, ast.Lambda):
+                            return stmt.value
+                        if isinstance(stmt.value, ast.Name):
+                            alias = stmt.value.id
+                if alias is not None:
+                    break
+            scope = getattr(scope, "parent", None)
+        if alias is None:
+            return None
+        name = alias
+    return None
+
+
+def _traced_roots(mod: Module) -> List[Tuple[ast.AST, str]]:
+    """(function node, why-traced) for every jit/scan entry in the module."""
+    roots: List[Tuple[ast.AST, str]] = []
+    for rec in mod.all_functions:
+        if _decorated_jit(rec.node):
+            roots.append((rec.node, f"decorated on line {rec.node.lineno}"))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        wrapper = dotted(node.func)
+        if wrapper not in _JIT_WRAPPERS or not node.args:
+            continue
+        target = node.args[0]
+        why = f"passed to {wrapper} on line {node.lineno}"
+        if isinstance(target, ast.Lambda):
+            roots.append((target, why))
+        elif isinstance(target, ast.Name):
+            resolved = _resolve_local(target.id, node, mod)
+            if resolved is not None:
+                roots.append((resolved, why))
+    return roots
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    local: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            local.add(a.arg)
+        for a in (args.vararg, args.kwarg):
+            if a is not None:
+                local.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            local.add(node.id)
+        elif isinstance(node, FuncNode):
+            local.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    local.add(sub.id)
+    return local
+
+
+def _is_metric_root(root: str) -> bool:
+    return root.isupper() or (root.startswith("_") and root.lstrip("_").isupper())
+
+
+def _impurities(fn: ast.AST, mod: Module, why: str) -> List[Finding]:
+    local = _local_names(fn)
+    out: List[Finding] = []
+    symbol = mod.symbol_for(fn) if not isinstance(fn, ast.Lambda) else mod.symbol_for(
+        getattr(fn, "parent", fn)
+    )
+
+    def emit(line: int, msg: str) -> None:
+        out.append(
+            Finding(
+                path=mod.path,
+                line=line,
+                check=CHECK,
+                symbol=symbol,
+                message=f"{msg} (traced: {why})",
+            )
+        )
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is None:
+                continue
+            root = name.split(".")[0]
+            if name == "print":
+                emit(node.lineno, "print() inside a traced function")
+            elif name.startswith(("time.", "random.", "np.random.", "numpy.random.")):
+                emit(node.lineno, f"call to {name}() inside a traced function")
+            elif "JOURNAL" in name.split("."):
+                emit(node.lineno, f"journal write {name}() inside a traced function")
+            elif root == "REGISTRY":
+                emit(node.lineno, f"registry call {name}() inside a traced function")
+            elif name.endswith((".inc", ".observe")) or (
+                name.endswith((".set", ".labels")) and _is_metric_root(root)
+            ):
+                emit(node.lineno, f"metric side effect {name}() inside a traced function")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and root not in local
+                # Result discarded => mutation idiom.  When the result is
+                # used (`updates, st = opt.update(...)`) it's the functional
+                # optax/jax style, which is pure.
+                and isinstance(getattr(node, "parent", None), ast.Expr)
+            ):
+                emit(
+                    node.lineno,
+                    f"mutation of closed-over container {name}() inside a traced function",
+                )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript):
+                    base = dotted(tgt.value)
+                    if base is not None and base.split(".")[0] not in local:
+                        emit(
+                            tgt.lineno,
+                            f"subscript store into closed-over {base}[...] inside a traced function",
+                        )
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            emit(node.lineno, "global/nonlocal rebinding inside a traced function")
+    return out
+
+
+def _check_module(mod: Module) -> List[Finding]:
+    roots = _traced_roots(mod)
+    if not roots:
+        return []
+
+    # Transitive closure over same-module calls: a helper called from a
+    # traced function is traced too.
+    queue: List[Tuple[ast.AST, str]] = list(roots)
+    seen: Set[int] = set()
+    findings: List[Finding] = []
+    while queue:
+        fn, why = queue.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        findings.extend(_impurities(fn, mod, why))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = _resolve_local(node.func.id, node, mod)
+                if callee is not None and id(callee) not in seen:
+                    queue.append((callee, f"called from traced code on line {node.lineno}"))
+    # A traced function's own Finding lines can repeat via multiple roots.
+    uniq = {(f.path, f.line, f.message): f for f in findings}
+    return sorted(uniq.values())

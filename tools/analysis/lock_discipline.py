@@ -1,0 +1,349 @@
+"""lock-discipline pass.
+
+Per class: any attribute *written* inside a ``with self.<lock>:`` block
+(outside ``__init__``/``__post_init__``) joins the class's guarded set.
+Reading or writing a guarded attribute from code that does not hold the
+lock is a finding — unless the accessing method is *lock-held-only*,
+i.e. every intra-class call site already holds the lock (computed to a
+fixpoint, so helper chains like ``pump -> _retire -> _free_slot`` under
+one ``with`` don't false-positive).
+
+A module-level twin covers the ``_SEQ = 0; _SEQ_LOCK = Lock()`` idiom:
+globals written under a module-level lock must always be accessed under
+it.
+
+Lock attributes are discovered, not declared: anything used as a
+``with self.X:`` context manager, or assigned from
+``threading.Lock/RLock/Condition``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .index import FuncNode, Module, ModuleIndex, dotted
+
+CHECK = "lock-discipline"
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
+_MUTATORS = {
+    "append",
+    "extend",
+    "add",
+    "update",
+    "insert",
+    "remove",
+    "discard",
+    "clear",
+    "pop",
+    "popleft",
+    "appendleft",
+    "setdefault",
+}
+
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.iter_modules():
+        for cls in mod.classes.values():
+            findings.extend(_check_class(mod, cls))
+        findings.extend(_check_module_globals(mod))
+    return findings
+
+
+# ---------------------------------------------------------------- class scope
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+_CONTAINER_FACTORIES = {
+    "list",
+    "dict",
+    "set",
+    "deque",
+    "collections.deque",
+    "defaultdict",
+    "collections.defaultdict",
+    "OrderedDict",
+    "collections.OrderedDict",
+    "Counter",
+    "collections.Counter",
+}
+
+
+def _container_fields(cls_node: ast.ClassDef) -> Set[str]:
+    """Fields ever assigned a container literal/factory.  Only for these do
+    mutator-method calls (``self.x.append(...)``) count as writes — calling
+    ``.update(pod)`` on an API client or ``.clear()`` on a threading.Event
+    is a thread-safe method call, not shared-state mutation."""
+    fields: Set[str] = set()
+    for node in ast.walk(cls_node):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        is_container = isinstance(
+            value, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+        ) or (isinstance(value, ast.Call) and dotted(value.func) in _CONTAINER_FACTORIES)
+        if not is_container:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is not None:
+                fields.add(attr)
+    return fields
+
+
+def _lock_attrs(cls_node: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    locks.add(attr)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if value is None:
+                continue
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Call) and dotted(sub.func) in _LOCK_FACTORIES:
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for tgt in targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            locks.add(attr)
+    return locks
+
+
+def _under_lock(node: ast.AST, method: ast.AST, locks: Set[str]) -> bool:
+    cur = getattr(node, "parent", None)
+    while cur is not None and cur is not method:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                attr = _self_attr(item.context_expr)
+                if attr in locks:
+                    return True
+        if isinstance(cur, FuncNode):  # nested def: its body runs later, unlocked
+            return False
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def _access_kind(attr_node: ast.Attribute, containers: Set[str]) -> str:
+    """'write' for stores, del, container mutation on the attribute; else 'read'."""
+    if isinstance(attr_node.ctx, (ast.Store, ast.Del)):
+        return "write"
+    parent = getattr(attr_node, "parent", None)
+    if isinstance(parent, ast.Subscript) and isinstance(parent.ctx, (ast.Store, ast.Del)):
+        return "write"
+    if (
+        attr_node.attr in containers
+        and isinstance(parent, ast.Attribute)
+        and parent.attr in _MUTATORS
+        and isinstance(getattr(parent, "parent", None), ast.Call)
+        and getattr(parent, "parent").func is parent
+    ):
+        return "write"
+    if isinstance(parent, ast.AugAssign) and parent.target is attr_node:
+        return "write"
+    return "read"
+
+
+def _check_class(mod: Module, cls) -> List[Finding]:
+    locks = _lock_attrs(cls.node)
+    if not locks:
+        return []
+    containers = _container_fields(cls.node)
+
+    # (method, attr, line, kind, under) for every self.<attr> touch.
+    accesses: List[Tuple[str, str, int, str, bool]] = []
+    # Intra-class call sites: callee -> [(caller, under_lock)]
+    callsites: Dict[str, List[Tuple[str, bool]]] = {}
+
+    for name, meth in cls.methods.items():
+        for node in ast.walk(meth.node):
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is None or attr in locks:
+                    continue
+                under = _under_lock(node, meth.node, locks)
+                parent = getattr(node, "parent", None)
+                if isinstance(parent, ast.Call) and parent.func is node and attr in cls.methods:
+                    callsites.setdefault(attr, []).append((name, under))
+                    continue
+                accesses.append((name, attr, node.lineno, _access_kind(node, containers), under))
+
+    guarded: Set[str] = {
+        attr
+        for (m, attr, _line, kind, under) in accesses
+        if under and kind == "write" and m not in _INIT_METHODS
+    }
+    if not guarded:
+        return []
+
+    # Fixpoint: a method whose every intra-class call site holds the lock
+    # (directly or via another lock-held method) inherits the lock context.
+    # Call sites in __init__/__post_init__ are neutral — the object isn't
+    # shared yet — so an init-only helper is held too.
+    held: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for callee, sites in callsites.items():
+            if callee in held:
+                continue
+            if sites and all(
+                under or caller in held
+                for caller, under in sites
+                if caller not in _INIT_METHODS
+            ):
+                held.add(callee)
+                changed = True
+
+    findings: List[Finding] = []
+    reported: Set[Tuple[str, str]] = set()
+    for m, attr, line, kind, under in sorted(accesses, key=lambda a: a[2]):
+        if attr not in guarded or under or m in _INIT_METHODS or m in held:
+            continue
+        if (m, attr) in reported:
+            continue
+        reported.add((m, attr))
+        lock_names = "/".join(sorted(f"self.{l}" for l in locks))
+        findings.append(
+            Finding(
+                path=mod.path,
+                line=line,
+                check=CHECK,
+                symbol=f"{cls.name}.{m}",
+                message=(
+                    f"{kind} of self.{attr} without holding {lock_names} "
+                    f"(field is written under the lock elsewhere in {cls.name})"
+                ),
+            )
+        )
+    return findings
+
+
+# ------------------------------------------------------------- module scope
+
+
+def _check_module_globals(mod: Module) -> List[Finding]:
+    # Module-level lock names: X = threading.Lock() at module scope.
+    locks: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if dotted(stmt.value.func) in _LOCK_FACTORIES:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        locks.add(tgt.id)
+    if not locks:
+        return []
+
+    def owner_is(node: ast.AST, fn: ast.AST) -> bool:
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            if isinstance(cur, FuncNode):
+                return cur is fn
+            cur = getattr(cur, "parent", None)
+        return False
+
+    def under(node: ast.AST, fn: ast.AST) -> bool:
+        cur = getattr(node, "parent", None)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    if isinstance(item.context_expr, ast.Name) and item.context_expr.id in locks:
+                        return True
+            cur = getattr(cur, "parent", None)
+        return False
+
+    # Phase 1: the guarded set — globals written under a module lock
+    # (writing a global from a function requires a `global` declaration).
+    guarded: Set[str] = set()
+    for rec in mod.all_functions:
+        declared: Set[str] = set()
+        for node in ast.walk(rec.node):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+        if not declared:
+            continue
+        for node in ast.walk(rec.node):
+            if (
+                isinstance(node, ast.Name)
+                and node.id in declared
+                and isinstance(node.ctx, (ast.Store, ast.Del))
+                and owner_is(node, rec.node)
+                and under(node, rec.node)
+            ):
+                guarded.add(node.id)
+    if not guarded:
+        return []
+
+    # Phase 2: every touch of a guarded global, from any function — readers
+    # don't need a `global` declaration, so resolve local shadowing first.
+    accesses: List[Tuple[str, str, int, str, bool]] = []  # (func, name, line, kind, under)
+    for rec in mod.all_functions:
+        declared = set()
+        local: Set[str] = set()
+        args = rec.node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            local.add(a.arg)
+        for a in (args.vararg, args.kwarg):
+            if a is not None:
+                local.add(a.arg)
+        for node in ast.walk(rec.node):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+        for node in ast.walk(rec.node):
+            if not isinstance(node, ast.Name) or node.id not in guarded:
+                continue
+            if not owner_is(node, rec.node):
+                continue  # belongs to a nested def; scanned under its own record
+            if node.id in local and node.id not in declared:
+                continue  # shadowed by a true local of the same name
+            kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+            accesses.append((rec.qualname, node.id, node.lineno, kind, under(node, rec.node)))
+    findings: List[Finding] = []
+    reported: Set[Tuple[str, str]] = set()
+    for fn, name, line, kind, u in sorted(accesses, key=lambda a: a[2]):
+        if name not in guarded or u or (fn, name) in reported:
+            continue
+        reported.add((fn, name))
+        findings.append(
+            Finding(
+                path=mod.path,
+                line=line,
+                check=CHECK,
+                symbol=fn,
+                message=(
+                    f"{kind} of module global {name} without holding its lock "
+                    f"({'/'.join(sorted(locks))})"
+                ),
+            )
+        )
+    return findings

@@ -1,0 +1,82 @@
+"""Pass orchestration: build the index once, run the passes, apply the
+pragma escapes and the baseline, and shape ``--json`` output."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional
+
+from . import block_accounting, jit_purity, lock_discipline, terminal_funnel
+from .findings import BaselineResult, Finding, apply_baseline, load_baseline
+from .index import ModuleIndex
+
+PASSES = {
+    lock_discipline.CHECK: lock_discipline.run,
+    jit_purity.CHECK: jit_purity.run,
+    terminal_funnel.CHECK: terminal_funnel.run,
+    block_accounting.CHECK: block_accounting.run,
+}
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclass
+class AnalysisReport:
+    files: int
+    result: BaselineResult
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.result.new)
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "version": 1,
+            "files": self.files,
+            "checks": sorted(PASSES),
+            "findings": [f.to_json() for f in self.result.new],
+            "baselined": [f.to_json() for f in self.result.baselined],
+            "stale_baseline_keys": list(self.result.stale),
+        }
+
+
+def collect_files(targets: Iterable[Path]) -> List[Path]:
+    files: List[Path] = []
+    for target in targets:
+        if target.is_dir():
+            files.extend(
+                p for p in sorted(target.rglob("*.py")) if "__pycache__" not in p.parts
+            )
+        elif target.suffix == ".py":
+            files.append(target)
+    return files
+
+
+def run_analysis(
+    targets: Iterable[Path],
+    baseline_path: Optional[Path] = None,
+    checks: Optional[Iterable[str]] = None,
+    root: Optional[Path] = None,
+) -> AnalysisReport:
+    files = collect_files([Path(t) for t in targets])
+    index = ModuleIndex.build(files, root=root)
+
+    selected = set(checks) if checks is not None else set(PASSES)
+    raw: List[Finding] = []
+    for name, runner in PASSES.items():
+        if name in selected:
+            raw.extend(runner(index))
+
+    kept: List[Finding] = []
+    for f in raw:
+        mod = index.modules.get(f.path)
+        if mod is None:
+            kept.append(f)
+            continue
+        if mod.skip or mod.ignored(f.line, f.check):
+            continue
+        kept.append(f)
+
+    keys = load_baseline(baseline_path) if baseline_path is not None else []
+    return AnalysisReport(files=len(files), result=apply_baseline(kept, keys))

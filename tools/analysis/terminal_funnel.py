@@ -1,0 +1,116 @@
+"""terminal-funnel pass.
+
+PR 5 funneled every terminal retirement through ``serve._early_retire``
+so that slot frees, paged block refunds, journal records, and telemetry
+all happen exactly once per request.  This pass machine-checks the
+funnel: constructing a ``Completion`` whose ``status=`` is terminal
+(``deadline_exceeded``/``cancelled``/``quarantined``/``shed``/``error``)
+is only legal inside ``_early_retire`` itself or a function registered
+with the ``@terminal_retirer`` decorator (``serve.terminal_retirer``
+sets ``__terminal_retirer__`` — the decorator IS the registration, so
+the set of allowed callees is statically enumerable).
+
+Two further shapes are findings anywhere:
+
+* ``Completion(..., error="...")`` with no ``status=`` — the status
+  defaults to ``"ok"`` while the error text says otherwise, a bug this
+  pass caught for real in the paged engine's failed-admission paths;
+* a *dynamic* ``status=<expr>`` outside the funnel — the analyzer can't
+  prove it never takes a terminal value, so route it through a
+  registered retirer instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .findings import Finding
+from .index import FuncNode, Module, ModuleIndex, dotted, enclosing
+
+CHECK = "terminal-funnel"
+
+TERMINAL_STATUSES = frozenset(
+    {"deadline_exceeded", "cancelled", "quarantined", "shed", "error"}
+)
+
+_FUNNEL_ROOT = "_early_retire"
+_DECORATOR = "terminal_retirer"
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None or name.split(".")[-1] != "Completion":
+                continue
+            finding = _check_construction(mod, node)
+            if finding is not None:
+                findings.append(finding)
+    return findings
+
+
+def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in enclosing(node, FuncNode):
+        return anc
+    return None
+
+
+def _is_registered(fn: Optional[ast.AST]) -> bool:
+    """Inside _early_retire, or inside any @terminal_retirer function."""
+    while fn is not None:
+        if getattr(fn, "name", None) == _FUNNEL_ROOT:
+            return True
+        for dec in getattr(fn, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = dotted(target)
+            if name is not None and name.split(".")[-1] == _DECORATOR:
+                return True
+        fn = _enclosing_function(fn)
+    return False
+
+
+def _check_construction(mod: Module, call: ast.Call) -> Optional[Finding]:
+    status_kw: Optional[ast.keyword] = None
+    error_kw: Optional[ast.keyword] = None
+    for kw in call.keywords:
+        if kw.arg == "status":
+            status_kw = kw
+        elif kw.arg == "error":
+            error_kw = kw
+
+    fn = _enclosing_function(call)
+    symbol = mod.symbol_for(call)
+    registered = _is_registered(fn)
+
+    def finding(msg: str) -> Finding:
+        return Finding(path=mod.path, line=call.lineno, check=CHECK, symbol=symbol, message=msg)
+
+    if status_kw is None:
+        if error_kw is not None and not (
+            isinstance(error_kw.value, ast.Constant) and error_kw.value.value == ""
+        ):
+            return finding(
+                "Completion carries error text but no status= — it defaults to "
+                "'ok'; route through serve._early_retire or a @terminal_retirer"
+            )
+        return None
+
+    value = status_kw.value
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        if value.value in TERMINAL_STATUSES and not registered:
+            return finding(
+                f"terminal Completion(status={value.value!r}) constructed outside "
+                "the retirement funnel (serve._early_retire / @terminal_retirer)"
+            )
+        return None
+
+    if not registered:
+        return finding(
+            "Completion with dynamic status= outside the retirement funnel — "
+            "the analyzer cannot prove it is never terminal; use a @terminal_retirer"
+        )
+    return None

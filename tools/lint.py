@@ -35,17 +35,32 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
                      metric inventory — the serving metrics are the
                      fleet load-signal contract, and an undocumented
                      signal is one routers can't rely on
+  metric-labels      cross-file: label keys at `tpu_serve_*` /
+                     `tpu_fleet_*` / `tpu_disagg_*` / `dra_*` metric
+                     call sites must come from the closed vocabulary
+                     (METRIC_LABEL_KEYS), and label values must not be
+                     f-strings / str.format — request-unique label
+                     values are unbounded cardinality, the classic
+                     Prometheus OOM
+
+Whole-program passes (lock-discipline, jit-purity, terminal-funnel,
+block-accounting) live in tools/analysis/ and run via ``--analyze``
+against tools/analysis/baseline.json; see that package's docstring.
 
 Suppress a line with ``# lint: ignore[<check>]`` or a whole file with
 ``# lint: skip-file`` in its first five lines.
 
-Usage: python tools/lint.py PATH [PATH...]   (exit 1 on findings)
+Usage: python tools/lint.py [--changed] [--json] PATH [PATH...]
+       python tools/lint.py --analyze [--json|--write-baseline] [PATH...]
+(exit 1 on findings, 2 on a bad target)
 """
 
 from __future__ import annotations
 
 import ast
+import json
 import re
+import subprocess
 import sys
 import tokenize
 from pathlib import Path
@@ -381,9 +396,212 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
     return findings
 
 
+# -- metric-labels (cross-file cardinality guard) ----------------------------
+# Every label key the serving/control-plane metrics may use.  A new key is a
+# contract change: dashboards, the fleet load-signal consumers, and the
+# cardinality budget all see it — extend the vocabulary deliberately, here.
+METRIC_LABEL_KEYS = frozenset({
+    "status", "kind", "reason", "outcome", "stage", "state",
+    "op", "node", "endpoint", "to", "section",
+    # fault-injection dimensions (utils/faults.py): profile names and fault
+    # kinds are both bounded, operator-declared sets
+    "profile", "fault",
+})
+METRIC_LABEL_PREFIXES = ("tpu_serve_", "tpu_fleet_", "tpu_disagg_", "dra_")
+_METRIC_CALL_ATTRS = {"inc", "observe", "set"}
+# First positionals of Counter.inc/Histogram.observe/Gauge.set when passed by
+# keyword; not labels.
+_NON_LABEL_KWARGS = {"amount", "value", "help"}
+
+
+def check_metric_labels(paths: list[Path]) -> list[Finding]:
+    """Cross-file: resolve metric variables (``_M_X = REGISTRY.counter("…")``)
+    to their metric names, then police every ``_M_X.inc/observe/set`` call
+    site: label keys must come from METRIC_LABEL_KEYS and label values must
+    not be f-strings or ``.format(...)`` — a per-request label value is
+    unbounded time-series cardinality."""
+    var_to_metric: dict[str, str] = {}
+    parsed: list[tuple[Path, ast.Module, list[str]]] = []
+    for path in paths:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (SyntaxError, OSError):
+            continue  # check_file already reports syntax findings
+        lines = source.splitlines()
+        if any(SKIP_FILE_RE.search(h) for h in lines[:5]):
+            continue
+        parsed.append((path, tree, lines))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr in METRIC_KINDS
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+                and isinstance(node.value.args[0].value, str)
+            ):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    var_to_metric[tgt.id] = node.value.args[0].value
+
+    findings: list[Finding] = []
+    for path, tree, lines in parsed:
+        def add(line: int, message: str) -> None:
+            if not _ignored(lines, line, "metric-labels"):
+                findings.append(Finding(path, line, "metric-labels", message))
+
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_CALL_ATTRS
+            ):
+                continue
+            base = node.func.value
+            var = base.id if isinstance(base, ast.Name) else (
+                base.attr if isinstance(base, ast.Attribute) else None
+            )
+            metric = var_to_metric.get(var or "")
+            if metric is None or not metric.startswith(METRIC_LABEL_PREFIXES):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    add(
+                        node.lineno,
+                        f"metric {metric!r}: **kwargs label expansion hides the "
+                        "label keys from this check; pass labels explicitly",
+                    )
+                    continue
+                if kw.arg in _NON_LABEL_KWARGS:
+                    continue
+                if kw.arg not in METRIC_LABEL_KEYS:
+                    add(
+                        node.lineno,
+                        f"metric {metric!r}: label key {kw.arg!r} is not in the "
+                        "closed vocabulary (lint.METRIC_LABEL_KEYS); extend it "
+                        "deliberately or rename the label",
+                    )
+                value = kw.value
+                if isinstance(value, ast.JoinedStr):
+                    add(
+                        node.lineno,
+                        f"metric {metric!r}: f-string value for label {kw.arg!r} "
+                        "is unbounded cardinality; use a small closed set",
+                    )
+                elif (
+                    isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr == "format"
+                ):
+                    add(
+                        node.lineno,
+                        f"metric {metric!r}: .format() value for label {kw.arg!r} "
+                        "is unbounded cardinality; use a small closed set",
+                    )
+    return findings
+
+
+# -- CLI ---------------------------------------------------------------------
+
+_KNOWN_FLAGS = {"--analyze", "--changed", "--json", "--write-baseline"}
+
+
+def changed_files(repo_root: Path) -> list[Path] | None:
+    """Tracked .py files differing from ``git merge-base HEAD main``, plus
+    untracked ones.  None when git can't answer (CI shallow clone, detached
+    tree without main, …) — the caller falls back to a full run."""
+    def git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args],
+            capture_output=True,
+            text=True,
+            cwd=repo_root,
+            check=True,
+        ).stdout
+
+    try:
+        base = git("merge-base", "HEAD", "main").strip()
+        names = git("diff", "--name-only", base, "--").splitlines()
+        names += git("ls-files", "--others", "--exclude-standard").splitlines()
+    except (subprocess.CalledProcessError, OSError):
+        return None
+    out: list[Path] = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        p = repo_root / name
+        if p.is_file():
+            out.append(p)
+    return sorted(set(out))
+
+
+def _run_analyze(positional: list[str], as_json: bool, write_baseline: bool) -> int:
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from analysis import findings as _findings  # tools/ on sys.path -> tools/analysis/
+    from analysis import runner as _runner
+
+    repo_root = Path(__file__).resolve().parent.parent
+    paths: list[Path] = []
+    for arg in positional or ["k8s_dra_driver_tpu"]:
+        p = Path(arg)
+        if not (p.is_dir() or (p.is_file() and p.suffix == ".py")):
+            print(f"lint: target {arg!r} is not a directory or .py file", file=sys.stderr)
+            return 2
+        paths.append(p)
+
+    if write_baseline:
+        report = _runner.run_analysis(paths, baseline_path=None, root=repo_root)
+        _findings.write_baseline(report.result.new, _runner.DEFAULT_BASELINE)
+        print(
+            f"analysis: wrote {len(report.result.new)} finding(s) to "
+            f"{_runner.DEFAULT_BASELINE}",
+            file=sys.stderr,
+        )
+        return 0
+
+    report = _runner.run_analysis(
+        paths, baseline_path=_runner.DEFAULT_BASELINE, root=repo_root
+    )
+    if as_json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.result.baselined:
+            print(f.render(baselined=True))
+        for f in report.result.new:
+            print(f.render())
+    for key in report.result.stale:
+        print(
+            f"analysis: stale baseline entry {key!r} (no matching finding; "
+            "delete it from baseline.json)",
+            file=sys.stderr,
+        )
+    print(
+        f"analysis: {report.files} files, {len(report.result.new)} new finding(s), "
+        f"{len(report.result.baselined)} baselined, "
+        f"{len(report.result.stale)} stale baseline entr(y/ies)",
+        file=sys.stderr,
+    )
+    return 1 if report.failed else 0
+
+
 def main(argv: list[str]) -> int:
+    args = argv[1:]
+    flags = {a for a in args if a.startswith("--")}
+    unknown = flags - _KNOWN_FLAGS
+    if unknown:
+        print(f"lint: unknown flag(s) {sorted(unknown)}", file=sys.stderr)
+        return 2
+    positional = [a for a in args if not a.startswith("--")]
+    as_json = "--json" in flags
+
+    if "--analyze" in flags:
+        return _run_analyze(positional, as_json, "--write-baseline" in flags)
+
     targets: list[Path] = []
-    for arg in argv[1:] or ["k8s_dra_driver_tpu", "tests"]:
+    for arg in positional or ["k8s_dra_driver_tpu", "tests"]:
         p = Path(arg)
         if p.is_dir():
             targets.extend(sorted(p.rglob("*.py")))
@@ -394,14 +612,34 @@ def main(argv: list[str]) -> int:
             print(f"lint: target {arg!r} is not a directory or .py file", file=sys.stderr)
             return 2
     targets = [t for t in targets if "proto/gen" not in str(t) and "__pycache__" not in str(t)]
+
+    if "--changed" in flags:
+        repo_root = Path(__file__).resolve().parent.parent
+        changed = changed_files(repo_root)
+        if changed is None:
+            print("lint: --changed could not resolve merge-base; full run", file=sys.stderr)
+        else:
+            resolved = {t.resolve() for t in targets}
+            targets = [c for c in changed if c.resolve() in resolved]
+
     all_findings: list[Finding] = []
     for t in targets:
         all_findings.extend(check_file(t))
     arch = Path(__file__).resolve().parent.parent / "ARCHITECTURE.md"
     arch_text = arch.read_text() if arch.is_file() else ""
     all_findings.extend(check_metric_docs(targets, arch_text))
-    for f in all_findings:
-        print(f)
+    all_findings.extend(check_metric_labels(targets))
+    if as_json:
+        print(json.dumps(
+            [
+                {"path": str(f.path), "line": f.line, "check": f.check, "message": f.message}
+                for f in all_findings
+            ],
+            indent=2,
+        ))
+    else:
+        for f in all_findings:
+            print(f)
     print(
         f"lint: {len(targets)} files, {len(all_findings)} finding(s)",
         file=sys.stderr,
