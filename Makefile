@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg demo dryrun lint analyze perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -53,6 +53,14 @@ chaos-fleet:
 # fallback, balanced per-pool block accounting.
 chaos-disagg:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_disagg_chaos.py -q
+
+# Autoscaler chaos suite (<15s, CPU, seeded): a flash-crowd trace drives
+# the closed loop while spawn_fail/spawn_latency_ms/replica_crash faults
+# break its actuators — zero lost or duplicated streams, completions
+# bit-equal to an unfaulted reference, one journal correlation per
+# scaling action, balanced block accounting at idle.
+chaos-autoscale:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_autoscale_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

@@ -310,6 +310,14 @@ def _data_plane_body(sink: dict | None = None) -> dict:
         out["serving_disagg"] = _disagg_benchmark_cpu()
     except Exception as exc:  # noqa: BLE001
         out["serving_disagg"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Closed-loop autoscaling macrobench (PR 12 headline): SLO attainment
+    # vs offered load for static / disagg / autoscaled fleets on the same
+    # seeded flash-crowd trace, plus the million-request compressed-time
+    # run.  Pure-simulation (no jax), same salvage-first placement.
+    try:
+        out["serving_autoscale"] = _autoscale_benchmark_cpu()
+    except Exception as exc:  # noqa: BLE001
+        out["serving_autoscale"] = {"error": f"{type(exc).__name__}: {exc}"}
     step_ms, last_loss, params = time_train("blocks")
     out.update({
         "backend": jax.default_backend(),
@@ -963,6 +971,163 @@ def _disagg_benchmark_cpu(
     }
 
 
+def _autoscale_benchmark_cpu(headline: bool = True) -> dict:
+    """Closed-loop autoscaling macrobench (PR 12 tentpole): SLO-attainment
+    vs offered-load curves for three fleet shapes over the SAME seeded
+    diurnal + flash-crowd trace, all in compressed simulated time over
+    models/workload.py engines (jax-free, wall-seconds on CPU):
+
+    * ``static``    — a fixed FleetRouter sized to the AUTOSCALED run's
+      mean replica count (rounded), so the comparison is at equal average
+      capacity: the honest framing from ParvaGPU (arxiv 2409.14447) —
+      what does closing the loop buy at the same average spend?
+    * ``disagg``    — a DisaggRouter splitting the same replica budget
+      into prefill/decode pools (KV handoff over the claimed channel).
+    * ``autoscaled``— FleetAutoscaler closing the loop: flash crowd ->
+      scale-up (engine factory + parked-overflow replay), crowd over ->
+      scale-down (drain + merge-restore, zero dropped streams).
+
+    ``headline`` adds the million-request run: one hour of simulated
+    diurnal load at ~290 rps mean with a 3x flash crowd, replayed in
+    compressed time, plus its equal-mean static twin.  The acceptance
+    property rides in ``headline.autoscaled_attains_geq_static``."""
+    from k8s_dra_driver_tpu.models import disagg, fleet, workload
+    from k8s_dra_driver_tpu.models.autoscaler import (
+        AutoscalerPolicy,
+        FleetAutoscaler,
+    )
+
+    def run(spec, shape, n_replicas=2, dt=0.1, queue_limit=2048,
+            policy=None, beefy=False):
+        clock = workload.SimClock()
+        sink = workload.SimSink()
+
+        if beefy:
+            # Headline shape: calibrated so ~1M requests replay in
+            # wall-seconds while the flash crowd still forces scaling.
+            kw = dict(n_slots=64, n_blocks=16384, prefill_tps=4000.0,
+                      decode_tps=200.0, interference=0.02)
+        else:
+            # Curve shape: small replicas a flash crowd can saturate, so
+            # the three fleet shapes separate instead of all attaining 1.
+            kw = dict(n_slots=8, n_blocks=2048, decode_tps=30.0)
+
+        def factory():
+            return workload.SimEngine(clock=clock, sink=sink, **kw)
+
+        asc = None
+        if shape == "disagg":
+            # Same replica budget, split: 1 prefill per 2 decode.
+            n_pre = max(1, n_replicas // 3) if n_replicas > 1 else 1
+            router = disagg.DisaggRouter(
+                prefill=[factory() for _ in range(n_pre)],
+                decode=[factory() for _ in range(max(1, n_replicas - n_pre))],
+                clock=clock,
+            )
+        else:
+            router = fleet.FleetRouter(
+                [factory() for _ in range(n_replicas)], clock=clock
+            )
+            if shape == "autoscaled":
+                asc = FleetAutoscaler(
+                    router, engine_factory=factory, clock=clock,
+                    policy=policy or AutoscalerPolicy(
+                        min_replicas=1, max_replicas=8,
+                        up_ticks=2, down_ticks=40, cooldown_s=5.0,
+                    ),
+                )
+        rep = workload.replay(
+            workload.generate(spec), router, clock=clock, sink=sink,
+            autoscaler=asc, dt=dt, queue_limit=queue_limit,
+        )
+        doc = rep.to_json()
+        if asc is not None:
+            asc.record_slo(rep.attained, rep.offered)
+            doc["scale_actions"] = asc.actions
+        return doc
+
+    def curve_spec(rate):
+        return workload.WorkloadSpec(
+            seed=1206, duration_s=120.0, base_rate_rps=rate,
+            diurnal_amplitude=0.4, diurnal_period_s=120.0,
+            flash_crowds=(
+                workload.FlashCrowd(start_s=40.0, duration_s=20.0,
+                                    multiplier=3.0),
+            ),
+        )
+
+    points = []
+    for rate in (6.0, 12.0, 18.0):
+        spec = curve_spec(rate)
+        auto = run(spec, "autoscaled", n_replicas=1)
+        n_eq = max(1, round(auto["mean_replicas"]))
+        static = run(spec, "static", n_replicas=n_eq)
+        dis = run(spec, "disagg", n_replicas=max(2, n_eq))
+        points.append({
+            "offered_rps": rate,
+            "offered": auto["offered"],
+            "equal_mean_replicas": n_eq,
+            "static": {k: static[k] for k in (
+                "slo_attainment", "completed", "shed", "lost",
+                "ttft_p99_s")},
+            "disagg": {k: dis[k] for k in (
+                "slo_attainment", "completed", "shed", "lost",
+                "ttft_p99_s")},
+            "autoscaled": {
+                **{k: auto[k] for k in (
+                    "slo_attainment", "completed", "shed", "lost",
+                    "ttft_p99_s", "mean_replicas", "max_replicas",
+                    "scale_actions")},
+            },
+            "autoscaled_attains_geq_static": (
+                auto["slo_attainment"] >= static["slo_attainment"]
+            ),
+        })
+
+    out = {
+        "workload": "diurnal sine + 3x flash crowd, lognormal prompts, "
+                    "Pareto streams, 3 SLO tiers (models/workload.py); "
+                    "static legs sized to the autoscaled run's mean "
+                    "replica count",
+        "curve": points,
+        "all_lost_zero": all(
+            p[shape]["lost"] == 0
+            for p in points
+            for shape in ("static", "disagg", "autoscaled")
+        ),
+    }
+    if headline:
+        spec = workload.WorkloadSpec(
+            seed=3, duration_s=3600.0, base_rate_rps=245.0,
+            diurnal_amplitude=0.6, diurnal_period_s=3600.0,
+            flash_crowds=(
+                workload.FlashCrowd(start_s=1200.0, duration_s=240.0,
+                                    multiplier=3.0),
+            ),
+        )
+        policy = AutoscalerPolicy(
+            min_replicas=2, max_replicas=8, up_ticks=2, down_ticks=40,
+            cooldown_s=20.0,
+        )
+
+        def run_headline(shape, n):
+            return run(spec, shape, n_replicas=n, dt=0.25,
+                       queue_limit=8192, policy=policy, beefy=True)
+
+        auto = run_headline("autoscaled", 2)
+        n_eq = max(1, round(auto["mean_replicas"]))
+        static = run_headline("static", n_eq)
+        out["headline"] = {
+            "autoscaled": auto,
+            "static_equal_mean": static,
+            "equal_mean_replicas": n_eq,
+            "autoscaled_attains_geq_static": (
+                auto["slo_attainment"] >= static["slo_attainment"]
+            ),
+        }
+    return out
+
+
 def _data_plane_degraded(sink: dict | None = None) -> dict:
     """Reduced data plane for the DEGRADED (backend-down, CPU-pinned)
     path: the full body's 4096-chain matmul and 512-seq burn-in take
@@ -984,6 +1149,12 @@ def _data_plane_degraded(sink: dict | None = None) -> dict:
         out["serving_disagg"] = _disagg_benchmark_cpu()
     except Exception as exc:  # noqa: BLE001
         out["serving_disagg"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # Degraded body skips the million-request headline: the curve
+        # points alone still carry the autoscaled-vs-static comparison.
+        out["serving_autoscale"] = _autoscale_benchmark_cpu(headline=False)
+    except Exception as exc:  # noqa: BLE001
+        out["serving_autoscale"] = {"error": f"{type(exc).__name__}: {exc}"}
     cfg = burnin.ModelConfig(
         vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
         max_seq=128,

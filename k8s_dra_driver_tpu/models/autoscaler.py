@@ -1,0 +1,441 @@
+"""Closed-loop fleet autoscaler: replica count tracks offered load.
+
+This is the controller that closes ROADMAP item 2's loop.  The sensor
+half exists (PR 6: per-replica ``EngineStats`` verdicts, fleet queue
+depth) and the actuator half exists (PR 7: ``add_replica`` with disjoint
+id strides, ``drain()`` → live-migration evacuation with zero dropped
+streams).  :class:`FleetAutoscaler` sits between them, turning load
+signals into scaling actions under a hard replica budget — ParvaGPU's
+SLO-aware sizing framing (arxiv 2409.14447), where the headline metric
+is SLO attainment at a replica count, not raw tokens/s.
+
+Control law, evaluated once per :meth:`tick`:
+
+* **Sense.**  Utilization = busy slots / total slots across the
+  ADMITTABLE replicas (``FleetRouter.admittable_replicas()`` — draining
+  and breaker-open replicas don't count as capacity), plus the fleet
+  front-door queue depth (or the driver's backlog, passed in).
+* **Vote.**  Pressure above ``target_util_high`` or a queue deeper than
+  ``queue_high`` per live replica votes up; utilization below
+  ``target_util_low`` with an empty queue votes down; anything else
+  resets both streaks.
+* **Hysteresis + cooldown.**  An action fires only after ``up_ticks``
+  (resp. ``down_ticks``) CONSECUTIVE votes, and never within
+  ``cooldown_s`` of the previous action — a breaker flap or a one-tick
+  queue spike cannot thrash the fleet.
+* **Act, bounded.**  Targets clamp to ``[min_replicas, max_replicas]``.
+  Scale-up runs the caller-supplied engine factory (fault hooks
+  ``spawn_fail``/``spawn_latency_ms`` fire BEFORE it — a failed spawn
+  journals, backs off ``spawn_backoff_s`` and never half-registers),
+  registers via ``add_replica`` and replays parked overflow.  Scale-down
+  picks the least-loaded admittable replica (never SUSPECT/EVACUATING/
+  DRAINED) and drains it through the evacuation path — zero dropped
+  streams.  Every action journals under ONE correlation id
+  (``scale-<router_seq>-<n>``) spanning decision → spawn/drain →
+  resumed, the same scheme as evacuations.
+
+The controller is host-only (dict/clock arithmetic over ``stats()``
+snapshots — ``tools/perf_smoke.py check_autoscaler_overhead`` pins that
+a no-op autoscaler adds ZERO device work) and jax-free, so
+``/debug/autoscale`` renders from control-plane binaries.  Drive it
+explicitly (``autoscaler.tick()`` from a replay/bench loop) or attach it
+to the router's tick hooks (:meth:`attach`) so ``FleetRouter.pump``
+drives it — never both.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from dataclasses import dataclass
+
+from k8s_dra_driver_tpu.models.fleet import DRAINED, FleetRouter
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+_M_REPLICAS = REGISTRY.gauge(
+    "tpu_autoscale_replicas",
+    "autoscaler replica counts, by kind (target vs actual)",
+)
+_M_EVENTS = REGISTRY.counter(
+    "tpu_autoscale_events_total",
+    "autoscaler scaling actions, by direction and reason",
+)
+_M_DECISION = REGISTRY.histogram(
+    "tpu_autoscale_decision_seconds",
+    "wall-clock seconds spent per autoscaler tick decision",
+    buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 1e-1),
+)
+_M_ATTAIN = REGISTRY.gauge(
+    "tpu_autoscale_slo_attainment",
+    "fraction of offered requests meeting their TTFT and TPOT targets",
+)
+
+UP = "up"
+DOWN = "down"
+
+
+@dataclass(frozen=True)
+class AutoscalerPolicy:
+    """Control-law thresholds.  All deterministic, all host-side."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_util_high: float = 0.85  # busy-slot fraction that votes up
+    target_util_low: float = 0.30   # busy-slot fraction that votes down
+    queue_high: int = 4             # queue depth per live replica voting up
+    up_ticks: int = 2               # consecutive up-votes before acting
+    down_ticks: int = 8             # consecutive down-votes before acting
+    cooldown_s: float = 20.0        # min seconds between scaling actions
+    max_step: int = 1               # replicas added/removed per action
+    spawn_backoff_s: float = 10.0   # pause after a failed spawn
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})"
+            )
+
+
+class FleetAutoscaler:
+    """The controller between the stats feed and the scaling actuators.
+
+    ``engine_factory`` is a zero-argument callable returning a fresh
+    Engine-protocol replica (the caller owns device placement, params,
+    clocks).  ``clock`` defaults to the router's — one clock rules the
+    whole loop, so simulated-time replays compress cooldowns too.
+    """
+
+    def __init__(
+        self,
+        router: FleetRouter,
+        engine_factory,
+        policy: AutoscalerPolicy | None = None,
+        clock=None,
+        fault_injector=None,
+        name_prefix: str = "as",
+    ):
+        self.router = router
+        self.engine_factory = engine_factory
+        self.policy = policy or AutoscalerPolicy()
+        self.clock = clock or router.clock
+        self.fault_injector = (
+            fault_injector if fault_injector is not None
+            else router.fault_injector
+        )
+        self.name_prefix = name_prefix
+        self.seq = router.seq
+        self.ticks = 0
+        self.actions = 0
+        self.spawn_failures = 0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_t: float | None = None
+        self._backoff_until: float | None = None
+        self._spawn_seq = 0
+        self._scale_seq = 0
+        self._pending_spawns: list[dict] = []
+        self._attained = 0
+        self._offered = 0
+        self.last_decision: dict = {}
+        self._attached = False
+        _LIVE_AUTOSCALERS.add(self)
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self) -> "FleetAutoscaler":
+        """Register on the router's tick hooks so ``FleetRouter.pump``
+        (or ``tick()``) drives the control loop.  Don't also call
+        :meth:`tick` from a driver loop — one drive path per loop."""
+        if not self._attached:
+            self.router.tick_hooks.append(self._on_router_tick)
+            self._attached = True
+        return self
+
+    def _on_router_tick(self) -> None:
+        self.tick()
+
+    # -- SLO feedback ------------------------------------------------------
+
+    def record_slo(self, attained: int, offered: int) -> None:
+        """Fold one measurement window into the attainment gauge (the
+        replay driver owns the per-request scoring)."""
+        self._attained += int(attained)
+        self._offered += int(offered)
+        if self._offered:
+            _M_ATTAIN.set(self._attained / self._offered)
+
+    # -- the control loop --------------------------------------------------
+
+    def tick(self, queue_depth: int | None = None) -> dict:
+        """One sense → vote → act evaluation.  Returns the decision doc
+        (also kept as ``last_decision`` for /debug/autoscale)."""
+        t0 = time.perf_counter()
+        now = self.clock()
+        self.ticks += 1
+        self._realize_spawns(now)
+        depth = (
+            int(queue_depth) if queue_depth is not None
+            else self.router._queue_depth
+        )
+        admittable = self.router.admittable_replicas()
+        actual = sum(1 for r in self.router.replicas if r.state != DRAINED)
+        total_slots = sum(r.engine.n_slots for r in admittable)
+        busy = sum(r.resident() for r in admittable)
+        util = busy / total_slots if total_slots else 1.0
+        vote = self._vote(util, depth, len(admittable))
+        if vote == UP:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif vote == DOWN:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        target = actual + len(self._pending_spawns)
+        action = ""
+        reason = ""
+        p = self.policy
+        cooling = (
+            self._last_action_t is not None
+            and now - self._last_action_t < p.cooldown_s
+        )
+        backing_off = (
+            self._backoff_until is not None and now < self._backoff_until
+        )
+        if (
+            actual < p.min_replicas
+            and not self._pending_spawns
+            and not backing_off
+        ):
+            # Below the floor (e.g. a replica died and was drained):
+            # hysteresis and cooldown never block restoring the minimum.
+            target = p.min_replicas
+            action, reason = UP, "min_replicas"
+        elif (
+            vote == UP and self._up_streak >= p.up_ticks
+            and not cooling and not backing_off
+            and actual + len(self._pending_spawns) < p.max_replicas
+        ):
+            target = min(
+                p.max_replicas,
+                actual + len(self._pending_spawns) + p.max_step,
+            )
+            action = UP
+            reason = (
+                "queue_pressure"
+                if depth >= p.queue_high * max(1, len(admittable))
+                else "overload"
+            )
+        elif (
+            vote == DOWN and self._down_streak >= p.down_ticks
+            and not cooling
+            and actual > p.min_replicas
+            and not self._pending_spawns
+        ):
+            target = max(p.min_replicas, actual - p.max_step)
+            action, reason = DOWN, "underload"
+        if action == UP:
+            self._scale_up(target - actual - len(self._pending_spawns),
+                           reason, now)
+        elif action == DOWN:
+            self._scale_down(actual - target, reason, now)
+        _M_REPLICAS.set(target, kind="target")
+        _M_REPLICAS.set(
+            sum(1 for r in self.router.replicas if r.state != DRAINED),
+            kind="actual",
+        )
+        self.last_decision = {
+            "tick": self.ticks,
+            "now": round(now, 3),
+            "utilization": round(util, 4),
+            "queue_depth": depth,
+            "admittable": len(admittable),
+            "actual": actual,
+            "target": target,
+            "vote": vote or "hold",
+            "up_streak": self._up_streak,
+            "down_streak": self._down_streak,
+            "cooling": cooling,
+            "backing_off": backing_off,
+            "pending_spawns": len(self._pending_spawns),
+            "action": action or "none",
+            "reason": reason,
+        }
+        _M_DECISION.observe(time.perf_counter() - t0)
+        return self.last_decision
+
+    def _vote(self, util: float, depth: int, n_admittable: int) -> str:
+        p = self.policy
+        if n_admittable == 0:
+            return UP  # no admittable capacity at all is maximal pressure
+        if util >= p.target_util_high or depth >= p.queue_high * n_admittable:
+            return UP
+        if util <= p.target_util_low and depth == 0:
+            return DOWN
+        return ""
+
+    # -- actuators ---------------------------------------------------------
+
+    def _mint_corr(self) -> str:
+        self._scale_seq += 1
+        return f"scale-{self.seq}-{self._scale_seq}"
+
+    def _scale_up(self, n: int, reason: str, now: float) -> None:
+        for _ in range(max(1, n)):
+            corr = self._mint_corr()
+            attempt = self._spawn_seq
+            self._spawn_seq += 1
+            inj = self.fault_injector
+            if inj is not None:
+                from k8s_dra_driver_tpu.utils.faults import SpawnFault
+
+                try:
+                    inj.maybe_fail_spawn(attempt)
+                except SpawnFault as exc:
+                    self.spawn_failures += 1
+                    self._backoff_until = now + self.policy.spawn_backoff_s
+                    self._last_action_t = now
+                    self._up_streak = 0
+                    _M_EVENTS.inc(direction=UP, reason="spawn_fail")
+                    JOURNAL.record(
+                        "autoscale", "scale_up.spawn_failed",
+                        correlation=corr, attempt=attempt, error=str(exc),
+                        backoff_s=self.policy.spawn_backoff_s,
+                    )
+                    return
+            ready_at = now
+            if inj is not None:
+                ready_at += inj.take_spawn_latency(attempt)
+            JOURNAL.record(
+                "autoscale", "scale_up.begin", correlation=corr,
+                attempt=attempt, reason=reason,
+                ready_in_s=round(ready_at - now, 3),
+            )
+            self._last_action_t = now
+            self._up_streak = 0
+            self.actions += 1
+            _M_EVENTS.inc(direction=UP, reason=reason)
+            self._pending_spawns.append(
+                {"corr": corr, "ready_at": ready_at, "attempt": attempt}
+            )
+        self._realize_spawns(now)
+
+    def _realize_spawns(self, now: float) -> None:
+        """Register every pending spawn whose (accounted) factory latency
+        has elapsed, then replay parked overflow onto the new capacity."""
+        if not self._pending_spawns:
+            return
+        still: list[dict] = []
+        for item in self._pending_spawns:
+            if item["ready_at"] > now:
+                still.append(item)
+                continue
+            name = f"{self.name_prefix}{item['attempt']}"
+            try:
+                engine = self.engine_factory()
+                rep = self.router.add_replica(engine, name=name)
+            except Exception as exc:
+                self.spawn_failures += 1
+                self._backoff_until = now + self.policy.spawn_backoff_s
+                _M_EVENTS.inc(direction=UP, reason="spawn_fail")
+                JOURNAL.record(
+                    "autoscale", "scale_up.spawn_failed",
+                    correlation=item["corr"], attempt=item["attempt"],
+                    error=f"{type(exc).__name__}: {exc}",
+                    backoff_s=self.policy.spawn_backoff_s,
+                )
+                continue
+            placed = self.router._replay_parked()
+            JOURNAL.record(
+                "autoscale", "scale_up.resumed", correlation=item["corr"],
+                replica=rep.name, parked_placed=placed,
+            )
+        self._pending_spawns = still
+
+    def _scale_down(self, n: int, reason: str, now: float) -> None:
+        for _ in range(max(1, n)):
+            victim = self._pick_victim()
+            if victim is None:
+                return
+            corr = self._mint_corr()
+            JOURNAL.record(
+                "autoscale", "scale_down.begin", correlation=corr,
+                replica=victim.name, reason=reason,
+                resident=victim.resident(),
+            )
+            # Pre-seeding evac_corr threads the whole drain (suspect →
+            # evacuating → drained → restored-on-survivors) under THIS
+            # action's correlation id — one id spans the scaling action.
+            victim.evac_corr = corr
+            moved = self.router.drain(victim.name, reason="scale_down")
+            self._last_action_t = now
+            self._down_streak = 0
+            self.actions += 1
+            _M_EVENTS.inc(direction=DOWN, reason=reason)
+            JOURNAL.record(
+                "autoscale", "scale_down.resumed", correlation=corr,
+                replica=victim.name, moved=len(moved),
+            )
+
+    def _pick_victim(self):
+        """Least-loaded ADMITTABLE replica.  SUSPECT/EVACUATING/DRAINED
+        replicas are never picked — they are already leaving or being
+        healed, and draining them again would double-journal the exit."""
+        candidates = self.router.admittable_replicas()
+        if len(candidates) <= self.policy.min_replicas:
+            return None
+        return min(
+            candidates,
+            key=lambda r: (r.resident(), -r.engine.free_slots(), r.name),
+        )
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /debug/autoscale contract: policy, streaks, pending
+        spawns and the latest decision doc."""
+        return {
+            "router_seq": self.router.seq,
+            "ticks": self.ticks,
+            "actions": self.actions,
+            "spawn_failures": self.spawn_failures,
+            "pending_spawns": [
+                {"corr": i["corr"], "ready_at": round(i["ready_at"], 3)}
+                for i in self._pending_spawns
+            ],
+            "policy": {
+                "min_replicas": self.policy.min_replicas,
+                "max_replicas": self.policy.max_replicas,
+                "target_util_high": self.policy.target_util_high,
+                "target_util_low": self.policy.target_util_low,
+                "queue_high": self.policy.queue_high,
+                "up_ticks": self.policy.up_ticks,
+                "down_ticks": self.policy.down_ticks,
+                "cooldown_s": self.policy.cooldown_s,
+            },
+            "slo": {
+                "offered": self._offered,
+                "attained": self._attained,
+                "attainment": (
+                    round(self._attained / self._offered, 6)
+                    if self._offered else None
+                ),
+            },
+            "last_decision": dict(self.last_decision),
+        }
+
+
+_LIVE_AUTOSCALERS: "weakref.WeakSet[FleetAutoscaler]" = weakref.WeakSet()
+
+
+def live_autoscalers() -> list[FleetAutoscaler]:
+    return sorted(list(_LIVE_AUTOSCALERS), key=lambda a: a.seq)
+
+
+def debug_autoscale_doc() -> dict:
+    """The /debug/autoscale payload: every live autoscaler's control-law
+    state and latest decision (the controller counterpart of
+    /debug/fleet)."""
+    return {"autoscalers": [a.stats() for a in live_autoscalers()]}

@@ -334,6 +334,7 @@ class DisaggRouter:
         self._staged: list[dict] = []      # handoffs awaiting channel budget
         self._t0: dict[int, float] = {}    # rid -> enqueue time (TTFT base)
         self._awaiting: dict[int, float] = {}  # rid -> delivery time (decode stage)
+        self._completions: list = []       # collected by the external drive
         self.handoffs = 0
         self.fallbacks = 0
         _LIVE_DISAGG.add(self)
@@ -514,6 +515,39 @@ class DisaggRouter:
                 self._observe_decode_stage(rid, now)
             for c in out:
                 self._observe_decode_stage(c.request_id, now)
+        return out
+
+    # -- externally driven surface (replay drivers, autoscale benches) -------
+
+    def submit(self, prompt, max_tokens: int, **kwargs) -> int:
+        """Route one request immediately into the prefill pool (handoff
+        mode — the router owns the admission mode, same as :meth:`_admit`).
+        Raises RuntimeError when the prefill pool has no admittable
+        capacity, the same contract as ``FleetRouter.submit``."""
+        kwargs.pop("handoff", None)
+        queued_at = kwargs.get("queued_at")
+        rid = self.prefill.submit(prompt, max_tokens, handoff=True, **kwargs)
+        self._t0[rid] = queued_at if queued_at is not None else self.clock()
+        return rid
+
+    def tick(self) -> int:
+        """ONE pump iteration without the cross-pool queue: tick the
+        prefill pool, move staged KV through the channel, tick the decode
+        pool.  Returns the slots stepped.  This mirrors
+        ``FleetRouter.tick()`` so :func:`~k8s_dra_driver_tpu.models.
+        workload.replay` drives unified and disaggregated fleets through
+        one surface; completions buffer for :meth:`completions`."""
+        self._tick += 1
+        stepped = self.prefill.tick()
+        self._completions.extend(self.prefill.completions())
+        self._collect_handoffs()
+        self._drive_channel()
+        stepped += self.decode.tick()
+        self._completions.extend(self._collect_decode())
+        return stepped
+
+    def completions(self) -> list:
+        out, self._completions = self._completions, []
         return out
 
     # -- observability -------------------------------------------------------

@@ -265,6 +265,12 @@ class FleetRouter:
         self._queue_depth = 0
         self.shed_count = 0
         self.last_shed = None
+        # Host-side observers invoked once per router tick (pump iteration
+        # or explicit tick()) after health verdicts and admission settle —
+        # the drive surface models/autoscaler.py attaches to.  Hooks must
+        # be cheap and must not dispatch device work (the perf-smoke
+        # autoscaler guard pins that).
+        self.tick_hooks: list = []
         for item in engines:
             if isinstance(item, tuple):
                 name, engine = item
@@ -317,6 +323,18 @@ class FleetRouter:
             if rep.name == name:
                 return rep
         raise KeyError(f"no replica named {name!r}")
+
+    def admittable_replicas(self) -> list[Replica]:
+        """Replicas that can take NEW work right now: healthy state and a
+        breaker that is not open.  This is the live denominator for every
+        fleet-wide admission hint (shed retry-after, autoscaler
+        utilization): draining/evacuating/drained replicas are out, and a
+        freshly added replica counts immediately — even before its first
+        health tick populates ``last_stats``."""
+        return [
+            r for r in self.replicas
+            if r.state == HEALTHY and r.breaker.state != CircuitBreaker.OPEN
+        ]
 
     # -- admission -----------------------------------------------------------
 
@@ -433,6 +451,8 @@ class FleetRouter:
                     f"sheds={self.shed_count}"
                 )
                 hb.beat()
+                for hook in self.tick_hooks:
+                    hook()
                 stepped = self._step_replicas()
                 out.extend(self.completions())
                 live = [r for r in self.replicas if r.state != DRAINED]
@@ -512,13 +532,19 @@ class FleetRouter:
         parallel, so the estimate must not be N times too pessimistic)."""
         from k8s_dra_driver_tpu.models.serve import Completion, ShedError
 
-        live = [
+        # Denominator = replicas that can actually absorb the backlog.
+        # Draining/evacuating replicas and open breakers are excluded (an
+        # in-flight scale-down must not promise drain parallelism it no
+        # longer has), while a just-added replica with no stats yet counts
+        # — its step estimate simply falls back to the fleet mean.
+        admittable = self.admittable_replicas()
+        n_live = max(1, len(admittable))
+        steps = [
             r.last_stats.last_step_s
-            for r in self.replicas
-            if r.state == HEALTHY and r.last_stats is not None
+            for r in admittable
+            if r.last_stats is not None
         ]
-        n_live = max(1, len(live))
-        step_s = max(sum(live) / n_live if live else 0.0, 1e-3)
+        step_s = max(sum(steps) / len(steps) if steps else 0.0, 1e-3)
         retry_after = round(max(0.05, depth * step_s / n_live), 3)
         err = ShedError(
             f"fleet shed: {why} ({depth} waiting across {n_live} live "
@@ -818,6 +844,8 @@ class FleetRouter:
         self._tick += 1
         self._health_tick()
         self._replay_parked()
+        for hook in self.tick_hooks:
+            hook()
         return self._step_replicas()
 
     def place(self, entries: list, correlation: str = "") -> list[int]:

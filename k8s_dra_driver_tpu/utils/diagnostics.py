@@ -29,6 +29,10 @@ Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
   disagg.DisaggRouter`'s view: prefill/decode pool membership (full
   fleet stats per pool), staged handoffs, in-flight transfers and the
   channel's claim/budget/outcome tally (JSON).
+* ``/debug/autoscale`` — every live :class:`~k8s_dra_driver_tpu.models.
+  autoscaler.FleetAutoscaler`'s view: policy thresholds, vote streaks,
+  pending spawns, SLO attainment window and the latest decision doc
+  (JSON).
 """
 
 from __future__ import annotations
@@ -128,6 +132,17 @@ class DiagnosticsServer:
 
                     body = json.dumps(
                         debug_disagg_doc(), indent=1, default=str
+                    ).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/autoscale":
+                    # Lazy for the same reason as /debug/fleet; the
+                    # autoscaler is jax-free host-side control law.
+                    from k8s_dra_driver_tpu.models.autoscaler import (
+                        debug_autoscale_doc,
+                    )
+
+                    body = json.dumps(
+                        debug_autoscale_doc(), indent=1, default=str
                     ).encode()
                     ctype = "application/json"
                 else:

@@ -55,6 +55,18 @@ class StepFault(RuntimeError):
         self.slot = slot
 
 
+class SpawnFault(RuntimeError):
+    """Injected replica-factory failure — the fault shape the autoscaler's
+    scale-up path must degrade under gracefully (journal the failure,
+    back off, keep serving on the replicas it has).  Raised by
+    :meth:`FaultInjector.maybe_fail_spawn` BEFORE the factory runs, so a
+    failed spawn never leaves a half-registered replica."""
+
+    def __init__(self, attempt: int, message: str):
+        super().__init__(message)
+        self.attempt = attempt
+
+
 class ReplicaCrash(RuntimeError):
     """Injected whole-replica death attributable to ONE fleet replica —
     the fault shape the fleet router's evacuation path must heal (trip
@@ -108,6 +120,13 @@ class FaultProfile:
     replica_wedge_rate: float = 0.0  # probability a replica hangs this tick
     stats_stale_rate: float = 0.0  # probability stats() serves a frozen copy
     replicas: tuple = ()  # e.g. (1,); empty = all replicas
+    # autoscaler-scoped (fleet controller) kinds: consulted by the
+    # FleetAutoscaler once per scale-up attempt, BEFORE the replica
+    # factory runs — a failed or stalled spawn never half-registers a
+    # replica.  Spawn latency is ACCOUNTED (the pending spawn completes
+    # later on the sim/monotonic clock), never slept, so chaos stays fast.
+    spawn_fail_rate: float = 0.0  # probability a replica spawn errors
+    spawn_latency_s: float = 0.0  # simulated seconds before a spawn is ready
     # channel-scoped (disaggregated KV handoff) kinds: consulted by the
     # HandoffChannel once per transfer, BEFORE the payload is delivered to
     # the decode pool — a dropped or corrupted transfer therefore never
@@ -276,6 +295,42 @@ class FaultInjector:
                 return True
         return False
 
+    # -- autoscaler decision points (fleet controller) ---------------------
+
+    def maybe_fail_spawn(self, attempt: int) -> None:
+        """Autoscaler hook: raise a :class:`SpawnFault` for this scale-up
+        attempt.  Called BEFORE the replica factory runs, so a failed
+        spawn leaves no half-registered replica — the autoscaler journals
+        the failure, backs off, and keeps serving on what it has.
+        Scoped by ``steps`` (= spawn attempt numbers), so a spec can fail
+        exactly the first N attempts."""
+        for p in self._matching_engine(None, attempt):
+            if p.spawn_fail_rate and self._roll(
+                p, p.spawn_fail_rate, "spawn_fail",
+                f"spawn-{attempt}", "autoscaler",
+            ):
+                raise SpawnFault(
+                    attempt,
+                    f"fault injected by profile {p.name!r} "
+                    f"(spawn attempt {attempt})",
+                )
+
+    def take_spawn_latency(self, attempt: int) -> float:
+        """Autoscaler hook: simulated seconds before this spawn is ready.
+        Like :meth:`take_handoff_latency` it does NOT sleep — the
+        autoscaler parks the spawn as pending and realizes it once the
+        clock passes readiness, so a stalled factory is exercised without
+        stalling the chaos suite."""
+        total = 0.0
+        for p in self._matching_engine(None, attempt):
+            if p.spawn_latency_s > 0:
+                with self._lock:
+                    if not self._budget_ok(p):
+                        continue
+                    self._record(p, "spawn_latency", "SPAWN", "autoscaler")
+                total += p.spawn_latency_s
+        return total
+
     # -- channel decision points (disaggregated KV handoff) ----------------
 
     def take_handoff_drop(self, request_id: int) -> bool:
@@ -421,12 +476,17 @@ class FaultInjector:
                 fields["handoff_drop_rate"] = float(value)
             elif key == "handoff_corrupt":
                 fields["handoff_corrupt_rate"] = float(value)
+            elif key == "spawn_fail":
+                fields["spawn_fail_rate"] = float(value)
+            elif key == "spawn_latency_ms":
+                fields["spawn_latency_s"] = float(value) / 1000.0
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
                          "watch_hang_s", "nan_logits_rate", "step_raise_rate",
                          "step_latency_s", "replica_crash_rate",
                          "replica_wedge_rate", "stats_stale_rate",
                          "handoff_drop_rate", "handoff_latency_s",
-                         "handoff_corrupt_rate"):
+                         "handoff_corrupt_rate", "spawn_fail_rate",
+                         "spawn_latency_s"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
                          "watch_hangs", "limit"):

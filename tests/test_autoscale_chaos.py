@@ -1,0 +1,252 @@
+"""Autoscaler chaos suite: flash crowds and replica kills mid-scale.
+
+The closed-loop twin of tests/test_fleet_chaos.py — a seeded trace with
+a 4x flash crowd drives an autoscaled fleet of simulated engines while
+utils/faults.py breaks the control loop's actuators (``spawn_fail``,
+``spawn_latency_ms``) and its data plane (``replica_crash`` during the
+post-crowd scale-down phase).  The PR's acceptance property:
+
+    flash crowd -> scale-up; crowd ends -> scale-down; one replica
+    killed mid-scale-down -> ZERO lost or duplicated streams, every
+    completion BIT-EQUAL to an unfaulted reference run of the same
+    trace (matched by prompt — prompts are unique per arrival), block
+    accounting balanced on every replica once the fleet idles, and one
+    journal correlation per scaling action.
+
+Every fault draws from a seeded injector armed through the same
+``DRA_FAULTS`` grammar operators use, so a failure replays from its
+spec.  Runs in `make chaos-autoscale` (<15s, CPU — no jax imports on
+the hot path; the engines are models/workload.py simulations).
+"""
+
+from collections import Counter
+
+import pytest
+
+from k8s_dra_driver_tpu.models import fleet
+from k8s_dra_driver_tpu.models import workload as W
+from k8s_dra_driver_tpu.models.autoscaler import (
+    AutoscalerPolicy,
+    FleetAutoscaler,
+)
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, SpawnFault
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+
+SPEC = W.WorkloadSpec(
+    seed=42,
+    duration_s=120.0,
+    base_rate_rps=12.0,
+    diurnal_amplitude=0.3,
+    diurnal_period_s=120.0,
+    flash_crowds=(W.FlashCrowd(start_s=30.0, duration_s=20.0, multiplier=4.0),),
+)
+
+N_BLOCKS = 512
+
+
+def _engine_factory(clock):
+    def factory():
+        return W.SimEngine(
+            clock=clock, n_slots=8, n_blocks=N_BLOCKS, decode_tps=30.0
+        )
+    return factory
+
+
+def _policy(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 5)
+    kw.setdefault("up_ticks", 3)
+    kw.setdefault("down_ticks", 30)
+    kw.setdefault("cooldown_s", 5.0)
+    return AutoscalerPolicy(**kw)
+
+
+def _spy_autoscale_journal(monkeypatch):
+    """The journal is a bounded ring; a 2000-request run evicts the early
+    scale events.  Tee the autoscaler's records as they happen instead of
+    reading the ring back."""
+    events = []
+    orig = JOURNAL.record
+
+    def spy(component, event, correlation="", **attrs):
+        if component == "autoscale":
+            events.append({"event": event, "correlation": correlation})
+        orig(component, event, correlation=correlation, **attrs)
+
+    monkeypatch.setattr(JOURNAL, "record", spy)
+    return events
+
+
+def _run(injector=None, policy=None, collect=None):
+    clock = W.SimClock()
+    sink = W.SimSink()
+    factory = _engine_factory(clock)
+
+    def sinked_factory():
+        eng = factory()
+        eng.sink = sink
+        return eng
+
+    router = fleet.FleetRouter(
+        [sinked_factory()], clock=clock, fault_injector=injector
+    )
+    asc = FleetAutoscaler(
+        router, engine_factory=sinked_factory,
+        policy=policy or _policy(), clock=clock,
+    )
+    rep = W.replay(
+        W.generate(SPEC), router, clock=clock, sink=sink, autoscaler=asc,
+        dt=0.1, queue_limit=2048, on_completion=collect,
+    )
+    return rep, router, asc
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """Unfaulted, statically overprovisioned run of the same trace: the
+    bit-equality baseline.  Completes everything (zero shed/lost), so
+    every chaos completion has a reference to match against."""
+    clock = W.SimClock()
+    sink = W.SimSink()
+    engines = [
+        W.SimEngine(clock=clock, n_slots=16, n_blocks=2048,
+                    decode_tps=60.0, sink=sink)
+        for _ in range(4)
+    ]
+    router = fleet.FleetRouter(engines, clock=clock)
+    by_prompt = {}
+
+    def collect(c):
+        if c.status == "ok":
+            prompt = tuple(c.tokens[: len(c.tokens) - len(c.generated)])
+            by_prompt[prompt] = tuple(c.generated)
+
+    rep = W.replay(W.generate(SPEC), router, clock=clock, sink=sink,
+                   dt=0.1, queue_limit=100_000, on_completion=collect)
+    assert rep.lost == 0 and rep.shed == 0
+    assert rep.completed == rep.offered
+    return by_prompt
+
+
+def _check_bit_equal(seen, reference):
+    """Every ok completion matches the reference stream for its prompt,
+    and no stream completed twice."""
+    assert seen, "chaos run completed nothing"
+    dupes = [p for p, (n, _) in seen.items() if n > 1]
+    assert not dupes, f"duplicated streams for prompts {dupes[:3]}"
+    for prompt, (count, generated) in seen.items():
+        assert prompt in reference, f"untraced completion {prompt}"
+        assert generated == reference[prompt], (
+            f"stream for {prompt} diverged from the unfaulted reference"
+        )
+
+
+class _OkCollector:
+    def __init__(self):
+        self.counts = Counter()
+        self.streams = {}
+
+    def __call__(self, c):
+        if c.status != "ok":
+            return
+        prompt = tuple(c.tokens[: len(c.tokens) - len(c.generated)])
+        self.counts[prompt] += 1
+        self.streams[prompt] = tuple(c.generated)
+
+    def seen(self):
+        return {
+            p: (self.counts[p], self.streams[p]) for p in self.counts
+        }
+
+
+class TestFlashCrowdLoop:
+    def test_scales_up_through_crowd_and_back_down(self, monkeypatch):
+        journal = _spy_autoscale_journal(monkeypatch)
+        collect = _OkCollector()
+        rep, router, asc = _run(collect=collect)
+        assert rep.lost == 0
+        assert rep.completed + rep.shed == rep.offered
+        assert rep.offered > 1000
+        # The crowd forced real growth...
+        assert rep.max_replicas >= 3
+        up = sum(1 for e in journal if e["event"] == "scale_up.begin")
+        down = sum(1 for e in journal if e["event"] == "scale_down.begin")
+        assert up >= 2 and down >= 1  # ...and the loop closed both ways
+        # No stream completed twice, even across migrations.
+        assert all(n == 1 for n in collect.counts.values())
+
+    def test_block_accounting_balances_at_idle(self):
+        rep, router, asc = _run()
+        assert rep.lost == 0
+        for r in router.replicas:
+            assert not r.engine._active, f"{r.name} still holds streams"
+            assert r.engine._free_blocks == N_BLOCKS, (
+                f"{r.name} leaked blocks: {r.engine._free_blocks}"
+            )
+
+    def test_one_journal_correlation_per_scaling_action(self, monkeypatch):
+        journal = _spy_autoscale_journal(monkeypatch)
+        rep, router, asc = _run()
+        begins = Counter(
+            e["correlation"] for e in journal
+            if e["event"] in ("scale_up.begin", "scale_down.begin")
+        )
+        assert sum(begins.values()) == asc.actions
+        assert all(n == 1 for n in begins.values())
+        # Every action's correlation also carries its terminal event.
+        for corr in begins:
+            events = [e["event"] for e in journal if e["correlation"] == corr]
+            assert (
+                "scale_up.resumed" in events
+                or "scale_down.resumed" in events
+            ), (corr, events)
+
+
+class TestFaultedLoop:
+    def test_replica_crash_mid_scale_down_stays_bit_equal(self, reference):
+        # Tick 700 = t=70s: the crowd ended at 50s and the down-streak /
+        # cooldown machinery is walking the fleet back down — the kill
+        # lands between scale-down actions, while spawns are also slowed.
+        inj = FaultInjector.from_env(
+            "replica_crash_rate=1.0,steps=700,limit=1,"
+            "spawn_latency_ms=500,seed=7"
+        )
+        collect = _OkCollector()
+        rep, router, asc = _run(injector=inj, collect=collect)
+        assert inj.stats().get("replica_crash") == 1, "the kill never fired"
+        assert rep.lost == 0
+        assert rep.completed + rep.shed == rep.offered
+        _check_bit_equal(collect.seen(), reference)
+        for r in router.replicas:
+            assert not r.engine._active
+            assert r.engine._free_blocks == N_BLOCKS
+
+    def test_spawn_fail_storm_starves_growth_but_loses_nothing(self, reference):
+        # Every spawn fails: the fleet is pinned at one replica through
+        # the whole crowd.  Requests shed (bounded queue) but NOTHING is
+        # lost or duplicated, and what completes is still bit-equal.
+        inj = FaultInjector.from_env("spawn_fail=1.0,seed=11")
+        collect = _OkCollector()
+        rep, router, asc = _run(injector=inj, collect=collect)
+        assert asc.spawn_failures >= 1
+        assert len(router.replicas) == 1
+        assert rep.lost == 0
+        assert rep.completed + rep.shed == rep.offered
+        _check_bit_equal(collect.seen(), reference)
+
+    def test_spawn_hooks_parse_and_scope_from_env(self):
+        inj = FaultInjector.from_env(
+            "spawn_fail=1.0,spawn_latency_ms=250,steps=0+1,limit=2,seed=3"
+        )
+        (p,) = inj._profiles
+        assert p.spawn_fail_rate == 1.0
+        assert p.spawn_latency_s == pytest.approx(0.25)
+        with pytest.raises(SpawnFault):
+            inj.maybe_fail_spawn(0)
+        inj.maybe_fail_spawn(5)  # out of steps scope: silent
+        assert inj.take_spawn_latency(1) == pytest.approx(0.25)
+        assert inj.take_spawn_latency(9) == 0.0  # out of scope
+        # The shared budget is spent: nothing further fires.
+        inj.maybe_fail_spawn(0)
+        assert inj.stats().get("spawn_fail") == 1
+        assert inj.stats().get("spawn_latency") == 1

@@ -1,0 +1,329 @@
+"""FleetAutoscaler state-machine unit suite (PR 12).
+
+The control law, exercised over simulated engines without faults unless
+a test arms them explicitly:
+
+* Hysteresis: an action fires only after ``up_ticks``/``down_ticks``
+  CONSECUTIVE votes; an interrupted streak starts over.
+* Cooldown: no two actions inside ``cooldown_s`` — except the
+  min-replicas floor, which restores the minimum immediately.
+* Clamps: replica count never leaves ``[min_replicas, max_replicas]``.
+* Victim selection: scale-down drains the least-loaded ADMITTABLE
+  replica; SUSPECT/EVACUATING/DRAINED replicas are never picked.
+* Spawn faults: ``spawn_fail`` backs off without half-registering;
+  ``spawn_latency_ms`` defers registration until the (accounted)
+  latency elapses on the shared clock.
+* Wiring: attach() drives the loop from router ticks; metrics land in
+  the registry; /debug/autoscale renders the control-law state.
+
+The fault-injected end-to-end suite (flash crowds + replica kills) is
+tests/test_autoscale_chaos.py (`make chaos-autoscale`).
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.models import fleet
+from k8s_dra_driver_tpu.models import workload as W
+from k8s_dra_driver_tpu.models.autoscaler import (
+    AutoscalerPolicy,
+    FleetAutoscaler,
+    debug_autoscale_doc,
+)
+from k8s_dra_driver_tpu.models.fleet import EVACUATING, SUSPECT
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
+
+
+def _build(n=2, *, policy=None, injector=None, n_slots=4):
+    clock = W.SimClock()
+
+    def factory():
+        return W.SimEngine(clock=clock, n_slots=n_slots, n_blocks=512)
+
+    router = fleet.FleetRouter(
+        [factory() for _ in range(n)], clock=clock, fault_injector=injector
+    )
+    asc = FleetAutoscaler(
+        router,
+        engine_factory=factory,
+        policy=policy or AutoscalerPolicy(
+            min_replicas=1, max_replicas=4, up_ticks=2, down_ticks=3,
+            cooldown_s=5.0,
+        ),
+        clock=clock,
+    )
+    return clock, router, asc, factory
+
+
+def _fill(router, n):
+    """Occupy n slots across the fleet so utilization reads high."""
+    for i in range(n):
+        router.submit([1, i + 2], max_tokens=64)
+
+
+def _live(router):
+    return sum(1 for r in router.replicas if r.state != "drained")
+
+
+class TestHysteresis:
+    def test_up_needs_consecutive_votes(self):
+        clock, router, asc, _ = _build()
+        _fill(router, 8)  # 8/8 slots busy -> vote up
+        d1 = asc.tick()
+        assert d1["vote"] == "up" and d1["action"] == "none"
+        assert _live(router) == 2
+        clock.advance(1.0)
+        d2 = asc.tick()
+        assert d2["action"] == "up"
+        assert _live(router) == 3
+
+    def test_interrupted_streak_starts_over(self):
+        clock, router, asc, _ = _build()
+        _fill(router, 8)
+        asc.tick()  # streak 1
+        # Neutral tick: mid utilization (free half the fleet's slots by
+        # voting with an explicit shallow queue on an idle twin is messy;
+        # simplest neutral signal is util between low and high).
+        for rep in router.replicas:
+            rep.engine.release_active()
+        router.submit([9, 9], max_tokens=64)  # 1/8 busy... still <= low
+        _fill(router, 3)  # 4/8 busy: between 0.30 and 0.85 -> hold
+        clock.advance(1.0)
+        d = asc.tick()
+        assert d["vote"] == "hold" and d["up_streak"] == 0
+        _fill(router, 4)  # back to full pressure
+        clock.advance(1.0)
+        assert asc.tick()["action"] == "none"  # streak restarted at 1
+        clock.advance(1.0)
+        assert asc.tick()["action"] == "up"
+
+    def test_down_needs_longer_streak(self):
+        clock, router, asc, _ = _build()
+        acted = []
+        for _ in range(3):
+            clock.advance(2.0)
+            acted.append(asc.tick()["action"])
+        assert acted == ["none", "none", "down"]
+        assert _live(router) == 1
+
+
+class TestCooldownAndClamps:
+    def test_cooldown_blocks_consecutive_actions(self):
+        clock, router, asc, _ = _build()
+        _fill(router, 8)
+        asc.tick()
+        clock.advance(1.0)
+        assert asc.tick()["action"] == "up"
+        _fill(router, 4)  # keep the new 3-replica fleet saturated
+        for _ in range(3):  # still inside cooldown_s=5
+            clock.advance(1.0)
+            assert asc.tick()["action"] == "none"
+        clock.advance(3.0)  # past cooldown; streak long since satisfied
+        assert asc.tick()["action"] == "up"
+        assert _live(router) == 4
+
+    def test_max_replicas_clamps_growth(self):
+        clock, router, asc, _ = _build(
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=2,
+                                    up_ticks=1, cooldown_s=0.0)
+        )
+        for _ in range(5):
+            _fill(router, 1)
+            clock.advance(1.0)
+            d = asc.tick(queue_depth=100)  # maximal pressure forever
+        assert _live(router) == 2
+        assert d["target"] == 2
+
+    def test_min_floor_restores_without_hysteresis(self):
+        clock, router, asc, _ = _build(
+            n=2,
+            policy=AutoscalerPolicy(min_replicas=2, max_replicas=4,
+                                    up_ticks=99, cooldown_s=1e9),
+        )
+        router.drain(router.replicas[0].name, reason="test")
+        assert _live(router) == 1
+        d = asc.tick()
+        # Neither the 99-tick hysteresis nor the infinite cooldown may
+        # block restoring the floor.
+        assert d["action"] == "up" and d["reason"] == "min_replicas"
+        assert _live(router) == 2
+
+    def test_min_replicas_blocks_scale_down(self):
+        clock, router, asc, _ = _build(
+            n=1,
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                                    down_ticks=1, cooldown_s=0.0),
+        )
+        for _ in range(4):
+            clock.advance(1.0)
+            assert asc.tick()["action"] == "none"
+        assert _live(router) == 1
+
+    def test_policy_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(min_replicas=0)
+
+
+class TestVictimSelection:
+    def _down_ready(self, n=3):
+        clock, router, asc, _ = _build(
+            n=n,
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                                    down_ticks=1, cooldown_s=0.0),
+        )
+        return clock, router, asc
+
+    def test_least_loaded_is_drained(self):
+        clock, router, asc = self._down_ready()
+        r0, r1, r2 = router.replicas
+        for j, (rep, streams) in enumerate(((r0, 2), (r1, 1), (r2, 3))):
+            for i in range(streams):
+                rep.engine.submit([j, i], max_tokens=64)
+        # All busy -> no down vote; empty the queue and let util sit low:
+        # 6/12 = 0.5 is a hold, so force the vote via an idle fleet is
+        # wrong here — drive _scale_down directly through a real tick by
+        # loosening the low-water mark instead.
+        asc.policy = AutoscalerPolicy(
+            min_replicas=1, max_replicas=4, down_ticks=1, cooldown_s=0.0,
+            target_util_low=0.60,
+        )
+        clock.advance(1.0)
+        d = asc.tick()
+        assert d["action"] == "down"
+        assert r1.state == "drained"  # 1 resident stream = least loaded
+        assert r0.state != "drained" and r2.state != "drained"
+
+    def test_suspect_and_evacuating_never_picked(self):
+        clock, router, asc = self._down_ready()
+        r0, r1, r2 = router.replicas
+        r0.state = SUSPECT
+        r1.state = EVACUATING
+        clock.advance(1.0)
+        d = asc.tick()
+        # r2 is the only admittable replica and min_replicas=1: draining
+        # it would leave zero admittable capacity -> no victim.
+        assert d["action"] == "none" or r2.state != "drained"
+        assert asc._pick_victim() is None
+
+    def test_scale_down_threads_one_correlation(self):
+        clock, router, asc = self._down_ready()
+        rid = router.submit([7, 7, 7], max_tokens=32)
+        clock.advance(1.0)
+        d = asc.tick()
+        assert d["action"] == "down"
+        corrs = {
+            e["correlation"]
+            for e in JOURNAL.tail(limit=200)
+            if str(e.get("correlation", "")).startswith("scale-")
+        }
+        assert len(corrs) == 1
+        corr = corrs.pop()
+        events = [e["event"] for e in JOURNAL.tail(limit=200, correlation=corr)]
+        assert "scale_down.begin" in events
+        assert "scale_down.resumed" in events
+        # The drain's whole evacuation rides under the SAME correlation.
+        assert "replica.evacuating" in events
+        assert "replica.drained" in events
+
+
+class TestSpawnFaults:
+    def _pressure_policy(self):
+        return AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                                up_ticks=1, cooldown_s=0.0,
+                                spawn_backoff_s=10.0)
+
+    def test_spawn_fail_backs_off_without_half_registering(self):
+        inj = FaultInjector(seed=0)
+        inj.arm(FaultProfile(name="boom", spawn_fail_rate=1.0, limit=1))
+        clock, router, asc, _ = _build(
+            n=1, policy=self._pressure_policy(), injector=inj
+        )
+        _fill(router, 4)
+        clock.advance(1.0)
+        d = asc.tick()
+        assert asc.spawn_failures == 1
+        assert _live(router) == 1  # nothing half-registered
+        events = [e["event"] for e in JOURNAL.tail(limit=50)]
+        assert "scale_up.spawn_failed" in events
+        # Inside the backoff window: pressure is ignored.
+        clock.advance(1.0)
+        assert asc.tick()["backing_off"] is True
+        assert _live(router) == 1
+        # Past the backoff (and the profile's limit=1 budget): retry wins.
+        clock.advance(10.0)
+        d = asc.tick()
+        assert d["action"] == "up"
+        assert _live(router) == 2
+
+    def test_spawn_latency_defers_registration(self):
+        inj = FaultInjector(seed=0)
+        inj.arm(FaultProfile(name="slow", spawn_latency_s=5.0))
+        clock, router, asc, _ = _build(
+            n=1, policy=self._pressure_policy(), injector=inj
+        )
+        _fill(router, 4)
+        clock.advance(1.0)
+        d = asc.tick()
+        assert d["action"] == "up"
+        assert _live(router) == 1  # factory latency still accounting
+        assert d["pending_spawns"] == 0 or asc._pending_spawns
+        clock.advance(2.0)
+        asc.tick()
+        assert _live(router) == 1
+        clock.advance(4.0)  # past ready_at
+        asc.tick()
+        assert _live(router) == 2
+        assert any(r.name.startswith("as") for r in router.replicas)
+
+
+class TestWiring:
+    def test_attach_drives_from_router_ticks(self):
+        clock, router, asc, _ = _build()
+        asc.attach()
+        asc.attach()  # idempotent: one hook, not two
+        assert router.tick_hooks.count(asc._on_router_tick) == 1
+        before = asc.ticks
+        router.tick()
+        assert asc.ticks == before + 1
+
+    def test_metrics_land_in_registry(self):
+        clock, router, asc, _ = _build(
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                                    up_ticks=1, cooldown_s=0.0)
+        )
+        _fill(router, 8)
+        clock.advance(1.0)
+        asc.tick()
+        asc.record_slo(attained=9, offered=10)
+        doc = parse_prom_text(REGISTRY.render())
+        assert doc["tpu_autoscale_events_total"][
+            (("direction", "up"), ("reason", "overload"))
+        ] == 1
+        assert doc["tpu_autoscale_replicas"][(("kind", "actual"),)] == 3
+        assert doc["tpu_autoscale_slo_attainment"][()] == pytest.approx(0.9)
+        assert any(
+            k == "tpu_autoscale_decision_seconds_count"
+            for k in doc
+        )
+
+    def test_debug_autoscale_doc_renders_state(self):
+        clock, router, asc, _ = _build()
+        asc.tick()
+        doc = debug_autoscale_doc()
+        ours = [
+            a for a in doc["autoscalers"] if a["router_seq"] == router.seq
+        ]
+        assert len(ours) == 1
+        st = ours[0]
+        assert st["ticks"] == 1
+        assert st["policy"]["max_replicas"] == 4
+        assert st["last_decision"]["action"] == "none"
+
+    def test_record_slo_accumulates(self):
+        clock, router, asc, _ = _build()
+        asc.record_slo(5, 10)
+        asc.record_slo(5, 10)
+        assert asc.stats()["slo"]["attainment"] == pytest.approx(0.5)

@@ -28,6 +28,7 @@ import pytest
 from k8s_dra_driver_tpu.models import burnin, fleet, lora, paged, serve
 from k8s_dra_driver_tpu.models.fleet import (
     DRAINED,
+    EVACUATING,
     HEALTHY,
     ID_STRIDE,
     SUSPECT,
@@ -306,6 +307,40 @@ class TestFleetPump:
 
         assert hint(1) == pytest.approx(1.0)
         assert hint(2) == pytest.approx(0.5)
+
+    def test_retry_after_counts_fresh_replicas_without_stats(self, params):
+        # A just-added healthy replica has last_stats=None until its first
+        # health tick, but it WILL absorb queue drain — the retry-after
+        # denominator must count it (regression: the old denominator only
+        # counted replicas with a cached stats snapshot).
+        router = FleetRouter([_dense(params), _dense(params)])
+        router.replicas[0].last_stats = dataclasses.replace(
+            router.replicas[0].engine.stats(), last_step_s=0.1
+        )
+        assert router.replicas[1].last_stats is None
+        router._fleet_shed({"prompt": [1, 2]}, depth=10, why="test")
+        assert router.last_shed.retry_after_s == pytest.approx(0.5)
+
+    def test_retry_after_excludes_draining_replicas(self, params):
+        # An evacuating replica takes no admissions, so it cannot help
+        # drain the queue — the hint must not be diluted by it.
+        router = FleetRouter([_dense(params), _dense(params)])
+        for rep in router.replicas:
+            rep.last_stats = dataclasses.replace(
+                rep.engine.stats(), last_step_s=0.1
+            )
+        router.replicas[1].state = EVACUATING
+        router._fleet_shed({"prompt": [1, 2]}, depth=10, why="test")
+        assert router.last_shed.retry_after_s == pytest.approx(1.0)
+
+    def test_admittable_replicas_gates_state_and_breaker(self, params):
+        router = FleetRouter([_dense(params) for _ in range(3)])
+        assert len(router.admittable_replicas()) == 3  # fresh = admittable
+        router.replicas[0].state = SUSPECT
+        router.replicas[1].breaker.trip()
+        assert [r.name for r in router.admittable_replicas()] == [
+            router.replicas[2].name
+        ]
 
 
 class TestDrainMigration:

@@ -70,3 +70,15 @@ def test_handoff_overhead_stays_within_perf_budgets():
     # on a fault-free channel.
     assert stats["host_syncs_disagg"] <= stats["host_sync_ceiling"]
     assert stats["transfers_ok"] == stats["requests_disagg"]
+
+
+def test_autoscaler_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_autoscaler_overhead()
+    assert stats["requests_scaled"] == 8
+    # The autoscaler's contract: the control loop is host-side arithmetic
+    # over stats() snapshots the router already collects — a 1-replica
+    # fleet under a pinned (min==max==1) autoscaler pays EXACTLY the bare
+    # fleet's host syncs and never touches the engine factory.
+    assert stats["host_syncs_scaled"] == stats["host_syncs_bare"]
+    assert stats["autoscaler_actions"] == 0
+    assert stats["autoscaler_ticks"] > 0

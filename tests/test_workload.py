@@ -1,0 +1,227 @@
+"""Workload generator + simulated-engine unit suite (PR 12).
+
+* Seeded determinism: the same WorkloadSpec seed replays an IDENTICAL
+  trace — times, lengths, SLO tiers — and different seeds diverge.
+* Distribution moments: the lognormal prompt-length and Pareto
+  stream-length samplers hit their documented means (exp(mu + sigma^2/2)
+  and xm*alpha/(alpha-1)) within sampling tolerance.
+* Rate curve: diurnal modulation, flash-crowd multipliers, and the
+  piecewise majorant all bound rate_at correctly.
+* SimEngine: satisfies the fleet Engine protocol, generates tokens as a
+  pure function of the prompt (bit-equal across snapshot/restore), and
+  keeps restore atomic (a refused restore mutates NOTHING — the fleet
+  re-parks the whole batch on raise).
+* replay(): drives a FleetRouter in simulated time and accounts every
+  offered request exactly once (completed + shed + lost == offered).
+
+The closed-loop autoscaler suite lives in tests/test_autoscaler.py; the
+fault-injected end-to-end suite is tests/test_autoscale_chaos.py
+(`make chaos-autoscale`).
+"""
+
+import math
+
+import pytest
+
+from k8s_dra_driver_tpu.models import fleet
+from k8s_dra_driver_tpu.models import workload as W
+from k8s_dra_driver_tpu.models.telemetry import EngineStats
+
+
+def _spec(**kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("duration_s", 120.0)
+    kw.setdefault("base_rate_rps", 20.0)
+    return W.WorkloadSpec(**kw)
+
+
+class TestTraceDeterminism:
+    def test_same_seed_identical_trace(self):
+        a = list(W.generate(_spec()))
+        b = list(W.generate(_spec()))
+        assert a == b
+        assert len(a) > 100
+
+    def test_different_seed_diverges(self):
+        a = list(W.generate(_spec(seed=1)))
+        b = list(W.generate(_spec(seed=2)))
+        assert a != b
+
+    def test_arrivals_ordered_and_bounded(self):
+        trace = list(W.generate(_spec()))
+        times = [a.t for a in trace]
+        assert times == sorted(times)
+        assert all(0.0 <= t < 120.0 for t in times)
+        assert [a.rid for a in trace] == list(range(len(trace)))
+
+    def test_arrival_count_tracks_offered_integral(self):
+        # Over a full diurnal period the sine integrates to zero, so the
+        # expected count is base * duration; allow 5 sigma of Poisson
+        # noise.
+        spec = _spec(duration_s=600.0, base_rate_rps=30.0,
+                     diurnal_period_s=600.0)
+        n = sum(1 for _ in W.generate(spec))
+        expect = 30.0 * 600.0
+        assert abs(n - expect) < 5.0 * math.sqrt(expect)
+
+
+class TestDistributions:
+    def test_prompt_lengths_hit_lognormal_mean(self):
+        spec = _spec(duration_s=2000.0, prompt_len_max=100_000)
+        lens = [a.prompt_len for a in W.generate(spec)]
+        want = math.exp(spec.prompt_len_mu + spec.prompt_len_sigma ** 2 / 2)
+        got = sum(lens) / len(lens)
+        assert len(lens) > 10_000
+        assert got == pytest.approx(want, rel=0.10)
+
+    def test_stream_lengths_hit_pareto_mean(self):
+        spec = _spec(duration_s=2000.0, stream_len_max=100_000)
+        lens = [a.max_tokens for a in W.generate(spec)]
+        a, xm = spec.stream_len_alpha, spec.stream_len_min
+        want = xm * a / (a - 1.0)
+        got = sum(lens) / len(lens)
+        assert got == pytest.approx(want, rel=0.10)
+        assert min(lens) >= 1
+
+    def test_slo_tier_mix_matches_weights(self):
+        spec = _spec(duration_s=2000.0)
+        trace = list(W.generate(spec))
+        interactive = sum(1 for a in trace if a.ttft_slo_s == 1.0)
+        assert interactive / len(trace) == pytest.approx(0.5, abs=0.03)
+
+
+class TestRateCurve:
+    def test_flash_crowd_multiplies_rate(self):
+        spec = _spec(flash_crowds=(W.FlashCrowd(50.0, 10.0, 4.0),),
+                     diurnal_amplitude=0.0)
+        assert W.rate_at(spec, 55.0) == pytest.approx(4.0 * 20.0)
+        assert W.rate_at(spec, 49.0) == pytest.approx(20.0)
+        assert W.rate_at(spec, 60.0) == pytest.approx(20.0)
+
+    def test_majorant_bounds_rate_everywhere(self):
+        spec = _spec(flash_crowds=(W.FlashCrowd(30.0, 20.0, 3.0),))
+        segs = W._majorant_segments(spec)
+        assert segs[0][0] == 0.0 and segs[-1][1] == spec.duration_s
+        for a, b, m in segs:
+            for frac in (0.0, 0.25, 0.5, 0.75, 0.999):
+                t = a + (b - a) * frac
+                assert W.rate_at(spec, t) <= m + 1e-9
+        assert max(m for _, _, m in segs) == pytest.approx(W.peak_rate(spec))
+
+    def test_clock_advances_monotonically(self):
+        clock = W.SimClock()
+        clock.advance(1.5)
+        assert clock() == pytest.approx(1.5)
+        with pytest.raises(ValueError):
+            clock.advance(-0.1)
+
+
+class TestSimEngine:
+    def _engine(self, clock, **kw):
+        kw.setdefault("n_slots", 4)
+        kw.setdefault("n_blocks", 256)
+        return W.SimEngine(clock=clock, **kw)
+
+    def test_satisfies_fleet_engine_protocol(self):
+        assert isinstance(self._engine(W.SimClock()), fleet.Engine)
+
+    def test_stats_contract_and_strict_uptime_advance(self):
+        eng = self._engine(W.SimClock())
+        s1, s2 = eng.stats(), eng.stats()
+        assert isinstance(s1, EngineStats)
+        # The router's stale-feed detector needs uptime to STRICTLY
+        # advance between consecutive reads even at frozen sim time.
+        assert s2.uptime_s > s1.uptime_s
+
+    def test_tokens_are_pure_function_of_prompt(self):
+        clock = W.SimClock()
+        e1, e2 = self._engine(clock), self._engine(clock)
+        r1 = e1.submit([3, 1, 4, 1, 5], max_tokens=12)
+        r2 = e2.submit([3, 1, 4, 1, 5], max_tokens=12)
+        for _ in range(40):
+            clock.advance(0.1)
+            e1.step_burst()
+            e2.step_burst()
+        c1 = {c.request_id: c for c in e1.completions()}[r1]
+        c2 = {c.request_id: c for c in e2.completions()}[r2]
+        assert c1.generated == c2.generated
+        assert len(c1.generated) == 12
+
+    def test_snapshot_restore_continues_bit_equal(self):
+        clock = W.SimClock()
+        # decode_tps=10 so five 0.1s bursts leave the stream mid-flight.
+        ref = self._engine(clock, decode_tps=10.0)
+        rid_ref = ref.submit([9, 8, 7], max_tokens=16)
+
+        src = self._engine(clock, decode_tps=10.0)
+        dst = self._engine(clock, decode_tps=10.0)
+        rid_src = src.submit([9, 8, 7], max_tokens=16)
+        for _ in range(5):
+            clock.advance(0.1)
+            ref.step_burst()
+            src.step_burst()
+        snap = src.snapshot_active()
+        src.release_active()
+        restored = dst.restore(snap, merge=True)
+        assert restored == [rid_src]  # rids survive the migration
+        for _ in range(60):
+            clock.advance(0.1)
+            ref.step_burst()
+            dst.step_burst()
+        ref_out = {c.request_id: c for c in ref.completions()}[rid_ref]
+        dst_out = {c.request_id: c for c in dst.completions()}[rid_src]
+        assert dst_out.generated == ref_out.generated
+
+    def test_restore_is_atomic_on_refusal(self):
+        clock = W.SimClock()
+        src = self._engine(clock, n_slots=3)
+        for p in ([1, 2], [3, 4], [5, 6]):
+            src.submit(p, max_tokens=8)
+        snap = src.snapshot_active()
+        dst = self._engine(clock, n_slots=2)  # one slot short
+        before = (dst.free_slots(), dst._free_blocks)
+        with pytest.raises(RuntimeError):
+            dst.restore(snap, merge=True)
+        # The fleet re-parks the WHOLE batch on raise, so a partial
+        # restore would duplicate streams: nothing may have landed.
+        assert (dst.free_slots(), dst._free_blocks) == before
+        assert not dst._active
+
+    def test_submit_raises_when_full(self):
+        clock = W.SimClock()
+        eng = self._engine(clock, n_slots=1)
+        eng.submit([1], max_tokens=4)
+        with pytest.raises(RuntimeError):
+            eng.submit([2], max_tokens=4)
+
+
+class TestReplay:
+    def _run(self, seed=11, **kw):
+        spec = _spec(seed=seed, duration_s=60.0, base_rate_rps=10.0)
+        clock = W.SimClock()
+        sink = W.SimSink()
+        engines = [
+            W.SimEngine(clock=clock, n_slots=8, n_blocks=1024, sink=sink)
+            for _ in range(2)
+        ]
+        router = fleet.FleetRouter(engines, clock=clock)
+        return W.replay(W.generate(spec), router, clock=clock, sink=sink,
+                        dt=0.25, **kw)
+
+    def test_accounts_every_offered_request(self):
+        rep = self._run()
+        assert rep.offered > 100
+        assert rep.lost == 0
+        assert rep.completed + rep.shed == rep.offered
+        assert 0 <= rep.attained <= rep.offered
+        assert rep.slo_attainment == pytest.approx(rep.attained / rep.offered)
+
+    def test_replay_is_deterministic(self):
+        a, b = self._run().to_json(), self._run().to_json()
+        a.pop("wall_s"), b.pop("wall_s")  # the one wall-clock field
+        assert a == b
+
+    def test_bounded_backlog_sheds_overflow(self):
+        rep = self._run(seed=12, queue_limit=4)
+        assert rep.offered == rep.completed + rep.shed
+        assert rep.lost == 0

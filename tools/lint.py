@@ -363,7 +363,8 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
                 and isinstance(node.args[0], ast.Constant)
                 and isinstance(node.args[0].value, str)
                 and node.args[0].value.startswith(
-                    ("tpu_serve_", "tpu_fleet_", "tpu_disagg_")
+                    ("tpu_serve_", "tpu_fleet_", "tpu_disagg_",
+                     "tpu_autoscale_")
                 )
             ):
                 continue
@@ -406,8 +407,13 @@ METRIC_LABEL_KEYS = frozenset({
     # fault-injection dimensions (utils/faults.py): profile names and fault
     # kinds are both bounded, operator-declared sets
     "profile", "fault",
+    # autoscaler scaling events (models/autoscaler.py): direction is the
+    # closed {up, down} pair
+    "direction",
 })
-METRIC_LABEL_PREFIXES = ("tpu_serve_", "tpu_fleet_", "tpu_disagg_", "dra_")
+METRIC_LABEL_PREFIXES = (
+    "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_", "dra_",
+)
 _METRIC_CALL_ATTRS = {"inc", "observe", "set"}
 # First positionals of Counter.inc/Histogram.observe/Gauge.set when passed by
 # keyword; not labels.

@@ -540,6 +540,114 @@ def check_handoff_overhead() -> dict:
     return stats
 
 
+# The autoscaler is a control law over stats() snapshots the router
+# already collects: a 1-replica fleet under a no-op autoscaler (min ==
+# max == 1, so no scaling action is ever legal) pays EXACTLY the bare
+# fleet's host syncs, never touches the engine factory, and its per-tick
+# vote (util/queue thresholds, hysteresis counters) stays inside the
+# same wall envelope the router itself is held to.
+AUTOSCALER_OVERHEAD_FRAC = 0.10
+AUTOSCALER_OVERHEAD_FLOOR_S = 0.10
+
+
+def check_autoscaler_overhead() -> dict:
+    """Budget guard for the closed-loop autoscaler (PR 12 tentpole): a
+    1-replica fleet pumped with a pinned FleetAutoscaler attached must
+    dispatch exactly the device work of the same fleet without one."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, fleet, serve
+    from k8s_dra_driver_tpu.models.autoscaler import (
+        AutoscalerPolicy,
+        FleetAutoscaler,
+    )
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(8)
+    ]
+
+    def engine():
+        return serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=4, prompt_bucket=16, sync_interval=8
+        )
+
+    reqs = [{"prompt": p, "max_tokens": 16} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    bare_eng = engine()
+    bare = fleet.FleetRouter([bare_eng])
+    start = time.perf_counter()
+    done_bare = bare.pump([dict(r) for r in reqs])
+    bare_wall = time.perf_counter() - start
+
+    scaled_eng = engine()
+    router = fleet.FleetRouter([scaled_eng])
+    factory_calls = []
+
+    def factory():
+        factory_calls.append(1)
+        return engine()
+
+    asc = FleetAutoscaler(
+        router,
+        engine_factory=factory,
+        policy=AutoscalerPolicy(min_replicas=1, max_replicas=1),
+    ).attach()
+    start = time.perf_counter()
+    done_scaled = router.pump([dict(r) for r in reqs])
+    scaled_wall = time.perf_counter() - start
+
+    budget = bare_wall * (1 + AUTOSCALER_OVERHEAD_FRAC) + AUTOSCALER_OVERHEAD_FLOOR_S
+    stats = {
+        "requests_bare": len(done_bare),
+        "requests_scaled": len(done_scaled),
+        "host_syncs_bare": bare_eng.host_syncs,
+        "host_syncs_scaled": scaled_eng.host_syncs,
+        "autoscaler_ticks": asc.ticks,
+        "autoscaler_actions": asc.actions,
+        "bare_s": round(bare_wall, 3),
+        "scaled_s": round(scaled_wall, 3),
+        "budget_frac": AUTOSCALER_OVERHEAD_FRAC,
+        "floor_s": AUTOSCALER_OVERHEAD_FLOOR_S,
+    }
+    if len(done_scaled) != len(reqs) or len(done_bare) != len(reqs):
+        raise PerfBudgetError(
+            f"autoscaler overhead run drained {len(done_scaled)}/{len(reqs)} "
+            f"scaled vs {len(done_bare)} bare"
+        )
+    if asc.ticks == 0:
+        raise PerfBudgetError(
+            "attached autoscaler never ticked during the pump — the "
+            "router tick hook is not being driven"
+        )
+    if asc.actions != 0 or factory_calls or len(router.replicas) != 1:
+        raise PerfBudgetError(
+            f"pinned autoscaler acted: {asc.actions} actions, "
+            f"{len(factory_calls)} factory calls, {len(router.replicas)} "
+            f"replicas — min==max==1 must make every scaling action illegal"
+        )
+    if scaled_eng.host_syncs != bare_eng.host_syncs:
+        raise PerfBudgetError(
+            f"autoscaler added device work: {scaled_eng.host_syncs} host "
+            f"syncs with the control loop attached vs {bare_eng.host_syncs} "
+            f"bare — the vote must stay host-side arithmetic over stats() "
+            f"snapshots the router already holds"
+        )
+    if scaled_wall > budget:
+        raise PerfBudgetError(
+            f"autoscaled pump took {scaled_wall:.3f}s > {budget:.3f}s "
+            f"({bare_wall:.3f}s bare + {AUTOSCALER_OVERHEAD_FRAC:.0%} + "
+            f"{AUTOSCALER_OVERHEAD_FLOOR_S}s floor): the per-tick vote is "
+            f"no longer cheap host work"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
@@ -548,6 +656,7 @@ def main() -> int:
         stats["telemetry_overhead"] = check_telemetry_overhead()
         stats["router_overhead"] = check_router_overhead()
         stats["handoff_overhead"] = check_handoff_overhead()
+        stats["autoscaler_overhead"] = check_autoscaler_overhead()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
