@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale demo dryrun lint analyze perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -61,6 +61,15 @@ chaos-disagg:
 # scaling action, balanced block accounting at idle.
 chaos-autoscale:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_autoscale_chaos.py -q
+
+# KV transport chaos suite (CPU, seeded): framed transfers between the
+# prefill and decode pools over REAL byte pipes under sock_truncate/
+# sock_reset/sock_latency_ms/peer_hang faults, plus one genuine
+# two-process run that SIGKILLs the decode worker mid-transfer — zero
+# lost or duplicated streams, bit-equal recovery, breaker-gated
+# reconnect, in-flight bytes drained to zero.
+chaos-transport:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

@@ -55,7 +55,7 @@ from __future__ import annotations
 import os
 import time
 import weakref
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from k8s_dra_driver_tpu.models.fleet import FleetPolicy, FleetRouter
 from k8s_dra_driver_tpu.models.telemetry import EngineTelemetry
@@ -224,12 +224,23 @@ class HandoffChannel:
             nbytes=nbytes, reason=why, budget=self.max_in_flight_bytes,
         )
 
-    def complete(self, transfer: Transfer, kv) -> str:
+    # The in-process channel is never "down" and has nothing to pump; the
+    # transport.TransportChannel subclass overrides both — the router
+    # consults them without caring which channel kind it holds.
+    down = False
+
+    def tick(self) -> int:
+        return 0
+
+    def complete(self, transfer: Transfer, kv, entry=None) -> str:
         """Resolve one transfer: account latency, consult the fault hooks,
         verify the checksum, release the in-flight budget.  Returns the
         outcome string; the payload object itself is never mutated — on a
         non-``ok`` outcome the ROUTER discards it, so corrupted/stale KV
-        bytes can never reach a decode replica."""
+        bytes can never reach a decode replica.  ``entry`` (the snapshot
+        entry the payload belongs to) is unused here; the transport
+        channel ships it alongside the KV bytes so the receiver can
+        install the stream atomically."""
         latency = transfer.nbytes / max(self.bandwidth_gbps * 1e9 / 8.0, 1.0)
         inj = self.fault_injector
         if inj is not None:
@@ -313,16 +324,21 @@ class DisaggRouter:
                 fault_injector = faults.FaultInjector.from_env(raw)
         self.fault_injector = fault_injector
         # One injector shared by both pools and the channel: one DRA_FAULTS
-        # spec (and one budget) drives chaos across every layer.
+        # spec (and one budget) drives chaos across every layer.  Pools are
+        # duck-typed on the FleetRouter drive surface (submit/place/tick/
+        # completions/idle) so a transport.RemotePool — the same pool
+        # hosted in a worker process — slots in unchanged.
         self.prefill = (
-            prefill if isinstance(prefill, FleetRouter)
-            else FleetRouter(prefill, policy=policy,
-                             fault_injector=fault_injector, clock=clock)
+            FleetRouter(prefill, policy=policy,
+                        fault_injector=fault_injector, clock=clock)
+            if isinstance(prefill, (list, tuple))
+            else prefill
         )
         self.decode = (
-            decode if isinstance(decode, FleetRouter)
-            else FleetRouter(decode, policy=policy,
-                             fault_injector=fault_injector, clock=clock)
+            FleetRouter(decode, policy=policy,
+                        fault_injector=fault_injector, clock=clock)
+            if isinstance(decode, (list, tuple))
+            else decode
         )
         self.channel = channel or HandoffChannel(
             fault_injector=fault_injector, clock=clock
@@ -335,6 +351,8 @@ class DisaggRouter:
         self._t0: dict[int, float] = {}    # rid -> enqueue time (TTFT base)
         self._awaiting: dict[int, float] = {}  # rid -> delivery time (decode stage)
         self._completions: list = []       # collected by the external drive
+        # locally re-run rid -> the rid the caller holds (crash resubmit)
+        self._rid_alias: dict[int, int] = {}
         self.handoffs = 0
         self.fallbacks = 0
         _LIVE_DISAGG.add(self)
@@ -357,11 +375,12 @@ class DisaggRouter:
             self._tick += 1
             admitted = self._admit(queue)
             stepped = self.prefill.tick()
-            out.extend(self.prefill.completions())
+            out.extend(self._remap(self.prefill.completions()))
             collected = self._collect_handoffs()
             moved = self._drive_channel()
             stepped += self.decode.tick()
-            out.extend(self._collect_decode())
+            out.extend(self._remap(self._collect_decode()))
+            moved += self._reclaim_failed()
             if (
                 not queue
                 and not self._staged
@@ -371,6 +390,16 @@ class DisaggRouter:
                 return out
             if admitted or stepped or collected or moved:
                 stall = 0
+            elif self._remote_waiting():
+                # Streams are in flight on a LIVE transport link: waiting
+                # is legitimate and wall-bounded — either the peer answers
+                # or its heartbeat liveness window expires and the link's
+                # death reclaims every stream.  Pace the spin so the
+                # window passes in real time instead of burning the
+                # tick-based stall bound in microseconds; max_steps still
+                # bounds a peer that answers heartbeats but withholds
+                # progress forever.
+                time.sleep(0.002)
             else:
                 stall += 1
                 if stall >= 200:
@@ -407,24 +436,36 @@ class DisaggRouter:
         the stream has left that pool and will complete from the decode
         side."""
         n = 0
-        for rep in self.prefill.replicas:
+        pool_take = getattr(self.prefill, "take_handoffs", None)
+        if callable(pool_take):
+            # Pool-level drain: a RemotePool aggregates its worker's
+            # replica handoffs into one queue (the replicas themselves
+            # live in another process).
+            for entry in pool_take():
+                self._stage_handoff(
+                    entry, getattr(self.prefill, "name", "remote")
+                )
+                n += 1
+        for rep in getattr(self.prefill, "replicas", ()):
             take = getattr(rep.engine, "take_handoffs", None)
             if not callable(take):
                 continue
             for entry in take():
-                rid = int(entry["request_id"])
-                self.prefill._owner.pop(rid, None)
-                now = self.clock()
-                t0 = self._t0.pop(rid, now)
-                _M_TTFT_BREAKDOWN.observe(max(0.0, now - t0), stage="prefill")
-                EngineTelemetry.annotate_trace_doc(
-                    entry.get("trace"), "handoff_begin", now,
-                    source=rep.name,
-                )
-                self._staged.append({"entry": entry, "staged_at": now})
-                self.handoffs += 1
+                self._stage_handoff(entry, rep.name)
                 n += 1
         return n
+
+    def _stage_handoff(self, entry: dict, source: str) -> None:
+        rid = int(entry["request_id"])
+        self.prefill._owner.pop(rid, None)
+        now = self.clock()
+        t0 = self._t0.pop(rid, now)
+        _M_TTFT_BREAKDOWN.observe(max(0.0, now - t0), stage="prefill")
+        EngineTelemetry.annotate_trace_doc(
+            entry.get("trace"), "handoff_begin", now, source=source,
+        )
+        self._staged.append({"entry": entry, "staged_at": now})
+        self.handoffs += 1
 
     def _drive_channel(self) -> int:
         """Move staged KV payloads through the channel.  Two passes: begin
@@ -435,6 +476,21 @@ class DisaggRouter:
         begun: list[tuple[dict, Transfer]] = []
         waiting: list[dict] = []
         moved = 0
+        self.channel.tick()  # heartbeats / liveness / paced reconnect
+        if self.channel.down and self._staged:
+            # Whole transport down: every staged payload lands on the
+            # fallback rung NOW (KV-less delivery, decode re-prefills) —
+            # staged KV must not ripen past its deadline waiting for a
+            # reconnect that may never come.
+            for item in self._staged:
+                entry = item["entry"]
+                if entry.get("kv") is not None:
+                    self._fallback(entry, "transport_down")
+                else:
+                    self._deliver(entry, transfer_s=0.0)
+                moved += 1
+            self._staged = []
+            return moved
         for item in self._staged:
             entry = item["entry"]
             kv = entry.get("kv")
@@ -458,7 +514,7 @@ class DisaggRouter:
             begun.append((item, t))
         for item, t in begun:
             entry = item["entry"]
-            outcome = self.channel.complete(t, entry["kv"])
+            outcome = self.channel.complete(t, entry["kv"], entry=entry)
             if outcome == OK:
                 _M_TTFT_BREAKDOWN.observe(t.latency_s, stage="transfer")
                 EngineTelemetry.annotate_trace_doc(
@@ -492,18 +548,116 @@ class DisaggRouter:
     def _deliver(self, entry: dict, transfer_s: float) -> None:
         """Hand one entry to the decode pool.  ``place()`` merge-restores
         onto a healthy replica or parks at that router — either way the
-        stream is owned downstream from here."""
+        stream is owned downstream from here.  A decode pool whose
+        transport is down collapses the stream to unified serving
+        instead (the last rung — never a lost request)."""
         rid = int(entry["request_id"])
         now = self.clock()
         self._awaiting[rid] = now
-        placed = self.decode.place([entry], correlation=f"handoff-req-{rid}")
+        try:
+            placed = self.decode.place(
+                [entry], correlation=f"handoff-req-{rid}"
+            )
+        except OSError as exc:  # transport.TransportDownError
+            if type(exc).__name__ != "TransportDownError":
+                raise
+            self._awaiting.pop(rid, None)
+            self._unified_collapse(entry, "transport_down")
+            return
         if rid in placed:
             self._observe_decode_stage(rid, now)
+
+    def _remote_waiting(self) -> bool:
+        """True when some pool has streams outstanding behind a transport
+        link that is still ALIVE — remote work the pump must wait out in
+        wall time (bounded by the link's liveness window), not a logical
+        wedge."""
+        for pool in (self.prefill, self.decode):
+            link = getattr(pool, "link", None)
+            if link is not None and not link.dead and not pool.idle():
+                return True
+        return False
+
+    def _local_pool(self):
+        """The first pool whose engines live in THIS process (no transport
+        link) — where unified collapse serves streams when a worker pool
+        is unreachable."""
+        for pool in (self.decode, self.prefill):
+            if not hasattr(pool, "link"):
+                return pool
+        return None
+
+    def _unified_collapse(self, entry: dict, reason: str) -> None:
+        """The last rung of the degradation ladder: the stream's target
+        pool is unreachable, so it serves on whatever pool is local —
+        disaggregation collapses to unified serving for this stream,
+        loudly journaled.  With NO local pool the entry re-parks in the
+        staging area and retries after reconnect (the pump stall bound
+        keeps a permanently-dead transport from spinning silently)."""
+        entry.pop("kv", None)
+        entry.pop("_placed_remote", None)
+        rid = int(entry["request_id"])
+        self.fallbacks += 1
+        _M_FALLBACK.inc(reason="unified_collapse")
+        JOURNAL.record(
+            "disagg", "handoff.unified_collapse",
+            correlation=f"req-{rid}", reason=reason,
+        )
+        pool = self._local_pool()
+        if pool is None:
+            self._staged.append({"entry": entry, "staged_at": self.clock()})
+            return
+        if entry.get("_resubmit"):
+            # Submit-time retention (the sampler key died with the worker):
+            # re-run the original request locally and alias the new rid
+            # back to the one the caller holds.
+            try:
+                new_rid = pool.submit(
+                    entry["prompt"], entry["max_tokens"],
+                    **entry.get("kwargs", {}),
+                )
+            except RuntimeError:  # local pool momentarily full: retry
+                self._staged.append({"entry": entry, "staged_at": self.clock()})
+                return
+            self._rid_alias[new_rid] = rid
+            return
+        if pool is self.decode:
+            self._awaiting[rid] = self.clock()
+        pool.place([entry], correlation=f"handoff-req-{rid}")
+
+    def _reclaim_failed(self) -> int:
+        """Drain streams whose worker pool died (transport.RemotePool
+        retains every shipped entry KV-less until its completion lands)
+        and re-serve each locally — the zero-loss half of crash
+        tolerance; the dead peer's rids are already marked reclaimed so
+        its late completions cannot double-deliver."""
+        n = 0
+        for pool in (self.prefill, self.decode):
+            take = getattr(pool, "take_failed", None)
+            if not callable(take):
+                continue
+            for entry in take():
+                self._unified_collapse(entry, "peer_died")
+                n += 1
+        return n
 
     def _observe_decode_stage(self, rid: int, now: float) -> None:
         t = self._awaiting.pop(rid, None)
         if t is not None:
             _M_TTFT_BREAKDOWN.observe(max(0.0, now - t), stage="decode")
+
+    def _remap(self, comps: list) -> list:
+        """Restore caller-visible rids on completions of crash-resubmitted
+        streams (``_unified_collapse`` re-ran them under fresh local rids)."""
+        if not self._rid_alias:
+            return comps
+        out = []
+        for c in comps:
+            alias = self._rid_alias.pop(c.request_id, None)
+            if alias is not None:
+                c = replace(c, request_id=alias)
+            out.append(c)
+        return out
 
     def _collect_decode(self) -> list:
         """Decode-pool completions, plus decode-stage latency for entries
@@ -539,11 +693,12 @@ class DisaggRouter:
         one surface; completions buffer for :meth:`completions`."""
         self._tick += 1
         stepped = self.prefill.tick()
-        self._completions.extend(self.prefill.completions())
+        self._completions.extend(self._remap(self.prefill.completions()))
         self._collect_handoffs()
         self._drive_channel()
         stepped += self.decode.tick()
-        self._completions.extend(self._collect_decode())
+        self._completions.extend(self._remap(self._collect_decode()))
+        self._reclaim_failed()
         return stepped
 
     def completions(self) -> list:

@@ -29,6 +29,12 @@ Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
   disagg.DisaggRouter`'s view: prefill/decode pool membership (full
   fleet stats per pool), staged handoffs, in-flight transfers and the
   channel's claim/budget/outcome tally (JSON).
+* ``/debug/transport`` — every live :class:`~k8s_dra_driver_tpu.models.
+  transport.TransportChannel`'s view: the link's breaker state and
+  cooldown, liveness (pong age, RTT), reconnect count, reclaimed-stream
+  count and the channel's claim/budget/outcome tally — plus every live
+  :class:`~k8s_dra_driver_tpu.models.transport.RemotePool`'s pending/
+  resident/failed stream counts (JSON).
 * ``/debug/autoscale`` — every live :class:`~k8s_dra_driver_tpu.models.
   autoscaler.FleetAutoscaler`'s view: policy thresholds, vote streaks,
   pending spawns, SLO attainment window and the latest decision doc
@@ -132,6 +138,18 @@ class DiagnosticsServer:
 
                     body = json.dumps(
                         debug_disagg_doc(), indent=1, default=str
+                    ).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/transport":
+                    # Lazy for the same reason as /debug/disagg; the
+                    # transport's engine imports live behind worker_main,
+                    # so this stays control-plane safe.
+                    from k8s_dra_driver_tpu.models.transport import (
+                        debug_transport_doc,
+                    )
+
+                    body = json.dumps(
+                        debug_transport_doc(), indent=1, default=str
                     ).encode()
                     ctype = "application/json"
                 elif url.path == "/debug/autoscale":

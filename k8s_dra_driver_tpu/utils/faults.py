@@ -134,6 +134,16 @@ class FaultProfile:
     handoff_drop_rate: float = 0.0  # probability a transfer is dropped in flight
     handoff_latency_s: float = 0.0  # simulated seconds added per transfer
     handoff_corrupt_rate: float = 0.0  # probability payload bytes arrive corrupted
+    # socket-scoped (models/transport.py) kinds: consulted at the
+    # transport's send/recv seams, so the in-process chaos suite covers
+    # truncated frames, peer resets, slow links and silent hangs without
+    # real sockets.  Latency is ACCOUNTED into the transfer's deadline
+    # arithmetic (never slept); ``peer_hang`` is a storm budget — the next
+    # N receiver polls process nothing, so heartbeats go unanswered.
+    sock_truncate_rate: float = 0.0  # probability a sent frame is cut mid-body
+    sock_reset_rate: float = 0.0  # probability the peer resets mid-transfer
+    sock_latency_s: float = 0.0  # simulated seconds added per frame
+    peer_hang: int = 0  # next N receiver polls stall silently
     limit: int = 0  # total-injection cap, 0 = unlimited
     injected: int = field(default=0, compare=False)
 
@@ -373,6 +383,61 @@ class FaultInjector:
                 return True
         return False
 
+    # -- socket decision points (models/transport.py wire seams) -----------
+
+    def take_sock_truncate(self, peer: str) -> bool:
+        """Transport send seam: should this frame be cut mid-body?  The
+        sender writes a prefix of the frame and the connection dies — the
+        receiver must surface a typed decode failure, never install a
+        partial payload, and never hang waiting for the rest."""
+        for p in self._matching_engine(None, None):
+            if p.sock_truncate_rate and self._roll(
+                p, p.sock_truncate_rate, "sock_truncate",
+                f"peer-{peer}", "transport",
+            ):
+                return True
+        return False
+
+    def take_sock_reset(self, peer: str) -> bool:
+        """Transport send seam: should the peer connection reset
+        (ECONNRESET-shaped) before this frame lands?  Nothing of the frame
+        arrives; the sender must attribute the failure to the in-flight
+        rid and unwind its in-flight-bytes reservation."""
+        for p in self._matching_engine(None, None):
+            if p.sock_reset_rate and self._roll(
+                p, p.sock_reset_rate, "sock_reset",
+                f"peer-{peer}", "transport",
+            ):
+                return True
+        return False
+
+    def take_sock_latency(self) -> float:
+        """Transport seam: simulated seconds this frame spends on the
+        wire.  Accounted into the transfer deadline ladder like
+        :meth:`take_handoff_latency` — never slept."""
+        total = 0.0
+        for p in self._matching_engine(None, None):
+            if p.sock_latency_s > 0:
+                with self._lock:
+                    if not self._budget_ok(p):
+                        continue
+                    self._record(p, "sock_latency", "FRAME", "transport")
+                total += p.sock_latency_s
+        return total
+
+    def take_peer_hang(self) -> bool:
+        """Transport recv seam: should the receiver stall silently this
+        poll (frames buffered but not processed, heartbeats unanswered)?
+        Storm-budgeted like ``watch_hangs`` — liveness detection, not the
+        data path, is what must catch it."""
+        for p in self._matching_engine(None, None):
+            with self._lock:
+                if p.peer_hang > 0 and self._budget_ok(p):
+                    p.peer_hang -= 1
+                    self._record(p, "peer_hang", "POLL", "transport")
+                    return True
+        return False
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict[str, int]:
@@ -480,16 +545,23 @@ class FaultInjector:
                 fields["spawn_fail_rate"] = float(value)
             elif key == "spawn_latency_ms":
                 fields["spawn_latency_s"] = float(value) / 1000.0
+            elif key == "sock_latency_ms":
+                fields["sock_latency_s"] = float(value) / 1000.0
+            elif key == "sock_truncate":
+                fields["sock_truncate_rate"] = float(value)
+            elif key == "sock_reset":
+                fields["sock_reset_rate"] = float(value)
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
                          "watch_hang_s", "nan_logits_rate", "step_raise_rate",
                          "step_latency_s", "replica_crash_rate",
                          "replica_wedge_rate", "stats_stale_rate",
                          "handoff_drop_rate", "handoff_latency_s",
                          "handoff_corrupt_rate", "spawn_fail_rate",
-                         "spawn_latency_s"):
+                         "spawn_latency_s", "sock_truncate_rate",
+                         "sock_reset_rate", "sock_latency_s"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
-                         "watch_hangs", "limit"):
+                         "watch_hangs", "peer_hang", "limit"):
                 fields[key] = int(value)
             elif key == "verbs":
                 fields["verbs"] = tuple(value.split("+"))

@@ -236,6 +236,19 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._transition(self.OPEN)
 
+    def cooldown_remaining(self) -> float:
+        """Seconds until an OPEN breaker will admit its half-open probe
+        (0.0 when closed or already probe-eligible).  The transport's
+        reconnect scheduler reads this instead of poking ``allow()`` —
+        ``allow()`` is a state transition (it STARTS the probe), while a
+        status page or a pacing decision only wants to look."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(
+                0.0, self.reset_timeout_s - (self._clock() - self._opened_at)
+            )
+
     def trip(self) -> None:
         """Force the breaker open immediately, bypassing the consecutive-
         failure count.  For callers with DIRECT evidence the endpoint is

@@ -1,14 +1,25 @@
-"""Shared harness for REAL two-process jax.distributed tests.
+"""Shared harness for REAL multi-process tests.
 
-One implementation of the fake-cluster → slice-test1 → CDI-env →
-subprocess-worker flow (coordinator re-pointing, CPU forcing, orphan
-cleanup), used by tests/test_multiprocess.py (training collective) and
-tests/test_multiprocess_serve.py (DP-sharded serving)."""
+One implementation of the supervised-subprocess flow used by
+tests/test_multiprocess.py (training collective), tests/
+test_multiprocess_serve.py (DP-sharded serving) and tests/
+test_transport_chaos.py (KV transport workers): spawn children, POLL
+them all, and fail fast with evidence when any child dies early.
+
+The failure mode this exists to kill: worker A crashes on startup while
+worker B blocks inside ``jax.distributed.initialize`` (or a transport
+dial loop) for its FULL init timeout — the test then reports a timeout
+on B instead of A's actual traceback.  :func:`supervise` watches every
+child concurrently; the first non-zero exit (or the deadline) reaps the
+siblings and raises with the dead worker's stderr tail AND a watchdog
+diag bundle (thread stacks, journal tail, metrics) for the supervisor
+side."""
 
 import json
 import socket
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -21,13 +32,131 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+class SupervisedWorker:
+    """One child process under supervision.
+
+    Holds the Popen plus the collected stdout/stderr once the child is
+    reaped — :func:`supervise` owns the lifecycle; tests only read
+    ``out`` / ``err`` / ``returncode`` afterwards."""
+
+    def __init__(self, name: str, argv: list, env: dict):
+        self.name = name
+        self.proc = subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        self.out = ""
+        self.err = ""
+        self.collected = False
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    @property
+    def returncode(self):
+        return self.proc.returncode
+
+    def poll(self):
+        return self.proc.poll()
+
+    def collect(self, timeout: float = 10.0) -> None:
+        """Reap the child's pipes (idempotent)."""
+        if self.collected:
+            return
+        try:
+            self.out, self.err = self.proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.out, self.err = self.proc.communicate()
+        self.collected = True
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.collect()
+
+    def last_json(self) -> dict:
+        """The worker-result convention: parse the last stdout line."""
+        return json.loads(self.out.strip().splitlines()[-1])
+
+    def stderr_tail(self, n: int = 3000) -> str:
+        return self.err[-n:]
+
+
+def _fail(workers, culprit: SupervisedWorker, why: str, bundle_dir) -> None:
+    """Reap every sibling, dump a diag bundle, raise with the evidence."""
+    from k8s_dra_driver_tpu.utils.watchdog import dump_diag_bundle
+
+    for w in workers:
+        w.kill()
+    bundle = dump_diag_bundle(
+        str(bundle_dir), reason=f"mp-harness: {why}",
+        correlation=f"worker-{culprit.name}",
+        extra={
+            "workers": {
+                w.name: {
+                    "pid": w.pid,
+                    "returncode": w.returncode,
+                    "stderr_tail": w.stderr_tail(),
+                }
+                for w in workers
+            },
+        },
+    )
+    raise AssertionError(
+        f"{why}\n"
+        f"--- worker {culprit.name!r} (pid {culprit.pid}, "
+        f"rc={culprit.returncode}) stderr tail ---\n"
+        f"{culprit.stderr_tail()}\n"
+        f"--- diag bundle: {bundle} ---"
+    )
+
+
+def supervise(workers: list, timeout: float, bundle_dir="/tmp") -> None:
+    """Watch every worker until ALL exit 0.
+
+    The first worker to die non-zero fails the run immediately — its
+    siblings are killed rather than left to block out their own timeouts
+    — and the raised AssertionError carries the dead worker's stderr
+    tail plus a supervisor-side diag bundle path.  The deadline is
+    enforced the same way, attributing the failure to the slowest
+    still-running worker."""
+    deadline = time.monotonic() + timeout
+    alive = list(workers)
+    while alive:
+        for w in list(alive):
+            rc = w.poll()
+            if rc is None:
+                continue
+            w.collect()
+            alive.remove(w)
+            if rc != 0:
+                _fail(
+                    workers, w,
+                    f"worker {w.name!r} exited rc={rc} with "
+                    f"{len(alive)} sibling(s) still running",
+                    bundle_dir,
+                )
+        if alive and time.monotonic() > deadline:
+            _fail(
+                workers, alive[0],
+                f"worker {alive[0].name!r} still running at the "
+                f"{timeout}s harness deadline",
+                bundle_dir,
+            )
+        if alive:
+            time.sleep(0.05)
+
+
 def run_two_process_workers(cluster, tmp_path, worker_src: str,
                             n_devices: int = 2, timeout: int = 300):
     """Apply slice-test1 scaled to 2 hosts, hand each pod's CDI env to a
     separate python process running ``worker_src``, and return the parsed
-    last-line JSON of each worker.  A failing worker never orphans its
-    sibling (the survivor would block in jax.distributed.initialize for
-    its full init timeout)."""
+    last-line JSON of each worker.  Supervision is poll-based
+    (:func:`supervise`): a worker failing EARLY fails the test with its
+    own stderr, instead of its sibling blocking in
+    ``jax.distributed.initialize`` for the full init timeout."""
     from k8s_dra_driver_tpu.e2e.dryrun import force_cpu_env
     from k8s_dra_driver_tpu.e2e.spec_runner import apply_spec
 
@@ -40,30 +169,20 @@ def run_two_process_workers(cluster, tmp_path, worker_src: str,
     assert len(pods) == 2
 
     port = free_port()
-    children = []
-    for pod in pods:
+    workers = []
+    for idx, pod in enumerate(pods):
         env = dict(pod.env)
         # the seat wired tpu-host-0:8476; re-point at this test's real TCP
         # port on localhost (the cluster DNS name cannot resolve here)
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         force_cpu_env(env, n_devices=n_devices)
         env["PYTHONPATH"] = str(REPO_ROOT)
-        children.append(
-            subprocess.Popen(
-                [sys.executable, "-c", worker_src],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    outs = []
+        workers.append(SupervisedWorker(
+            f"host-{idx}", [sys.executable, "-c", worker_src], env,
+        ))
     try:
-        for child in children:
-            out, err = child.communicate(timeout=timeout)
-            assert child.returncode == 0, f"worker failed:\n{err[-3000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
+        supervise(workers, timeout, bundle_dir=tmp_path)
     finally:
-        for c in children:
-            if c.poll() is None:
-                c.kill()
-                c.wait()
-    return outs
+        for w in workers:
+            w.kill()
+    return [w.last_json() for w in workers]

@@ -34,7 +34,11 @@ from k8s_dra_driver_tpu.models.disagg import (
     HandoffChannel,
     debug_disagg_doc,
 )
-from k8s_dra_driver_tpu.models.serve import KVSlice, ServeEngine
+from k8s_dra_driver_tpu.models.serve import (
+    KVSlice,
+    ServeEngine,
+    WireFormatError,
+)
 from k8s_dra_driver_tpu.plugin.deviceinfo import (
     DEVICE_TYPE_CHANNEL,
     AllocatableDevice,
@@ -292,6 +296,72 @@ class TestHandoffChannel:
         kv = _kv()
         t = ch.begin(4, kv.nbytes, kv.checksum() ^ 0xDEAD)
         assert ch.complete(t, kv) == "corrupt"
+
+
+def _assert_wire_roundtrip(kv: KVSlice, rid: int) -> bytes:
+    wire = kv.to_wire(rid)
+    got_rid, got = KVSlice.from_wire(wire)
+    assert got_rid == rid
+    assert np.array_equal(np.asarray(got.k), np.asarray(kv.k))
+    assert np.array_equal(np.asarray(got.v), np.asarray(kv.v))
+    assert (got.valid_len, got.n_layers, got.kv_heads, got.head_dim) == (
+        kv.valid_len, kv.n_layers, kv.kv_heads, kv.head_dim
+    )
+    assert got.dtype == kv.dtype
+    assert got.checksum() == kv.checksum()
+    return wire
+
+
+class TestWireFormat:
+    """Property tests for the KVSlice wire codec (models/transport.py
+    ships these bytes between processes): decode(encode(kv)) is identity,
+    and EVERY truncation point and EVERY single-byte flip is a typed
+    ``WireFormatError`` — never a partially-installed payload, never an
+    untyped struct/index error."""
+
+    def test_roundtrip_identity_real_captures_both_kinds(self, params):
+        (p,) = _prompts(1, rng=23, lo=9, hi=10)
+        for i, make in enumerate((_dense, _paged)):
+            eng = make(params)
+            eng.submit(p, max_tokens=5, handoff=True)
+            eng.run_until_drained()
+            (entry,) = eng.take_handoffs()
+            _assert_wire_roundtrip(entry["kv"], rid=1000 + i)
+
+    def test_truncation_at_every_byte_is_typed_never_partial(self):
+        wire = _assert_wire_roundtrip(_kv(), rid=7)
+        for cut in range(len(wire)):
+            with pytest.raises(WireFormatError):
+                KVSlice.from_wire(wire[:cut])
+
+    def test_single_byte_flips_at_every_offset_are_typed(self):
+        kv = _kv()
+        wire = bytearray(kv.to_wire(9))
+        for off in range(len(wire)):
+            for flip in (0x01, 0x80):
+                mutated = bytes(
+                    wire[:off] + bytes([wire[off] ^ flip]) + wire[off + 1:]
+                )
+                try:
+                    got_rid, got = KVSlice.from_wire(mutated)
+                except WireFormatError:
+                    continue
+                pytest.fail(
+                    f"flip 0x{flip:02x} at offset {off} decoded "
+                    f"silently (rid={got_rid})"
+                )
+
+    def test_error_carries_request_id_once_header_is_readable(self):
+        kv = _kv()
+        wire = bytearray(kv.to_wire(42))
+        wire[-5] ^= 0x10  # corrupt the last payload byte, header intact
+        with pytest.raises(WireFormatError) as exc:
+            KVSlice.from_wire(bytes(wire))
+        assert exc.value.request_id == 42
+        # truncated before the header completes: rid unknowable, -1
+        with pytest.raises(WireFormatError) as exc:
+            KVSlice.from_wire(bytes(wire[:6]))
+        assert exc.value.request_id == -1
 
 
 class TestChannelClaim:

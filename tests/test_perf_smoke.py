@@ -72,6 +72,18 @@ def test_handoff_overhead_stays_within_perf_budgets():
     assert stats["transfers_ok"] == stats["requests_disagg"]
 
 
+def test_transport_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_transport_overhead()
+    assert stats["requests_wired"] == 8
+    # The transport's contract: the real wire is host work (numpy + crc32
+    # over already-captured bytes, frame/deque bookkeeping) — a loopback
+    # TransportChannel pays EXACTLY the in-process channel's host syncs,
+    # and every payload physically crosses and decodes at the far end.
+    assert stats["host_syncs_wired"] == stats["host_syncs_inproc"]
+    assert stats["transfers_ok"] == stats["requests_wired"]
+    assert stats["frames_decoded"] == stats["requests_wired"]
+
+
 def test_autoscaler_overhead_stays_within_perf_budgets():
     stats = perf_smoke.check_autoscaler_overhead()
     assert stats["requests_scaled"] == 8

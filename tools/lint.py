@@ -29,15 +29,16 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
                      models/paged.py (the two engines, where the batched
                      readback lives) are exempt
   metric-docs        cross-file: every `tpu_serve_*` / `tpu_fleet_*` /
-                     `tpu_disagg_*` metric declared in
+                     `tpu_disagg_*` / `tpu_transport_*` metric declared in
                      models/ must carry non-empty help text at some
                      declaring site AND appear in ARCHITECTURE.md's
                      metric inventory — the serving metrics are the
                      fleet load-signal contract, and an undocumented
                      signal is one routers can't rely on
   metric-labels      cross-file: label keys at `tpu_serve_*` /
-                     `tpu_fleet_*` / `tpu_disagg_*` / `dra_*` metric
-                     call sites must come from the closed vocabulary
+                     `tpu_fleet_*` / `tpu_disagg_*` / `tpu_transport_*` /
+                     `dra_*` metric call sites must come from the closed
+                     vocabulary
                      (METRIC_LABEL_KEYS), and label values must not be
                      f-strings / str.format — request-unique label
                      values are unbounded cardinality, the classic
@@ -364,7 +365,7 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
                 and isinstance(node.args[0].value, str)
                 and node.args[0].value.startswith(
                     ("tpu_serve_", "tpu_fleet_", "tpu_disagg_",
-                     "tpu_autoscale_")
+                     "tpu_autoscale_", "tpu_transport_")
                 )
             ):
                 continue
@@ -412,7 +413,8 @@ METRIC_LABEL_KEYS = frozenset({
     "direction",
 })
 METRIC_LABEL_PREFIXES = (
-    "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_", "dra_",
+    "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",
+    "tpu_transport_", "dra_",
 )
 _METRIC_CALL_ATTRS = {"inc", "observe", "set"}
 # First positionals of Counter.inc/Histogram.observe/Gauge.set when passed by

@@ -540,6 +540,103 @@ def check_handoff_overhead() -> dict:
     return stats
 
 
+# The real wire may not cost device work: KVSlice.to_wire/from_wire run
+# on the already-captured host bytes (numpy + crc32), and the frame
+# exchange is socket/deque bookkeeping.  A loopback TransportChannel
+# therefore pays EXACTLY the in-process channel's host syncs — any extra
+# sync means the transport added a device->host readback per transfer.
+TRANSPORT_OVERHEAD_FRAC = 0.50
+TRANSPORT_OVERHEAD_FLOOR_S = 0.25
+
+
+def check_transport_overhead() -> dict:
+    """Budget guard for the KV transport (PR 13 tentpole): a DisaggRouter
+    whose channel physically wire-encodes every payload, ships it across
+    a loopback conn, and waits for the receiver's decode ACK must
+    dispatch exactly the device work of the same router on the
+    in-process channel, and the codec/framing host work stays inside a
+    wall-clock envelope."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, disagg, serve, transport
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(8)
+    ]
+
+    def engine():
+        return serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=4, prompt_bucket=16, sync_interval=8
+        )
+
+    reqs = [{"prompt": p, "max_tokens": 16} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    pre_i, dec_i = engine(), engine()
+    inproc = disagg.DisaggRouter(prefill=[pre_i], decode=[dec_i])
+    start = time.perf_counter()
+    done_inproc = inproc.pump([dict(r) for r in reqs])
+    inproc_wall = time.perf_counter() - start
+
+    a, b = transport.LoopbackConn.pair()
+    receiver = transport.WireReceiver(b)
+    link = transport.PeerLink("overhead-peer", a)
+    channel = transport.TransportChannel(link, peer_pump=receiver.pump)
+    pre_w, dec_w = engine(), engine()
+    wired = disagg.DisaggRouter(
+        prefill=[pre_w], decode=[dec_w], channel=channel
+    )
+    start = time.perf_counter()
+    done_wired = wired.pump([dict(r) for r in reqs])
+    wired_wall = time.perf_counter() - start
+
+    inproc_syncs = pre_i.host_syncs + dec_i.host_syncs
+    wired_syncs = pre_w.host_syncs + dec_w.host_syncs
+    budget = inproc_wall * (1 + TRANSPORT_OVERHEAD_FRAC) + TRANSPORT_OVERHEAD_FLOOR_S
+    stats = {
+        "requests_inproc": len(done_inproc),
+        "requests_wired": len(done_wired),
+        "host_syncs_inproc": inproc_syncs,
+        "host_syncs_wired": wired_syncs,
+        "transfers_ok": channel.counts.get(disagg.OK, 0),
+        "frames_decoded": len(receiver.delivered),
+        "inproc_s": round(inproc_wall, 3),
+        "wired_s": round(wired_wall, 3),
+        "budget_frac": TRANSPORT_OVERHEAD_FRAC,
+        "floor_s": TRANSPORT_OVERHEAD_FLOOR_S,
+    }
+    if len(done_wired) != len(reqs) or len(done_inproc) != len(reqs):
+        raise PerfBudgetError(
+            f"transport overhead run drained {len(done_wired)}/{len(reqs)} "
+            f"wired vs {len(done_inproc)} in-process"
+        )
+    if wired.fallbacks or channel.counts.get(disagg.OK, 0) != len(reqs):
+        raise PerfBudgetError(
+            f"transport overhead run fell back {wired.fallbacks} times with "
+            f"{channel.counts} on a fault-free loopback — every transfer "
+            f"must cross the wire and ACK ok"
+        )
+    if wired_syncs != inproc_syncs:
+        raise PerfBudgetError(
+            f"the wire added device work: {wired_syncs} host syncs through "
+            f"the loopback transport vs {inproc_syncs} in-process — the "
+            f"codec must run on already-captured host bytes"
+        )
+    if wired_wall > budget:
+        raise PerfBudgetError(
+            f"wired pump took {wired_wall:.3f}s > {budget:.3f}s "
+            f"({inproc_wall:.3f}s in-process + {TRANSPORT_OVERHEAD_FRAC:.0%} "
+            f"+ {TRANSPORT_OVERHEAD_FLOOR_S}s floor): framing/codec is no "
+            f"longer cheap host work"
+        )
+    return stats
+
+
 # The autoscaler is a control law over stats() snapshots the router
 # already collects: a 1-replica fleet under a no-op autoscaler (min ==
 # max == 1, so no scaling action is ever legal) pays EXACTLY the bare
@@ -656,6 +753,7 @@ def main() -> int:
         stats["telemetry_overhead"] = check_telemetry_overhead()
         stats["router_overhead"] = check_router_overhead()
         stats["handoff_overhead"] = check_handoff_overhead()
+        stats["transport_overhead"] = check_transport_overhead()
         stats["autoscaler_overhead"] = check_autoscaler_overhead()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
