@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport demo dryrun lint analyze perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -70,6 +70,16 @@ chaos-autoscale:
 # reconnect, in-flight bytes drained to zero.
 chaos-transport:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport_chaos.py -q
+
+# Rebalance chaos suite (<20s, CPU, seeded): multi-link channel sets
+# under channel_down/channel_degrade faults (mid-transfer failover to a
+# sibling link, bit-equal, zero re-prefill), KV-demand admission
+# backpressure (starved handoffs park then complete; impossible streams
+# fire the deadlock detector and collapse unified), and scale_move pool
+# rebalancing under replica crashes — zero lost or duplicated streams,
+# balanced block accounting.
+chaos-rebalance:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_rebalance_chaos.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

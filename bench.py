@@ -1346,7 +1346,14 @@ def _speculative_throughput(
     }
 
 
-def _wait_for_backend(max_wait_s: float) -> dict:
+# Process-wide backend-probe verdict cache: a 120s retry schedule run once
+# per PROCESS, not once per scenario that wonders about the backend — the
+# second caller gets the cached verdict instantly.  Only real verdicts
+# cache (attempts > 0); a disabled wait (max_wait_s=0) never does.
+_BACKEND_PROBE: "dict | None" = None
+
+
+def _wait_for_backend(max_wait_s: float, refresh: bool = False) -> dict:
     """Bounded retry-with-backoff for the device link (VERDICT r4 weak #1:
     one tunnel outage must not void a round's data plane).  Returns probe
     metadata for the artifact; the caller decides how hard to try the real
@@ -1356,12 +1363,18 @@ def _wait_for_backend(max_wait_s: float) -> dict:
     forever rather than raising.  Each probe's own timeout is clamped to
     the remaining budget so the wall-clock spend never exceeds
     ``max_wait_s`` by more than scheduler noise; ``max_wait_s=0`` disables
-    the wait entirely (attempts=0)."""
-    from tools.tunnel_probe import probe
+    the wait entirely (attempts=0).  The verdict caches process-wide
+    (``refresh=True`` forces a fresh schedule); failed verdicts carry the
+    probe's own error detail in ``last_error``."""
+    global _BACKEND_PROBE
+    if _BACKEND_PROBE is not None and not refresh:
+        return dict(_BACKEND_PROBE)
+    import tools.tunnel_probe as tp
 
     delays = [0, 30, 60, 120, 240] + [300] * 64
     waited = 0.0
     attempt = 0
+    ok = False
     for delay in delays:
         if delay:
             sleep_for = min(delay, max_wait_s - waited)
@@ -1377,15 +1390,18 @@ def _wait_for_backend(max_wait_s: float) -> dict:
             break
         attempt += 1
         t0 = time.perf_counter()
-        ok = probe(
+        ok = tp.probe(
             timeout_s=min(90.0, max(max_wait_s - waited, 5.0)), quiet=True
         )
         waited += time.perf_counter() - t0
-        if ok:
-            return {"ok": True, "attempts": attempt, "waited_s": round(waited, 1)}
-        if waited >= max_wait_s:
+        if ok or waited >= max_wait_s:
             break
-    return {"ok": False, "attempts": attempt, "waited_s": round(waited, 1)}
+    out = {"ok": ok, "attempts": attempt, "waited_s": round(waited, 1)}
+    if not ok and attempt > 0:
+        out["last_error"] = getattr(tp, "LAST_ERROR", "")
+    if attempt > 0:
+        _BACKEND_PROBE = dict(out)
+    return out
 
 
 def _run_data_plane_guarded(timeout_s: float = 600.0, degraded: bool = False) -> dict:
@@ -1494,6 +1510,9 @@ def main() -> int:
     )
     if degraded:
         data["degraded"] = True
+        # Say WHY the body degraded — the cached probe verdict carries the
+        # subprocess's own failure detail (rc + stderr tail, or timeout).
+        data["degraded_reason"] = probe.get("last_error", "")
     data["backend_probe"] = probe
     print(
         f"# control-plane: {len(samples)} cycles, p50={p50:.2f}ms "

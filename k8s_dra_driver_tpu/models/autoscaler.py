@@ -73,6 +73,7 @@ _M_ATTAIN = REGISTRY.gauge(
 
 UP = "up"
 DOWN = "down"
+MOVE = "move"
 
 
 @dataclass(frozen=True)
@@ -379,6 +380,43 @@ class FleetAutoscaler:
                 replica=victim.name, moved=len(moved),
             )
 
+    def scale_move(self, dst: FleetRouter, reason: str = "rebalance"):
+        """Zero-loss pool rebalancing: drain the least-loaded replica out
+        of THIS autoscaler's pool (live migration — resident streams
+        restore onto its siblings or park), detach it, and merge-restore
+        its engine into ``dst`` under one ``scale-<seq>-<n>`` correlation
+        spanning begin → drain → resumed.  ``add_replica``'s fresh id
+        stride plus the restore-side ``max(next_id, ...)`` clamp keep
+        request ids monotonic across the move, so a replica can bounce
+        between pools without ever reissuing an id.  Returns the
+        correlation id, or None when no replica can leave (pool at
+        ``min_replicas`` or nothing admittable)."""
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        corr = self._mint_corr()
+        now = self.clock()
+        JOURNAL.record(
+            "autoscale", "scale_move.begin", correlation=corr,
+            replica=victim.name, reason=reason, resident=victim.resident(),
+        )
+        victim.evac_corr = corr
+        self.router.drain(victim.name, reason="scale_move")
+        engine = self.router.remove_replica(victim.name)
+        name = victim.name
+        if any(r.name == name for r in dst.replicas):
+            name = f"{name}-m{self._scale_seq}"
+        rep = dst.add_replica(engine, name=name)
+        placed = dst._replay_parked()
+        self._last_action_t = now
+        self.actions += 1
+        _M_EVENTS.inc(direction=MOVE, reason=reason)
+        JOURNAL.record(
+            "autoscale", "scale_move.resumed", correlation=corr,
+            replica=rep.name, parked_placed=placed,
+        )
+        return corr
+
     def _pick_victim(self):
         """Least-loaded ADMITTABLE replica.  SUSPECT/EVACUATING/DRAINED
         replicas are never picked — they are already leaving or being
@@ -423,6 +461,113 @@ class FleetAutoscaler:
                     if self._offered else None
                 ),
             },
+            "last_decision": dict(self.last_decision),
+        }
+
+
+@dataclass(frozen=True)
+class RebalancePolicy:
+    """Thresholds for TTFT-stage-driven pool rebalancing."""
+
+    dominance: float = 2.0   # losing-stage mean must exceed the other's by this
+    min_samples: int = 8     # per-stage observations before a window can vote
+    vote_ticks: int = 3      # consecutive same-direction votes before acting
+    cooldown_s: float = 60.0  # min seconds between moves
+
+
+class PoolRebalancer:
+    """Moves replicas between a disaggregated router's pools toward the
+    TTFT stage that dominates the breakdown.
+
+    Sense: drain :meth:`DisaggRouter.take_stage_attribution` each tick
+    (the per-stage accumulator behind
+    ``tpu_disagg_ttft_breakdown_seconds``).  Vote: when the decode-stage
+    mean dominates the prefill-stage mean by ``policy.dominance`` (with
+    ``min_samples`` observations on each side), the decode pool is
+    starved — vote to move a prefill replica over; the mirror-image vote
+    moves one back.  Hysteresis (``vote_ticks`` consecutive
+    same-direction votes) and ``cooldown_s`` keep a single slow request
+    from sloshing replicas.  Act: the donor pool's
+    :meth:`FleetAutoscaler.scale_move` — live-drained, zero-loss, one
+    correlation id.
+    """
+
+    def __init__(
+        self,
+        disagg,
+        prefill_scaler: FleetAutoscaler,
+        decode_scaler: FleetAutoscaler,
+        policy: RebalancePolicy | None = None,
+        clock=None,
+    ):
+        self.disagg = disagg
+        self.prefill_scaler = prefill_scaler
+        self.decode_scaler = decode_scaler
+        self.policy = policy or RebalancePolicy()
+        self.clock = clock or disagg.clock
+        self.ticks = 0
+        self.moves = 0
+        self._streak_dir = ""
+        self._streak = 0
+        self._last_move_t: float | None = None
+        self.last_decision: dict = {}
+
+    def _vote(self, attr: dict) -> str:
+        p = self.policy
+        pre = attr.get("prefill") or {}
+        dec = attr.get("decode") or {}
+        if pre.get("n", 0) < p.min_samples or dec.get("n", 0) < p.min_samples:
+            return ""
+        if dec["mean_s"] > pre["mean_s"] * p.dominance:
+            return "to_decode"   # decode starved: donate a prefill replica
+        if pre["mean_s"] > dec["mean_s"] * p.dominance:
+            return "to_prefill"
+        return ""
+
+    def tick(self) -> dict:
+        """One control-law evaluation.  Returns the decision doc (also
+        kept as ``last_decision`` for /debug surfaces)."""
+        self.ticks += 1
+        now = self.clock()
+        attr = self.disagg.take_stage_attribution()
+        vote = self._vote(attr)
+        if vote and vote == self._streak_dir:
+            self._streak += 1
+        elif vote:
+            self._streak_dir, self._streak = vote, 1
+        else:
+            self._streak_dir, self._streak = "", 0
+        corr = None
+        in_cooldown = (
+            self._last_move_t is not None
+            and now - self._last_move_t < self.policy.cooldown_s
+        )
+        if (
+            self._streak >= self.policy.vote_ticks
+            and not in_cooldown
+        ):
+            donor, taker = (
+                (self.prefill_scaler, self.decode_scaler)
+                if vote == "to_decode"
+                else (self.decode_scaler, self.prefill_scaler)
+            )
+            corr = donor.scale_move(taker.router, reason=f"ttft_{vote}")
+            if corr is not None:
+                self.moves += 1
+                self._last_move_t = now
+            self._streak_dir, self._streak = "", 0
+        self.last_decision = {
+            "vote": vote, "streak": self._streak, "corr": corr,
+            "cooldown": in_cooldown, "attribution": attr,
+        }
+        return self.last_decision
+
+    def stats(self) -> dict:
+        return {
+            "ticks": self.ticks,
+            "moves": self.moves,
+            "streak": self._streak,
+            "streak_dir": self._streak_dir,
             "last_decision": dict(self.last_decision),
         }
 

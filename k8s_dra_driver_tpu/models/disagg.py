@@ -61,6 +61,7 @@ from k8s_dra_driver_tpu.models.fleet import FleetPolicy, FleetRouter
 from k8s_dra_driver_tpu.models.telemetry import EngineTelemetry
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+from k8s_dra_driver_tpu.utils.retry import CircuitBreaker
 
 _M_TRANSFERS = REGISTRY.counter(
     "tpu_disagg_transfers_total",
@@ -83,6 +84,18 @@ _M_INFLIGHT = REGISTRY.gauge(
     "tpu_disagg_inflight_bytes",
     "KV handoff bytes currently in flight on the channel",
 )
+_M_CHANNEL_UP = REGISTRY.gauge(
+    "tpu_disagg_channel_up",
+    "interconnect link usability in the bound channel set (1 = scoreable), by channel",
+)
+_M_FAILOVER = REGISTRY.counter(
+    "tpu_disagg_channel_failover_total",
+    "mid-transfer hops to a sibling interconnect channel, by reason",
+)
+_M_ADMISSION_PARKED = REGISTRY.gauge(
+    "tpu_disagg_admission_parked",
+    "handoffs parked at the prefill side by KV-demand admission control",
+)
 # Declared (with help) in models/serve.py, where the engine-level fallback
 # arms live; looked up by name here so both layers share one counter.
 _M_FALLBACK = REGISTRY.counter("tpu_disagg_fallback_total")
@@ -94,6 +107,7 @@ DROPPED = "dropped"
 DEADLINE = "deadline"
 CORRUPT = "corrupt"
 NO_CAPACITY = "no_capacity"
+CHANNEL_DOWN = "channel_down"  # the carrying link died between begin and complete
 
 
 @dataclass(frozen=True)
@@ -111,14 +125,7 @@ class ChannelClaim:
     source: str = "static"               # "daemon" when claimed via topology
 
     @staticmethod
-    def from_daemon_info(doc: dict) -> "ChannelClaim | None":
-        """Bind to the channel the topology daemon published in its info
-        doc (``topology_daemon.DaemonState.to_info()["channel"]``).
-        Returns None when the daemon publishes no channel — the caller
-        falls back to a static claim."""
-        ch = (doc or {}).get("channel")
-        if not ch:
-            return None
+    def _parse(ch: dict) -> "ChannelClaim":
         return ChannelClaim(
             name=str(ch.get("name", "ici-0")),
             bandwidth_gbps=float(ch.get("bandwidth_gbps", 100.0)),
@@ -126,6 +133,40 @@ class ChannelClaim:
             transfer_deadline_s=float(ch.get("transfer_deadline_s", 0.25)),
             source="daemon",
         )
+
+    @staticmethod
+    def all_from_daemon_info(doc: dict) -> "tuple[ChannelClaim, ...]":
+        """Every scoreable link the daemon published.  The multi-link
+        ``channels`` list wins when present; an old info doc carrying only
+        the single ``channel`` key yields a one-claim tuple, and a doc
+        with neither yields an empty tuple (static fallback).  Duplicate
+        link names raise — two claims would alias one breaker endpoint
+        and merge distinct failure domains — and zero-bandwidth links are
+        excluded from scoring outright (a link that can never move a byte
+        must not absorb transfers)."""
+        raw = list((doc or {}).get("channels") or ())
+        if not raw:
+            one = (doc or {}).get("channel")
+            if not one:
+                return ()
+            raw = [one]
+        claims = [ChannelClaim._parse(ch) for ch in raw]
+        names = [c.name for c in claims]
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        if dupes:
+            raise ValueError(f"duplicate channel names in daemon info: {dupes}")
+        return tuple(c for c in claims if c.bandwidth_gbps > 0.0)
+
+    @staticmethod
+    def from_daemon_info(doc: dict) -> "ChannelClaim | None":
+        """Bind to the (best single) channel the topology daemon published
+        in its info doc.  Returns None when the daemon publishes no
+        scoreable channel — the caller falls back to a static claim.
+        Multi-channel callers use :meth:`all_from_daemon_info`."""
+        claims = ChannelClaim.all_from_daemon_info(doc)
+        if not claims:
+            return None
+        return max(claims, key=lambda c: c.bandwidth_gbps)
 
     def to_json(self) -> dict:
         return {
@@ -147,6 +188,7 @@ class Transfer:
     started_at: float
     latency_s: float = 0.0
     outcome: str = ""
+    channel: str = ""  # the link carrying this hop (set-level failover retags)
 
 
 class HandoffChannel:
@@ -208,7 +250,7 @@ class HandoffChannel:
             return None
         t = Transfer(
             request_id=request_id, nbytes=nbytes, crc=crc,
-            started_at=self.clock(),
+            started_at=self.clock(), channel=self.claim.name,
         )
         self.in_flight_bytes += nbytes
         self._in_flight[request_id] = t
@@ -241,8 +283,13 @@ class HandoffChannel:
         entry the payload belongs to) is unused here; the transport
         channel ships it alongside the KV bytes so the receiver can
         install the stream atomically."""
-        latency = transfer.nbytes / max(self.bandwidth_gbps * 1e9 / 8.0, 1.0)
         inj = self.fault_injector
+        bw = self.bandwidth_gbps
+        if inj is not None:
+            # Link brownout (channel_degrade fault): bandwidth shrinks, so
+            # the same payload slides toward the deadline bound.
+            bw *= inj.channel_bandwidth_factor(self.claim.name)
+        latency = transfer.nbytes / max(bw * 1e9 / 8.0, 1.0)
         if inj is not None:
             latency += inj.take_handoff_latency()
         transfer.latency_s = latency
@@ -275,6 +322,23 @@ class HandoffChannel:
         )
         return outcome
 
+    def abort(self, transfer: Transfer, reason: str) -> None:
+        """Release one in-flight reservation WITHOUT resolving the payload
+        — the set-level failover path, for a transfer whose carrying link
+        died between :meth:`begin` and :meth:`complete`.  Counted and
+        journaled like any other non-``ok`` outcome so the dashboards see
+        the failed half of the hop."""
+        transfer.outcome = reason
+        self._in_flight.pop(transfer.request_id, None)
+        self.in_flight_bytes -= transfer.nbytes
+        _M_INFLIGHT.set(self.in_flight_bytes)
+        self._count(reason)
+        JOURNAL.record(
+            "disagg", f"transfer.{reason}",
+            correlation=f"req-{transfer.request_id}",
+            nbytes=transfer.nbytes, channel=self.claim.name,
+        )
+
     def _count(self, outcome: str) -> None:
         _M_TRANSFERS.inc(outcome=outcome)
         self.counts[outcome] = self.counts.get(outcome, 0) + 1
@@ -294,6 +358,240 @@ class HandoffChannel:
         }
 
 
+class ChannelSet:
+    """N interconnect links to one peer, scored like replicas.
+
+    Members are plain :class:`HandoffChannel`\\ s (or transport-backed
+    subclasses); the router drives the SAME surface (``fits``/``begin``/
+    ``refuse``/``complete``/``tick``/``down``/``stats``) without caring
+    whether it holds one link or a set.  Selection prefers the usable
+    link with the most headroom per unit bandwidth; a per-link
+    :class:`CircuitBreaker` at ``transport/<peer>/<channel>`` takes a
+    flapping link out of scoring, and a link death between ``begin`` and
+    ``complete`` fails the transfer over to the best sibling — a
+    journaled hop under the transfer's ``req-<rid>`` correlation plus
+    ``tpu_disagg_channel_failover_total`` — instead of burning a
+    re-prefill.  Only when EVERY link is unusable does the set report
+    ``down``; the router's existing fallback ladder owns it from there."""
+
+    def __init__(
+        self,
+        channels,
+        *,
+        peer: str = "",
+        fault_injector=None,
+        clock=time.monotonic,
+    ):
+        members: list[HandoffChannel] = []
+        for ch in channels:
+            if isinstance(ch, ChannelClaim):
+                ch = HandoffChannel(
+                    ch, fault_injector=fault_injector, clock=clock
+                )
+            members.append(ch)
+        if not members:
+            raise ValueError("ChannelSet needs at least one channel")
+        names = [m.claim.name for m in members]
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        if dupes:
+            raise ValueError(f"duplicate channel names in set: {dupes}")
+        if not peer:
+            peer = getattr(
+                getattr(members[0], "link", None), "peer", "local"
+            ) or "local"
+        self.members = members
+        self.peer = peer
+        self.clock = clock
+        self.breakers = {
+            m.claim.name: CircuitBreaker(
+                endpoint=f"transport/{peer}/{m.claim.name}", clock=clock
+            )
+            for m in members
+        }
+        self._carrier: dict[int, HandoffChannel] = {}
+        self._forced_down: dict[str, str] = {}  # name -> reason
+        self.failovers = 0
+        self.fault_injector = fault_injector
+        for m in members:
+            _M_CHANNEL_UP.set(1.0 if self._link_up(m) else 0.0,
+                              channel=m.claim.name)
+
+    # The router arms a shared injector post-construction; propagate it to
+    # members that came in bare so one DRA_FAULTS spec drives every link.
+    @property
+    def fault_injector(self):
+        return self._fault_injector
+
+    @fault_injector.setter
+    def fault_injector(self, inj) -> None:
+        self._fault_injector = inj
+        for m in self.members:
+            if m.fault_injector is None:
+                m.fault_injector = inj
+
+    # -- link health ---------------------------------------------------------
+
+    def _link_up(self, m: HandoffChannel) -> bool:
+        name = m.claim.name
+        if name in self._forced_down or m.down:
+            return False
+        br = self.breakers[name]
+        return br.state != CircuitBreaker.OPEN or br.cooldown_remaining() <= 0.0
+
+    def _maybe_kill(self, m: HandoffChannel) -> bool:
+        """Consult the ``channel_down`` fault for this link (and remember a
+        prior death): a killed link leaves scoring NOW and its breaker
+        trips — counting failures toward the threshold would just route
+        more transfers into the corpse."""
+        name = m.claim.name
+        if name in self._forced_down:
+            return True
+        inj = self._fault_injector
+        if inj is not None and inj.take_channel_down(name):
+            self._forced_down[name] = "fault"
+            self.breakers[name].trip()
+            _M_CHANNEL_UP.set(0.0, channel=name)
+            JOURNAL.record(
+                "disagg", "channel.down",
+                correlation=f"{self.peer}/{name}", reason="channel_down",
+            )
+            return True
+        return False
+
+    @property
+    def down(self) -> bool:
+        """The SET is down only when no link is usable — the precondition
+        for the router's transport-down fallback rung."""
+        return not any(self._link_up(m) for m in self.members)
+
+    # -- the channel surface the router drives -------------------------------
+
+    def tick(self) -> int:
+        n = 0
+        for m in self.members:
+            n += m.tick()
+            _M_CHANNEL_UP.set(1.0 if self._link_up(m) else 0.0,
+                              channel=m.claim.name)
+        return n
+
+    def fits(self, nbytes: int) -> bool:
+        return any(m.fits(nbytes) for m in self.members)
+
+    def _pick(self, nbytes: int, exclude=()) -> HandoffChannel | None:
+        """Best usable link with budget room for this payload: lowest
+        resulting in-flight bytes per unit bandwidth — the same
+        load-per-capacity shape the fleet router scores replicas with."""
+        best, best_score = None, None
+        for m in self.members:
+            name = m.claim.name
+            if name in exclude or not self._link_up(m):
+                continue
+            if m.in_flight_bytes + nbytes > m.max_in_flight_bytes:
+                continue
+            if not self.breakers[name].allow():
+                continue
+            score = (m.in_flight_bytes + nbytes) / max(m.bandwidth_gbps, 1e-9)
+            if best_score is None or score < best_score:
+                best, best_score = m, score
+        return best
+
+    def begin(self, request_id: int, nbytes: int, crc: int) -> Transfer | None:
+        m = self._pick(nbytes)
+        if m is None:
+            return None  # every usable link's budget is spent: backpressure
+        t = m.begin(request_id, nbytes, crc)
+        if t is None:
+            return None
+        self._carrier[request_id] = m
+        return t
+
+    def refuse(self, request_id: int, nbytes: int, why: str) -> None:
+        # Charge the largest link: its refusal is what proves NO link can
+        # ever carry the payload.
+        m = max(self.members, key=lambda m: m.max_in_flight_bytes)
+        m.refuse(request_id, nbytes, why)
+
+    def complete(self, transfer: Transfer, kv, entry=None) -> str:
+        """Resolve one transfer with mid-flight failover: a channel-fault
+        outcome (drop, stale, corrupt-on-the-wire, link death) re-begins
+        the SAME payload on the best untried sibling and journals the hop
+        under the transfer's correlation.  Only when no sibling can take
+        the payload does the failing outcome surface — and only then does
+        the router's re-prefill ladder run."""
+        m = self._carrier.pop(transfer.request_id, None)
+        if m is None:
+            m = self.members[0]
+        first = transfer
+        tried = {m.claim.name}
+        while True:
+            name = m.claim.name
+            br = self.breakers[name]
+            if self._maybe_kill(m):
+                m.abort(transfer, CHANNEL_DOWN)
+                outcome = CHANNEL_DOWN
+            else:
+                outcome = m.complete(transfer, kv, entry=entry)
+                if outcome == OK:
+                    br.on_success()
+                    if transfer is not first:
+                        # The caller holds the FIRST hop's Transfer: fold
+                        # the winning hop's accounting back into it.
+                        first.latency_s = transfer.latency_s
+                        first.outcome = transfer.outcome
+                        first.channel = transfer.channel
+                    return OK
+                br.on_failure()
+            sib = self._pick(transfer.nbytes, exclude=tried)
+            if sib is None:
+                return outcome
+            t2 = sib.begin(transfer.request_id, transfer.nbytes, transfer.crc)
+            if t2 is None:
+                return outcome
+            self.failovers += 1
+            _M_FAILOVER.inc(reason=outcome)
+            JOURNAL.record(
+                "disagg", "transfer.failover",
+                correlation=f"req-{transfer.request_id}",
+                from_channel=name, to_channel=sib.claim.name, reason=outcome,
+            )
+            tried.add(sib.claim.name)
+            transfer, m = t2, sib
+
+    # -- observability -------------------------------------------------------
+
+    @property
+    def counts(self) -> dict:
+        agg: dict[str, int] = {}
+        for m in self.members:
+            for k, v in m.counts.items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
+
+    @property
+    def bytes_moved(self) -> int:
+        return sum(m.bytes_moved for m in self.members)
+
+    def stats(self) -> dict:
+        """The /debug/disagg channel view, per-link: each member's claim,
+        budget and outcome tally plus set-level health and hop count."""
+        return {
+            "peer": self.peer,
+            "failovers": self.failovers,
+            "in_flight_bytes": sum(m.in_flight_bytes for m in self.members),
+            "outcomes": self.counts,
+            "bytes_moved": self.bytes_moved,
+            "channels": [
+                {
+                    **m.stats(),
+                    "up": self._link_up(m),
+                    "breaker": self.breakers[m.claim.name].state,
+                    "forced_down": self._forced_down.get(m.claim.name, ""),
+                }
+                for m in self.members
+            ],
+        }
+
+
 class DisaggRouter:
     """The disaggregated front door: one queue, two pools, one channel.
 
@@ -310,10 +608,12 @@ class DisaggRouter:
         self,
         prefill=(),
         decode=(),
-        channel: HandoffChannel | None = None,
+        channel=None,
         policy: FleetPolicy | None = None,
         fault_injector=None,
         clock=time.monotonic,
+        admission_control: bool = True,
+        deadlock_ticks: int = 50,
     ):
         self.clock = clock
         if fault_injector is None:
@@ -340,6 +640,12 @@ class DisaggRouter:
             if isinstance(decode, (list, tuple))
             else decode
         )
+        if isinstance(channel, (list, tuple)):
+            # A claim/channel LIST binds a multi-link ChannelSet: channels
+            # scored like replicas, mid-transfer failover between them.
+            channel = ChannelSet(
+                channel, fault_injector=fault_injector, clock=clock
+            )
         self.channel = channel or HandoffChannel(
             fault_injector=fault_injector, clock=clock
         )
@@ -353,6 +659,21 @@ class DisaggRouter:
         self._completions: list = []       # collected by the external drive
         # locally re-run rid -> the rid the caller holds (crash resubmit)
         self._rid_alias: dict[int, int] = {}
+        # KV-demand admission: rid -> committed full-stream block
+        # reservation on the decode pool, spanning resident + parked +
+        # in-flight-PLACE streams.  Handoffs whose demand cannot fit park
+        # in _admission_parked (typed backpressure at the prefill side)
+        # instead of deadlocking an undersized decode pool.
+        self.admission_control = admission_control
+        self.deadlock_ticks = max(1, int(deadlock_ticks))
+        self._ledger: dict[int, int] = {}
+        self._admission_parked: list[dict] = []
+        self._starved_ticks = 0
+        self._last_unparked = 0
+        self.deadlock_fired = 0
+        # per-stage TTFT attribution window (the rebalance policy's vote
+        # signal): stage -> [sum_seconds, observations]
+        self._stage_acc: dict[str, list] = {}
         self.handoffs = 0
         self.fallbacks = 0
         _LIVE_DISAGG.add(self)
@@ -381,9 +702,11 @@ class DisaggRouter:
             stepped += self.decode.tick()
             out.extend(self._remap(self._collect_decode()))
             moved += self._reclaim_failed()
+            moved += self._deadlock_tick()
             if (
                 not queue
                 and not self._staged
+                and not self._admission_parked
                 and self.prefill.idle()
                 and self.decode.idle()
             ):
@@ -460,7 +783,7 @@ class DisaggRouter:
         self.prefill._owner.pop(rid, None)
         now = self.clock()
         t0 = self._t0.pop(rid, now)
-        _M_TTFT_BREAKDOWN.observe(max(0.0, now - t0), stage="prefill")
+        self._observe_stage("prefill", max(0.0, now - t0))
         EngineTelemetry.annotate_trace_doc(
             entry.get("trace"), "handoff_begin", now, source=source,
         )
@@ -477,6 +800,20 @@ class DisaggRouter:
         waiting: list[dict] = []
         moved = 0
         self.channel.tick()  # heartbeats / liveness / paced reconnect
+        # KV-demand admission runs BEFORE any bytes move: freed decode
+        # capacity re-admits parked handoffs oldest-first, then each newly
+        # staged handoff must fit the full-stream ledger or park.
+        self._last_unparked = self._unpark_admissions()
+        moved += self._last_unparked
+        if self.admission_control and self._staged:
+            fitting: list[dict] = []
+            for item in self._staged:
+                if self._admit_handoff(item):
+                    fitting.append(item)
+                else:
+                    self._park_admission(item)
+                    moved += 1
+            self._staged = fitting
         if self.channel.down and self._staged:
             # Whole transport down: every staged payload lands on the
             # fallback rung NOW (KV-less delivery, decode re-prefills) —
@@ -516,7 +853,7 @@ class DisaggRouter:
             entry = item["entry"]
             outcome = self.channel.complete(t, entry["kv"], entry=entry)
             if outcome == OK:
-                _M_TTFT_BREAKDOWN.observe(t.latency_s, stage="transfer")
+                self._observe_stage("transfer", t.latency_s)
                 EngineTelemetry.annotate_trace_doc(
                     entry.get("trace"), "handoff_transfer", self.clock(),
                     nbytes=t.nbytes, latency_s=round(t.latency_s, 6),
@@ -597,6 +934,7 @@ class DisaggRouter:
         entry.pop("kv", None)
         entry.pop("_placed_remote", None)
         rid = int(entry["request_id"])
+        self._ledger_release(rid)  # the stream leaves the admission path
         self.fallbacks += 1
         _M_FALLBACK.inc(reason="unified_collapse")
         JOURNAL.record(
@@ -623,6 +961,9 @@ class DisaggRouter:
             return
         if pool is self.decode:
             self._awaiting[rid] = self.clock()
+            demand = self._full_demand_blocks(entry)
+            if demand is not None:
+                self._ledger_commit(rid, demand)  # back under the ledger
         pool.place([entry], correlation=f"handoff-req-{rid}")
 
     def _reclaim_failed(self) -> int:
@@ -641,10 +982,217 @@ class DisaggRouter:
                 n += 1
         return n
 
+    # -- KV-demand admission (tentpole b) ------------------------------------
+    #
+    # The decode pool admits a handoff only if the FULL stream fits: KV
+    # blocks for prompt + max_tokens, committed in a reservation ledger
+    # covering resident, parked and in-flight streams.  A handoff whose
+    # full demand exceeds the uncommitted headroom parks at the prefill
+    # side (typed backpressure) instead of landing on a decode replica
+    # that will wedge mid-stream when its allocator runs dry.  The
+    # ledger mutates ONLY through _ledger_commit/_ledger_release, and
+    # _admission_parked ONLY through _park_admission/_unpark_admissions/
+    # _deadlock_tick — the invariant analyzer (tools/analysis/
+    # admission_funnel.py) enforces both funnels.
+
+    def _decode_block_size(self) -> "int | None":
+        """Smallest KV block size across decode replicas, or None when the
+        pool is remote/dense/empty — blocks-needed rounds UP, so the
+        smallest block size is the conservative (largest) demand."""
+        replicas = getattr(self.decode, "replicas", None)
+        if not replicas:
+            return None
+        sizes = []
+        for r in replicas:
+            eng = r.engine
+            if not hasattr(eng, "block_size") or not hasattr(eng, "free_blocks"):
+                return None
+            sizes.append(int(eng.block_size))
+        return min(sizes) if sizes else None
+
+    def _decode_headroom_blocks(self) -> "int | None":
+        """Reservable decode blocks minus every committed reservation, or
+        None when capacity is not accountable (remote pool, dense
+        engines) — admission stands aside rather than guessing."""
+        admittable = getattr(self.decode, "admittable_replicas", None)
+        if not callable(admittable):
+            return None
+        total = 0
+        for r in admittable():
+            # RemotePool replicas carry no local engine: unaccountable.
+            cap = getattr(getattr(r, "engine", None), "reservable_blocks", None)
+            if cap is None:
+                return None
+            total += int(cap)
+        return total - sum(self._ledger.values())
+
+    def _full_demand_blocks(self, entry: dict) -> "int | None":
+        """KV blocks the stream needs at FULL growth (prompt + max_tokens
+        — the bound that makes admission deadlock-proof: an admitted
+        stream can always finish without waiting on another's blocks)."""
+        from k8s_dra_driver_tpu.models.serve import full_stream_tokens
+
+        bs = self._decode_block_size()
+        if bs is None or bs <= 0:
+            return None
+        return -(-full_stream_tokens(entry) // bs)
+
+    def _ledger_commit(self, rid: int, blocks: int) -> None:
+        self._ledger[int(rid)] = int(blocks)
+
+    def _ledger_release(self, rid) -> None:
+        self._ledger.pop(int(rid), None)
+
+    def _admit_handoff(self, item: dict) -> bool:
+        """True iff the decode pool can commit the entry's full-stream KV
+        demand (or capacity is not accountable, in which case admission
+        stands aside).  Commits the reservation on admit; releases it on
+        refusal so a parked stream holds no blocks hostage."""
+        if not self.admission_control:
+            return True
+        entry = item["entry"]
+        rid = int(entry["request_id"])
+        demand = self._full_demand_blocks(entry)
+        headroom = self._decode_headroom_blocks()
+        if demand is None or headroom is None:
+            return True
+        headroom += self._ledger.get(rid, 0)  # re-admitting own reservation
+        if demand > headroom:
+            self._ledger_release(rid)
+            item["demand"] = demand
+            return False
+        self._ledger_commit(rid, demand)
+        return True
+
+    def _park_admission(self, item: dict) -> None:
+        rid = int(item["entry"]["request_id"])
+        self._admission_parked.append(item)
+        _M_ADMISSION_PARKED.set(float(len(self._admission_parked)))
+        JOURNAL.record(
+            "disagg", "admission.parked",
+            correlation=f"req-{rid}",
+            demand_blocks=item.get("demand"),
+            parked=len(self._admission_parked),
+        )
+
+    def _unpark_admissions(self) -> int:
+        """Re-admit parked handoffs oldest-first as decode capacity frees.
+        FIFO keeps backpressure fair; a large stream at the head does NOT
+        let smaller later streams starve it forever (no overtaking)."""
+        if not self._admission_parked:
+            return 0
+        moved = 0
+        still: list[dict] = []
+        blocked = False
+        for item in self._admission_parked:
+            if not blocked and self._admit_handoff(item):
+                rid = int(item["entry"]["request_id"])
+                JOURNAL.record(
+                    "disagg", "admission.unparked",
+                    correlation=f"req-{rid}",
+                    demand_blocks=item.get("demand"),
+                )
+                self._staged.append(item)
+                moved += 1
+            else:
+                blocked = True  # strict FIFO: later streams never overtake
+                still.append(item)
+        self._admission_parked = still
+        _M_ADMISSION_PARKED.set(float(len(self._admission_parked)))
+        return moved
+
+    def _deadlock_tick(self) -> int:
+        """Watchdog-integrated deadlock detector: handoffs parked with NO
+        admission progress while the decode pool sits idle (nothing
+        draining toward freeing blocks) for ``deadlock_ticks``
+        consecutive ticks means nothing will EVER free the capacity the
+        head-of-line stream needs.  Fire once: dump a diag bundle, then
+        force every parked stream down the unified-collapse rung —
+        degraded service beats a silent wedge."""
+        if not self._admission_parked or self._last_unparked > 0:
+            self._starved_ticks = 0
+            return 0
+        idle = getattr(self.decode, "idle", None)
+        if callable(idle) and not idle():
+            # Decode still drains resident streams; their completions
+            # will release reservations — starvation, not deadlock.
+            self._starved_ticks = 0
+            return 0
+        self._starved_ticks += 1
+        if self._starved_ticks < self.deadlock_ticks:
+            return 0
+        self.deadlock_fired += 1
+        self._starved_ticks = 0
+        state = {
+            "parked": len(self._admission_parked),
+            "ledger_streams": len(self._ledger),
+            "ledger_blocks": sum(self._ledger.values()),
+            "deadlock_ticks": self.deadlock_ticks,
+            "router_seq": self.seq,
+        }
+        try:
+            from k8s_dra_driver_tpu.utils.watchdog import (
+                WATCHDOG, dump_diag_bundle,
+            )
+
+            dump_diag_bundle(
+                WATCHDOG.bundle_dir,
+                reason="disagg_admission_deadlock", state=state,
+            )
+        except Exception:  # diagnostics never block the forced drain
+            pass
+        JOURNAL.record("disagg", "admission.deadlock", **state)
+        drained, self._admission_parked = self._admission_parked, []
+        _M_ADMISSION_PARKED.set(0.0)
+        for item in drained:
+            self._force_collapse(item["entry"])
+        return len(drained)
+
+    def _force_collapse(self, entry: dict) -> None:
+        """Deadlock fallback: the decode pool provably cannot hold this
+        stream at full growth, so serve it unified on the PREFILL pool
+        (KV-less — it re-prefills there).  Remote prefill degrades to the
+        ordinary unified-collapse ladder."""
+        entry.pop("kv", None)
+        rid = int(entry["request_id"])
+        self._ledger_release(rid)
+        self.fallbacks += 1
+        _M_FALLBACK.inc(reason="deadlock_collapse")
+        JOURNAL.record(
+            "disagg", "handoff.deadlock_collapse", correlation=f"req-{rid}",
+        )
+        if hasattr(self.prefill, "link"):
+            self._unified_collapse(entry, "admission_deadlock")
+            return
+        self.prefill.place([entry], correlation=f"handoff-req-{rid}")
+
+    # -- TTFT stage attribution ----------------------------------------------
+
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        """Histogram observation PLUS a per-stage accumulator the pool
+        rebalancer reads through :meth:`take_stage_attribution`."""
+        _M_TTFT_BREAKDOWN.observe(seconds, stage=stage)
+        acc = self._stage_acc.setdefault(stage, [0.0, 0])
+        acc[0] += float(seconds)
+        acc[1] += 1
+
+    def take_stage_attribution(self) -> dict:
+        """Drain the per-stage TTFT accumulators since the last call —
+        the signal ``autoscaler.PoolRebalancer`` votes on (a move toward
+        whichever stage dominates the breakdown)."""
+        out = {}
+        for stage, (total, n) in self._stage_acc.items():
+            out[stage] = {
+                "sum_s": total, "n": n,
+                "mean_s": (total / n) if n else 0.0,
+            }
+        self._stage_acc = {}
+        return out
+
     def _observe_decode_stage(self, rid: int, now: float) -> None:
         t = self._awaiting.pop(rid, None)
         if t is not None:
-            _M_TTFT_BREAKDOWN.observe(max(0.0, now - t), stage="decode")
+            self._observe_stage("decode", max(0.0, now - t))
 
     def _remap(self, comps: list) -> list:
         """Restore caller-visible rids on completions of crash-resubmitted
@@ -664,6 +1212,8 @@ class DisaggRouter:
         that parked before a replica could take them."""
         out = self.decode.completions()
         now = self.clock()
+        for c in out:
+            self._ledger_release(c.request_id)  # blocks freed with the stream
         if self._awaiting:
             for rid in [r for r in self._awaiting if r in self.decode._owner]:
                 self._observe_decode_stage(rid, now)
@@ -699,6 +1249,7 @@ class DisaggRouter:
         stepped += self.decode.tick()
         self._completions.extend(self._remap(self._collect_decode()))
         self._reclaim_failed()
+        self._deadlock_tick()
         return stepped
 
     def completions(self) -> list:
@@ -716,6 +1267,13 @@ class DisaggRouter:
             "handoffs": self.handoffs,
             "fallbacks": self.fallbacks,
             "staged": len(self._staged),
+            "admission": {
+                "parked": len(self._admission_parked),
+                "ledger_streams": len(self._ledger),
+                "ledger_blocks": sum(self._ledger.values()),
+                "starved_ticks": self._starved_ticks,
+                "deadlock_fired": self.deadlock_fired,
+            },
             "prefill": self.prefill.stats(),
             "decode": self.decode.stats(),
             "channel": self.channel.stats(),

@@ -723,6 +723,27 @@ class FleetRouter:
         self._set_state(rep, SUSPECT, reason)
         return self._evacuate(rep, reason)
 
+    def remove_replica(self, name: str):
+        """Detach a DRAINED replica from the fleet and return its engine
+        (the zero-loss pool-move building block: drain here, add_replica
+        there).  Refuses any replica still in the lifecycle — removal
+        must never strand resident streams."""
+        rep = self.replica(name)
+        if rep.state != DRAINED:
+            raise ValueError(
+                f"replica {name!r} is {rep.state}, not drained — "
+                "drain() before remove_replica()"
+            )
+        self.replicas.remove(rep)
+        for rid in [r for r, own in self._owner.items() if own is rep]:
+            self._owner.pop(rid, None)
+        JOURNAL.record(
+            "fleet", "replica.remove", correlation=name,
+            engine=type(rep.engine).__name__,
+        )
+        self._publish_states()
+        return rep.engine
+
     def _evacuate(self, rep: Replica, reason: str) -> list[int]:
         """snapshot → release → restore-onto-survivors.  Returns the ids
         moved (parked leftovers restore as capacity frees).  The whole

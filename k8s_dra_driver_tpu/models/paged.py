@@ -1017,6 +1017,14 @@ class PagedServeEngine:
     def free_blocks(self) -> int:
         return sum(a.free_blocks for a in self._allocs)
 
+    @property
+    def reservable_blocks(self) -> int:
+        """Total usable KV blocks (the reserved null block per shard
+        excluded) — the capacity denominator the decode-side KV-demand
+        admission ledger (models/disagg.py) budgets full-stream
+        reservations against."""
+        return sum(a.n_blocks - 1 for a in self._allocs)
+
     def free_slots(self) -> int:
         return sum(1 for s in self._slots if s is None)
 

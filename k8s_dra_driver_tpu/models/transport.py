@@ -544,7 +544,13 @@ class TransportChannel(HandoffChannel):
     ``peer_pump`` is the in-process far end's poll (a
     :class:`WireReceiver` or :class:`PoolWorker`) for single-process
     rigs; with a real worker process it is None and the link's socket is
-    polled directly."""
+    polled directly.
+
+    A TransportChannel is a valid :class:`~k8s_dra_driver_tpu.models.
+    disagg.ChannelSet` member: pass prebuilt instances (one per physical
+    link to the peer) and the set scores them like replicas, failing a
+    mid-transfer link over to a sibling before the router's re-prefill
+    ladder runs."""
 
     def __init__(self, link: PeerLink, *, peer_pump=None, remote_place=False,
                  **kwargs):
@@ -683,6 +689,7 @@ class TransportChannel(HandoffChannel):
                 nbytes=transfer.nbytes,
                 latency_s=round(transfer.latency_s, 6),
                 peer=self.link.peer,
+                channel=self.claim.name,
             ),
         )
         return outcome

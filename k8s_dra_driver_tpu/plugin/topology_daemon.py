@@ -93,6 +93,11 @@ class DaemonState:
     # ``deviceinfo.InterconnectChannelInfo.to_info()`` dict, or empty when
     # the host publishes no channel.
     channel: dict = field(default_factory=dict)
+    # Multi-link publication: EVERY interconnect channel this host offers
+    # for KV handoff (same to_info() dicts), so routers can bind a channel
+    # SET per peer and fail over between links.  ``channel`` stays as the
+    # legacy single-link key for old consumers.
+    channels: list = field(default_factory=list)
 
 
 class TopologyDaemonServer:
@@ -112,15 +117,20 @@ class TopologyDaemonServer:
         hbm_limits: Optional[dict[str, str]] = None,
         quantum_ms: int = DEFAULT_QUANTUM_MS,
         channel: Optional[dict] = None,
+        channels: Optional[list] = None,
     ):
         self.socket_path = socket_path
+        chans = list(channels or [])
+        if channel and not chans:
+            chans = [channel]
         self.state = DaemonState(
             claim_uid=claim_uid,
             partition_spec=partition_spec,
             partitions=partitions or [],
             hbm_limits=hbm_limits or {},
             quantum_ms=quantum_ms,
-            channel=channel or {},
+            channel=channel or (chans[0] if chans else {}),
+            channels=chans,
         )
         self._cond = threading.Condition()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -145,6 +155,12 @@ class TopologyDaemonServer:
             # (deviceinfo.InterconnectChannelInfo.to_info() shape) —
             # injected by the template alongside TPU_PARTITIONS.
             channel = json.loads(raw)
+        channels: list = []
+        raw = environ.get("TPU_HANDOFF_CHANNELS", "")
+        if raw:
+            # Multi-link form: a JSON LIST of to_info() dicts.  Takes
+            # precedence over the legacy single-channel variable.
+            channels = json.loads(raw)
         return cls(
             socket_path,
             claim_uid=claim_uid,
@@ -153,6 +169,7 @@ class TopologyDaemonServer:
             hbm_limits=hbm_limits,
             quantum_ms=int(environ.get("TPU_QUEUE_QUANTUM_MS", DEFAULT_QUANTUM_MS)),
             channel=channel,
+            channels=channels,
         )
 
     # -- request handling ---------------------------------------------------
@@ -179,6 +196,7 @@ class TopologyDaemonServer:
                 "hbm_limits": self.state.hbm_limits,
                 "quantum_ms": self.state.quantum_ms,
                 "channel": self.state.channel,
+                "channels": self.state.channels,
                 "consumers": sorted(self.state.consumers),
                 "lease_holders": {
                     scope: lease.consumer

@@ -134,6 +134,15 @@ class FaultProfile:
     handoff_drop_rate: float = 0.0  # probability a transfer is dropped in flight
     handoff_latency_s: float = 0.0  # simulated seconds added per transfer
     handoff_corrupt_rate: float = 0.0  # probability payload bytes arrive corrupted
+    # link-scoped (multi-channel failover) kinds: consulted by the
+    # ChannelSet per link consult.  ``channel_down`` kills a scoped link —
+    # mid-transfer, the set must fail the hop over to a sibling link;
+    # ``channel_degrade`` multiplies a scoped link's bandwidth (brownout:
+    # transfers slide toward the deadline bound).  Scope by ``channels``
+    # (link names); the shared ``limit`` budget caps both.
+    channel_down_rate: float = 0.0  # probability a scoped link dies this consult
+    channel_degrade: float = 0.0  # bandwidth multiplier (0 < f <= 1) when armed
+    channels: tuple = ()  # e.g. ("ici-1",); empty = all links
     # socket-scoped (models/transport.py) kinds: consulted at the
     # transport's send/recv seams, so the in-process chaos suite covers
     # truncated frames, peer resets, slow links and silent hangs without
@@ -383,6 +392,38 @@ class FaultInjector:
                 return True
         return False
 
+    # -- link decision points (multi-channel failover) ---------------------
+
+    def take_channel_down(self, channel: str) -> bool:
+        """Link hook: should this interconnect link die NOW?  Consulted by
+        the ChannelSet both at tick time and between a transfer's begin
+        and complete — a mid-transfer death must fail the hop over to a
+        sibling link, never lose or duplicate the stream."""
+        for p in self._matching_channel(channel):
+            if p.channel_down_rate and self._roll(
+                p, p.channel_down_rate, "channel_down",
+                f"channel-{channel}", "channel",
+            ):
+                return True
+        return False
+
+    def channel_bandwidth_factor(self, channel: str) -> float:
+        """Link hook: the bandwidth multiplier for this link (1.0 = no
+        brownout).  Accounted into the transfer's latency arithmetic like
+        :meth:`take_handoff_latency` — never slept; the shared ``limit``
+        budget caps how many transfers ride the degraded link."""
+        factor = 1.0
+        for p in self._matching_channel(channel):
+            if 0.0 < p.channel_degrade < 1.0:
+                with self._lock:
+                    if not self._budget_ok(p):
+                        continue
+                    self._record(
+                        p, "channel_degrade", "TRANSFER", f"channel-{channel}"
+                    )
+                factor *= p.channel_degrade
+        return factor
+
     # -- socket decision points (models/transport.py wire seams) -----------
 
     def take_sock_truncate(self, peer: str) -> bool:
@@ -471,6 +512,16 @@ class FaultInjector:
                 and (step is None or not p.steps or step in p.steps)
             ]
 
+    def _matching_channel(self, channel: str) -> list[FaultProfile]:
+        """Profiles matching an interconnect link by name — the channel-set
+        twin of :meth:`_matching_engine` (empty scope matches every link)."""
+        with self._lock:
+            return [
+                p
+                for p in self._profiles
+                if not p.channels or channel in p.channels
+            ]
+
     def _matching_replica(self, replica: int, tick: int) -> list[FaultProfile]:
         """Profiles matching a fleet (replica, tick) decision point — the
         router twin of :meth:`_matching_engine` (``steps`` doubles as the
@@ -541,6 +592,10 @@ class FaultInjector:
                 fields["handoff_drop_rate"] = float(value)
             elif key == "handoff_corrupt":
                 fields["handoff_corrupt_rate"] = float(value)
+            elif key == "channel_down":
+                fields["channel_down_rate"] = float(value)
+            elif key == "channel_degrade":
+                fields["channel_degrade"] = float(value)
             elif key == "spawn_fail":
                 fields["spawn_fail_rate"] = float(value)
             elif key == "spawn_latency_ms":
@@ -558,7 +613,8 @@ class FaultInjector:
                          "handoff_drop_rate", "handoff_latency_s",
                          "handoff_corrupt_rate", "spawn_fail_rate",
                          "spawn_latency_s", "sock_truncate_rate",
-                         "sock_reset_rate", "sock_latency_s"):
+                         "sock_reset_rate", "sock_latency_s",
+                         "channel_down_rate"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
                          "watch_hangs", "peer_hang", "limit"):
@@ -567,6 +623,8 @@ class FaultInjector:
                 fields["verbs"] = tuple(value.split("+"))
             elif key == "kinds":
                 fields["kinds"] = tuple(value.split("+"))
+            elif key == "channels":
+                fields["channels"] = tuple(value.split("+"))
             elif key in ("slots", "steps", "replicas"):
                 fields[key] = tuple(int(v) for v in value.split("+"))
             else:

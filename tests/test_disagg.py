@@ -30,6 +30,7 @@ import pytest
 from k8s_dra_driver_tpu.models import burnin, lora, paged
 from k8s_dra_driver_tpu.models.disagg import (
     ChannelClaim,
+    ChannelSet,
     DisaggRouter,
     HandoffChannel,
     debug_disagg_doc,
@@ -417,6 +418,157 @@ class TestChannelClaim:
         assert attrs["type"].string == DEVICE_TYPE_CHANNEL
         assert attrs["channelName"].string == "ici-0"
         assert "inFlightBytes" in rendered.basic.capacity
+
+
+class TestMultiChannelBinding:
+    """Multi-link parsing: the daemon publishes a channel LIST and
+    ``all_from_daemon_info`` binds the whole scoreable set."""
+
+    def _doc(self, *chans):
+        return {"channels": [c.to_json() for c in chans]}
+
+    def test_n_links_parse_with_daemon_source(self):
+        claims = ChannelClaim.all_from_daemon_info(self._doc(
+            ChannelClaim(name="ici-0", bandwidth_gbps=100.0),
+            ChannelClaim(name="ici-1", bandwidth_gbps=50.0),
+            ChannelClaim(name="dcn-0", bandwidth_gbps=10.0),
+        ))
+        assert [c.name for c in claims] == ["ici-0", "ici-1", "dcn-0"]
+        assert all(c.source == "daemon" for c in claims)
+        assert claims[1].bandwidth_gbps == 50.0
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate channel names"):
+            ChannelClaim.all_from_daemon_info(self._doc(
+                ChannelClaim(name="ici-0"), ChannelClaim(name="ici-0"),
+            ))
+
+    def test_zero_bandwidth_links_excluded_from_scoring(self):
+        claims = ChannelClaim.all_from_daemon_info(self._doc(
+            ChannelClaim(name="ici-0", bandwidth_gbps=100.0),
+            ChannelClaim(name="dead", bandwidth_gbps=0.0),
+        ))
+        assert [c.name for c in claims] == ["ici-0"]
+
+    def test_old_single_channel_doc_still_binds(self):
+        doc = {"channel": ChannelClaim(name="ici-7", bandwidth_gbps=9.0).to_json()}
+        claims = ChannelClaim.all_from_daemon_info(doc)
+        assert [c.name for c in claims] == ["ici-7"]
+        one = ChannelClaim.from_daemon_info(doc)
+        assert one is not None and one.name == "ici-7"
+
+    def test_from_daemon_info_picks_highest_bandwidth(self):
+        doc = self._doc(
+            ChannelClaim(name="slow", bandwidth_gbps=10.0),
+            ChannelClaim(name="fast", bandwidth_gbps=200.0),
+        )
+        assert ChannelClaim.from_daemon_info(doc).name == "fast"
+
+    def test_daemon_publishes_channel_list_from_env(self, tmp_path):
+        links = [
+            InterconnectChannelInfo(
+                channel_name=f"ici-{i}", bandwidth_gbps=100.0 - i
+            ).to_info()
+            for i in range(3)
+        ]
+        srv = TopologyDaemonServer.from_env(
+            str(tmp_path / "c.sock"), "uid-3",
+            environ={"TPU_HANDOFF_CHANNELS": json.dumps(links)},
+        )
+        doc = srv.handle_request({"op": "info"})
+        assert len(doc["channels"]) == 3
+        claims = ChannelClaim.all_from_daemon_info(doc)
+        assert [c.name for c in claims] == ["ici-0", "ici-1", "ici-2"]
+        # legacy single-channel key still served for old binders
+        assert ChannelClaim.from_daemon_info(doc).name == "ici-0"
+
+
+class TestChannelSet:
+    """Set-level selection, health and failover — no pools involved."""
+
+    def _set(self, *, inj=None):
+        return ChannelSet(
+            [
+                ChannelClaim(name="ici-0", bandwidth_gbps=100.0,
+                             max_in_flight_bytes=1 << 20),
+                ChannelClaim(name="ici-1", bandwidth_gbps=50.0,
+                             max_in_flight_bytes=1 << 20),
+            ],
+            fault_injector=inj,
+        )
+
+    def test_empty_and_duplicate_sets_rejected(self):
+        with pytest.raises(ValueError, match="at least one"):
+            ChannelSet([])
+        with pytest.raises(ValueError, match="duplicate channel names"):
+            ChannelSet([ChannelClaim(name="x"), ChannelClaim(name="x")])
+
+    def test_pick_prefers_headroom_per_bandwidth(self):
+        cs = self._set()
+        # Empty set: the faster link wins (same bytes, more bandwidth).
+        assert cs._pick(1000).claim.name == "ici-0"
+        # Load ici-0 heavily: per-capacity score now favors ici-1.
+        cs.members[0].in_flight_bytes = 900_000
+        assert cs._pick(1000).claim.name == "ici-1"
+
+    def test_begin_complete_routes_through_picked_member(self):
+        cs = self._set()
+        kv = _kv()
+        t = cs.begin(1, kv.nbytes, kv.checksum())
+        assert t is not None and t.channel == "ici-0"
+        assert cs.complete(t, kv) == "ok"
+        assert cs.members[0].counts.get("ok") == 1
+        assert cs.failovers == 0
+
+    def test_mid_transfer_link_death_fails_over_to_sibling(self):
+        inj = FaultInjector.from_env(
+            "channel_down=1.0,channels=ici-0,limit=1,seed=5"
+        )
+        cs = self._set(inj=inj)
+        kv = _kv()
+        t = cs.begin(2, kv.nbytes, kv.checksum())
+        assert t.channel == "ici-0"
+        assert cs.complete(t, kv) == "ok"          # hopped, not failed
+        assert cs.failovers == 1
+        assert t.channel == "ici-1"                # winning hop folded back
+        assert cs.members[1].counts.get("ok") == 1
+        assert "ici-0" in cs._forced_down
+        assert not cs.down                          # sibling keeps the set up
+
+    def test_down_only_when_every_link_unusable(self):
+        inj = FaultInjector.from_env("channel_down=1.0,limit=4,seed=5")
+        cs = self._set(inj=inj)
+        assert cs._maybe_kill(cs.members[0])
+        assert not cs.down                          # one survivor: still up
+        assert cs._maybe_kill(cs.members[1])
+        assert cs.down
+
+    def test_stats_has_per_channel_table(self):
+        cs = self._set()
+        doc = cs.stats()
+        assert {c["claim"]["name"] for c in doc["channels"]} == {
+            "ici-0", "ici-1"
+        }
+        assert all(
+            set(c) >= {"up", "breaker", "forced_down"}
+            for c in doc["channels"]
+        )
+        assert doc["failovers"] == 0
+
+    def test_router_binds_claim_list_as_channel_set(self, params):
+        router = DisaggRouter(
+            prefill=[_dense(params)], decode=[_dense(params)],
+            channel=[
+                ChannelClaim(name="a", bandwidth_gbps=10.0),
+                ChannelClaim(name="b", bandwidth_gbps=10.0),
+            ],
+        )
+        assert isinstance(router.channel, ChannelSet)
+        done = router.pump(
+            [{"prompt": [5, 6, 7], "max_tokens": 4}]
+        )
+        assert len(done) == 1 and done[0].status == "ok"
+        assert router.stats()["channel"]["peer"] == "local"
 
 
 class TestFallbackLadder:

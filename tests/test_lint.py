@@ -733,6 +733,85 @@ class TestBlockAccounting:
         assert new_checks(report) == []
 
 
+class TestAdmissionFunnel:
+    FUNNELED = (
+        "class DisaggRouter:\n"
+        "    def __init__(self):\n"
+        "        self._ledger = {}\n"
+        "        self._admission_parked = []\n"
+        "    def _ledger_commit(self, rid, blocks):\n"
+        "        self._ledger[rid] = blocks\n"
+        "    def _ledger_release(self, rid):\n"
+        "        self._ledger.pop(rid, None)\n"
+        "    def _park_admission(self, item):\n"
+        "        self._admission_parked.append(item)\n"
+        "    def _unpark_admissions(self):\n"
+        "        self._admission_parked = []\n"
+        "    def _deadlock_tick(self):\n"
+        "        drained, self._admission_parked = self._admission_parked, []\n"
+        "        return drained\n"
+        "    def reads_are_legal(self, rid):\n"
+        "        return self._ledger.get(rid, 0) + len(self._admission_parked)\n"
+    )
+
+    def test_funneled_mutations_clean(self, tmp_path):
+        report = analyze(
+            tmp_path, self.FUNNELED, name="models/disagg.py",
+            checks=["admission-funnel"],
+        )
+        assert new_checks(report) == []
+
+    def test_raw_ledger_store_outside_funnel_flagged(self, tmp_path):
+        src = self.FUNNELED + (
+            "    def sneak(self, rid):\n"
+            "        self._ledger[rid] = 1\n"
+        )
+        report = analyze(
+            tmp_path, src, name="models/disagg.py",
+            checks=["admission-funnel"],
+        )
+        assert new_checks(report) == ["admission-funnel"]
+        assert report.result.new[0].symbol == "DisaggRouter.sneak"
+
+    def test_stray_park_append_flagged(self, tmp_path):
+        src = self.FUNNELED + (
+            "    def sneak(self, item):\n"
+            "        self._admission_parked.append(item)\n"
+        )
+        report = analyze(
+            tmp_path, src, name="models/disagg.py",
+            checks=["admission-funnel"],
+        )
+        assert new_checks(report) == ["admission-funnel"]
+        assert "_admission_parked" in report.result.new[0].message
+
+    def test_del_and_augassign_flagged(self, tmp_path):
+        src = self.FUNNELED + (
+            "    def sneak(self, rid):\n"
+            "        del self._ledger[rid]\n"
+            "    def sneak2(self):\n"
+            "        self._admission_parked += []\n"
+        )
+        report = analyze(
+            tmp_path, src, name="models/disagg.py",
+            checks=["admission-funnel"],
+        )
+        assert sorted(new_checks(report)) == [
+            "admission-funnel", "admission-funnel",
+        ]
+
+    def test_repo_disagg_funnels_hold(self):
+        import tools.analysis.runner as ar
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parents[1]
+        report = ar.run_analysis(
+            [root / "k8s_dra_driver_tpu" / "models" / "disagg.py"],
+            checks=["admission-funnel"], root=root,
+        )
+        assert new_checks(report) == []
+
+
 class TestAnalysisBaseline:
     LEAK = "class E:\n    def bad(self, n):\n        self._alloc.alloc(n)\n"
 

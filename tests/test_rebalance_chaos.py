@@ -1,0 +1,472 @@
+"""Rebalance chaos suite: the deadlock-proof disaggregated control plane
+under injected link death, decode starvation and replica crashes
+(`make chaos-rebalance`, <20s, CPU, seeded).
+
+The three acceptance scenarios this PR pins:
+
+* **Link death mid-transfer** — a ``channel_down`` fault kills the
+  carrying interconnect link between ``begin`` and ``complete``; the
+  transfer fails over to a sibling link in the bound :class:`ChannelSet`
+  and the stream completes BIT-EQUAL with zero re-prefill fallbacks.
+  Only when EVERY link is gone does the fallback ladder run.
+* **Decode starvation** — full-stream KV demand exceeds the decode
+  pool's reservable blocks; over-demand handoffs park at the prefill
+  side (typed backpressure, gauge + journal) and re-admit FIFO as
+  completions free capacity — no deadlock, no lost stream.  When the
+  pool provably can NEVER hold a stream, the deadlock detector fires a
+  diag bundle and force-collapses it to unified service on the prefill
+  pool — degraded beats wedged.
+* **Pool move under replica crash** — ``FleetAutoscaler.scale_move``
+  live-drains a replica out of one pool and merge-restores it into the
+  other under one ``scale-<seq>-<n>`` correlation; a fault crashes the
+  moved replica mid-load and the fleet machinery still delivers every
+  stream exactly once with balanced block accounting.
+
+Every fault draws from a seeded injector, latency is accounted (never
+slept), and each scenario replays from its seed.
+"""
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, paged
+from k8s_dra_driver_tpu.models.autoscaler import (
+    FleetAutoscaler,
+    PoolRebalancer,
+    RebalancePolicy,
+)
+from k8s_dra_driver_tpu.models.disagg import ChannelClaim, DisaggRouter
+from k8s_dra_driver_tpu.models.fleet import DRAINED, FleetRouter
+from k8s_dra_driver_tpu.models.serve import ServeEngine
+from k8s_dra_driver_tpu.utils.faults import FaultInjector
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _dense(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prompt_bucket", 16)
+    return ServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 41)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _inj(spec: str) -> FaultInjector:
+    return FaultInjector.from_env(spec)
+
+
+# Explicit per-request seeds: router-minted ids differ from the unified
+# reference, so sampling keys must come from the request, never the id.
+REQS = [
+    {"prompt": [7, 8, 9], "max_tokens": 6, "seed": 5},
+    {"prompt": [3, 4], "max_tokens": 6, "temperature": 0.7, "seed": 9},
+    {"prompt": [11, 12, 13, 14], "max_tokens": 6, "seed": 21},
+    {"prompt": [1, 2], "max_tokens": 6, "seed": 33},
+    {"prompt": [21, 22, 23], "max_tokens": 6, "seed": 44},
+]
+
+# Two-link channel set: selection prefers ici-0 (more bandwidth) when
+# idle, so killing it exercises the mid-transfer failover path.
+LINKS = (
+    dict(name="ici-0", bandwidth_gbps=100.0),
+    dict(name="ici-1", bandwidth_gbps=50.0),
+)
+
+
+def _links():
+    return [ChannelClaim(**kw) for kw in LINKS]
+
+
+def _by_prompt(completions):
+    out = {}
+    for c in completions:
+        out[tuple(c.tokens[: len(c.tokens) - len(c.generated)])] = tuple(
+            c.generated
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def reference(params):
+    """Fault-free streams for REQS — the bit-equality baseline."""
+    return _by_prompt(_dense(params).pump([dict(r) for r in REQS]))
+
+
+def _storm(params, spec, *, channel=None, decode_kw=None):
+    inj = _inj(spec) if spec else None
+    pre, dec = _paged(params), _paged(params, **(decode_kw or {}))
+    free0 = (pre.free_blocks, dec.free_blocks)
+    router = DisaggRouter(
+        prefill=[pre], decode=[dec],
+        channel=channel if channel is not None else _links(),
+        fault_injector=inj,
+    )
+    done = router.pump([dict(r) for r in REQS])
+    free1 = (pre.free_blocks, dec.free_blocks)
+    return router, done, free0, free1
+
+
+def _assert_no_lost_or_dup(done, reference):
+    assert len(done) == len(REQS)
+    assert [c.status for c in done].count("ok") == len(REQS)
+    rids = [c.request_id for c in done]
+    assert len(rids) == len(set(rids)), "duplicated completion ids"
+    assert _by_prompt(done) == reference
+
+
+class TestRebalanceFaultHooks:
+    def test_from_env_parses_link_kinds(self):
+        inj = _inj(
+            "channel_down=1.0,channel_degrade=0.25,channels=ici-0,"
+            "limit=3,seed=7"
+        )
+        (p,) = inj._profiles
+        assert p.channel_down_rate == 1.0
+        assert p.channel_degrade == 0.25
+        assert p.channels == ("ici-0",)
+        assert p.limit == 3
+
+    def test_channel_scope_and_budget(self):
+        inj = _inj("channel_down=1.0,channels=ici-0,limit=1,seed=3")
+        assert not inj.take_channel_down("ici-1")  # out of scope: silent
+        assert inj.take_channel_down("ici-0")
+        assert not inj.take_channel_down("ici-0")  # budget spent
+
+    def test_degrade_scales_only_scoped_links(self):
+        inj = _inj("channel_degrade=0.25,channels=ici-0,limit=2,seed=7")
+        assert inj.channel_bandwidth_factor("ici-0") == pytest.approx(0.25)
+        assert inj.channel_bandwidth_factor("ici-1") == pytest.approx(1.0)
+        assert inj.channel_bandwidth_factor("ici-0") == pytest.approx(0.25)
+        # budget exhausted: the link browns back in
+        assert inj.channel_bandwidth_factor("ici-0") == pytest.approx(1.0)
+
+
+class TestLinkDeathFailover:
+    """Scenario 1: the carrying link dies mid-transfer; the sibling takes
+    the payload and the fallback ladder never runs."""
+
+    def test_sibling_failover_bit_equal_no_fallback(self, params, reference):
+        JOURNAL.clear()
+        router, done, free0, free1 = _storm(
+            params, "channel_down=1.0,channels=ici-0,limit=1,seed=3"
+        )
+        _assert_no_lost_or_dup(done, reference)
+        assert router.fallbacks == 0, "failover must not burn a re-prefill"
+        # every transfer already in flight on the dead link hops once
+        hops = router.channel.failovers
+        assert hops >= 1
+        counts = router.channel.counts
+        assert counts["channel_down"] == hops
+        assert counts["ok"] == len(REQS)
+        assert free1 == free0
+        events = JOURNAL.tail(limit=400, component="disagg")
+        hopped = [e for e in events if e["event"] == "transfer.failover"]
+        assert len(hopped) == hops
+        assert all(
+            e["attrs"]["from_channel"] == "ici-0"
+            and e["attrs"]["to_channel"] == "ici-1"
+            for e in hopped
+        )
+        assert any(e["event"] == "channel.down" for e in events)
+
+    def test_failover_metrics_rendered(self, params, reference):
+        router, done, _, _ = _storm(
+            params, "channel_down=1.0,channels=ici-0,limit=1,seed=3"
+        )
+        _assert_no_lost_or_dup(done, reference)
+        doc = parse_prom_text(REGISTRY.render())
+        up = doc["tpu_disagg_channel_up"]
+        assert up[(("channel", "ici-0"),)] == 0.0
+        assert up[(("channel", "ici-1"),)] == 1.0
+        hops = doc["tpu_disagg_channel_failover_total"]
+        assert hops[(("reason", "channel_down"),)] >= 1.0
+        # the per-channel /debug/disagg table shows the dead link
+        table = router.stats()["channel"]["channels"]
+        by_name = {row["claim"]["name"]: row for row in table}
+        assert by_name["ici-0"]["up"] is False
+        assert by_name["ici-0"]["forced_down"] == "fault"
+        assert by_name["ici-1"]["up"] is True
+
+    def test_browned_out_link_hops_without_fallback(self, params, reference):
+        # channel_degrade shrinks ici-0's bandwidth so far every transfer
+        # on it goes stale — each one hops to the healthy sibling instead
+        # of falling back to re-prefill.
+        router, done, free0, free1 = _storm(
+            params, "channel_degrade=0.00000001,channels=ici-0,seed=7"
+        )
+        _assert_no_lost_or_dup(done, reference)
+        assert router.fallbacks == 0
+        assert router.channel.failovers >= 1
+        counts = router.channel.counts
+        assert counts["ok"] == len(REQS)
+        assert counts.get("deadline", 0) >= 1
+        assert free1 == free0
+
+    def test_all_links_down_falls_back_to_reprefill(self, params, reference):
+        # Both links die: the SET reports down and every staged payload
+        # lands on the KV-less fallback rung — degraded, never lost.
+        router, done, free0, free1 = _storm(
+            params, "channel_down=1.0,limit=2,seed=3"
+        )
+        _assert_no_lost_or_dup(done, reference)
+        assert router.channel.down
+        assert router.fallbacks >= 1
+        assert free1 == free0
+
+    def test_storm_replays_from_seed(self, params):
+        spec = "channel_down=1.0,channels=ici-0,limit=1,seed=11"
+        a = _storm(params, spec)[0].channel.counts
+        b = _storm(params, spec)[0].channel.counts
+        assert a == b
+
+
+class TestAdmissionBackpressure:
+    """Scenario 2: KV demand beyond decode capacity parks at the prefill
+    side and re-admits as capacity frees — starvation is backpressure,
+    not deadlock."""
+
+    def test_starved_handoffs_park_then_complete(self, params, reference):
+        JOURNAL.clear()
+        # reservable = n_blocks - 1 = 7 decode blocks vs 13 blocks of
+        # full-stream demand across REQS: some streams must park.
+        router, done, free0, free1 = _storm(
+            params, "", decode_kw=dict(n_blocks=8)
+        )
+        _assert_no_lost_or_dup(done, reference)
+        assert free1 == free0
+        events = JOURNAL.tail(limit=600, component="disagg")
+        kinds = [e["event"] for e in events]
+        assert kinds.count("admission.parked") >= 1
+        assert kinds.count("admission.unparked") >= 1
+        adm = router.stats()["admission"]
+        assert adm["parked"] == 0
+        assert adm["ledger_streams"] == 0
+        assert adm["deadlock_fired"] == 0
+        doc = parse_prom_text(REGISTRY.render())
+        assert doc["tpu_disagg_admission_parked"][()] == 0.0
+
+    def test_impossible_stream_fires_deadlock_collapse(
+        self, params, tmp_path, monkeypatch
+    ):
+        from k8s_dra_driver_tpu.utils.watchdog import WATCHDOG
+
+        monkeypatch.setattr(
+            WATCHDOG, "_bundle_dir", str(tmp_path), raising=False
+        )
+        JOURNAL.clear()
+        req = {"prompt": list(range(20, 34)), "max_tokens": 16, "seed": 3}
+        ref = _by_prompt(_dense(params).pump([dict(req)]))
+        pre, dec = _paged(params), _paged(params, n_blocks=5)
+        # full-stream demand = ceil(30 / 4) = 8 blocks vs 4 reservable:
+        # NOTHING will ever free enough — the detector must fire.
+        router = DisaggRouter(
+            prefill=[pre], decode=[dec], channel=_links(), deadlock_ticks=5
+        )
+        done = router.pump([dict(req)])
+        assert len(done) == 1 and done[0].status == "ok"
+        assert _by_prompt(done) == ref, "collapsed stream must stay bit-equal"
+        assert router.deadlock_fired == 1
+        assert router.fallbacks == 1
+        assert REGISTRY.counter("tpu_disagg_fallback_total").value(
+            reason="deadlock_collapse"
+        ) == 1
+        events = JOURNAL.tail(limit=400, component="disagg")
+        kinds = [e["event"] for e in events]
+        assert kinds.count("admission.deadlock") == 1
+        assert kinds.count("handoff.deadlock_collapse") == 1
+        bundles = list(tmp_path.iterdir())
+        assert bundles, "deadlock must dump a diag bundle"
+
+    def test_deadlock_replays_from_seed(self, params):
+        req = {"prompt": list(range(20, 34)), "max_tokens": 16, "seed": 3}
+
+        def run():
+            router = DisaggRouter(
+                prefill=[_paged(params)],
+                decode=[_paged(params, n_blocks=5)],
+                channel=_links(), deadlock_ticks=5,
+            )
+            done = router.pump([dict(req)])
+            return [tuple(c.generated) for c in done], router.deadlock_fired
+
+        assert run() == run()
+
+
+class TestScaleMove:
+    """The zero-loss pool-rebalancing actuator, fault-free."""
+
+    def test_move_replica_between_pools(self, params):
+        JOURNAL.clear()
+        src = FleetRouter([_dense(params), _dense(params)])
+        dst = FleetRouter([_dense(params)])
+        scaler = FleetAutoscaler(src, lambda: _dense(params))
+        corr = scaler.scale_move(dst)
+        assert corr is not None and corr.startswith("scale-")
+        assert len(src.replicas) == 1
+        assert len(dst.replicas) == 2
+        assert REGISTRY.counter("tpu_autoscale_events_total").value(
+            direction="move", reason="rebalance"
+        ) == 1
+        events = JOURNAL.tail(limit=100, component="autoscale")
+        spans = {
+            e["event"]: e["correlation"]
+            for e in events
+            if e["event"] in ("scale_move.begin", "scale_move.resumed")
+        }
+        assert spans == {
+            "scale_move.begin": corr, "scale_move.resumed": corr,
+        }
+
+    def test_move_refused_at_min_replicas(self, params):
+        src = FleetRouter([_dense(params)])
+        dst = FleetRouter([_dense(params)])
+        scaler = FleetAutoscaler(src, lambda: _dense(params))
+        assert scaler.scale_move(dst) is None
+        assert len(src.replicas) == 1 and len(dst.replicas) == 1
+
+    def test_remove_replica_requires_drained(self, params):
+        router = FleetRouter([_dense(params), _dense(params)])
+        name = router.replicas[0].name
+        with pytest.raises(ValueError):
+            router.remove_replica(name)
+
+    def test_pool_move_under_replica_crash_zero_loss(self, params, reference):
+        """Scenario 3: move a prefill replica into the decode pool
+        mid-load, then crash the moved replica — every stream still
+        delivers exactly once, bit-equal, blocks balanced."""
+        JOURNAL.clear()
+        # replicas=1 scopes the crash to pool index 1: after the move
+        # only the DECODE pool has a second replica — the moved engine.
+        inj = _inj("replica_crash_rate=1.0,replicas=1,steps=8,limit=1,seed=2")
+        e1, e2, d1 = _paged(params), _paged(params), _paged(params)
+        free0 = (e1.free_blocks, e2.free_blocks, d1.free_blocks)
+        router = DisaggRouter(prefill=[e1, e2], decode=[d1],
+                              channel=_links(), fault_injector=inj)
+        scaler = FleetAutoscaler(router.prefill, lambda: _paged(params))
+        for r in REQS:
+            req = dict(r)
+            router.submit(req.pop("prompt"), req.pop("max_tokens"), **req)
+        done, corr = [], None
+        for i in range(400):
+            router.tick()
+            done.extend(router.completions())
+            if i == 2:
+                corr = scaler.scale_move(router.decode)
+                assert corr is not None
+                assert len(router.prefill.replicas) == 1
+                assert len(router.decode.replicas) == 2
+            if (
+                len(done) == len(REQS)
+                and router.prefill.idle() and router.decode.idle()
+            ):
+                break
+        _assert_no_lost_or_dup(done, reference)
+        assert inj.stats().get("replica_crash") == 1
+        assert any(r.state == DRAINED for r in router.decode.replicas)
+        assert (e1.free_blocks, e2.free_blocks, d1.free_blocks) == free0
+        assert REGISTRY.counter("tpu_autoscale_events_total").value(
+            direction="move", reason="rebalance"
+        ) == 1
+        adm = router.stats()["admission"]
+        assert adm["ledger_streams"] == 0 and adm["parked"] == 0
+
+
+class TestPoolRebalancer:
+    """TTFT-stage-driven control law over scale_move."""
+
+    def _setup(self, params, **pol):
+        now = [0.0]
+        pol.setdefault("dominance", 2.0)
+        pol.setdefault("min_samples", 4)
+        pol.setdefault("vote_ticks", 2)
+        pol.setdefault("cooldown_s", 60.0)
+        clock = lambda: now[0]
+        router = DisaggRouter(
+            prefill=[_dense(params), _dense(params)],
+            decode=[_dense(params)], channel=_links(), clock=clock,
+        )
+        pre_s = FleetAutoscaler(
+            router.prefill, lambda: _dense(params), clock=clock
+        )
+        dec_s = FleetAutoscaler(
+            router.decode, lambda: _dense(params), clock=clock
+        )
+        rb = PoolRebalancer(
+            router, pre_s, dec_s, RebalancePolicy(**pol), clock=clock
+        )
+        return router, rb, now
+
+    def _feed(self, router, pre_mean, dec_mean, n=4):
+        for _ in range(n):
+            router._observe_stage("prefill", pre_mean)
+            router._observe_stage("decode", dec_mean)
+
+    def test_vote_needs_dominance_and_samples(self):
+        rb = PoolRebalancer.__new__(PoolRebalancer)
+        rb.policy = RebalancePolicy(dominance=2.0, min_samples=4)
+        v = rb._vote
+        pre = lambda m, n=8: {"mean_s": m, "n": n, "sum_s": m * n}
+        assert v({"prefill": pre(0.01), "decode": pre(0.1)}) == "to_decode"
+        assert v({"prefill": pre(0.1), "decode": pre(0.01)}) == "to_prefill"
+        assert v({"prefill": pre(0.01), "decode": pre(0.015)}) == ""
+        assert v({"prefill": pre(0.01, n=2), "decode": pre(0.1)}) == ""
+        assert v({}) == ""
+
+    def test_sustained_decode_starvation_moves_a_replica(self, params):
+        router, rb, _ = self._setup(params)
+        self._feed(router, 0.01, 0.1)
+        d1 = rb.tick()
+        assert d1["vote"] == "to_decode" and d1["corr"] is None
+        self._feed(router, 0.01, 0.1)
+        d2 = rb.tick()
+        assert d2["corr"] is not None
+        assert rb.moves == 1
+        assert len(router.prefill.replicas) == 1
+        assert len(router.decode.replicas) == 2
+
+    def test_single_slow_window_does_not_slosh(self, params):
+        router, rb, _ = self._setup(params)
+        self._feed(router, 0.01, 0.1)
+        rb.tick()
+        rb.tick()  # empty window: streak resets
+        self._feed(router, 0.01, 0.1)
+        rb.tick()
+        assert rb.moves == 0
+        assert len(router.prefill.replicas) == 2
+
+    def test_cooldown_blocks_immediate_counter_move(self, params):
+        router, rb, now = self._setup(params)
+        for _ in range(2):
+            self._feed(router, 0.01, 0.1)
+            rb.tick()
+        assert rb.moves == 1
+        # mirror-image pressure inside the cooldown window: no slosh
+        for _ in range(3):
+            self._feed(router, 0.1, 0.01)
+            rb.tick()
+        assert rb.moves == 1
+        assert rb.last_decision["cooldown"] is True
+        # window passes: the counter-move is allowed again
+        now[0] += 61.0
+        for _ in range(2):
+            self._feed(router, 0.1, 0.01)
+            rb.tick()
+        assert rb.moves == 2
+        assert len(router.prefill.replicas) == 2
+        assert len(router.decode.replicas) == 1

@@ -1,0 +1,138 @@
+"""admission-funnel pass.
+
+The KV-demand admission control in ``models/disagg.py`` is deadlock-proof
+only if its two pieces of state move through their funnels:
+
+* ``self._ledger`` — the decode-side block-reservation ledger.  Every
+  commit/release must go through ``_ledger_commit``/``_ledger_release``
+  (``__init__`` seeds the empty dict); a raw ``self._ledger[rid] = n``
+  elsewhere can strand a reservation past the stream's life and starve
+  admission forever, or double-release and over-admit into a wedge.
+* ``self._admission_parked`` — the parked-handoff queue.  Only
+  ``_park_admission`` (enqueue + gauge + journal), ``_unpark_admissions``
+  (FIFO re-admit) and ``_deadlock_tick`` (forced drain) may mutate it;
+  a stray ``append`` skips the ``tpu_disagg_admission_parked`` gauge and
+  the journal record, so the deadlock detector and the operator both go
+  blind to the parked stream.
+
+This pass machine-checks both funnels: any mutation of either attribute
+(attribute assign, subscript store/delete, augmented assign, or a call
+to a mutating method like ``append``/``pop``/``update``) outside its
+allowlisted methods is a finding.  Reads (``len``, ``.get``,
+``.values``, iteration) are not mutations and stay legal everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .findings import Finding
+from .index import FuncNode, ModuleIndex, dotted, enclosing
+
+CHECK = "admission-funnel"
+
+# attribute -> methods allowed to mutate it (anywhere in the tree rooted
+# at that method, so helper closures inside a funnel stay legal).
+FUNNELS = {
+    "_ledger": frozenset({"__init__", "_ledger_commit", "_ledger_release"}),
+    "_admission_parked": frozenset(
+        {"__init__", "_park_admission", "_unpark_admissions", "_deadlock_tick"}
+    ),
+}
+
+MUTATORS = frozenset(
+    {
+        "append", "extend", "insert", "remove", "clear", "pop", "popitem",
+        "update", "setdefault", "sort", "reverse",
+    }
+)
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.iter_modules():
+        for node in ast.walk(mod.tree):
+            attr = _mutated_attr(node)
+            if attr is None:
+                continue
+            if _in_funnel(node, FUNNELS[attr]):
+                continue
+            findings.append(
+                Finding(
+                    path=mod.path,
+                    line=node.lineno,
+                    check=CHECK,
+                    symbol=mod.symbol_for(node),
+                    message=(
+                        f"self.{attr} mutated outside its admission funnel "
+                        f"({', '.join(sorted(FUNNELS[attr]))}) — gauge, "
+                        "journal and reservation accounting go out of sync"
+                    ),
+                )
+            )
+    return findings
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``_ledger``/``_admission_parked`` when node is that ``self.<attr>``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and node.attr in FUNNELS
+    ):
+        return node.attr
+    return None
+
+
+def _target_attr(target: ast.AST) -> Optional[str]:
+    """The funneled attribute a store/delete target reaches, if any:
+    ``self.x``, ``self.x[i]``, or either inside a tuple unpack."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            attr = _target_attr(elt)
+            if attr is not None:
+                return attr
+        return None
+    if isinstance(target, (ast.Subscript, ast.Starred)):
+        return _target_attr(target.value)
+    return _self_attr(target)
+
+
+def _mutated_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Assign):
+        for target in node.targets:
+            attr = _target_attr(target)
+            if attr is not None:
+                return attr
+        return None
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return _target_attr(node.target)
+    if isinstance(node, ast.Delete):
+        for target in node.targets:
+            attr = _target_attr(target)
+            if attr is not None:
+                return attr
+        return None
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        name = dotted(node.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        # self.<attr>.<mutator>(...) — reads like .get/.values pass through
+        if (
+            len(parts) == 3
+            and parts[0] == "self"
+            and parts[1] in FUNNELS
+            and parts[2] in MUTATORS
+        ):
+            return parts[1]
+    return None
+
+
+def _in_funnel(node: ast.AST, allowed: frozenset) -> bool:
+    for fn in enclosing(node, FuncNode):
+        if getattr(fn, "name", "") in allowed:
+            return True
+    return False

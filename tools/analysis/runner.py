@@ -7,7 +7,13 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional
 
-from . import block_accounting, jit_purity, lock_discipline, terminal_funnel
+from . import (
+    admission_funnel,
+    block_accounting,
+    jit_purity,
+    lock_discipline,
+    terminal_funnel,
+)
 from .findings import BaselineResult, Finding, apply_baseline, load_baseline
 from .index import ModuleIndex
 
@@ -16,6 +22,7 @@ PASSES = {
     jit_purity.CHECK: jit_purity.run,
     terminal_funnel.CHECK: terminal_funnel.run,
     block_accounting.CHECK: block_accounting.run,
+    admission_funnel.CHECK: admission_funnel.run,
 }
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"

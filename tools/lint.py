@@ -409,8 +409,12 @@ METRIC_LABEL_KEYS = frozenset({
     # kinds are both bounded, operator-declared sets
     "profile", "fault",
     # autoscaler scaling events (models/autoscaler.py): direction is the
-    # closed {up, down} pair
+    # closed {up, down, move} set
     "direction",
+    # interconnect channel set (models/disagg.py ChannelSet): channel names
+    # come from the topology daemon's published link list — an operator-
+    # declared, bounded set, same cardinality class as endpoint/node
+    "channel",
 })
 METRIC_LABEL_PREFIXES = (
     "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",

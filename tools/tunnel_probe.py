@@ -22,6 +22,12 @@ _PROBE_CODE = (
     "print(jax.default_backend())"
 )
 
+# Detail of the most recent FAILED probe (timeout marker, or rc + stderr
+# tail) — empty after a success.  Callers that cache the probe verdict
+# (bench._wait_for_backend) attach this to their degraded-body marker so
+# the artifact says WHY the backend was judged down.
+LAST_ERROR = ""
+
 
 def probe(timeout_s: float = 90.0, quiet: bool = False) -> bool:
     """One subprocess attempt to init the backend and run a real matmul.
@@ -32,6 +38,7 @@ def probe(timeout_s: float = 90.0, quiet: bool = False) -> bool:
     ``subprocess.run``'s post-timeout ``communicate()`` into a second
     unbounded hang, exactly the failure this subprocess exists to bound.
     """
+    global LAST_ERROR
     say = (lambda *a: None) if quiet else (lambda *a: print(*a))
     proc = subprocess.Popen(
         [sys.executable, "-c", _PROBE_CODE],
@@ -51,12 +58,15 @@ def probe(timeout_s: float = 90.0, quiet: bool = False) -> bool:
             proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             pass  # D-state child: give up on reaping, report down
+        LAST_ERROR = f"timeout after {timeout_s:.0f}s (backend init hung)"
         say("tunnel_probe: TIMEOUT (backend init hung)")
         return False
     if proc.returncode == 0:
+        LAST_ERROR = ""
         say(f"tunnel_probe: OK backend={out.strip().splitlines()[-1]}")
         return True
     tail = (err or "").strip().splitlines()
+    LAST_ERROR = f"rc={proc.returncode}: {tail[-1] if tail else ''}".strip()
     say(f"tunnel_probe: DOWN rc={proc.returncode} {tail[-1] if tail else ''}")
     return False
 
