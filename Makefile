@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance demo dryrun lint analyze perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance sim-cluster demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -80,6 +80,15 @@ chaos-transport:
 # balanced block accounting.
 chaos-rebalance:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_rebalance_chaos.py -q
+
+# Cluster-scale gang allocator suite (<30s, CPU, seeded; tier-1 via
+# tests/): synthetic-cluster churn with watch storms driving the REAL
+# AllocationIndex + plan()/plan_gang() — every claim accounted exactly
+# once (relist audits, zero leaks at drain), gang atomicity under 409/500
+# storms, deterministic reports, and a 10k-pool build with flat plan()
+# latency.
+sim-cluster:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cluster_sim.py tests/test_gang_alloc.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

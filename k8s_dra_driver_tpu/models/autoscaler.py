@@ -45,6 +45,7 @@ drives it — never both.
 
 from __future__ import annotations
 
+import os
 import time
 import weakref
 from dataclasses import dataclass
@@ -74,6 +75,33 @@ _M_ATTAIN = REGISTRY.gauge(
 UP = "up"
 DOWN = "down"
 MOVE = "move"
+
+# Flag for the remote-spawn path: when truthy, scale-ups run the
+# transport-backed factory (a worker process / PoolWorker rig behind a
+# PeerLink) instead of constructing an engine in the supervisor.
+ENV_REMOTE_WORKERS = "DRA_REMOTE_WORKERS"
+
+
+def select_engine_factory(local_factory, remote_factory=None,
+                          environ=os.environ):
+    """Flagged engine-factory selection for the spawn path.
+
+    ``local_factory`` builds in-supervisor engines (the default);
+    ``remote_factory`` builds transport-worker-backed replicas — usually
+    :func:`k8s_dra_driver_tpu.models.transport.make_remote_engine_factory`
+    over the ``worker_main`` rig.  :data:`ENV_REMOTE_WORKERS` picks:
+    truthy ("1"/"true"/"yes"/"on") selects the remote factory and raises
+    loudly when none was wired (a production flag must never silently
+    degrade to local spawning); anything else selects local."""
+    raw = environ.get(ENV_REMOTE_WORKERS, "").strip().lower()
+    if raw in ("1", "true", "yes", "on"):
+        if remote_factory is None:
+            raise ValueError(
+                f"{ENV_REMOTE_WORKERS} is set but no remote engine factory "
+                "was provided"
+            )
+        return remote_factory
+    return local_factory
 
 
 @dataclass(frozen=True)

@@ -1071,6 +1071,389 @@ class RemotePool:
             return
 
 
+class RemoteWorkerEngine:
+    """Engine-protocol replica whose real engine lives behind a
+    :class:`PeerLink` in a worker process (or an in-process
+    :class:`PoolWorker` over a loopback pair — same protocol loop
+    ``worker_main`` drives).  This is what lets ``FleetAutoscaler`` scale
+    up by SPAWNING A PROCESS instead of constructing an engine in the
+    supervisor: ``add_replica`` protocol-checks it, seeds its id stride
+    (forwarded to the worker as a ``reseed`` CONTROL frame, partitioned
+    across the worker's own replicas), and routes to it like any local
+    engine.
+
+    Zero-loss contract, inherited from :class:`RemotePool`: every
+    submitted stream is retained as a KV-less snapshot entry until its
+    COMPLETION frame lands.  When the worker dies the fleet router's
+    stall/heartbeat detectors fire (tokens stop advancing while slots
+    stay resident), the replica is evacuated through the ordinary
+    ``snapshot_active`` → ``release_active`` path, and the retained
+    entries re-prefill on surviving replicas — the ids join
+    ``link.reclaimed`` so a half-dead worker's late completions are
+    dropped, never double-delivered."""
+
+    def __init__(self, link: PeerLink, *, n_slots: int = 8,
+                 sync_interval: int = 8, name: str = "",
+                 clock=time.monotonic, peer_pump=None):
+        from k8s_dra_driver_tpu.models.telemetry import _next_seq
+
+        self.link = link
+        self.n_slots = int(n_slots)
+        self.sync_interval = int(sync_interval)
+        self.name = name or f"remote-engine-{link.peer}"
+        self.clock = clock
+        self.peer_pump = peer_pump  # in-process far end's poll (tests)
+        self.engine_seq = _next_seq()
+        self._resident: dict[int, dict] = {}
+        self._completions: list = []
+        self._departed: set[int] = set()
+        self._submit_seq = 0
+        self._next_id = 0
+        self.bursts = 0
+        self.tokens_generated = 0
+        self._completed = 0
+        self._statuses: dict[str, int] = {}
+        self._created_at = clock()
+        self._last_progress_t = self._created_at
+        self._last_burst_t = self._created_at
+        self._last_step_s = 0.0
+        self._stat_reads = 0
+
+    # -- admission -----------------------------------------------------------
+
+    def free_slots(self) -> int:
+        return self.n_slots - len(self._resident)
+
+    def submit(self, prompt, max_tokens: int, **kwargs) -> int:
+        """Synchronous submit RPC (the :meth:`RemotePool.submit` shape):
+        SUBMIT out, pump until the seq-matched SUBMITTED lands.  Raises
+        RuntimeError on a full pool, a refused submit, a dead link or an
+        ack timeout — the same surface local engines present, so the
+        router's admission/breaker paths need no special casing."""
+        if self.free_slots() <= 0:
+            raise RuntimeError("no free slot")
+        if self.link.dead and not self.link.try_reconnect():
+            raise RuntimeError(f"{self.name}: transport down")
+        self._submit_seq += 1
+        seq = self._submit_seq
+        prompt = [int(t) for t in prompt]
+        doc = {
+            "seq": seq, "prompt": prompt, "max_tokens": int(max_tokens),
+            "kwargs": {
+                k: v for k, v in kwargs.items() if not k.startswith("_")
+            },
+        }
+        try:
+            self.link.send_json(SUBMIT, doc)
+        except (PeerDiedError, TransportDownError):
+            raise RuntimeError(f"{self.name}: peer died on submit")
+        deadline = time.monotonic() + self.link.ack_timeout_s
+        while True:
+            self.link.pump()
+            if self.peer_pump is not None and not self.link.dead:
+                self.peer_pump()
+            self._drain_completions()
+            body = self.link.take(SUBMITTED)
+            if body is not None:
+                resp = json.loads(body.decode())
+                if int(resp.get("seq", -1)) != seq:
+                    continue
+                if not resp.get("ok"):
+                    raise RuntimeError(
+                        f"{self.name} refused submit: "
+                        f"{resp.get('error', 'full')}"
+                    )
+                rid = int(resp["rid"])
+                self._next_id = max(self._next_id, rid + 1)
+                now = self.clock()
+                self._last_progress_t = now
+                if rid in self._departed:
+                    # Completed inside the RPC window (short prompt).
+                    self._departed.discard(rid)
+                    return rid
+                # KV-less snapshot retention: enough for a surviving
+                # replica's restore() to re-prefill the stream verbatim.
+                self._resident[rid] = {
+                    "request_id": rid,
+                    "tokens": prompt,
+                    "generated": [],
+                    "max_tokens": int(max_tokens),
+                    "prompt_len": len(prompt),
+                    "ttft_slo_s": kwargs.get("ttft_slo_s"),
+                    "tpot_slo_s": kwargs.get("tpot_slo_s"),
+                    "queued_at": kwargs.get("queued_at", now),
+                    "t_first": None,
+                }
+                return rid
+            if self.link.dead:
+                raise RuntimeError(
+                    f"{self.name}: peer died awaiting submit ack"
+                )
+            if time.monotonic() >= deadline:
+                raise RuntimeError(f"{self.name}: submit ack timed out")
+            time.sleep(0.002)
+
+    # -- stepping ------------------------------------------------------------
+
+    def step_burst(self) -> int:
+        now = self.clock()
+        self._last_step_s = max(now - self._last_burst_t, 0.0)
+        self._last_burst_t = now
+        self.bursts += 1
+        self.link.pump()
+        if self.peer_pump is not None and not self.link.dead:
+            self.peer_pump()
+            self.link.pump()
+        self._drain_completions()
+        if self.link.dead:
+            self.link.try_reconnect()
+        return len(self._resident)
+
+    @terminal_retirer
+    def _drain_completions(self) -> None:
+        # Legal re-materialization point: the worker's engine retired the
+        # stream through its own funnel; this side only decodes frames.
+        from k8s_dra_driver_tpu.models.serve import Completion
+
+        while True:
+            body = self.link.take(COMPLETION)
+            if body is None:
+                break
+            doc = json.loads(body.decode())
+            rid = int(doc.get("request_id", -1))
+            if rid in self.link.reclaimed:
+                JOURNAL.record(
+                    "transport", "completion.stale_dropped",
+                    correlation=f"req-{rid}", peer=self.link.peer,
+                )
+                continue
+            if self._resident.pop(rid, None) is None:
+                self._departed.add(rid)
+            status = str(doc.get("status", "ok"))
+            generated = [int(t) for t in doc.get("generated", [])]
+            self._completed += 1
+            self._statuses[status] = self._statuses.get(status, 0) + 1
+            self.tokens_generated += len(generated)
+            self._last_progress_t = self.clock()
+            self._completions.append(Completion(
+                request_id=rid,
+                tokens=[int(t) for t in doc.get("tokens", [])],
+                generated=generated,
+                error=str(doc.get("error", "")),
+                status=status,
+            ))
+
+    def completions(self) -> list:
+        self._drain_completions()
+        out, self._completions = self._completions, []
+        return out
+
+    def cancel(self, request_id: int) -> bool:
+        if request_id not in self._resident:
+            return False
+        try:
+            self.link.send_json(CONTROL, {"op": "cancel", "rid": request_id})
+        except (PeerDiedError, TransportDownError):
+            return False
+        # The cancelled Completion rides back on the next pump.
+        return True
+
+    # -- snapshot / restore / release (live migration) -----------------------
+
+    def snapshot_active(self) -> dict:
+        return {
+            "engine": type(self).__name__,
+            "next_id": self._next_id,
+            "requests": [dict(e) for e in self._resident.values()],
+        }
+
+    def restore(self, snapshot: dict, merge: bool = False) -> list:
+        """The add_replica id-seed doc forwards to the worker as a
+        ``reseed`` CONTROL frame (stride partitioned across its
+        replicas); non-empty snapshots ship entry-by-entry as KV-less
+        PLACE frames and block for the PLACED acks."""
+        from k8s_dra_driver_tpu.models.fleet import ID_STRIDE
+
+        entries = list(snapshot.get("requests", ()))
+        if not merge and self._resident:
+            raise RuntimeError("restore needs an idle engine (use merge=True)")
+        if len(entries) > self.free_slots():
+            raise RuntimeError(
+                f"restore needs {len(entries)} slots, {self.free_slots()} free"
+            )
+        if self.link.dead and not self.link.try_reconnect():
+            raise RuntimeError(f"{self.name}: transport down")
+        base = int(snapshot.get("next_id", 0))
+        self._next_id = max(self._next_id, base)
+        try:
+            self.link.send_json(CONTROL, {
+                "op": "reseed", "next_id": base, "stride": ID_STRIDE,
+            })
+        except (PeerDiedError, TransportDownError):
+            raise RuntimeError(f"{self.name}: peer died on reseed")
+        restored: list = []
+        pending: set = set()
+        for e in entries:
+            keep = copy.deepcopy(_sanitize_entry(e))
+            rid = int(keep["request_id"])
+            try:
+                self.link.send_frame(
+                    PLACE,
+                    encode_meta_frame(
+                        PLACE, dict(keep, _correlation=f"req-{rid}"),
+                    )[_FRAME_HEADER.size:],
+                    request_id=rid,
+                )
+            except (PeerDiedError, TransportDownError):
+                raise RuntimeError(f"{self.name}: peer died on restore")
+            pending.add(rid)
+            self._resident[rid] = keep
+            restored.append(rid)
+        deadline = time.monotonic() + self.link.ack_timeout_s
+        while pending:
+            self.link.pump()
+            if self.peer_pump is not None and not self.link.dead:
+                self.peer_pump()
+            body = self.link.take(PLACED)
+            if body is not None:
+                pending.discard(int(json.loads(body.decode()).get("rid", -1)))
+                continue
+            if self.link.dead or time.monotonic() >= deadline:
+                for rid in restored:
+                    self._resident.pop(rid, None)
+                raise RuntimeError(f"{self.name}: restore acks lost")
+            time.sleep(0.002)
+        if restored:
+            self._last_progress_t = self.clock()
+        return restored
+
+    def release_active(self) -> int:
+        n = len(self._resident)
+        for rid in self._resident:
+            self.link.reclaimed.add(rid)
+        self._resident.clear()
+        try:
+            self.link.send_json(CONTROL, {"op": "release"})
+        except (PeerDiedError, TransportDownError):
+            pass  # dead worker holds nothing worth releasing
+        return n
+
+    # -- protocol conformance pump -------------------------------------------
+
+    def pump(self, requests, max_steps: int = 100_000,
+             queue_limit: int | None = None) -> list:
+        queue = []
+        for r in requests:
+            if isinstance(r, dict):
+                queue.append(dict(r))
+            else:
+                prompt, max_tokens = r
+                queue.append({"prompt": list(prompt), "max_tokens": max_tokens})
+        out: list = []
+        for _ in range(max_steps):
+            while queue:
+                kw = dict(queue[0])
+                try:
+                    self.submit(kw.pop("prompt"), kw.pop("max_tokens"), **kw)
+                except RuntimeError:
+                    break
+                queue.pop(0)
+            advance = getattr(self.clock, "advance", None)
+            if callable(advance):
+                advance(0.05)
+            self.step_burst()
+            out.extend(self.completions())
+            if not queue and not self._resident:
+                return out
+        raise RuntimeError(f"remote pump did not drain in {max_steps} steps")
+
+    # -- the load-signal contract --------------------------------------------
+
+    def stats(self):
+        """Local-knowledge EngineStats — no stats RPC per tick.  The
+        detector-relevant fields behave like a real engine's: ``bursts``
+        advances per step, ``uptime_s`` strictly advances per read, and
+        ``heartbeat_age_s``/``tokens_generated`` freeze when the worker
+        stops delivering completions — which is exactly how a dead worker
+        trips the stall/heartbeat verdicts and gets evacuated."""
+        from k8s_dra_driver_tpu.models.telemetry import EngineStats
+
+        now = self.clock()
+        self._stat_reads += 1
+        return EngineStats(
+            engine=type(self).__name__,
+            engine_seq=self.engine_seq,
+            n_slots=self.n_slots,
+            resident_slots=len(self._resident),
+            free_slots=self.free_slots(),
+            queue_depth=0,
+            admitting=0,
+            preempted=0,
+            free_blocks=None,
+            quarantined=0,
+            shed_count=0,
+            in_flight=len(self._resident),
+            completed=self._completed,
+            statuses=dict(self._statuses),
+            tokens_generated=self.tokens_generated,
+            bursts=self.bursts,
+            host_syncs=self.bursts,
+            last_step_s=self._last_step_s,
+            sync_interval=self.sync_interval,
+            uptime_s=(now - self._created_at) + self._stat_reads * 1e-9,
+            heartbeat_age_s=max(0.0, now - self._last_progress_t),
+            ttft_p50_s=0.0, ttft_p90_s=0.0, ttft_p99_s=0.0,
+            tpot_p50_s=0.0, tpot_p90_s=0.0, tpot_p99_s=0.0,
+            queue_wait_p50_s=0.0, queue_wait_p90_s=0.0,
+        )
+
+
+def make_remote_engine_factory(worker_factory=None, *, link_factory=None,
+                               n_slots: int = 8, sync_interval: int = 8,
+                               name_prefix: str = "rworker",
+                               clock=time.monotonic, link_kwargs=None):
+    """Zero-arg engine factory for :class:`FleetAutoscaler`'s flagged
+    remote-spawn path (``autoscaler.select_engine_factory``).
+
+    Two rigs, one protocol:
+
+    * ``worker_factory`` — in-process: each call builds a fresh
+      ``LoopbackConn`` pair and a :class:`PoolWorker` around the router
+      ``worker_factory()`` returns (the same protocol loop
+      ``worker_main`` drives, minus the process), pumped via
+      ``peer_pump``.  This is what the chaos tests use.
+    * ``link_factory`` — process-backed: each call returns a live
+      :class:`PeerLink` (e.g. ``hub.link_for(name)`` after spawning
+      ``python -m k8s_dra_driver_tpu.models.transport config.json``);
+      the worker pumps itself.
+
+    Exactly one of the two must be provided."""
+    if (worker_factory is None) == (link_factory is None):
+        raise ValueError(
+            "make_remote_engine_factory needs exactly one of "
+            "worker_factory (in-process rig) or link_factory (PeerLink)"
+        )
+    counter = [0]
+
+    def factory() -> RemoteWorkerEngine:
+        counter[0] += 1
+        name = f"{name_prefix}-{counter[0]}"
+        if link_factory is not None:
+            return RemoteWorkerEngine(
+                link_factory(), n_slots=n_slots,
+                sync_interval=sync_interval, name=name, clock=clock,
+            )
+        near, far = LoopbackConn.pair()
+        worker = PoolWorker(far, worker_factory())
+        link = PeerLink(name, near, clock=clock, **(link_kwargs or {}))
+        return RemoteWorkerEngine(
+            link, n_slots=n_slots, sync_interval=sync_interval,
+            name=name, clock=clock, peer_pump=worker.pump_once,
+        )
+
+    return factory
+
+
 class PoolWorker:
     """The worker-process protocol loop around one FleetRouter pool.
     Also instantiable in-process (over a :class:`LoopbackConn`) so the
@@ -1143,6 +1526,28 @@ class PoolWorker:
             elif doc.get("op") == "reset":
                 self.hold_ticks = False
                 self.router.completions()  # discard residuals
+            elif doc.get("op") == "cancel":
+                self.router.cancel(int(doc.get("rid", -1)))
+            elif doc.get("op") == "release":
+                for rep in getattr(self.router, "replicas", ()):
+                    rep.engine.release_active()
+            elif doc.get("op") == "reseed":
+                # The supervisor fleet reserved ONE id stride for this
+                # worker (RemoteWorkerEngine is one replica up there), so
+                # the worker's own engines partition that single stride —
+                # ids stay fleet-unique without a second reservation.
+                base = int(doc.get("next_id", 0))
+                reps = list(getattr(self.router, "replicas", ()))
+                slot = int(doc.get("stride", 0)) // max(1, len(reps))
+                for i, rep in enumerate(reps):
+                    rep.engine.restore(
+                        {
+                            "engine": type(rep.engine).__name__,
+                            "next_id": base + i * slot,
+                            "requests": [],
+                        },
+                        merge=True,
+                    )
         elif ftype == SUBMIT:
             doc = json.loads(body.decode())
             kwargs = doc.get("kwargs", {})

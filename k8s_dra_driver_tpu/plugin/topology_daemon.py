@@ -98,6 +98,11 @@ class DaemonState:
     # SET per peer and fail over between links.  ``channel`` stays as the
     # legacy single-link key for old consumers.
     channels: list = field(default_factory=list)
+    # Per-shape watt table (``{"1": 310, "8": 2240}`` — chip count, as a
+    # JSON-string key, to whole-device watts).  Published so the scheduler
+    # extender's power objective (scheduler/objectives.py) scores against
+    # fleet-measured numbers instead of its built-in defaults.
+    power: dict = field(default_factory=dict)
 
 
 class TopologyDaemonServer:
@@ -118,6 +123,7 @@ class TopologyDaemonServer:
         quantum_ms: int = DEFAULT_QUANTUM_MS,
         channel: Optional[dict] = None,
         channels: Optional[list] = None,
+        power: Optional[dict] = None,
     ):
         self.socket_path = socket_path
         chans = list(channels or [])
@@ -131,6 +137,7 @@ class TopologyDaemonServer:
             quantum_ms=quantum_ms,
             channel=channel or (chans[0] if chans else {}),
             channels=chans,
+            power=power or {},
         )
         self._cond = threading.Condition()
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -161,6 +168,12 @@ class TopologyDaemonServer:
             # Multi-link form: a JSON LIST of to_info() dicts.  Takes
             # precedence over the legacy single-channel variable.
             channels = json.loads(raw)
+        power: dict = {}
+        raw = environ.get("TPU_POWER_TABLE", "")
+        if raw:
+            # Per-shape watt table, JSON object (chip count -> watts) —
+            # consumed by the extender's power objective via the info doc.
+            power = json.loads(raw)
         return cls(
             socket_path,
             claim_uid=claim_uid,
@@ -170,6 +183,7 @@ class TopologyDaemonServer:
             quantum_ms=int(environ.get("TPU_QUEUE_QUANTUM_MS", DEFAULT_QUANTUM_MS)),
             channel=channel,
             channels=channels,
+            power=power,
         )
 
     # -- request handling ---------------------------------------------------
@@ -197,6 +211,7 @@ class TopologyDaemonServer:
                 "quantum_ms": self.state.quantum_ms,
                 "channel": self.state.channel,
                 "channels": self.state.channels,
+                "power": self.state.power,
                 "consumers": sorted(self.state.consumers),
                 "lease_holders": {
                     scope: lease.consumer

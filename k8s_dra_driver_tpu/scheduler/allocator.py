@@ -58,6 +58,11 @@ _CEL_EVALS = REGISTRY.counter(
     "dra_cel_evals_total",
     "CEL selector expressions actually evaluated against a device",
 )
+_GANG_PLANS = REGISTRY.counter(
+    "dra_gang_plans_total",
+    "Gang allocation attempts, by outcome "
+    "(planned | infeasible | committed | unwound)",
+)
 
 
 class AllocationError(Exception):
@@ -172,7 +177,7 @@ class Plan:
     # Union of the node's visible candidates' markers, precomputed by the
     # allocation index from per-slice marker unions.  Equivalent to the
     # union over ``free``: an allocated device's markers are all in
-    # ``used_markers`` (the consumed set records every capacity of every
+    # ``used_markers`` (the consumed set records every chip marker of every
     # allocated device), so the difference washes out in tightness().
     node_markers: frozenset = frozenset()
 
@@ -203,6 +208,16 @@ class Plan:
         return len(used & available) / len(available)
 
 
+@dataclass(frozen=True)
+class GangMember:
+    """One node-claim of a multi-host gang: this claim must land on this
+    node, together with every sibling, or not at all."""
+
+    claim: ResourceClaim
+    node_name: str
+    node_labels: Optional[dict] = None
+
+
 class Allocator:
     """Allocates pending ResourceClaims against published ResourceSlices.
 
@@ -212,14 +227,30 @@ class Allocator:
     the total inventory or the number of existing claims.
     """
 
+    # Bound on unwind retries per claim when a gang rolls back under an
+    # API fault storm: enough attempts that any limited/sub-certain fault
+    # budget converges, small enough that a permanently broken server
+    # fails loudly instead of spinning.
+    GANG_UNWIND_ATTEMPTS = 100
+
     def __init__(self, server: InMemoryAPIServer):
         self._server = server
         self._index = AllocationIndex(server)
+        self._gang_seq = 0
 
     def close(self) -> None:
         """Detach the allocation index's watches (long-lived processes that
         create throwaway Allocators against one server should call this)."""
         self._index.close()
+
+    def view(self, node_name: str = "", node_labels: Optional[dict] = None):
+        """One node's indexed :class:`~k8s_dra_driver_tpu.scheduler.index.PlanView`
+        without running a search — the cluster simulator's fragmentation
+        probe and debug surfaces read occupancy through this instead of
+        groping the private index."""
+        labels = dict(node_labels or {})
+        labels.setdefault("kubernetes.io/hostname", node_name)
+        return self._index.snapshot(node_name, labels)
 
     # -- public ------------------------------------------------------------
 
@@ -247,6 +278,15 @@ class Allocator:
                 ),
             )
             raise
+        return self._commit_plan(claim, node_name, p)
+
+    def _commit_plan(self, claim: ResourceClaim, node_name: str, p: "Plan") -> ResourceClaim:
+        """Write one planned allocation through the API server.  On update
+        failure the in-memory claim's allocation is reset to None before
+        re-raising: faults fire BEFORE the store mutates (utils/faults.py),
+        so the store still has no allocation — a retry path that kept the
+        local copy's allocation would trip allocate()'s idempotent
+        early-return and silently never persist."""
         results = [
             DeviceRequestAllocationResult(
                 request=req_name, driver=c.driver, pool=c.pool, device=c.device.name
@@ -277,7 +317,11 @@ class Allocator:
                 devices=[r.device for r in results],
             ),
         )
-        return self._server.update(claim)
+        try:
+            return self._server.update(claim)
+        except Exception:
+            claim.status.allocation = None
+            raise
 
     def plan(
         self,
@@ -394,6 +438,122 @@ class Allocator:
             used_markers=frozenset(used_markers),
             node_markers=view.node_markers,
         )
+
+    # -- gang allocation (multi-host slices, all-or-nothing) ----------------
+
+    def plan_gang(self, members: list) -> list:
+        """Plan a multi-host gang JOINTLY: each :class:`GangMember`'s claim
+        is planned on its node with every EARLIER member's chosen devices
+        and markers excluded (the `_joint_plans` discipline lifted across
+        nodes — device keys and markers are pool-scoped, so the union is
+        safe cross-node).  Returns ``[(member, Plan)]`` in member order, or
+        raises AllocationError if ANY member is infeasible — nothing was
+        committed, so there is nothing to undo (Flex-MIG's gang-execution
+        framing: the slice runs whole or not at all)."""
+        if not members:
+            raise AllocationError("empty gang")
+        plans: list = []
+        taken_keys: set = set()
+        taken_markers: set = set()
+        for m in members:
+            try:
+                p = self.plan(
+                    m.claim,
+                    node_name=m.node_name,
+                    node_labels=m.node_labels,
+                    exclude_devices=frozenset(taken_keys),
+                    extra_markers=frozenset(taken_markers),
+                )
+            except AllocationError:
+                _GANG_PLANS.inc(outcome="infeasible")
+                raise
+            for _, c in p.chosen:
+                taken_keys.add(c.key)
+                taken_markers.update(c.markers)
+            plans.append((m, p))
+        _GANG_PLANS.inc(outcome="planned")
+        return plans
+
+    def allocate_gang(self, members: list) -> list:
+        """Commit a gang atomically: plan every member first (a single
+        infeasible member aborts before ANY write), then commit member by
+        member; a failed commit unwinds every already-committed sibling in
+        reverse before raising.  Returns the updated claims in member
+        order.  One journal correlation (``gang-<n>``) spans the whole
+        attempt — begin, every commit, any unwind."""
+        self._gang_seq += 1
+        corr = f"gang-{self._gang_seq}"
+        plans = self.plan_gang(members)  # raises (and counts) if infeasible
+        JOURNAL.record_lazy(
+            "allocator", "gang.begin", correlation=corr,
+            attrs=lambda: dict(
+                members=[
+                    (m.claim.metadata.name, m.node_name) for m, _ in plans
+                ],
+            ),
+        )
+        committed: list = []
+        out: list = []
+        for m, p in plans:
+            try:
+                updated = self._commit_plan(m.claim, m.node_name, p)
+            except Exception as exc:  # noqa: BLE001 - any failed write unwinds
+                JOURNAL.record(
+                    "allocator", "gang.commit_failed", correlation=corr,
+                    claim=m.claim.metadata.name, node=m.node_name,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                self._unwind_gang(corr, committed)
+                _GANG_PLANS.inc(outcome="unwound")
+                raise AllocationError(
+                    f"gang commit failed at {m.claim.metadata.name!r} on "
+                    f"{m.node_name!r} ({type(exc).__name__}: {exc}); "
+                    f"{len(committed)} sibling(s) unwound"
+                ) from exc
+            committed.append(updated)
+            out.append(updated)
+        _GANG_PLANS.inc(outcome="committed")
+        JOURNAL.record(
+            "allocator", "gang.committed", correlation=corr,
+            members=len(out),
+        )
+        return out
+
+    def _unwind_gang(self, corr: str, committed: list) -> None:
+        """Roll back committed gang members in reverse, retrying each
+        deallocation under whatever fault storm broke the commit.  Every
+        attempt REFETCHES the claim: the store deep-copies on update, so
+        retrying with the stale in-memory object after an injected
+        conflict would fight resourceVersions forever."""
+        for claim in reversed(committed):
+            name = claim.metadata.name
+            namespace = claim.metadata.namespace
+            last: Exception | None = None
+            for _ in range(self.GANG_UNWIND_ATTEMPTS):
+                try:
+                    current = self._server.get(ResourceClaim.KIND, name, namespace)
+                    if current.status.allocation is None:
+                        last = None
+                        break
+                    self.deallocate(current)
+                    last = None
+                    break
+                except Exception as exc:  # noqa: BLE001 - retry under storm
+                    last = exc
+            if last is not None:
+                # Leaked reservation: loud, journaled, never silent.
+                JOURNAL.record(
+                    "allocator", "gang.unwind_leak", correlation=corr,
+                    claim=name,
+                    error=f"{type(last).__name__}: {last}",
+                )
+                raise AllocationError(
+                    f"gang unwind could not deallocate {name!r} after "
+                    f"{self.GANG_UNWIND_ATTEMPTS} attempts: {last}"
+                ) from last
+            JOURNAL.record(
+                "allocator", "gang.unwound", correlation=corr, claim=name,
+            )
 
     def deallocate(self, claim: ResourceClaim) -> ResourceClaim:
         if claim.status.reserved_for:

@@ -1,0 +1,741 @@
+"""Seeded synthetic-cluster churn simulator: plan() at production scale.
+
+ROADMAP item 3's complaint is that the allocation path has only ever been
+measured on toy inventories — a handful of pools, no churn, no faults.
+This module builds a synthetic cluster of **thousands of nodes/pools**
+with realistic v5e/v6e slice-shape inventories and drives the REAL
+`AllocationIndex` + `Allocator.plan()` through compressed time, the way
+``models/workload.py::replay()`` drives the fleet: an event heap of claim
+arrivals (exponential interarrivals), binds, and releases (lognormal
+lifetimes), with conflict/error storms from `utils/faults.py` armed in
+windows mid-run.  Nothing in the hot path is mocked — claims go through
+the in-memory API server, allocations through `allocate()`/
+`allocate_gang()`, occupancy through the index's watch events.
+
+Every claim is accounted **exactly once**: the simulator keeps its own
+ledger (submitted = bound + infeasible + failed; bound = live + released)
+and periodically *relists* the server's claims to cross-check the ledger
+against the store — the audit that catches a double-bind, a leaked
+allocation after a gang unwind, or an index that drifted under a fault
+storm.  The run fails loudly on any mismatch.
+
+Measured outputs (`SimReport`):
+
+* plan() latency p50/p90 across every scored candidate node,
+* packing efficiency — served chip-seconds / offered chip-seconds (how
+  much of the demand the placement policy actually managed to pack),
+* fragmentation — mean stranded-free fraction over a seeded node sample:
+  free chips no intact multi-chip subslice can cover (the arxiv
+  2502.01909 fragmentation measure mapped onto ICI markers),
+* gang outcomes (committed / infeasible / unwound) and audit failures.
+
+`bench.py plan_scale` runs this at 1k/10k pools with single-objective
+(`TIGHTNESS_WEIGHTS`) vs multi-objective (`DEFAULT_WEIGHTS`) scoring on
+identical seeds; `make sim-cluster` wires the chaos suite into tier-1.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import random
+import time
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import (
+    SUBSLICE_CLASS,
+    TPU_CLASS,
+    install_device_classes,
+    simple_claim,
+)
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import (
+    BasicDevice,
+    Device,
+    DeviceAttribute,
+    ObjectMeta,
+    ResourceClaim,
+    ResourcePool,
+    ResourceSlice,
+    ResourceSliceSpec,
+)
+from k8s_dra_driver_tpu.plugin.geometry import chip_marker
+from k8s_dra_driver_tpu.scheduler import objectives
+from k8s_dra_driver_tpu.scheduler.allocator import (
+    AllocationError,
+    Allocator,
+    GangMember,
+)
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+_SIM_CLAIMS = REGISTRY.counter(
+    "dra_sim_claims_total",
+    "Simulator claim lifecycle events, by outcome "
+    "(bound | infeasible | failed | released | gang_committed | "
+    "gang_infeasible | gang_unwound)",
+)
+_SIM_PACKING = REGISTRY.gauge(
+    "dra_sim_packing_efficiency",
+    "Simulator packing efficiency: served chip-seconds / offered chip-seconds",
+)
+_SIM_FRAG = REGISTRY.gauge(
+    "dra_sim_fragmentation",
+    "Simulator fragmentation: mean stranded-free-chip fraction over sampled nodes",
+)
+_SIM_AUDIT_FAILURES = REGISTRY.counter(
+    "dra_sim_audit_failures_total",
+    "Simulator relist audits that found ledger/store disagreement",
+)
+
+
+class SimAccountingError(AssertionError):
+    """The relist audit found a claim accounted zero or twice."""
+
+
+# -- synthetic inventory -----------------------------------------------------
+
+# (kind, generation, 2D chip grid).  The grids mirror the per-host chip
+# counts of real v5e/v6e machine types (4- and 8-chip hosts).
+NODE_TEMPLATES: tuple = (
+    ("v5e-4", "v5e", (2, 2)),
+    ("v5e-8", "v5e", (2, 4)),
+    ("v6e-8", "v6e", (2, 4)),
+)
+
+# Published subslice extents per grid: aligned power-of-two blocks, the
+# same inventory discipline as plugin/geometry.enumerate_subslices but
+# over the simulator's synthetic 2D grids (no tpuinfo binding).  The
+# (1, 1) block is the chip device itself, published separately.
+_EXTENTS = (1, 2, 4, 8)
+
+
+def _node_devices(grid: tuple[int, int], generation: str) -> list[Device]:
+    """Per-chip devices plus aligned multi-chip subslice devices for one
+    node, sharing ``chip%d`` capacity markers so overlapping shapes can
+    never be double-booked (the geometry.py non-overlap invariant)."""
+    w, h = grid
+    common = {
+        "generation": DeviceAttribute.of(generation),
+        "healthy": DeviceAttribute.of(True),
+    }
+    devices: list[Device] = []
+    for y in range(h):
+        for x in range(w):
+            i = x + y * w
+            devices.append(
+                Device(
+                    name=f"chip{i}",
+                    basic=BasicDevice(
+                        attributes={
+                            "type": DeviceAttribute.of("tpu"),
+                            "index": DeviceAttribute.of(i),
+                            **common,
+                        },
+                        capacity={"hbm": "16Gi", chip_marker(i): "1"},
+                    ),
+                )
+            )
+    for ew in _EXTENTS:
+        if ew > w or w % ew:
+            continue
+        for eh in _EXTENTS:
+            if eh > h or h % eh or ew * eh < 2:
+                continue
+            for oy in range(0, h, eh):
+                for ox in range(0, w, ew):
+                    members = [
+                        (ox + dx) + (oy + dy) * w
+                        for dy in range(eh)
+                        for dx in range(ew)
+                    ]
+                    capacity = {"hbm": f"{16 * len(members)}Gi"}
+                    for i in members:
+                        capacity[chip_marker(i)] = "1"
+                    devices.append(
+                        Device(
+                            name=f"ss-{ew}x{eh}-{ox}-{oy}",
+                            basic=BasicDevice(
+                                attributes={
+                                    "type": DeviceAttribute.of("subslice"),
+                                    "shape": DeviceAttribute.of(f"{ew}x{eh}"),
+                                    "chipCount": DeviceAttribute.of(len(members)),
+                                    **common,
+                                },
+                                capacity=capacity,
+                            ),
+                        )
+                    )
+    return devices
+
+
+# -- configuration -----------------------------------------------------------
+
+@dataclass
+class StormWindow:
+    """One fault-storm window: ``profile`` is armed at ``start_s`` of sim
+    time and disarmed at ``start_s + duration_s``."""
+
+    start_s: float
+    duration_s: float
+    profile: FaultProfile
+
+
+@dataclass
+class SimConfig:
+    seed: int = 0
+    n_nodes: int = 1000
+    # Node mix weights over NODE_TEMPLATES, in order.
+    node_mix: tuple = (0.35, 0.35, 0.30)
+    duration_s: float = 600.0  # simulated seconds of churn
+    arrival_rate: float = 2.0  # claims per simulated second
+    # Lognormal lifetime of a bound claim (simulated seconds).
+    lifetime_mu: float = 4.0
+    lifetime_sigma: float = 0.8
+    # Claim chip-count mix: (chips, weight).  Large shapes are what make
+    # fragmentation a real objective — a cluster of 1-chip claims never
+    # strands anything.
+    claim_mix: tuple = ((1, 0.40), (2, 0.25), (4, 0.22), (8, 0.13))
+    fanout: int = 6  # candidate nodes scored per arrival
+    gang_fraction: float = 0.08  # fraction of arrivals that are gangs
+    gang_size: int = 3  # node-claims per gang
+    weights: dict = field(
+        default_factory=lambda: dict(objectives.DEFAULT_WEIGHTS)
+    )
+    power_table: dict = field(
+        default_factory=lambda: dict(objectives.DEFAULT_POWER_TABLE)
+    )
+    storms: tuple = ()  # StormWindow list
+    audit_interval_s: float = 60.0  # relist / fragmentation sample cadence
+    sample_nodes: int = 64  # nodes probed per fragmentation sample
+    bind_attempts: int = 200  # API retries per bind/release under storms
+
+
+def default_storms() -> tuple:
+    """The `make sim-cluster` chaos recipe: a 409 storm and an APIError
+    burst against claim writes mid-run, both budget-capped so the retry
+    paths converge deterministically."""
+    return (
+        StormWindow(
+            start_s=120.0,
+            duration_s=90.0,
+            profile=FaultProfile(
+                name="sim-conflict-storm",
+                conflict_rate=0.35,
+                verbs=("PUT",),
+                kinds=("ResourceClaim",),
+                limit=300,
+            ),
+        ),
+        StormWindow(
+            start_s=300.0,
+            duration_s=60.0,
+            profile=FaultProfile(
+                name="sim-error-burst",
+                error_rate=0.25,
+                error_code=500,
+                verbs=("PUT", "POST", "DELETE"),
+                kinds=("ResourceClaim",),
+                limit=200,
+            ),
+        ),
+    )
+
+
+# -- report ------------------------------------------------------------------
+
+@dataclass
+class SimReport:
+    n_nodes: int = 0
+    seed: int = 0
+    duration_s: float = 0.0
+    total_chips: int = 0
+    submitted: int = 0
+    bound: int = 0
+    infeasible: int = 0
+    failed: int = 0
+    released: int = 0
+    gangs_submitted: int = 0
+    gangs_committed: int = 0
+    gangs_infeasible: int = 0
+    gangs_unwound: int = 0
+    audits: int = 0
+    audit_failures: int = 0
+    leaked_claims: int = 0
+    plan_samples: int = 0
+    plan_p50_ms: float = 0.0
+    plan_p90_ms: float = 0.0
+    packing_efficiency: float = 0.0
+    fragmentation: float = 0.0  # mean over samples
+    fragmentation_final: float = 0.0
+    utilization_mean: float = 0.0
+    wall_s: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__, sort_keys=True)
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+# -- the simulator -----------------------------------------------------------
+
+_ARRIVE, _RELEASE, _AUDIT, _STORM_ON, _STORM_OFF = range(5)
+
+
+class ClusterSim:
+    """One seeded churn run over a synthetic cluster.
+
+    Deterministic by construction: one ``random.Random(seed)`` drives
+    arrivals, lifetimes, node sampling and claim shapes; the fault
+    injector gets ``seed + 1``.  Two runs with the same config produce
+    identical event sequences (the gang-atomicity property tests replay
+    runs from their seed)."""
+
+    def __init__(self, config: SimConfig | None = None):
+        self.config = config or SimConfig()
+        self.rng = random.Random(self.config.seed)
+        self.injector = FaultInjector(seed=self.config.seed + 1)
+        self.server = InMemoryAPIServer(fault_injector=self.injector)
+        install_device_classes(self.server)
+        self.nodes: list[tuple[str, dict, int]] = []  # (name, labels, chips)
+        self.total_chips = 0
+        self.report = SimReport(
+            n_nodes=self.config.n_nodes,
+            seed=self.config.seed,
+            duration_s=self.config.duration_s,
+        )
+        self._build_cluster()
+        self.allocator = Allocator(self.server)
+        # Ledger: claim name -> (chips, release_t) while live.
+        self._live: dict[str, tuple[int, float]] = {}
+        self._claim_seq = 0
+        self._plan_ms: list[float] = []
+        self._frag_samples: list[float] = []
+        self._util_samples: list[float] = []
+        self._offered_cs = 0.0
+        self._served_cs = 0.0
+
+    # -- inventory ----------------------------------------------------------
+
+    def _build_cluster(self) -> None:
+        cfg = self.config
+        kinds = list(NODE_TEMPLATES)
+        weights = list(cfg.node_mix)
+        # Device lists are immutable per template — build each once and
+        # share: the server deep-copies on create, so sharing the template
+        # is safe and keeps 10k-node startup off the profile.
+        cache: dict[str, list[Device]] = {}
+        for i in range(cfg.n_nodes):
+            kind, generation, grid = self.rng.choices(kinds, weights)[0]
+            name = f"node-{i:05d}-{kind}"
+            devices = cache.get(kind)
+            if devices is None:
+                devices = cache[kind] = _node_devices(grid, generation)
+            self.server.create(
+                ResourceSlice(
+                    metadata=ObjectMeta(name=f"{name}-slice"),
+                    spec=ResourceSliceSpec(
+                        driver=DRIVER_NAME,
+                        pool=ResourcePool(name=name, generation=1),
+                        node_name=name,
+                        devices=devices,
+                    ),
+                )
+            )
+            chips = grid[0] * grid[1]
+            labels = {"kubernetes.io/hostname": name, "tpu.google.com/kind": kind}
+            self.nodes.append((name, labels, chips))
+            self.total_chips += chips
+        self.report.total_chips = self.total_chips
+
+    # -- claim construction -------------------------------------------------
+
+    def _new_claim(self, chips: int) -> ResourceClaim:
+        self._claim_seq += 1
+        name = f"sim-claim-{self._claim_seq:06d}"
+        if chips <= 1:
+            return simple_claim(name, device_class=TPU_CLASS, count=1)
+        return simple_claim(
+            name,
+            device_class=SUBSLICE_CLASS,
+            count=1,
+            selectors=[
+                f"device.attributes['{DRIVER_NAME}'].chipCount == {chips}"
+            ],
+        )
+
+    # -- fault-tolerant API verbs ------------------------------------------
+
+    def _retry(self, what: str, fn):
+        """Retry a store verb through injected Conflicts/APIErrors.  Faults
+        fire BEFORE the store mutates (utils/faults.py), so a failed verb
+        can always be retried verbatim; profiles are budget-capped, so the
+        loop converges.  Exhaustion raises — a silent drop here would be a
+        mis-accounted claim."""
+        last: Exception | None = None
+        for _ in range(self.config.bind_attempts):
+            try:
+                return fn()
+            except AllocationError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - injected Conflict/APIError
+                last = exc
+        raise SimAccountingError(f"{what}: retries exhausted: {last}")
+
+    def _bind(self, claim: ResourceClaim, node: str, labels: dict) -> ResourceClaim:
+        def attempt():
+            # REFETCH each try: a failed update left the local copy's
+            # allocation reset, but resourceVersion may have moved.
+            current = self._retry(
+                "get", lambda: self.server.get(
+                    ResourceClaim.KIND, claim.metadata.name,
+                    claim.metadata.namespace,
+                )
+            )
+            return self.allocator.allocate(
+                current, node_name=node, node_labels=labels
+            )
+
+        return self._retry(f"bind {claim.metadata.name}", attempt)
+
+    def _unbind(self, name: str, namespace: str = "default") -> None:
+        def attempt():
+            current = self.server.get(ResourceClaim.KIND, name, namespace)
+            if current.status.allocation is not None:
+                self.allocator.deallocate(current)
+            return True
+
+        self._retry(f"release {name}", attempt)
+        self._retry(
+            f"delete {name}",
+            lambda: self.server.delete(ResourceClaim.KIND, name, namespace),
+        )
+
+    # -- event handlers -----------------------------------------------------
+
+    def _score_nodes(self, claim: ResourceClaim, candidates: list) -> list:
+        """(score, -1*tie, name, labels, plan) per feasible candidate node,
+        best first.  Every plan() call is timed — this IS the latency
+        sample the report's p50/p90 comes from."""
+        scored = []
+        for name, labels, _ in candidates:
+            t0 = time.perf_counter()
+            try:
+                plan = self.allocator.plan(claim, node_name=name, node_labels=labels)
+            except AllocationError:
+                self._plan_ms.append((time.perf_counter() - t0) * 1000.0)
+                continue
+            self._plan_ms.append((time.perf_counter() - t0) * 1000.0)
+            total = objectives.score_plan(
+                plan,
+                weights=self.config.weights,
+                power_table=self.config.power_table,
+            ).total
+            # Quantize to the extender's 0..10 wire contract: the
+            # kube-scheduler never sees the float, so the simulator must
+            # not rank on precision the real system cannot express.  The
+            # coarse bins also make near-ties collapse onto the name
+            # tie-break, the same first-fit concentration the extender's
+            # deterministic node ordering produces in a real cluster.
+            scored.append((round(10 * total), name, labels, plan))
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        return scored
+
+    def _arrive(self, now: float) -> None:
+        cfg = self.config
+        chips = self.rng.choices(
+            [c for c, _ in cfg.claim_mix], [w for _, w in cfg.claim_mix]
+        )[0]
+        lifetime = self.rng.lognormvariate(cfg.lifetime_mu, cfg.lifetime_sigma)
+        candidates = self.rng.sample(self.nodes, min(cfg.fanout, len(self.nodes)))
+        if cfg.gang_fraction > 0 and self.rng.random() < cfg.gang_fraction:
+            self._arrive_gang(now, chips, lifetime, candidates)
+            return
+        self.report.submitted += 1
+        self._offered_cs += chips * lifetime
+        claim = self._new_claim(chips)
+        claim = self._retry(
+            f"create {claim.metadata.name}", lambda: self.server.create(claim)
+        )
+        scored = self._score_nodes(claim, candidates)
+        if not scored:
+            self.report.infeasible += 1
+            _SIM_CLAIMS.inc(outcome="infeasible")
+            self._retry(
+                f"delete {claim.metadata.name}",
+                lambda: self.server.delete(
+                    ResourceClaim.KIND, claim.metadata.name,
+                    claim.metadata.namespace,
+                ),
+            )
+            return
+        _, node, labels, _ = scored[0]
+        try:
+            bound = self._bind(claim, node, labels)
+        except AllocationError:
+            # Lost a race against a concurrent event between plan and bind
+            # (single-threaded here, so this is storm-driven state drift).
+            self.report.infeasible += 1
+            _SIM_CLAIMS.inc(outcome="infeasible")
+            self._retry(
+                f"delete {claim.metadata.name}",
+                lambda: self.server.delete(
+                    ResourceClaim.KIND, claim.metadata.name,
+                    claim.metadata.namespace,
+                ),
+            )
+            return
+        self.report.bound += 1
+        _SIM_CLAIMS.inc(outcome="bound")
+        self._served_cs += chips * lifetime
+        self._live[bound.metadata.name] = (chips, now + lifetime)
+        heapq.heappush(
+            self._events,
+            (now + lifetime, self._seq(), _RELEASE, bound.metadata.name),
+        )
+
+    def _arrive_gang(self, now: float, chips: int, lifetime: float,
+                     candidates: list) -> None:
+        cfg = self.config
+        self.report.gangs_submitted += 1
+        size = min(cfg.gang_size, len(candidates))
+        self.report.submitted += size
+        self._offered_cs += chips * lifetime * size
+        # Rank candidate nodes by a probe member's score, take the top
+        # ``size`` distinct nodes as the gang's placement.
+        probe = self._new_claim(chips)
+        scored = self._score_nodes(probe, candidates)
+        self._claim_seq -= 1  # probe claim was never created server-side
+        if len(scored) < size:
+            self.report.infeasible += size
+            self.report.gangs_infeasible += 1
+            _SIM_CLAIMS.inc(outcome="gang_infeasible")
+            return
+        members = []
+        for _, node, labels, _ in scored[:size]:
+            claim = self._new_claim(chips)
+            claim = self._retry(
+                f"create {claim.metadata.name}",
+                lambda c=claim: self.server.create(c),
+            )
+            members.append(GangMember(claim=claim, node_name=node, node_labels=labels))
+        try:
+            committed = self._retry(
+                "gang allocate", lambda: self._gang_attempt(members)
+            )
+        except (AllocationError, SimAccountingError):
+            for m in members:
+                self._retry(
+                    f"delete {m.claim.metadata.name}",
+                    lambda mm=m: self.server.delete(
+                        ResourceClaim.KIND, mm.claim.metadata.name,
+                        mm.claim.metadata.namespace,
+                    ),
+                )
+            self.report.infeasible += size
+            self.report.gangs_infeasible += 1
+            _SIM_CLAIMS.inc(outcome="gang_infeasible")
+            return
+        self.report.gangs_committed += 1
+        _SIM_CLAIMS.inc(outcome="gang_committed")
+        for claim in committed:
+            self.report.bound += 1
+            _SIM_CLAIMS.inc(outcome="bound")
+            self._served_cs += chips * lifetime
+            self._live[claim.metadata.name] = (chips, now + lifetime)
+            heapq.heappush(
+                self._events,
+                (now + lifetime, self._seq(), _RELEASE, claim.metadata.name),
+            )
+
+    def _gang_attempt(self, members: list) -> list:
+        """One allocate_gang try with refetched members — after a storm
+        unwind, the claims must be re-read (committed-then-unwound members
+        have new resourceVersions and no allocation)."""
+        fresh = []
+        for m in members:
+            current = self.server.get(
+                ResourceClaim.KIND, m.claim.metadata.name,
+                m.claim.metadata.namespace,
+            )
+            fresh.append(GangMember(
+                claim=current, node_name=m.node_name, node_labels=m.node_labels,
+            ))
+        try:
+            return self.allocator.allocate_gang(fresh)
+        except AllocationError as exc:
+            # Unwound commits re-raise as AllocationError; distinguish a
+            # genuinely infeasible gang (give up) from a storm-broken one
+            # (retry) by whether anything was unwound.
+            if "unwound" in str(exc):
+                self.report.gangs_unwound += 1
+                _SIM_CLAIMS.inc(outcome="gang_unwound")
+                raise RuntimeError("gang unwound under storm; retry") from exc
+            raise
+
+    def _release(self, name: str) -> None:
+        self._unbind(name)
+        self._live.pop(name, None)
+        self.report.released += 1
+        _SIM_CLAIMS.inc(outcome="released")
+
+    # -- audits -------------------------------------------------------------
+
+    def _audit(self) -> None:
+        """Relist the store and reconcile against the ledger: every claim
+        with an allocation must be exactly one live ledger entry and vice
+        versa — the exactly-once accounting check."""
+        self.report.audits += 1
+        allocated = {
+            c.metadata.name
+            for c in self.server.list(ResourceClaim.KIND)
+            if c.status.allocation is not None
+        }
+        ledger = set(self._live)
+        if allocated != ledger:
+            self.report.audit_failures += 1
+            _SIM_AUDIT_FAILURES.inc()
+            JOURNAL.record(
+                "cluster_sim", "audit.mismatch",
+                store_only=sorted(allocated - ledger)[:5],
+                ledger_only=sorted(ledger - allocated)[:5],
+            )
+        self._sample_fragmentation()
+
+    def _sample_fragmentation(self) -> None:
+        """Stranded-free fraction over a seeded node sample: free chips
+        that NO intact (fully-free) multi-chip subslice device covers.
+        Also samples cluster utilization over the same nodes."""
+        sample = self.rng.sample(
+            self.nodes, min(self.config.sample_nodes, len(self.nodes))
+        )
+        stranded_total = 0
+        free_total = 0
+        chips_total = 0
+        for name, labels, chips in sample:
+            view = self.allocator.view(name, labels)
+            free = set(view.node_markers) - view.used_markers
+            chips_total += chips
+            if not free:
+                continue
+            intact: set = set()
+            for c in view.candidates:
+                m = c.markers
+                if len(m) >= 2 and not (m & view.used_markers):
+                    intact |= m
+            stranded_total += len(free - intact)
+            free_total += len(free)
+        if free_total:
+            frac = stranded_total / free_total
+            self._frag_samples.append(frac)
+            _SIM_FRAG.set(frac)
+        if chips_total:
+            used = chips_total - free_total
+            self._util_samples.append(used / chips_total)
+
+    # -- main loop ----------------------------------------------------------
+
+    def _seq(self) -> int:
+        self._event_seq += 1
+        return self._event_seq
+
+    def run(self) -> SimReport:
+        cfg = self.config
+        wall0 = time.perf_counter()
+        self._events: list = []
+        self._event_seq = 0
+        # Seed the schedule: first arrival, audits, storm windows.
+        heapq.heappush(self._events, (0.0, self._seq(), _ARRIVE, None))
+        t = cfg.audit_interval_s
+        while t < cfg.duration_s:
+            heapq.heappush(self._events, (t, self._seq(), _AUDIT, None))
+            t += cfg.audit_interval_s
+        for storm in cfg.storms:
+            heapq.heappush(
+                self._events, (storm.start_s, self._seq(), _STORM_ON, storm)
+            )
+            heapq.heappush(
+                self._events,
+                (storm.start_s + storm.duration_s, self._seq(), _STORM_OFF, storm),
+            )
+        JOURNAL.record(
+            "cluster_sim", "run.begin", nodes=cfg.n_nodes, seed=cfg.seed,
+            duration_s=cfg.duration_s, arrival_rate=cfg.arrival_rate,
+        )
+        while self._events:
+            now, _, kind, payload = heapq.heappop(self._events)
+            if kind == _ARRIVE:
+                if now < cfg.duration_s:
+                    self._arrive(now)
+                    gap = self.rng.expovariate(cfg.arrival_rate)
+                    heapq.heappush(
+                        self._events, (now + gap, self._seq(), _ARRIVE, None)
+                    )
+            elif kind == _RELEASE:
+                self._release(payload)
+            elif kind == _AUDIT:
+                self._audit()
+            elif kind == _STORM_ON:
+                self.injector.arm(payload.profile)
+            elif kind == _STORM_OFF:
+                self.injector.disarm(payload.profile.name)
+        # Drain done (RELEASE events past duration_s still ran).  Disarm
+        # everything and run the final audit: the cluster must be empty.
+        self.injector.disarm()
+        self._audit()
+        self.report.leaked_claims = len(self._live) + sum(
+            1
+            for c in self.server.list(ResourceClaim.KIND)
+            if c.status.allocation is not None
+        )
+        self._finalize(wall0)
+        JOURNAL.record(
+            "cluster_sim", "run.end", bound=self.report.bound,
+            released=self.report.released,
+            audit_failures=self.report.audit_failures,
+            leaked=self.report.leaked_claims,
+        )
+        return self.report
+
+    def _finalize(self, wall0: float) -> None:
+        r = self.report
+        r.plan_samples = len(self._plan_ms)
+        r.plan_p50_ms = round(_percentile(self._plan_ms, 0.50), 3)
+        r.plan_p90_ms = round(_percentile(self._plan_ms, 0.90), 3)
+        r.packing_efficiency = round(
+            self._served_cs / self._offered_cs if self._offered_cs else 0.0, 4
+        )
+        r.fragmentation = round(
+            sum(self._frag_samples) / len(self._frag_samples)
+            if self._frag_samples else 0.0, 4
+        )
+        r.fragmentation_final = round(
+            self._frag_samples[-1] if self._frag_samples else 0.0, 4
+        )
+        r.utilization_mean = round(
+            sum(self._util_samples) / len(self._util_samples)
+            if self._util_samples else 0.0, 4
+        )
+        r.wall_s = round(time.perf_counter() - wall0, 2)
+        _SIM_PACKING.set(r.packing_efficiency)
+
+    def close(self) -> None:
+        self.allocator.close()
+
+
+def run_sim(config: SimConfig | None = None) -> SimReport:
+    """Build, run, close — the one-call surface bench.py and the chaos
+    suite use."""
+    sim = ClusterSim(config)
+    try:
+        return sim.run()
+    finally:
+        sim.close()

@@ -13,10 +13,13 @@ tightness policy) can delegate:
 * ``POST /filter`` — for each candidate node, dry-run every one of the
   pod's ResourceClaims (`Allocator.plan`, no write); nodes where any claim
   is unsatisfiable land in ``failedNodes`` with the allocator's reason.
-* ``POST /prioritize`` — score feasible nodes 0..10 by plan *tightness*
-  (fraction of the node's free chip markers consumed — MostAllocated-style
-  packing, so small claims densify broken regions and intact blocks
-  survive for whole-subslice claims).
+* ``POST /prioritize`` — score feasible nodes 0..10 by the weighted
+  multi-objective :class:`~k8s_dra_driver_tpu.scheduler.objectives.PlanScore`
+  (packing tightness, remaining-geometry fragmentation, stranding risk,
+  power, spread — weights from ``DRA_SCORE_WEIGHTS``).  ``PlanScore.total``
+  is in [0, 1], so ``round(MAX_PRIORITY * total)`` stays on the upstream
+  0..10 wire contract.  Scoring failures are journaled per node and counted
+  (``dra_extender_score_errors_total``) instead of silently zeroing.
 * ``POST /bind`` — commit: allocate all claims, reserve them for the pod,
   then bind the pod to the node; every step is compensated on failure
   (deallocate/unreserve in reverse) so a lost race leaves no partial state.
@@ -42,9 +45,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from k8s_dra_driver_tpu.e2e.harness import claim_name_for_ref
 from k8s_dra_driver_tpu.kube.objects import Node, Pod, ResourceClaim
+from k8s_dra_driver_tpu.scheduler import objectives
 from k8s_dra_driver_tpu.scheduler.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 
 MAX_PRIORITY = 10  # upstream extender/v1 MaxExtenderPriority
+
+_SCORE_ERRORS = REGISTRY.counter(
+    "dra_extender_score_errors_total",
+    "prioritize() scoring failures that zeroed a node, by exception type",
+)
 
 
 class SchedulerExtender:
@@ -62,9 +73,18 @@ class SchedulerExtender:
 
     def __init__(self, server, allocator: Allocator | None = None,
                  port: int = 0, bind_host: str = "127.0.0.1",
-                 tls_cert: str | None = None, tls_key: str | None = None):
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 weights: dict | None = None,
+                 power_table: dict | None = None):
         self._server = server
         self._allocator = allocator or Allocator(server)
+        # Scoring policy: explicit weights win; otherwise DRA_SCORE_WEIGHTS
+        # (weights_from_env raises on a malformed spec — a typo'd production
+        # knob must fail deploy, not silently revert to defaults).
+        self._weights = weights if weights is not None else objectives.weights_from_env()
+        self._power_table = (
+            power_table if power_table is not None else dict(objectives.DEFAULT_POWER_TABLE)
+        )
         self._lock = threading.Lock()  # one verb at a time: plan vs bind races
         outer = self
 
@@ -200,8 +220,24 @@ class SchedulerExtender:
                 try:
                     plans = self._joint_plans(claims, name, labels)
                     if plans:
-                        score = max(p.tightness() for p in plans)
+                        score = max(
+                            objectives.score_plan(
+                                p,
+                                weights=self._weights,
+                                power_table=self._power_table,
+                            ).total
+                            for p in plans
+                        )
                 except AllocationError:
+                    # Infeasible is a normal verdict (the node just loses),
+                    # not a scoring failure — no error metric.
+                    score = 0.0
+                except Exception as exc:  # noqa: BLE001 - zero the node LOUDLY
+                    _SCORE_ERRORS.inc(reason=type(exc).__name__)
+                    JOURNAL.record(
+                        "extender", "score.error", node=name,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
                     score = 0.0
                 out.append({"host": name, "score": round(MAX_PRIORITY * score)})
         return out

@@ -52,7 +52,7 @@ _INDEX_MISSES = REGISTRY.counter(
 )
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: groups live in per-node index lists
 class _SliceGroup:
     """Candidates of ONE backing ResourceSlice, plus its node scoping."""
 
@@ -90,11 +90,21 @@ class AllocationIndex:
         self._pools: dict[tuple[str, str], _PoolSnapshot] = {}
         self._dirty_pools: set[tuple[str, str]] = set()
         self._classes: dict[str, object] = {}
+        # Node-scoped group indexes: snapshot(node) walks only the groups
+        # that can possibly be visible to that node, not every pool in the
+        # cluster — at 10k pools the all-pools walk IS the plan() cost.
+        self._node_groups: dict[str, list] = {}  # node_name -> [_SliceGroup]
+        self._global_groups: list = []  # all-nodes / node-selector groups
         # claim uid -> tuple of consuming (driver, pool, device) result keys
         self._claim_alloc: dict[str, tuple] = {}
         self._consumed_dirty = True
         self._in_use: set = set()
         self._used_markers: set = set()
+        # Refcounts behind the consumed sets: several claims may pin the
+        # same device key or chip marker transiently (unwind races), so
+        # set-removal on delta must only fire when the LAST holder leaves.
+        self._in_use_refs: dict = {}
+        self._marker_refs: dict = {}
         self._device_index: dict | None = None
         self._watches: list = []
         # Live (event-driven) mode requires synchronous in-process watch
@@ -127,17 +137,24 @@ class AllocationIndex:
             self._dirty_pools.clear()
             candidates: list = []
             markers: set = set()
-            for snap in self._pools.values():
+            # Only this node's own groups plus the cluster-global ones are
+            # consulted — pools pinned to OTHER nodes never enter the walk.
+            for g in self._node_groups.get(node_name, ()):
+                if g.node_selector is not None and not g.node_selector.matches(
+                    node_labels
+                ):
+                    continue
                 _INDEX_HITS.inc()
-                for g in snap.groups:
-                    if g.node_name and g.node_name != node_name:
-                        continue
-                    if g.node_selector is not None and not g.node_selector.matches(
-                        node_labels
-                    ):
-                        continue
-                    candidates.extend(g.candidates)
-                    markers |= g.marker_union
+                candidates.extend(g.candidates)
+                markers |= g.marker_union
+            for g in self._global_groups:
+                if g.node_selector is not None and not g.node_selector.matches(
+                    node_labels
+                ):
+                    continue
+                _INDEX_HITS.inc()
+                candidates.extend(g.candidates)
+                markers |= g.marker_union
             if self._consumed_dirty:
                 self._rebuild_consumed()
             return PlanView(
@@ -173,8 +190,9 @@ class AllocationIndex:
         uid = c.metadata.uid
         with self._lock:
             if event.type == "DELETED":
-                if self._claim_alloc.pop(uid, None):
-                    self._consumed_dirty = True
+                old = self._claim_alloc.pop(uid, None)
+                if old:
+                    self._consumed_delta(old, ())
                 return
             self._apply_claim(uid, c)
 
@@ -195,12 +213,56 @@ class AllocationIndex:
                 for r in alloc.devices.results
                 if not r.admin_access  # admin access observes, never consumes
             )
+        old = self._claim_alloc.get(uid)
         if results:
-            if self._claim_alloc.get(uid) != results:
+            if old != results:
                 self._claim_alloc[uid] = results
-                self._consumed_dirty = True
-        elif self._claim_alloc.pop(uid, None) is not None:
+                self._consumed_delta(old or (), results)
+        elif old is not None:
+            del self._claim_alloc[uid]
+            self._consumed_delta(old, ())
+
+    def _consumed_delta(self, old: tuple, new: tuple) -> None:
+        """Apply one claim's allocation change to the consumed sets
+        incrementally.  Falls back to marking dirty (full rebuild at next
+        snapshot) when a rebuild is already pending or the device index is
+        invalidated — deltas against stale refcounts would corrupt them."""
+        if self._consumed_dirty or self._device_index is None:
             self._consumed_dirty = True
+            return
+        for key in old:
+            if key not in new:
+                self._consumed_ref(key, -1)
+        for key in new:
+            if key not in old:
+                self._consumed_ref(key, +1)
+
+    def _consumed_ref(self, key: tuple, step: int) -> None:
+        n = self._in_use_refs.get(key, 0) + step
+        if n <= 0:
+            self._in_use_refs.pop(key, None)
+            self._in_use.discard(key)
+        else:
+            self._in_use_refs[key] = n
+            self._in_use.add(key)
+        dev = self._device_index.get(key)
+        if dev is None:
+            # Allocation names a device we can't resolve (slice churn racing
+            # the claim event) — punt to the full rebuild.
+            self._consumed_dirty = True
+            return
+        pool = key[1]
+        for cap in dev.basic.capacity:
+            if not cap.startswith("chip"):
+                continue  # hbm etc. is shared capacity, not an exclusion marker
+            m = (pool, cap)
+            c = self._marker_refs.get(m, 0) + step
+            if c <= 0:
+                self._marker_refs.pop(m, None)
+                self._used_markers.discard(m)
+            else:
+                self._marker_refs[m] = c
+                self._used_markers.add(m)
 
     # -- list-and-diff refresh (fallback mode) -------------------------------
 
@@ -225,8 +287,8 @@ class AllocationIndex:
             self._apply_claim(c.metadata.uid, c)
         for uid in list(self._claim_alloc):
             if uid not in claim_uids:
-                del self._claim_alloc[uid]
-                self._consumed_dirty = True
+                old = self._claim_alloc.pop(uid)
+                self._consumed_delta(old, ())
         self._classes = {
             dc.metadata.name: dc for dc in self._server.list(DeviceClass.KIND)
         }
@@ -256,6 +318,9 @@ class AllocationIndex:
         _INDEX_MISSES.inc()
         old = self._pools.get(key)
         old_groups = {g.name: g for g in old.groups} if old else {}
+        if old:
+            for g in old.groups:
+                self._index_remove(g)
         slices = [
             self._slices[n] for n, pk in self._slice_pool.items() if pk == key
         ]
@@ -292,7 +357,32 @@ class AllocationIndex:
                     marker_union=union,
                 )
             )
+        for g in groups:
+            self._index_add(g)
         self._pools[key] = _PoolSnapshot(generation=gen, groups=groups)
+
+    def _index_add(self, g: _SliceGroup) -> None:
+        if g.node_name:
+            self._node_groups.setdefault(g.node_name, []).append(g)
+        else:
+            self._global_groups.append(g)
+
+    def _index_remove(self, g: _SliceGroup) -> None:
+        if g.node_name:
+            bucket = self._node_groups.get(g.node_name)
+            if bucket is None:
+                return
+            try:
+                bucket.remove(g)  # identity match: _SliceGroup is eq=False
+            except ValueError:
+                pass
+            if not bucket:
+                del self._node_groups[g.node_name]
+        else:
+            try:
+                self._global_groups.remove(g)
+            except ValueError:
+                pass
 
     def _rebuild_consumed(self) -> None:
         if self._device_index is None:
@@ -301,15 +391,22 @@ class AllocationIndex:
                 for s in self._slices.values()
                 for d in s.spec.devices
             }
-        in_use: set = set()
-        used_markers: set = set()
+        in_use_refs: dict = {}
+        marker_refs: dict = {}
         for results in self._claim_alloc.values():
             for driver, pool, device in results:
-                in_use.add((driver, pool, device))
-                dev = self._device_index.get((driver, pool, device))
+                key = (driver, pool, device)
+                in_use_refs[key] = in_use_refs.get(key, 0) + 1
+                dev = self._device_index.get(key)
                 if dev is not None:
                     for cap in dev.basic.capacity:
-                        used_markers.add((pool, cap))
-        self._in_use = in_use
-        self._used_markers = used_markers
+                        # Only chip markers are exclusion state; shared caps
+                        # like hbm would mark EVERY device in the pool used.
+                        if cap.startswith("chip"):
+                            m = (pool, cap)
+                            marker_refs[m] = marker_refs.get(m, 0) + 1
+        self._in_use_refs = in_use_refs
+        self._marker_refs = marker_refs
+        self._in_use = set(in_use_refs)
+        self._used_markers = set(marker_refs)
         self._consumed_dirty = False

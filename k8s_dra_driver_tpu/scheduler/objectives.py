@@ -1,0 +1,304 @@
+"""Multi-objective plan scoring — allocation *quality* as a first-class
+policy surface.
+
+``Plan.tightness()`` (scheduler/allocator.py) is a single MostAllocated
+scalar: fraction of the node's available chip markers the plan consumes.
+It packs well in the small but is blind to everything operators of
+partitioned accelerators actually tune for: whether the geometry LEFT
+BEHIND is still usable (arxiv 2502.01909's MIG VM-placement framework —
+fragmentation of remaining placements, not just fill), what the placement
+costs in watts (arxiv 2501.17752: multi-instance power partitioning shows
+per-slice power is a schedulable quantity), and whether the largest slice
+shapes survive (stranding risk).  This module lifts the scalar into a
+weighted :class:`PlanScore` over five objectives, each in ``[0, 1]``
+(higher is better), composable by the extender's ``/prioritize``, the
+cluster simulator, and ``bench.py plan_scale``:
+
+* **packing** — ``Plan.tightness()`` unchanged: MostAllocated fill of the
+  node's available markers.
+* **fragmentation** — fraction of the node's REMAINING free chips still
+  coverable by an intact multi-chip subslice after this plan commits.
+  1.0 means the leftover geometry is whole; 0.0 means the plan shatters
+  every surviving block (2502.01909's "remaining placement count"
+  objective mapped onto ICI markers).
+* **stranding** — shape-aware best fit: the ratio of the node's largest
+  intact (fully-free) device before vs after the plan commits.
+  Distinguishes "this placement halves the biggest shape the node can
+  still serve" from "it only consumed slivers" — the risk that big-slice
+  claims starve even though total free capacity looks healthy.
+* **power** — normalized watts-per-chip of the chosen devices against the
+  per-shape watt table (larger slices amortize controller/interconnect
+  power, so filling one 2x4 beats scattering eight singles).  The table
+  ships with the topology daemon's info doc (``TPU_POWER_TABLE`` →
+  ``power``) or defaults to :data:`DEFAULT_POWER_TABLE`.
+* **spread** — LeastAllocated counterweight: fraction of the node's
+  available markers the plan leaves free.  A nonzero weight here lets
+  operators dial in utilization-balancing instead of pure bin packing.
+
+Weights come from the caller or the ``DRA_SCORE_WEIGHTS`` env var
+(``packing=0.4,fragmentation=0.4,power=0.2``), parsed LOUDLY — unknown
+objective names, negative/non-finite values and an all-zero vector raise
+``ValueError`` (the ``FaultInjector.from_env`` discipline: a typo in a
+production knob must never silently fall back to defaults).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+ENV_WEIGHTS = "DRA_SCORE_WEIGHTS"
+
+# Packing stays DOMINANT; geometry objectives act inside its quantization
+# bins.  The extender wire has 11 score levels, so with packing at 0.75 a
+# full stranding swing (1 -> 0) moves the total ~1.5 bins — geometry flips
+# a choice only between nodes packing ranks (nearly) equal.  Tuned on the
+# cluster simulator's saturated-churn A/B (bench.py plan_scale): across
+# seeds this vector beats single-objective tightness on BOTH packing
+# efficiency and fragmentation, where geometry-heavy vectors bought their
+# fragmentation wins with packing regressions (they out-vote the
+# densification signal and scatter small claims over intact nodes).
+# ``spread`` ships at 0: it is the exact complement of packing
+# (LeastAllocated), kept as a dial for utilization-balancing operators.
+DEFAULT_WEIGHTS: dict[str, float] = {
+    "packing": 0.75,
+    "fragmentation": 0.07,
+    "stranding": 0.15,
+    "power": 0.03,
+    "spread": 0.0,
+}
+
+# The single-objective baseline: exactly the pre-PR-15 tightness() policy,
+# used as the A side of bench.py plan_scale's A/B.
+TIGHTNESS_WEIGHTS: dict[str, float] = {"packing": 1.0}
+
+# Per-DEVICE watts by chip count (not per chip): one v5e chip draws its
+# board share alone; a 2x4 subslice amortizes host/ICI overhead across 8
+# chips.  Derived from the public v5e ~300W/chip envelope with a modest
+# amortization slope — a placeholder the topology daemon's TPU_POWER_TABLE
+# overrides with fleet-measured numbers.
+DEFAULT_POWER_TABLE: dict[int, float] = {
+    1: 310.0,
+    2: 600.0,
+    4: 1160.0,
+    8: 2240.0,
+}
+
+_PLAN_SCORE = REGISTRY.gauge(
+    "dra_plan_score",
+    "Latest multi-objective plan score components (and 'total'), by objective",
+)
+
+
+def parse_weights(raw: str | None) -> dict[str, float]:
+    """Parse a ``name=float,name=float`` weight spec (the
+    ``DRA_SCORE_WEIGHTS`` wire format).  ``None``/empty returns a copy of
+    :data:`DEFAULT_WEIGHTS`.  A provided spec REPLACES the vector:
+    objectives not named weigh zero (so ``packing=1`` expresses the
+    single-objective baseline).  Unknown names, negative or non-finite
+    values, and an all-zero vector raise ``ValueError`` — loud, like
+    ``FaultInjector.from_env``."""
+    if not raw or not raw.strip():
+        return dict(DEFAULT_WEIGHTS)
+    out = {name: 0.0 for name in DEFAULT_WEIGHTS}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"{ENV_WEIGHTS}: malformed entry {part!r} (want name=float)"
+            )
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in DEFAULT_WEIGHTS:
+            raise ValueError(
+                f"{ENV_WEIGHTS}: unknown objective {name!r} "
+                f"(have {sorted(DEFAULT_WEIGHTS)})"
+            )
+        try:
+            w = float(val)
+        except ValueError as exc:
+            raise ValueError(
+                f"{ENV_WEIGHTS}: objective {name!r} has non-numeric "
+                f"weight {val!r}"
+            ) from exc
+        if not math.isfinite(w) or w < 0.0:
+            raise ValueError(
+                f"{ENV_WEIGHTS}: objective {name!r} weight {w} must be "
+                f"finite and >= 0"
+            )
+        out[name] = w
+    if not any(out.values()):
+        raise ValueError(f"{ENV_WEIGHTS}: all weights are zero")
+    return out
+
+
+def weights_from_env(environ=os.environ) -> dict[str, float]:
+    return parse_weights(environ.get(ENV_WEIGHTS))
+
+
+def power_table_from_info(info: dict) -> dict[int, float]:
+    """Extract the per-shape watt table from a topology daemon info doc
+    (``{"power": {"1": 310, "8": 2240}}`` — JSON object keys are strings).
+    Missing/empty yields the default table; malformed entries raise."""
+    raw = info.get("power") or {}
+    if not raw:
+        return dict(DEFAULT_POWER_TABLE)
+    out: dict[int, float] = {}
+    for k, v in raw.items():
+        chips = int(k)
+        watts = float(v)
+        if chips <= 0 or not math.isfinite(watts) or watts <= 0:
+            raise ValueError(f"power table entry {k!r}={v!r} is not positive")
+        out[chips] = watts
+    return out
+
+
+def watts_for(chip_count: int, table: dict[int, float]) -> float:
+    """Per-device watts for a ``chip_count``-chip device.  Exact table hit
+    or nearest-key scaling (per-chip watts of the closest entry times the
+    count) — a 3-chip shape interpolates rather than KeyErroring."""
+    chip_count = max(1, int(chip_count))
+    if chip_count in table:
+        return table[chip_count]
+    if not table:
+        return float(chip_count)
+    nearest = min(table, key=lambda k: (abs(k - chip_count), k))
+    return table[nearest] / nearest * chip_count
+
+
+@dataclass(frozen=True)
+class PlanScore:
+    """One plan's scored verdict: per-objective components (each in
+    [0, 1]) and the weight vector that combined them."""
+
+    components: dict[str, float] = field(default_factory=dict)
+    weights: dict[str, float] = field(default_factory=lambda: dict(DEFAULT_WEIGHTS))
+
+    @property
+    def total(self) -> float:
+        """Weighted mean over the components, in [0, 1]."""
+        num = 0.0
+        den = 0.0
+        for name, w in self.weights.items():
+            if w <= 0.0:
+                continue
+            num += w * self.components.get(name, 0.0)
+            den += w
+        return num / den if den else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "total": round(self.total, 6),
+            "components": {k: round(v, 6) for k, v in self.components.items()},
+            "weights": dict(self.weights),
+        }
+
+
+def _largest_intact(free, consumed: set) -> int:
+    """Chip count of the largest free device whose markers are untouched
+    by ``consumed`` — the biggest shape still placeable on the node."""
+    best = 0
+    for c in free:
+        m = c.markers
+        if len(m) > best and not (m & consumed):
+            best = len(m)
+    return best
+
+
+def _intact_markers(free, consumed: set, min_chips: int) -> set:
+    """Union of markers of free, un-consumed devices with at least
+    ``min_chips`` chip markers — the geometry still whole after
+    ``consumed`` commits."""
+    alive: set = set()
+    for c in free:
+        m = c.markers
+        if len(m) < min_chips:
+            continue
+        if m & consumed:
+            continue
+        alive |= m
+    return alive
+
+
+def score_plan(plan, weights: dict[str, float] | None = None,
+               power_table: dict[int, float] | None = None) -> PlanScore:
+    """Score one :class:`~k8s_dra_driver_tpu.scheduler.allocator.Plan`.
+
+    Reads only what the plan already carries (chosen/free candidates and
+    marker sets) — no index access, no server round trips — so the
+    extender can score a fanout of nodes at plan() cost."""
+    weights = dict(DEFAULT_WEIGHTS) if weights is None else weights
+    table = DEFAULT_POWER_TABLE if power_table is None else power_table
+
+    chosen_markers: set = set()
+    for _, c in plan.chosen:
+        chosen_markers |= c.markers
+
+    if plan.node_markers:
+        available = set(plan.node_markers)
+    else:
+        available = set()
+        for c in plan.free:
+            available |= c.markers
+    available -= set(plan.used_markers)
+    remaining = available - chosen_markers
+    consumed_after = set(plan.used_markers) | chosen_markers
+
+    # packing: the original tightness, unchanged.
+    packing = plan.tightness()
+
+    # fragmentation: how much of the leftover geometry is still coverable
+    # by an intact multi-chip device.  Empty leftovers are perfect (the
+    # node is exactly full — nothing got stranded).
+    if remaining:
+        alive = _intact_markers(plan.free, consumed_after, min_chips=2)
+        fragmentation = len(alive & remaining) / len(remaining)
+    else:
+        fragmentation = 1.0
+
+    # stranding: shape-aware best fit — the ratio of the node's largest
+    # INTACT (fully-free) device before vs after this plan commits.  A
+    # 1-chip claim dropped on an untouched 8-chip node halves its largest
+    # intact shape (0.5); the same claim on a node whose biggest survivor
+    # is a stray chip changes nothing (1.0).  This is the term that keeps
+    # whole big slices alive for the gang claims that need them.
+    before = _largest_intact(plan.free, set(plan.used_markers))
+    if before >= 2:
+        stranding = _largest_intact(plan.free, consumed_after) / before
+    else:
+        stranding = 1.0  # nothing shaped left to preserve
+
+    # power: mean per-chip watts of the chosen devices, normalized to the
+    # table's [min, max] per-chip band.  No consuming choices (admin-only
+    # plans) and flat tables score neutral 1.0.
+    per_chip = [watts_for(k, table) / k for k in table] or [1.0]
+    lo, hi = min(per_chip), max(per_chip)
+    chosen_counts = [max(1, len(c.markers)) for _, c in plan.chosen]
+    if chosen_counts and hi > lo:
+        mean = sum(
+            watts_for(k, table) / k for k in chosen_counts
+        ) / len(chosen_counts)
+        power = 1.0 - min(1.0, max(0.0, (mean - lo) / (hi - lo)))
+    else:
+        power = 1.0
+
+    # spread: LeastAllocated counterweight (how much headroom survives).
+    spread = len(remaining) / len(available) if available else 0.0
+
+    components = {
+        "packing": packing,
+        "fragmentation": fragmentation,
+        "stranding": stranding,
+        "power": power,
+        "spread": spread,
+    }
+    score = PlanScore(components=components, weights=weights)
+    for name, value in components.items():
+        _PLAN_SCORE.set(value, objective=name)
+    _PLAN_SCORE.set(score.total, objective="total")
+    return score

@@ -1,0 +1,89 @@
+"""The ``make sim-cluster`` chaos suite (PR 15 acceptance gate).
+
+Drives the REAL ``AllocationIndex`` + ``plan()``/``plan_gang()`` through
+seeded synthetic-cluster churn and pins the invariants the simulator
+exists to check:
+
+* **Exactly-once accounting** — every submitted claim ends in exactly
+  one of bound/infeasible/failed; relist audits against the store find
+  zero mismatches; nothing leaks at drain.
+* **Gang atomicity under storms** — a 409/500 storm breaks commits
+  mid-gang; every broken gang unwinds whole (the audit would catch a
+  half-committed gang as a ledger mismatch).
+* **Determinism** — one seed, one report, bit-for-bit (minus wall time).
+* **Scale** — a 10k-pool build stays correct and plan() latency stays
+  sub-millisecond-ish (the hard p90 budget lives in
+  ``tools/perf_smoke.py check_plan_scale``).
+
+Budget: the whole file is tier-1 and must stay well under 30s CPU.
+"""
+
+import json
+
+from k8s_dra_driver_tpu.scheduler.cluster_sim import (
+    SimConfig,
+    default_storms,
+    run_sim,
+)
+
+
+def _accounts_exactly_once(r):
+    assert r.submitted == r.bound + r.infeasible + r.failed, (
+        f"claim accounting leak: submitted={r.submitted} != "
+        f"bound={r.bound} + infeasible={r.infeasible} + failed={r.failed}"
+    )
+    assert r.gangs_submitted == r.gangs_committed + r.gangs_infeasible
+    assert r.audit_failures == 0, "relist audit found ledger/store mismatch"
+    assert r.leaked_claims == 0, "claims survived the drain"
+
+
+class TestChurnUnderStorms:
+    def test_1k_chaos_run_accounts_every_claim(self):
+        r = run_sim(SimConfig(
+            seed=42, n_nodes=300, duration_s=300.0, arrival_rate=3.0,
+            storms=default_storms(), audit_interval_s=30.0,
+        ))
+        _accounts_exactly_once(r)
+        assert r.audits >= 9
+        assert r.bound > 500, "churn must actually bind claims"
+        assert r.released == r.bound, "every bound claim must release"
+        # The storm must break commits mid-gang AND every break must
+        # converge: unwound gangs retried to commit or counted
+        # infeasible, never half-committed (the audit above is the
+        # half-commit detector).
+        assert r.gangs_unwound > 0, "storm never exercised the unwind path"
+        assert r.gangs_committed > 0
+        assert r.plan_samples > 1000
+        assert 0.0 < r.packing_efficiency <= 1.0
+        assert 0.0 < r.utilization_mean < 1.0
+
+    def test_same_seed_same_report(self):
+        cfg = dict(
+            seed=11, n_nodes=120, duration_s=150.0, arrival_rate=3.0,
+            storms=default_storms(), audit_interval_s=30.0,
+        )
+        a = json.loads(run_sim(SimConfig(**cfg)).to_json())
+        b = json.loads(run_sim(SimConfig(**cfg)).to_json())
+        for doc in (a, b):
+            for key in ("wall_s", "plan_p50_ms", "plan_p90_ms"):
+                doc.pop(key)  # wall-clock measurements may jitter
+        assert a == b
+
+    def test_different_seed_different_trace(self):
+        base = dict(n_nodes=120, duration_s=150.0, arrival_rate=3.0)
+        a = run_sim(SimConfig(seed=1, **base))
+        b = run_sim(SimConfig(seed=2, **base))
+        assert (a.submitted, a.bound) != (b.submitted, b.bound)
+
+
+class TestScale:
+    def test_10k_pools_zero_misaccounting(self):
+        r = run_sim(SimConfig(
+            seed=7, n_nodes=10_000, duration_s=30.0, arrival_rate=3.0,
+            fanout=4, audit_interval_s=15.0,
+        ))
+        _accounts_exactly_once(r)
+        assert r.n_nodes == 10_000
+        assert r.bound > 50
+        assert r.plan_samples > 100
+        assert r.plan_p90_ms > 0.0

@@ -1,0 +1,234 @@
+"""Gang allocation property tests (PR 15 satellite).
+
+The gang contract under test, from ``Allocator.allocate_gang``:
+
+* **All-or-nothing** — a gang either commits every member or leaves the
+  store EXACTLY as it found it, including under an injected 409 storm
+  that breaks commits mid-gang (the unwind path).
+* **No leaked reservations** — after any unwound gang, every device
+  marker the gang touched is free again: the index's consumed set and
+  the store agree with a world where the gang never happened.
+* **Determinism** — identical inventories and claims produce identical
+  plans (device-for-device), seed-independent of dict/set iteration.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu import DRIVER_NAME
+from k8s_dra_driver_tpu.e2e.harness import (
+    SUBSLICE_CLASS,
+    install_device_classes,
+    simple_claim,
+)
+from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
+from k8s_dra_driver_tpu.kube.objects import ResourceClaim
+from k8s_dra_driver_tpu.kube.resourceslice_controller import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+    Slice,
+)
+from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
+from k8s_dra_driver_tpu.scheduler.allocator import (
+    AllocationError,
+    Allocator,
+    GangMember,
+)
+from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
+
+
+def publish_host(server, node, spec="v5e-16", host_id=0, pool=None):
+    """One v5e-16 host block (a 2x2: four chips, subslices up to 2x2) in
+    its own pool on ``node`` — co-locating several blocks per node gives
+    gangs same-node headroom."""
+    pool = pool or node
+    topo = enumerate_topology(env={
+        "TPUINFO_FAKE_TOPOLOGY": spec,
+        "TPUINFO_FAKE_HOST_ID": str(host_id),
+    })
+    devices = AllocatableDevices.from_topology(topo).get_devices()
+    ctrl = ResourceSliceController(server, DRIVER_NAME, pool)
+    ctrl.update(DriverResources(pools={
+        pool: Pool(slices=[Slice(devices=devices)], node_name=node),
+    }))
+
+
+def build_cluster(n_nodes=3, blocks=4, injector=None):
+    server = InMemoryAPIServer(fault_injector=injector)
+    install_device_classes(server)
+    for i in range(n_nodes):
+        for b in range(blocks):
+            publish_host(
+                server, f"node-{i}", host_id=b, pool=f"node-{i}-b{b}",
+            )
+    return server, Allocator(server)
+
+
+def subslice_claim(server, name, chips=4):
+    return server.create(simple_claim(
+        name,
+        device_class=SUBSLICE_CLASS,
+        selectors=(
+            f"device.attributes['{DRIVER_NAME}'].chipCount == {chips}",
+        ),
+    ))
+
+
+def gang_of(server, tag, nodes, chips=4):
+    return [
+        GangMember(
+            claim=subslice_claim(server, f"{tag}-{i}", chips=chips),
+            node_name=node,
+        )
+        for i, node in enumerate(nodes)
+    ]
+
+
+def allocated_names(server):
+    return {
+        c.metadata.name
+        for c in server.list(ResourceClaim.KIND)
+        if c.status.allocation is not None
+    }
+
+
+def consumed_markers(allocator, n_nodes=3):
+    taken = set()
+    for i in range(n_nodes):
+        view = allocator.view(f"node-{i}")
+        taken |= set(view.used_markers)
+    return taken
+
+
+class TestGangCommit:
+    def test_commits_every_member(self):
+        server, alloc = build_cluster()
+        members = gang_of(server, "g", ["node-0", "node-1", "node-2"])
+        out = alloc.allocate_gang(members)
+        assert len(out) == 3
+        assert allocated_names(server) == {"g-0", "g-1", "g-2"}
+        counts = parse_prom_text(REGISTRY.render())["dra_gang_plans_total"]
+        assert counts[(("outcome", "committed"),)] == 1.0
+
+    def test_same_node_members_get_disjoint_devices(self):
+        server, alloc = build_cluster(n_nodes=1, blocks=1)
+        members = gang_of(server, "g", ["node-0", "node-0"], chips=2)
+        out = alloc.allocate_gang(members)
+        picks = [
+            (r.pool, r.device)
+            for c in out for r in c.status.allocation.devices.results
+        ]
+        assert len(picks) == len(set(picks)) == 2
+        # Both 2-chip subslices of the lone 2x2 block are now taken, so
+        # the covering 4-chip subslice must be unplaceable.
+        extra = gang_of(server, "x", ["node-0"], chips=4)
+        with pytest.raises(AllocationError):
+            alloc.allocate_gang(extra)
+
+    def test_empty_gang_is_loud(self):
+        _, alloc = build_cluster(n_nodes=1)
+        with pytest.raises(AllocationError, match="empty"):
+            alloc.allocate_gang([])
+
+
+class TestAllOrNothing:
+    def test_infeasible_member_writes_nothing(self):
+        server, alloc = build_cluster(n_nodes=2)
+        # Three 8-chip members on two 16-chip nodes plus one on a node
+        # that doesn't exist: the gang must abort before ANY write.
+        members = gang_of(
+            server, "g", ["node-0", "node-1", "node-no-such"], chips=8
+        )
+        with pytest.raises(AllocationError):
+            alloc.allocate_gang(members)
+        assert allocated_names(server) == set()
+        assert consumed_markers(alloc, 2) == set()
+        counts = parse_prom_text(REGISTRY.render())["dra_gang_plans_total"]
+        assert counts.get((("outcome", "infeasible"),)) == 1.0
+        assert (("outcome", "committed"),) not in counts
+
+    def test_atomic_under_conflict_storm_no_leaked_reservations(self):
+        """The property run: gangs attempted under a seeded 409/500 storm
+        either commit whole or unwind whole; when the storm clears, the
+        store and the index match a world containing exactly the
+        committed gangs — and after deallocating those, nothing at all."""
+        inj = FaultInjector(seed=11)
+        server, alloc = build_cluster(n_nodes=3, injector=inj)
+        inj.arm(FaultProfile(
+            name="storm-409", conflict_rate=0.30,
+            verbs=("PUT",), kinds=(ResourceClaim.KIND,),
+        ))
+        inj.arm(FaultProfile(
+            name="storm-500", error_rate=0.10, error_code=500,
+            verbs=("PUT",), kinds=(ResourceClaim.KIND,),
+        ))
+        committed = []
+        for g in range(12):
+            members = gang_of(
+                server, f"g{g}", ["node-0", "node-1", "node-2"], chips=4
+            )
+            try:
+                alloc.allocate_gang(members)
+                committed.append(f"g{g}")
+            except AllocationError:
+                # Whatever broke it, nothing of THIS gang may survive.
+                assert not any(
+                    n.startswith(f"g{g}-") for n in allocated_names(server)
+                )
+        inj.disarm(None)
+        # Exactly the committed gangs' members hold allocations.
+        expect = {f"{g}-{i}" for g in committed for i in range(3)}
+        assert allocated_names(server) == expect
+        events = [e["event"] for e in JOURNAL.tail(limit=5000)]
+        assert "gang.unwound" in events, \
+            "storm must exercise the mid-gang unwind path"
+        # Deallocate every committed gang: zero markers must remain.
+        for name in sorted(expect):
+            alloc.deallocate(server.get(ResourceClaim.KIND, name, "default"))
+        assert consumed_markers(alloc, 3) == set()
+        assert allocated_names(server) == set()
+
+    def test_unwind_exhaustion_is_loud(self):
+        """A storm the unwind can't outlast raises and journals the leak
+        instead of silently abandoning the reservation."""
+        inj = FaultInjector(seed=5)
+        server, alloc = build_cluster(n_nodes=1, injector=inj)
+        alloc.GANG_UNWIND_ATTEMPTS = 3
+        members = gang_of(server, "g", ["node-0", "node-0"], chips=4)
+        # Make the SECOND member's commit conflict genuinely (its held
+        # copy goes stale when the server-side object advances)...
+        server.update(server.get(ResourceClaim.KIND, "g-1", "default"))
+        # ...and jam every refetch so the unwind cannot converge.
+        inj.arm(FaultProfile(
+            name="jam", error_rate=1.0, error_code=500,
+            verbs=("GET",), kinds=(ResourceClaim.KIND,),
+        ))
+        with pytest.raises(AllocationError, match="unwind"):
+            alloc.allocate_gang(members)
+        events = [e["event"] for e in JOURNAL.tail(limit=200)]
+        assert "gang.unwind_leak" in events
+
+
+class TestDeterminism:
+    def _run(self):
+        server, alloc = build_cluster(n_nodes=3)
+        out = alloc.allocate_gang(
+            gang_of(server, "g", ["node-0", "node-1", "node-0"], chips=4)
+        )
+        picks = tuple(
+            (r.pool, r.device)
+            for c in out for r in c.status.allocation.devices.results
+        )
+        plans = alloc.plan_gang(
+            gang_of(server, "h", ["node-1", "node-2"], chips=2)
+        )
+        planned = tuple(
+            c.key for _, p in plans for _, c in p.chosen
+        )
+        return picks, planned
+
+    def test_identical_worlds_plan_identically(self):
+        assert self._run() == self._run()

@@ -84,6 +84,16 @@ def test_transport_overhead_stays_within_perf_budgets():
     assert stats["frames_decoded"] == stats["requests_wired"]
 
 
+def test_plan_scale_stays_within_perf_budgets():
+    stats = perf_smoke.check_plan_scale()
+    # Cluster-scale placement's contract: plan() against a 1k-node
+    # inventory is index-backed dict work — latency stays flat in pool
+    # count — and the churn slice accounts every claim exactly once.
+    assert stats["plan_samples"] >= 100
+    assert stats["plan_p90_ms"] <= stats["plan_p90_ceiling_ms"]
+    assert stats["audit_failures"] == 0 and stats["leaked_claims"] == 0
+
+
 def test_autoscaler_overhead_stays_within_perf_budgets():
     stats = perf_smoke.check_autoscaler_overhead()
     assert stats["requests_scaled"] == 8

@@ -1,0 +1,200 @@
+"""Remote-worker scale-up: the autoscaler's flagged transport path.
+
+The satellite contract (PR 15): ``FleetAutoscaler`` can spawn a
+transport-worker-backed replica — :class:`RemoteWorkerEngine` over the
+same ``PoolWorker`` protocol loop ``worker_main`` drives — behind the
+``DRA_REMOTE_WORKERS`` flag, and the chaos suite proves a scale-up
+registers one and serves through it:
+
+* Flag selection is loud: set-without-wiring raises, unset stays local.
+* Scale-up under spawn faults: the first attempt fails and backs off
+  (nothing half-registers), the retry lands a RemoteWorkerEngine whose
+  request ids come from the fleet-reserved stride (the worker reseeds).
+* Worker death mid-stream: the stall detectors evacuate the replica and
+  its retained KV-less entries finish on the survivors — zero loss,
+  no double delivery.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_dra_driver_tpu.models import fleet, workload as W
+from k8s_dra_driver_tpu.models.autoscaler import (
+    ENV_REMOTE_WORKERS,
+    AutoscalerPolicy,
+    FleetAutoscaler,
+    select_engine_factory,
+)
+from k8s_dra_driver_tpu.models.fleet import ID_STRIDE, Engine
+from k8s_dra_driver_tpu.models.transport import (
+    RemoteWorkerEngine,
+    make_remote_engine_factory,
+)
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+
+
+def _remote_factory(clock, *, n_slots=4):
+    """In-process worker rig: each spawn hosts a fresh single-SimEngine
+    FleetRouter behind a loopback PoolWorker (the worker_main loop,
+    minus the process)."""
+    return make_remote_engine_factory(
+        worker_factory=lambda: fleet.FleetRouter(
+            [W.SimEngine(clock=clock, n_slots=n_slots, n_blocks=512)],
+            clock=clock,
+        ),
+        n_slots=n_slots,
+        clock=clock,
+    )
+
+
+def _drive(clock, router, until, *, dt=0.1, max_ticks=500):
+    """Advance sim time and pump the fleet until ``until()`` or budget."""
+    out = []
+    for _ in range(max_ticks):
+        if until():
+            return out
+        clock.advance(dt)
+        router.tick()
+        out.extend(router.completions())
+    raise AssertionError(f"fleet did not converge in {max_ticks} ticks")
+
+
+class TestFlagSelection:
+    def test_unset_selects_local(self):
+        local, remote = object(), object()
+        assert select_engine_factory(local, remote, environ={}) is local
+
+    def test_set_selects_remote(self):
+        local, remote = object(), object()
+        env = {ENV_REMOTE_WORKERS: "1"}
+        assert select_engine_factory(local, remote, environ=env) is remote
+
+    def test_set_without_remote_factory_raises(self):
+        with pytest.raises(ValueError, match=ENV_REMOTE_WORKERS):
+            select_engine_factory(object(), None,
+                                  environ={ENV_REMOTE_WORKERS: "true"})
+
+    def test_factory_needs_exactly_one_rig(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            make_remote_engine_factory()
+
+
+class TestRemoteEngineProtocol:
+    def test_satisfies_engine_protocol_and_serves(self):
+        clock = W.SimClock()
+        engine = _remote_factory(clock)()
+        assert isinstance(engine, Engine)
+        out = engine.pump([([1, 2, 3], 8), ([4, 5], 4)])
+        assert sorted(len(c.generated) for c in out) == [4, 8]
+        assert all(c.status == "ok" for c in out)
+        assert engine.free_slots() == engine.n_slots
+
+    def test_reseed_forwards_id_stride_to_worker(self):
+        clock = W.SimClock()
+        engine = _remote_factory(clock)()
+        base = 7 * ID_STRIDE
+        engine.restore(
+            {"engine": "RemoteWorkerEngine", "next_id": base, "requests": []},
+            merge=True,
+        )
+        rid = engine.submit([1, 2], max_tokens=4)
+        assert rid >= base
+
+    def test_cancel_round_trips_a_cancelled_completion(self):
+        clock = W.SimClock()
+        engine = _remote_factory(clock)()
+        rid = engine.submit([1, 2, 3], max_tokens=64)
+        assert engine.cancel(rid) is True
+        clock.advance(0.1)
+        engine.step_burst()
+        (c,) = engine.completions()
+        assert c.request_id == rid and c.status == "cancelled"
+        assert engine.free_slots() == engine.n_slots
+
+
+class TestRemoteScaleUp:
+    def _build(self, *, injector=None):
+        clock = W.SimClock()
+        router = fleet.FleetRouter(
+            [W.SimEngine(clock=clock, n_slots=4, n_blocks=512)],
+            clock=clock,
+            fault_injector=injector,
+        )
+        local = lambda: W.SimEngine(clock=clock, n_slots=4)  # noqa: E731
+        factory = select_engine_factory(
+            local, _remote_factory(clock),
+            environ={ENV_REMOTE_WORKERS: "1"},
+        )
+        asc = FleetAutoscaler(
+            router, engine_factory=factory,
+            policy=AutoscalerPolicy(
+                min_replicas=1, max_replicas=3, up_ticks=2,
+                cooldown_s=1.0, spawn_backoff_s=2.0,
+            ),
+            clock=clock,
+        )
+        return clock, router, asc
+
+    def test_scale_up_registers_remote_worker_under_spawn_faults(self):
+        inj = FaultInjector(seed=3)
+        inj.arm(FaultProfile(name="boom", spawn_fail_rate=1.0, limit=1))
+        clock, router, asc = self._build(injector=inj)
+        for i in range(4):
+            router.submit([1, i + 2], max_tokens=32)
+
+        asc.tick()  # streak 1
+        clock.advance(0.5)
+        asc.tick()  # streak 2 -> act, but the spawn fault eats it
+        assert asc.spawn_failures == 1
+        assert len(router.replicas) == 1  # nothing half-registered
+
+        clock.advance(5.0)  # past spawn backoff + cooldown
+        asc.tick()
+        clock.advance(0.5)
+        asc.tick()
+        remotes = [
+            r for r in router.replicas
+            if isinstance(r.engine, RemoteWorkerEngine)
+        ]
+        assert len(remotes) == 1, "retry must register the remote replica"
+
+        # The fleet serves THROUGH the worker: saturate the local replica
+        # so placement must pick the remote one, then ride a completion
+        # back across the protocol with a fleet-stride request id.
+        rid = router.submit([9, 9, 9], max_tokens=8)
+        assert rid >= ID_STRIDE  # the worker reseeded onto its stride
+        assert remotes[0].engine.free_slots() < remotes[0].engine.n_slots
+        done = _drive(clock, router, lambda: router.idle())
+        assert rid in {c.request_id for c in done}
+        events = [e["event"] for e in JOURNAL.tail(limit=200)]
+        assert "scale_up.spawn_failed" in events
+        assert "scale_up.resumed" in events
+
+    def test_worker_death_evacuates_retained_streams(self):
+        clock, router, asc = self._build()
+        for i in range(4):
+            router.submit([1, i + 2], max_tokens=32)
+        asc.tick()
+        clock.advance(1.5)
+        asc.tick()
+        (remote,) = [
+            r for r in router.replicas
+            if isinstance(r.engine, RemoteWorkerEngine)
+        ]
+        rid = router.submit([5, 6, 7], max_tokens=64)
+        assert rid in remote.engine._resident
+
+        # Kill the worker mid-stream: sever its side of the loopback pair.
+        worker = remote.engine.peer_pump.__self__
+        worker.conn.close()
+        worker.dead = True
+
+        # Drain the local replicas' head start, then let the detectors
+        # catch the frozen remote and evacuate its retained entry.
+        done = _drive(clock, router, lambda: router.idle(), max_ticks=2000)
+        assert rid in {c.request_id for c in done}, "stream must survive"
+        assert sum(1 for c in done if c.request_id == rid) == 1, \
+            "no double delivery"
+        assert remote.state in (fleet.DRAINED, fleet.EVACUATING, "suspect")

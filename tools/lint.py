@@ -30,11 +30,14 @@ catch real bugs with near-zero false positives, over ast/tokenize only:
                      readback lives) are exempt
   metric-docs        cross-file: every `tpu_serve_*` / `tpu_fleet_*` /
                      `tpu_disagg_*` / `tpu_transport_*` metric declared in
-                     models/ must carry non-empty help text at some
-                     declaring site AND appear in ARCHITECTURE.md's
-                     metric inventory — the serving metrics are the
-                     fleet load-signal contract, and an undocumented
-                     signal is one routers can't rely on
+                     models/ — plus the scheduler observability surface
+                     (`dra_plan_*` / `dra_gang_*` / `dra_sim_*` /
+                     `dra_extender_*`) wherever declared — must carry
+                     non-empty help text at some declaring site AND
+                     appear in ARCHITECTURE.md's metric inventory — the
+                     serving metrics are the fleet load-signal contract,
+                     and an undocumented signal is one routers and
+                     dashboards can't rely on
   metric-labels      cross-file: label keys at `tpu_serve_*` /
                      `tpu_fleet_*` / `tpu_disagg_*` / `tpu_transport_*` /
                      `dra_*` metric call sites must come from the closed
@@ -341,7 +344,9 @@ def check_file(path: Path) -> list[Finding]:
 
 def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
     """Cross-file check: every ``tpu_serve_*`` / ``tpu_fleet_*`` /
-    ``tpu_disagg_*`` metric declared in models/ must (a) carry non-empty
+    ``tpu_disagg_*`` metric declared in models/ — and every scheduler
+    observability metric (``dra_plan_*`` / ``dra_gang_*`` / ``dra_sim_*``
+    / ``dra_extender_*``) wherever declared — must (a) carry non-empty
     help text at at least one declaring site and (b) appear in
     ARCHITECTURE.md (the metric inventory / telemetry section).  Pure over
     its inputs so tests can drive it with synthetic trees and doc text."""
@@ -349,8 +354,17 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
     sites: dict[str, list[tuple[Path, int, bool]]] = {}
     for path in paths:
         norm = str(path).replace("\\", "/")
-        if "/models/" not in norm and not norm.startswith("models/"):
-            continue
+        in_models = "/models/" in norm or norm.startswith("models/")
+        # Serving metrics (tpu_*) live in models/; the scheduler/simulator
+        # observability surface (PR 15) is policed wherever it is declared.
+        prefixes = (
+            "dra_plan_", "dra_gang_", "dra_sim_", "dra_extender_",
+        )
+        if in_models:
+            prefixes += (
+                "tpu_serve_", "tpu_fleet_", "tpu_disagg_",
+                "tpu_autoscale_", "tpu_transport_",
+            )
         try:
             tree = ast.parse(path.read_text(), filename=str(path))
         except (SyntaxError, OSError):
@@ -363,10 +377,7 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
                 and node.args
                 and isinstance(node.args[0], ast.Constant)
                 and isinstance(node.args[0].value, str)
-                and node.args[0].value.startswith(
-                    ("tpu_serve_", "tpu_fleet_", "tpu_disagg_",
-                     "tpu_autoscale_", "tpu_transport_")
-                )
+                and node.args[0].value.startswith(prefixes)
             ):
                 continue
             help_node = node.args[1] if len(node.args) > 1 else next(
@@ -415,6 +426,9 @@ METRIC_LABEL_KEYS = frozenset({
     # come from the topology daemon's published link list — an operator-
     # declared, bounded set, same cardinality class as endpoint/node
     "channel",
+    # multi-objective plan scoring (scheduler/objectives.py): objective
+    # names are the closed PlanScore component set
+    "objective",
 })
 METRIC_LABEL_PREFIXES = (
     "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",
