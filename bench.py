@@ -996,11 +996,16 @@ def _autoscale_benchmark_cpu(headline: bool = True) -> dict:
         AutoscalerPolicy,
         FleetAutoscaler,
     )
+    from k8s_dra_driver_tpu.models.obs_plane import SloBurnRateMonitor
 
     def run(spec, shape, n_replicas=2, dt=0.1, queue_limit=2048,
             policy=None, beefy=False):
         clock = workload.SimClock()
         sink = workload.SimSink()
+        # Burn-rate monitor runs in the SAME simulated-time domain as the
+        # replay clock: replay feeds it sim-now per tick, so the 5m/1h
+        # windows are simulated minutes/hours, not wall time.
+        monitor = SloBurnRateMonitor()
 
         if beefy:
             # Headline shape: calibrated so ~1M requests replay in
@@ -1035,12 +1040,16 @@ def _autoscale_benchmark_cpu(headline: bool = True) -> dict:
                         min_replicas=1, max_replicas=8,
                         up_ticks=2, down_ticks=40, cooldown_s=5.0,
                     ),
+                    burn_monitor=monitor,
                 )
         rep = workload.replay(
             workload.generate(spec), router, clock=clock, sink=sink,
             autoscaler=asc, dt=dt, queue_limit=queue_limit,
+            burn_monitor=monitor,
         )
         doc = rep.to_json()
+        doc["burn_rate_timeline"] = monitor.timeline()
+        doc["burn_alerts"] = monitor.stats()["transitions"]
         if asc is not None:
             asc.record_slo(rep.attained, rep.offered)
             doc["scale_actions"] = asc.actions
@@ -1077,7 +1086,8 @@ def _autoscale_benchmark_cpu(headline: bool = True) -> dict:
                 **{k: auto[k] for k in (
                     "slo_attainment", "completed", "shed", "lost",
                     "ttft_p99_s", "mean_replicas", "max_replicas",
-                    "scale_actions")},
+                    "scale_actions", "burn_rate_timeline",
+                    "burn_alerts")},
             },
             "autoscaled_attains_geq_static": (
                 auto["slo_attainment"] >= static["slo_attainment"]

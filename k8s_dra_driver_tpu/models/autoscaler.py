@@ -144,10 +144,15 @@ class FleetAutoscaler:
         clock=None,
         fault_injector=None,
         name_prefix: str = "as",
+        burn_monitor=None,
     ):
         self.router = router
         self.engine_factory = engine_factory
         self.policy = policy or AutoscalerPolicy()
+        # Optional obs_plane.SloBurnRateMonitor: while it alerts, the
+        # error budget is burning on every window — treated as scale-up
+        # pressure even when utilization alone wouldn't vote.
+        self.burn_monitor = burn_monitor
         self.clock = clock or router.clock
         self.fault_injector = (
             fault_injector if fault_injector is not None
@@ -214,6 +219,16 @@ class FleetAutoscaler:
         busy = sum(r.resident() for r in admittable)
         util = busy / total_slots if total_slots else 1.0
         vote = self._vote(util, depth, len(admittable))
+        burn_forced = False
+        if (
+            vote != UP
+            and self.burn_monitor is not None
+            and self.burn_monitor.alerting
+        ):
+            # The SLO burn monitor says the error budget is being spent
+            # past threshold on every window: that is demand pressure the
+            # utilization signal can miss (e.g. slow-but-full replicas).
+            vote, burn_forced = UP, True
         if vote == UP:
             self._up_streak += 1
             self._down_streak = 0
@@ -252,11 +267,12 @@ class FleetAutoscaler:
                 actual + len(self._pending_spawns) + p.max_step,
             )
             action = UP
-            reason = (
-                "queue_pressure"
-                if depth >= p.queue_high * max(1, len(admittable))
-                else "overload"
-            )
+            if burn_forced:
+                reason = "slo_burn"
+            elif depth >= p.queue_high * max(1, len(admittable)):
+                reason = "queue_pressure"
+            else:
+                reason = "overload"
         elif (
             vote == DOWN and self._down_streak >= p.down_ticks
             and not cooling
@@ -291,6 +307,9 @@ class FleetAutoscaler:
             "pending_spawns": len(self._pending_spawns),
             "action": action or "none",
             "reason": reason,
+            "burn_alert": burn_forced or (
+                self.burn_monitor is not None and self.burn_monitor.alerting
+            ),
         }
         _M_DECISION.observe(time.perf_counter() - t0)
         return self.last_decision
@@ -527,11 +546,16 @@ class PoolRebalancer:
         decode_scaler: FleetAutoscaler,
         policy: RebalancePolicy | None = None,
         clock=None,
+        burn_monitor=None,
     ):
         self.disagg = disagg
         self.prefill_scaler = prefill_scaler
         self.decode_scaler = decode_scaler
         self.policy = policy or RebalancePolicy()
+        # Optional obs_plane.SloBurnRateMonitor: a live burn alert drops
+        # the hysteresis to a single vote — when the budget is burning,
+        # the stage imbalance is costing real SLO, so act now.
+        self.burn_monitor = burn_monitor
         self.clock = clock or disagg.clock
         self.ticks = 0
         self.moves = 0
@@ -570,8 +594,12 @@ class PoolRebalancer:
             self._last_move_t is not None
             and now - self._last_move_t < self.policy.cooldown_s
         )
+        burn_alert = (
+            self.burn_monitor is not None and self.burn_monitor.alerting
+        )
+        need_ticks = 1 if burn_alert else self.policy.vote_ticks
         if (
-            self._streak >= self.policy.vote_ticks
+            self._streak >= need_ticks
             and not in_cooldown
         ):
             donor, taker = (
@@ -587,6 +615,7 @@ class PoolRebalancer:
         self.last_decision = {
             "vote": vote, "streak": self._streak, "corr": corr,
             "cooldown": in_cooldown, "attribution": attr,
+            "burn_alert": burn_alert,
         }
         return self.last_decision
 

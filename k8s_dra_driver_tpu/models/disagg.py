@@ -58,10 +58,12 @@ import weakref
 from dataclasses import dataclass, replace
 
 from k8s_dra_driver_tpu.models.fleet import FleetPolicy, FleetRouter
+from k8s_dra_driver_tpu.models.obs_plane import FLEET
 from k8s_dra_driver_tpu.models.telemetry import EngineTelemetry
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 from k8s_dra_driver_tpu.utils.retry import CircuitBreaker
+from k8s_dra_driver_tpu.utils.tracing import TRACES
 
 _M_TRANSFERS = REGISTRY.counter(
     "tpu_disagg_transfers_total",
@@ -787,6 +789,20 @@ class DisaggRouter:
         EngineTelemetry.annotate_trace_doc(
             entry.get("trace"), "handoff_begin", now, source=source,
         )
+        # Fleet span tree: a LOCAL prefill pool has no worker process to
+        # record its hop, so the router records it here (duration mapped
+        # into the monotonic domain) and notes the hop so the wire span
+        # parents to it.  A remote prefill already noted its own span via
+        # the HANDOFF frame's trace context — keep that one.
+        ctx = FLEET.hop_ctx(rid)
+        if not ctx or not ctx.get("parent_id"):
+            mono = time.monotonic()
+            span = TRACES.record(
+                f"req-{rid}", "hop.prefill",
+                mono - max(0.0, now - t0), mono,
+                request_id=rid, source=source,
+            )
+            FLEET.note_hop(rid, f"req-{rid}", span.span_id, instance=source)
         self._staged.append({"entry": entry, "staged_at": now})
         self.handoffs += 1
 

@@ -68,7 +68,7 @@ from dataclasses import dataclass, field
 
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
-from k8s_dra_driver_tpu.utils.tracing import TRACER, Span
+from k8s_dra_driver_tpu.utils.tracing import TRACER, TRACES, Span
 
 # SLO histograms (the request-latency counterpart of the control plane's
 # dra_node_prepare_seconds).  Every observation carries the request's
@@ -503,6 +503,18 @@ class EngineTelemetry:
             },
         )
         TRACER.add(span)
+        # Federable flat span for the fleet plane: monotonic-domain
+        # timestamps so the control plane can skew-normalize across
+        # processes (the presentation Span above keeps wall time).
+        mono = time.monotonic()
+        TRACES.record(
+            f"req-{request_id}", "serve.request",
+            mono - (e2e or 0.0), mono,
+            request_id=request_id, status=status,
+            engine=self._engine_kind, generated=tr.generated,
+            queue_wait_s=qw, ttft_s=ttft, tpot_s=tpot,
+            bursts=len(tr.bursts), migrations=tr.migrations,
+        )
         self._done.append(request_id)
         while len(self._done) > MAX_DONE_TRACES:
             old = self._done.popleft()

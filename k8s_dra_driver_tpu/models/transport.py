@@ -67,10 +67,16 @@ from k8s_dra_driver_tpu.models.disagg import (
     OK,
     HandoffChannel,
 )
+from k8s_dra_driver_tpu.models.obs_plane import (
+    FLEET,
+    TELEM_BUDGET_BYTES,
+    TelemetryShipper,
+)
 from k8s_dra_driver_tpu.models.telemetry import terminal_retirer
 from k8s_dra_driver_tpu.utils.journal import JOURNAL
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY
 from k8s_dra_driver_tpu.utils.retry import Backoff, CircuitBreaker, RetryPolicy
+from k8s_dra_driver_tpu.utils.tracing import TRACES
 
 _M_FRAMES = REGISTRY.counter(
     "tpu_transport_frames_total",
@@ -89,6 +95,11 @@ _M_RTT = REGISTRY.histogram(
     "tpu_transport_rtt_seconds",
     "Heartbeat round-trip time per transport peer",
     buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+)
+_M_CLOCK_OFFSET = REGISTRY.gauge(
+    "tpu_transport_clock_offset_seconds",
+    "Estimated peer monotonic-clock offset (NTP half-rtt model over "
+    "PING/PONG), by endpoint — what skew-normalizes federated spans",
 )
 
 # Additional transfer outcomes the REAL wire introduces on top of the
@@ -112,6 +123,7 @@ SUBMITTED = 9
 HANDOFF = 10    # worker→supervisor: a prefill handoff entry (meta + wire)
 COMPLETION = 11
 CONTROL = 12
+TELEM = 13      # worker→supervisor: CRC'd telemetry snapshot (obs_plane)
 
 _FRAME_HEADER = struct.Struct("!IB")
 MAX_FRAME_BYTES = 1 << 30  # sanity bound: a length beyond this is garbage
@@ -364,6 +376,9 @@ class PeerLink:
         self._last_ping_at = 0.0
         self._retry_at = 0.0
         self.last_rtt_s = None
+        # NTP half-rtt skew estimate: (peer_clock - local_clock), EWMA'd
+        # across heartbeats.  None until the first timestamped PONG lands.
+        self.clock_offset_s: float | None = None
         _M_PEER_UP.set(0.0 if self.dead else 1.0, endpoint=self.endpoint)
 
     # -- liveness ------------------------------------------------------------
@@ -495,6 +510,9 @@ class PeerLink:
     def _dispatch(self, ftype: int, body: bytes) -> None:
         if ftype == PING:
             doc = json.loads(body.decode())
+            # Stamp OUR clock into the echo so the pinger can estimate
+            # the skew between the two monotonic domains.
+            doc["pt"] = self.clock()
             try:
                 self.send_json(PONG, doc)
             except (PeerDiedError, TransportDownError):
@@ -507,6 +525,19 @@ class PeerLink:
             rtt = max(0.0, now - float(doc.get("t", now)))
             self.last_rtt_s = rtt
             _M_RTT.observe(rtt)
+            if "pt" in doc:
+                # Classic NTP single-exchange estimate: the peer stamped
+                # pt halfway through a round trip that took rtt, so
+                # offset = pt - (t + rtt/2) maps the peer's monotonic
+                # domain onto ours.  EWMA smooths jittered exchanges.
+                offset = float(doc["pt"]) - (float(doc.get("t", now)) + rtt / 2.0)
+                if self.clock_offset_s is None:
+                    self.clock_offset_s = offset
+                else:
+                    self.clock_offset_s = (
+                        0.8 * self.clock_offset_s + 0.2 * offset
+                    )
+                _M_CLOCK_OFFSET.set(self.clock_offset_s, endpoint=self.endpoint)
             return
         self.inbox.setdefault(ftype, deque()).append(body)
 
@@ -526,6 +557,7 @@ class PeerLink:
             "breaker_cooldown_s": round(self.breaker.cooldown_remaining(), 3),
             "reconnects": self.reconnects,
             "last_rtt_s": self.last_rtt_s,
+            "clock_offset_s": self.clock_offset_s,
             "pong_age_s": round(self.clock() - self._last_pong_at, 3),
             "reclaimed": len(self.reclaimed),
         }
@@ -558,6 +590,9 @@ class TransportChannel(HandoffChannel):
         self.link = link
         self.peer_pump = peer_pump
         self.remote_place = remote_place
+        # rid -> (trace_id, span_id, parent_id, t_send): the in-flight
+        # wire hop, recorded as a SpanRecord when the transfer resolves.
+        self._wire_spans: dict[int, tuple] = {}
         _LIVE_TRANSPORTS.add(self)
 
     @property
@@ -603,6 +638,19 @@ class TransportChannel(HandoffChannel):
             "request_id": rid
         }
         meta["_correlation"] = f"handoff-req-{rid}"
+        # Distributed-tracing context: the wire hop gets its own span
+        # (parented to the prefill hop when the HANDOFF frame named one),
+        # and the receiver parents its decode hop to the wire span.  The
+        # hop note survives the worker: if the peer dies mid-transfer the
+        # supervisor attributes the dead hop into the same tree.
+        ctx = FLEET.hop_ctx(rid) or {}
+        trace_id = ctx.get("trace_id") or f"req-{rid}"
+        wire_span_id = TRACES.mint_id("hop.wire")
+        meta["_trace"] = {"tid": trace_id, "parent": wire_span_id}
+        FLEET.note_hop(rid, trace_id, wire_span_id, instance=self.link.peer)
+        self._wire_spans[rid] = (
+            trace_id, wire_span_id, ctx.get("parent_id", ""), time.monotonic()
+        )
         try:
             latency += self.link.send_frame(
                 KV, encode_meta_frame(KV, meta, wire)[_FRAME_HEADER.size:],
@@ -672,6 +720,15 @@ class TransportChannel(HandoffChannel):
 
     def _finish(self, transfer, outcome: str) -> str:
         transfer.outcome = outcome
+        span = self._wire_spans.pop(transfer.request_id, None)
+        if span is not None:
+            trace_id, span_id, parent_id, t_send = span
+            TRACES.record(
+                trace_id, "hop.wire", t_send, time.monotonic(),
+                span_id=span_id, parent_id=parent_id,
+                peer=self.link.peer, outcome=outcome,
+                nbytes=transfer.nbytes, request_id=transfer.request_id,
+            )
         self._in_flight.pop(transfer.request_id, None)
         self.in_flight_bytes -= transfer.nbytes
         # Metric + counts + journal via the parent's bookkeeping path.
@@ -746,7 +803,12 @@ class WireReceiver:
         for ftype, body in self.frames.frames():
             n += 1
             if ftype == PING:
-                self._send(PONG, body)
+                try:
+                    doc = json.loads(body.decode())
+                except ValueError:
+                    doc = {}
+                doc["pt"] = self.clock()
+                self._send_json(PONG, doc)
             elif ftype == KV:
                 meta, wire = decode_meta_frame(body)
                 rid = int(meta.get("request_id", -1))
@@ -867,6 +929,7 @@ class RemotePool:
                     self._departed.discard(rid)
                     return rid
                 self._owner[rid] = self.link.peer
+                FLEET.note_hop(rid, f"req-{rid}", instance=self.link.peer)
                 # Submit-time retention is a RESUBMIT doc, not a snapshot
                 # entry: the sampler key lives in the worker's engine, so
                 # on crash the router re-submits the original request
@@ -912,6 +975,13 @@ class RemotePool:
                 raise TransportDownError(self.link.peer)
             meta = dict(keep)
             meta["_correlation"] = correlation or f"req-{rid}"
+            ctx = FLEET.hop_ctx(rid) or {}
+            trace_id = ctx.get("trace_id") or f"req-{rid}"
+            meta["_trace"] = {
+                "tid": trace_id, "parent": ctx.get("parent_id", ""),
+            }
+            FLEET.note_hop(rid, trace_id, ctx.get("parent_id", ""),
+                           instance=self.link.peer)
             try:
                 self.link.send_frame(
                     PLACE,
@@ -1007,6 +1077,7 @@ class RemotePool:
             if not (was_pending or was_resident):
                 self._departed.add(rid)
             self._owner.pop(rid, None)
+            FLEET.forget_hop(rid)
             self._completions.append(Completion(
                 request_id=rid,
                 tokens=[int(t) for t in doc.get("tokens", [])],
@@ -1020,6 +1091,14 @@ class RemotePool:
                 break
             meta, wire = decode_meta_frame(body)
             rid = int(meta.get("request_id", -1))
+            trace = meta.get("_trace") or {}
+            if trace:
+                # The prefill worker named its hop span; note it so the
+                # wire span (TransportChannel.complete) parents to it.
+                FLEET.note_hop(
+                    rid, str(trace.get("tid") or f"req-{rid}"),
+                    str(trace.get("parent", "")), instance=self.link.peer,
+                )
             # The stream has left the worker pool — from here the router
             # supervises it (staging area → channel → decode pool), so
             # the crash-recovery retention ends.
@@ -1040,6 +1119,14 @@ class RemotePool:
                     # KV-less handoff: the decode side re-prefills.
             self._owner.pop(rid, None)
             self._handoffs.append(entry)
+        while True:
+            body = self.link.take(TELEM)
+            if body is None:
+                break
+            FLEET.ingest_wire(
+                self.link.peer, body,
+                clock_offset_s=self.link.clock_offset_s,
+            )
 
     def _collect_failures(self) -> None:
         """Peer death: every retained stream drains to ``take_failed`` and
@@ -1054,6 +1141,11 @@ class RemotePool:
             self.link.reclaimed.add(rid)
             self._owner.pop(rid, None)
             self._failed.append(entry)
+            # The worker that owned these hops is a corpse: whatever spans
+            # it flushed before death already federated; mark the gap.
+            FLEET.attribute_dead_hop(
+                rid, self.link.peer, reason=self.link.death_reason
+            )
         JOURNAL.record(
             "transport", "pool.reclaim", correlation=self.link.endpoint,
             streams=len(moved), reason=self.link.death_reason,
@@ -1171,6 +1263,7 @@ class RemoteWorkerEngine:
                     # Completed inside the RPC window (short prompt).
                     self._departed.discard(rid)
                     return rid
+                FLEET.note_hop(rid, f"req-{rid}", instance=self.link.peer)
                 # KV-less snapshot retention: enough for a surviving
                 # replica's restore() to re-prefill the stream verbatim.
                 self._resident[rid] = {
@@ -1235,6 +1328,7 @@ class RemoteWorkerEngine:
             self._statuses[status] = self._statuses.get(status, 0) + 1
             self.tokens_generated += len(generated)
             self._last_progress_t = self.clock()
+            FLEET.forget_hop(rid)
             self._completions.append(Completion(
                 request_id=rid,
                 tokens=[int(t) for t in doc.get("tokens", [])],
@@ -1242,6 +1336,14 @@ class RemoteWorkerEngine:
                 error=str(doc.get("error", "")),
                 status=status,
             ))
+        while True:
+            body = self.link.take(TELEM)
+            if body is None:
+                break
+            FLEET.ingest_wire(
+                self.link.peer, body,
+                clock_offset_s=self.link.clock_offset_s,
+            )
 
     def completions(self) -> list:
         self._drain_completions()
@@ -1464,7 +1566,11 @@ class PoolWorker:
     SIGKILL chaos test uses to pin streams resident mid-decode."""
 
     def __init__(self, conn, router, *, role: str = "decode",
-                 fault_injector=None, hold_ticks: bool = False):
+                 fault_injector=None, hold_ticks: bool = False,
+                 name: str = "", clock=time.monotonic,
+                 telem_interval_s: float | None = None,
+                 telem_budget_bytes: int = TELEM_BUDGET_BYTES,
+                 traces=None):
         self.conn = conn
         self.router = router
         self.role = role
@@ -1472,6 +1578,28 @@ class PoolWorker:
         self.hold_ticks = hold_ticks
         self.frames = FrameBuffer()
         self.dead = False
+        self.clock = clock
+        self.instance = name or f"worker-{os.getpid()}"
+        # In-process rigs emulating a separate worker process hand in a
+        # private TraceBuffer so "worker" spans don't land in the
+        # supervisor's own ring (a real subprocess separates them free).
+        self.traces = traces if traces is not None else TRACES
+        # rid -> {"tid", "parent", "t0"}: the trace context that rode in
+        # on the frame that handed this worker the stream; closed out as
+        # a hop span when the stream leaves (COMPLETION / HANDOFF).
+        self._trace_ctx: dict[int, dict] = {}
+        # Telemetry federation is OPT-IN (worker_main turns it on): the
+        # in-process chaos rigs share one process's journal/registry with
+        # the supervisor, so shipping there would just echo global state.
+        self.shipper: TelemetryShipper | None = None
+        if telem_interval_s is not None:
+            self.shipper = TelemetryShipper(
+                lambda body: self._send(TELEM, body),
+                self.instance, clock=clock,
+                interval_s=telem_interval_s,
+                budget_bytes=telem_budget_bytes,
+                traces=self.traces,
+            )
 
     def pump_once(self) -> int:
         from k8s_dra_driver_tpu.models.serve import KVSlice, WireFormatError
@@ -1495,6 +1623,15 @@ class PoolWorker:
         if not self.hold_ticks:
             n += self.router.tick()
             for c in self.router.completions():
+                ctx = self._trace_ctx.pop(c.request_id, None)
+                if ctx is not None:
+                    self.traces.record(
+                        ctx["tid"], f"hop.{self.role}",
+                        ctx["t0"], self.clock(),
+                        parent_id=ctx["parent"],
+                        request_id=c.request_id, status=c.status,
+                        instance=self.instance,
+                    )
                 self._send_json(COMPLETION, {
                     "request_id": c.request_id, "tokens": c.tokens,
                     "generated": c.generated, "status": c.status,
@@ -1509,20 +1646,53 @@ class PoolWorker:
                     self.router._owner.pop(rid, None)
                     kv = entry.pop("kv", None)
                     wire = kv.to_wire(rid) if kv is not None else b""
+                    meta = _sanitize_entry(entry)
+                    ctx = self._trace_ctx.pop(rid, None)
+                    if ctx is not None:
+                        span = self.traces.record(
+                            ctx["tid"], "hop.prefill",
+                            ctx["t0"], self.clock(),
+                            parent_id=ctx["parent"], request_id=rid,
+                            instance=self.instance,
+                        )
+                        # Downstream hops (wire, decode) chain under the
+                        # prefill hop via the HANDOFF meta.
+                        meta["_trace"] = {
+                            "tid": ctx["tid"], "parent": span.span_id,
+                        }
                     self._send(HANDOFF, encode_meta_frame(
-                        HANDOFF, _sanitize_entry(entry), wire,
+                        HANDOFF, meta, wire,
                     )[_FRAME_HEADER.size:])
+        if self.shipper is not None and not self.dead:
+            # Cadence-paced: ships even while hold_ticks parks the router,
+            # so spans recorded before a SIGKILL still reach the fleet.
+            self.shipper.maybe_ship()
         return n
 
     def _handle(self, ftype, body, KVSlice, WireFormatError) -> None:
         if ftype == PING:
-            self._send(PONG, body)
+            try:
+                doc = json.loads(body.decode())
+            except ValueError:
+                doc = {}
+            doc["pt"] = self.clock()
+            self._send_json(PONG, doc)
         elif ftype == HELLO:
             pass
         elif ftype == CONTROL:
             doc = json.loads(body.decode())
             if doc.get("op") == "resume":
                 self.hold_ticks = False
+            elif doc.get("op") == "hold":
+                # Park decode ticks (frames still answered) — the chaos
+                # suite uses this to pin a WARM worker's streams resident
+                # before a SIGKILL, after earlier waves already served.
+                self.hold_ticks = True
+            elif doc.get("op") == "telem_flush":
+                # Forced snapshot (death reports, fleet diag bundles):
+                # everything new plus thread stacks, cadence ignored.
+                if self.shipper is not None:
+                    self.shipper.maybe_ship(force=True, include_stacks=True)
             elif doc.get("op") == "reset":
                 self.hold_ticks = False
                 self.router.completions()  # discard residuals
@@ -1557,6 +1727,11 @@ class PoolWorker:
                 rid = self.router.submit(
                     doc["prompt"], doc["max_tokens"], **kwargs
                 )
+                # Trace ids are rid-keyed by convention, so SUBMIT needs
+                # no explicit context: the hop starts here.
+                self._trace_ctx[rid] = {
+                    "tid": f"req-{rid}", "parent": "", "t0": self.clock(),
+                }
                 self._send_json(SUBMITTED, {
                     "seq": doc.get("seq"), "ok": True, "rid": rid,
                 })
@@ -1568,6 +1743,7 @@ class PoolWorker:
             meta, _ = decode_meta_frame(body)
             entry = {k: v for k, v in meta.items() if not k.startswith("_")}
             corr = meta.get("_correlation", "")
+            self._note_trace(int(entry["request_id"]), meta)
             self.router.place([entry], correlation=corr)
             self._send_json(PLACED, {"rid": int(entry["request_id"])})
         elif ftype == KV:
@@ -1575,6 +1751,7 @@ class PoolWorker:
             rid = int(meta.get("request_id", -1))
             corr = meta.get("_correlation", f"req-{rid}")
             entry = {k: v for k, v in meta.items() if not k.startswith("_")}
+            self._note_trace(rid, meta)
             try:
                 wrid, kv = KVSlice.from_wire(wire)
                 if wrid != rid:
@@ -1599,6 +1776,16 @@ class PoolWorker:
                     "rid": rid if rid >= 0 else exc.request_id,
                     "outcome": CORRUPT, "error": str(exc),
                 })
+
+    def _note_trace(self, rid: int, meta: dict) -> None:
+        """Capture the trace context a PLACE/KV frame carried, starting
+        this worker's hop clock for the stream."""
+        trace = meta.get("_trace") or {}
+        self._trace_ctx[rid] = {
+            "tid": str(trace.get("tid") or f"req-{rid}"),
+            "parent": str(trace.get("parent", "")),
+            "t0": self.clock(),
+        }
 
     def _send(self, ftype: int, body: bytes) -> None:
         try:
@@ -1783,6 +1970,13 @@ def worker_main(argv) -> int:
         conn, router, role=config.get("role", "decode"),
         fault_injector=fault_injector,
         hold_ticks=bool(config.get("hold_ticks", False)),
+        name=config.get("name", ""),
+        # Federation defaults ON in real worker processes — this is the
+        # only observability channel out of the process.
+        telem_interval_s=float(config.get("telem_interval_s", 0.25)),
+        telem_budget_bytes=int(
+            config.get("telem_budget_bytes", TELEM_BUDGET_BYTES)
+        ),
     )
     print(json.dumps({"ready": True, "pid": os.getpid()}), flush=True)
     while not worker.dead:

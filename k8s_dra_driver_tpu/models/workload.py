@@ -786,6 +786,7 @@ def replay(
     vocab: int = 64,
     prompt_limit: int | None = 24,
     on_completion=None,
+    burn_monitor=None,
 ) -> ReplayReport:
     """Drive ``router`` (FleetRouter or DisaggRouter) through a trace in
     simulated time.  Per tick: advance the clock, move due arrivals into
@@ -798,7 +799,10 @@ def replay(
     counted lost.  ``on_completion(completion)`` fires once per scored
     completion — the chaos suite uses it to prove bit-equality against
     an unfaulted reference without the driver retaining millions of
-    completion objects."""
+    completion objects.  ``burn_monitor`` (an
+    ``obs_plane.SloBurnRateMonitor``) is fed every scored verdict in
+    simulated time and ticked per replay tick, so the burn-rate windows
+    evaluate against the same clock the SLOs are scored on."""
     rep = ReplayReport()
     wall0 = time.perf_counter()
     arrivals = iter(trace)
@@ -818,8 +822,13 @@ def replay(
             backlog.append(nxt)
             nxt = next(arrivals, None)
         while len(backlog) > queue_limit:
-            backlog.pop()  # newest-first, same policy as the fleet queue
+            a_shed = backlog.pop()  # newest-first, same policy as the fleet queue
             rep.shed += 1
+            if burn_monitor is not None:
+                # A shed is an SLO miss by definition — it burns budget.
+                burn_monitor.observe(
+                    now, burn_monitor.classify_tier(a_shed.ttft_slo_s), False,
+                )
         while backlog:
             a = backlog[0]
             try:
@@ -835,6 +844,10 @@ def replay(
             last_progress_t = now
         rep.peak_backlog = max(rep.peak_backlog, len(backlog))
         router.tick()
+        if burn_monitor is not None:
+            # Evaluate BEFORE the autoscaler tick so a freshly-fired
+            # alert is visible to this tick's scale vote.
+            burn_monitor.tick(now)
         if autoscaler is not None:
             autoscaler.tick(queue_depth=len(backlog))
         live = _live_replica_count(router)
@@ -859,6 +872,12 @@ def replay(
             )
             ok_ttft = ttft <= a.ttft_slo_s
             ok_tpot = tpot <= a.tpot_slo_s
+            if burn_monitor is not None:
+                burn_monitor.observe(
+                    now,
+                    burn_monitor.classify_tier(a.ttft_slo_s),
+                    ok_ttft and ok_tpot,
+                )
             if ok_ttft and ok_tpot:
                 rep.attained += 1
             if not ok_ttft:

@@ -39,6 +39,22 @@ Endpoints (ARCHITECTURE.md "Observability" documents the inventory):
   autoscaler.FleetAutoscaler`'s view: policy thresholds, vote streaks,
   pending spawns, SLO attainment window and the latest decision doc
   (JSON).
+* ``/debug/fleet-journal`` — the observability plane's merged,
+  instance-tagged journal: every federated worker's flight-recorder
+  tail interleaved with the local process's, ordered by event
+  timestamp (JSON); filters: ``?limit=N&correlation=<id>&
+  component=<name>&instance=<worker>``.
+* ``/debug/fleet-traces`` — merged cross-process span trees: every
+  federated worker's spans skew-normalized into the control plane's
+  monotonic domain and joined with local spans by trace/span/parent
+  ids (JSON); filters: ``?trace_id=<id>&limit=N``.
+
+``/metrics`` federates automatically: when the observability plane
+(models/obs_plane.py) is loaded and has ingested TELEM snapshots, the
+local render is followed by every worker's registry rewritten under
+its ``instance=`` label.  Without the plane loaded the endpoint is
+byte-identical to the plain local render — control-plane binaries pay
+nothing for the feature they don't use.
 """
 
 from __future__ import annotations
@@ -74,7 +90,17 @@ class DiagnosticsServer:
                 url = urllib.parse.urlsplit(self.path)
                 query = urllib.parse.parse_qs(url.query)
                 if url.path == "/metrics":
-                    body = registry_ref.render().encode()
+                    # Federate only when the obs plane is ALREADY loaded:
+                    # importing it here would drag models/ into
+                    # control-plane binaries that never use federation.
+                    import sys
+
+                    obs = sys.modules.get("k8s_dra_driver_tpu.models.obs_plane")
+                    if obs is not None and obs.FLEET.stats()["instances"]:
+                        text = obs.FLEET.render_federated(registry_ref)
+                    else:
+                        text = registry_ref.render()
+                    body = text.encode()
                     ctype = "text/plain; version=0.0.4"
                 elif url.path == "/healthz":
                     body = b"ok"
@@ -162,6 +188,36 @@ class DiagnosticsServer:
                     body = json.dumps(
                         debug_autoscale_doc(), indent=1, default=str
                     ).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/fleet-journal":
+                    # Lazy for the same reason as /debug/fleet; the obs
+                    # plane imports only utils, never jax.
+                    from k8s_dra_driver_tpu.models.obs_plane import FLEET
+
+                    try:
+                        limit = int(query.get("limit", ["200"])[0])
+                    except ValueError:
+                        limit = 200
+                    doc = FLEET.fleet_journal_doc(
+                        limit=limit,
+                        correlation=query.get("correlation", [None])[0],
+                        component=query.get("component", [None])[0],
+                        instance=query.get("instance", [None])[0],
+                    )
+                    body = json.dumps(doc, indent=1, default=str).encode()
+                    ctype = "application/json"
+                elif url.path == "/debug/fleet-traces":
+                    from k8s_dra_driver_tpu.models.obs_plane import FLEET
+
+                    try:
+                        limit = int(query.get("limit", ["50"])[0])
+                    except ValueError:
+                        limit = 50
+                    doc = FLEET.fleet_traces_doc(
+                        trace_id=query.get("trace_id", [None])[0],
+                        limit=limit,
+                    )
+                    body = json.dumps(doc, indent=1, default=str).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

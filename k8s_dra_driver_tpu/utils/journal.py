@@ -125,6 +125,23 @@ class Journal:
             events = [e for e in events if e.component == component]
         return [e.to_json() for e in events[-limit:]]
 
+    def export_since(self, cursor: int) -> tuple[int, list[dict]]:
+        """Events recorded after ``cursor`` (a value previously returned by
+        this method; start from 0), plus the new cursor — the exactly-once
+        shipping primitive for telemetry federation.  Exported docs carry
+        the RAW epoch timestamp (``ts_s``) alongside the formatted one so
+        the fleet merger can order events from many processes without
+        re-parsing strings.  Events evicted from the ring before export
+        show up as a larger skip: bounded loss, never an error."""
+        with self._lock:
+            total = self._recorded
+            events = list(self._events)
+        start = total - len(events)  # seq of events[0]
+        skip = max(0, int(cursor) - start)
+        return total, [
+            {**e.to_json(), "ts_s": e.ts} for e in events[skip:]
+        ]
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -9,6 +9,7 @@ span.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -81,3 +82,123 @@ class Tracer:
 
 
 TRACER = Tracer()
+
+
+@dataclass(frozen=True)
+class SpanRecord:
+    """One federable span: flat (no object children), identified by
+    ``span_id`` and stitched into a tree via ``parent_id`` AFTER transport.
+
+    ``Span`` above is the in-process presentation shape; SpanRecord is the
+    wire shape.  Timestamps ``t0``/``t1`` are in the RECORDING process's
+    ``time.monotonic()`` domain — meaningless across processes until the
+    fleet merger subtracts that process's clock offset (estimated from
+    PING/PONG rtt) — which is exactly why they are shipped raw: the
+    control plane owns the skew model, not the worker."""
+
+    trace_id: str  # correlates every hop of one request, e.g. "req-17"
+    span_id: str
+    name: str  # "serve.request", "hop.prefill", "hop.wire", ...
+    t0: float  # time.monotonic() at span start (recorder's clock)
+    t1: float  # time.monotonic() at span end
+    parent_id: str = ""
+    attrs: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "name": self.name,
+            "t0": self.t0,
+            "t1": self.t1,
+            **({"parent_id": self.parent_id} if self.parent_id else {}),
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+    @staticmethod
+    def from_json(doc: dict) -> "SpanRecord":
+        return SpanRecord(
+            trace_id=str(doc.get("trace_id", "")),
+            span_id=str(doc.get("span_id", "")),
+            name=str(doc.get("name", "")),
+            t0=float(doc.get("t0", 0.0)),
+            t1=float(doc.get("t1", 0.0)),
+            parent_id=str(doc.get("parent_id", "")),
+            attrs=dict(doc.get("attrs", {}) or {}),
+        )
+
+
+class TraceBuffer:
+    """Bounded ring of SpanRecords with a monotonic sequence cursor, so a
+    shipper can export exactly-once without copying the whole ring each
+    cadence: ``export_since(cursor)`` returns only records appended after
+    the cursor, plus the new cursor.  Records evicted before export are
+    simply gone (drop-oldest — telemetry must never block serving)."""
+
+    def __init__(self, capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._records: deque[SpanRecord] = deque(maxlen=capacity)
+        self._seq = 0  # total records ever appended
+
+    def mint_id(self, name: str) -> str:
+        """Span ids unique across processes: pid-qualified sequence."""
+        with self._lock:
+            n = self._seq
+        return f"s{os.getpid():x}.{name}.{n}"
+
+    def record(self, trace_id: str, name: str, t0: float, t1: float, *,
+               parent_id: str = "", span_id: str = "", **attrs) -> SpanRecord:
+        rec = SpanRecord(
+            trace_id=str(trace_id),
+            span_id=span_id or self.mint_id(name),
+            name=name,
+            t0=float(t0),
+            t1=float(t1),
+            parent_id=str(parent_id),
+            attrs=attrs,
+        )
+        with self._lock:
+            self._records.append(rec)
+            self._seq += 1
+        return rec
+
+    def add(self, rec: SpanRecord) -> None:
+        with self._lock:
+            self._records.append(rec)
+            self._seq += 1
+
+    def export_since(self, cursor: int) -> tuple[int, list[dict]]:
+        """New records appended after ``cursor`` (a value previously
+        returned by this method; start from 0).  Ring eviction shows up as
+        a silently larger skip — bounded loss, never an error."""
+        with self._lock:
+            total = self._seq
+            records = list(self._records)
+        start = total - len(records)  # seq of records[0]
+        skip = max(0, int(cursor) - start)
+        return total, [r.to_json() for r in records[skip:]]
+
+    def snapshot(self, limit: int = 256) -> list[dict]:
+        with self._lock:
+            records = list(self._records)[-limit:]
+        return [r.to_json() for r in records]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self._records.maxlen,
+                "buffered": len(self._records),
+                "recorded": self._seq,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._seq = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+TRACES = TraceBuffer()

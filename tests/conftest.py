@@ -27,11 +27,21 @@ def _reset_observability():
     left in the process-wide REGISTRY, and journal asserts can't match a
     previous test's events.  Values reset, objects kept — modules bind
     metrics at import time (see Registry.reset)."""
+    import sys
+
     from k8s_dra_driver_tpu.utils.journal import JOURNAL
     from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+    from k8s_dra_driver_tpu.utils.tracing import TRACES
 
     REGISTRY.reset()
     JOURNAL.clear()
+    TRACES.clear()
+    # The fleet merger is models-side; clear it only when some test has
+    # already pulled it in — importing models/ from here would tax every
+    # utils-only test with the package import.
+    obs = sys.modules.get("k8s_dra_driver_tpu.models.obs_plane")
+    if obs is not None:
+        obs.FLEET.clear()
     yield
 
 

@@ -90,19 +90,28 @@ def _fail(workers, culprit: SupervisedWorker, why: str, bundle_dir) -> None:
 
     for w in workers:
         w.kill()
+    extra = {
+        "workers": {
+            w.name: {
+                "pid": w.pid,
+                "returncode": w.returncode,
+                "stderr_tail": w.stderr_tail(),
+            }
+            for w in workers
+        },
+    }
+    # When the observability plane is live in this supervisor, the death
+    # report also carries every federated worker's last journal/metrics/
+    # stacks snapshot — the SURVIVORS' view of the crash, not just the
+    # corpse's stderr.  Guarded on sys.modules so harness users that
+    # never load models/ pay nothing.
+    obs = sys.modules.get("k8s_dra_driver_tpu.models.obs_plane")
+    if obs is not None:
+        extra["fleet_telemetry"] = obs.FLEET.bundle_doc()
     bundle = dump_diag_bundle(
         str(bundle_dir), reason=f"mp-harness: {why}",
         correlation=f"worker-{culprit.name}",
-        extra={
-            "workers": {
-                w.name: {
-                    "pid": w.pid,
-                    "returncode": w.returncode,
-                    "stderr_tail": w.stderr_tail(),
-                }
-                for w in workers
-            },
-        },
+        extra=extra,
     )
     raise AssertionError(
         f"{why}\n"
@@ -111,6 +120,43 @@ def _fail(workers, culprit: SupervisedWorker, why: str, bundle_dir) -> None:
         f"{culprit.stderr_tail()}\n"
         f"--- diag bundle: {bundle} ---"
     )
+
+
+def wait_ready(workers: list, is_ready, timeout: float, bundle_dir="/tmp",
+               poll_s: float = 0.02):
+    """Block until ``is_ready()`` returns truthy, watching every worker
+    for early death the whole time.
+
+    The failure mode this kills: a worker crashes during startup while
+    the test blocks inside a ready-side call (``hub.link_for``, a dial
+    loop) for ITS full timeout — the eventual error says "timeout", not
+    why the worker died.  A worker that dies before the handshake fails
+    the wait immediately with its stderr tail attached (via
+    :func:`_fail`'s evidence bundle), ALWAYS — there is no JSON result
+    line to parse from a corpse.  Returns ``is_ready()``'s truthy value
+    so readiness probes can hand back a link/handle."""
+    deadline = time.monotonic() + timeout
+    while True:
+        val = is_ready()
+        if val:
+            return val
+        for w in workers:
+            rc = w.poll()
+            if rc is not None:
+                w.collect()
+                _fail(
+                    workers, w,
+                    f"worker {w.name!r} died rc={rc} before its ready "
+                    f"handshake",
+                    bundle_dir,
+                )
+        if time.monotonic() > deadline:
+            _fail(
+                workers, workers[0],
+                f"ready handshake still pending at the {timeout}s deadline",
+                bundle_dir,
+            )
+        time.sleep(poll_s)
 
 
 def supervise(workers: list, timeout: float, bundle_dir="/tmp") -> None:

@@ -1,0 +1,525 @@
+"""Fleet observability plane (PR 16): TELEM codec and budget, shipper
+cursors, skew-normalized span-tree merge, dead-hop attribution, SLO
+burn-rate windows, federated /metrics rendering, the /debug/fleet-*
+endpoints, diag-bundle fleet mode, autoscaler/rebalancer burn coupling,
+and an in-process PoolWorker federation rig (LoopbackConn standing in
+for the real socket; tests/test_transport_chaos.py covers the real
+two-process wire)."""
+
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from k8s_dra_driver_tpu.models import obs_plane as O
+from k8s_dra_driver_tpu.models.obs_plane import (
+    FLEET,
+    FleetObservability,
+    SloBurnRateMonitor,
+    TelemetryShipper,
+    decode_telem,
+    encode_telem,
+)
+from k8s_dra_driver_tpu.utils.journal import Journal
+from k8s_dra_driver_tpu.utils.metrics import (
+    REGISTRY,
+    Registry,
+    parse_prom_text,
+)
+from k8s_dra_driver_tpu.utils.tracing import TraceBuffer
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def _metric(name):
+    return parse_prom_text(REGISTRY.render()).get(name, {})
+
+
+class TestTelemCodec:
+    def test_roundtrip(self):
+        doc = {"instance": "w1", "journal": [{"event": "x"}], "mono": 1.5}
+        assert decode_telem(encode_telem(doc)) == doc
+
+    def test_crc_flip_is_counted_drop_never_fatal(self):
+        body = bytearray(encode_telem({"instance": "w1", "metrics": "a 1"}))
+        body[-1] ^= 0x40  # flip a payload byte; CRC rides up front
+        assert decode_telem(bytes(body)) is None
+        drops = _metric("tpu_obs_telem_frames_total")
+        assert drops[(("outcome", "crc_drop"),)] == 1.0
+
+    def test_short_and_malformed_frames_drop(self):
+        assert decode_telem(b"\x01") is None
+        import zlib as _z
+        bad = b"not json"
+        framed = O._CRC.pack(_z.crc32(bad)) + bad
+        assert decode_telem(framed) is None
+        drops = _metric("tpu_obs_telem_frames_total")
+        assert drops[(("outcome", "decode_drop"),)] == 2.0
+
+
+class TestShipper:
+    def _shipper(self, sent, **kw):
+        jr, tb, reg = Journal(), TraceBuffer(), Registry()
+        reg.counter("tpu_serve_test_total", "test").inc()
+        kw.setdefault("interval_s", 0.0)
+        return (
+            TelemetryShipper(
+                sent.append, "w1", journal=jr, traces=tb, registry=reg, **kw
+            ),
+            jr, tb, reg,
+        )
+
+    def test_cursor_exports_are_exactly_once(self):
+        sent = []
+        shipper, jr, tb, _ = self._shipper(sent)
+        jr.record("serve", "admit", correlation="req-1")
+        tb.record("req-1", "serve.request", 0.0, 1.0)
+        assert shipper.maybe_ship(force=True) > 0
+        first = decode_telem(sent[-1])
+        assert [e["event"] for e in first["journal"]] == ["admit"]
+        assert [s["name"] for s in first["spans"]] == ["serve.request"]
+        # Nothing new: the next ship carries empty deltas, but the
+        # registry re-renders every time (idempotent full snapshot).
+        shipper.maybe_ship(force=True)
+        second = decode_telem(sent[-1])
+        assert second["journal"] == [] and second["spans"] == []
+        assert "tpu_serve_test_total" in second["metrics"]
+        # New events after the cursor ship exactly once.
+        jr.record("serve", "retire", correlation="req-1")
+        shipper.maybe_ship(force=True)
+        assert [e["event"] for e in decode_telem(sent[-1])["journal"]] == [
+            "retire"
+        ]
+
+    def test_budget_truncation_sheds_and_marks(self):
+        sent = []
+        shipper, jr, _, _ = self._shipper(sent, budget_bytes=2048)
+        for i in range(400):
+            jr.record("serve", "admit", correlation=f"req-{i}", pad="y" * 64)
+        shipper.maybe_ship(force=True, include_stacks=True)
+        assert len(sent[-1]) <= 2048
+        doc = decode_telem(sent[-1])
+        assert doc["truncated"] is True
+        assert "stacks" not in doc  # shed first
+        # Oldest-first shed: whatever journal survived is the newest tail.
+        if doc["journal"]:
+            assert doc["journal"][-1]["correlation"] == "req-399"
+
+    def test_cadence_holds_fire_between_intervals(self):
+        sent = []
+        t = [0.0]
+        jr, tb, reg = Journal(), TraceBuffer(), Registry()
+        shipper = TelemetryShipper(
+            sent.append, "w1", clock=lambda: t[0], interval_s=1.0,
+            journal=jr, traces=tb, registry=reg,
+        )
+        assert shipper.maybe_ship() > 0
+        assert shipper.maybe_ship() == 0  # same instant: cadence holds
+        t[0] = 0.5
+        assert shipper.maybe_ship() == 0
+        t[0] = 1.1
+        assert shipper.maybe_ship() > 0
+        assert shipper.shipped_frames == 2
+
+
+class TestFleetMerge:
+    def _worker_doc(self, instance, spans=(), journal=(), metrics="",
+                    mono=0.0):
+        return {
+            "instance": instance, "mono": mono, "spans": list(spans),
+            "journal": list(journal), "metrics": metrics,
+        }
+
+    def test_federated_render_has_distinct_instance_labels(self):
+        plane = FleetObservability()
+        plane.ingest("w1", self._worker_doc(
+            "w1", metrics='tpu_serve_x_total{status="ok"} 3\nbare_metric 1'))
+        plane.ingest("w2", self._worker_doc("w2", metrics="tpu_serve_x_total 7"))
+        text = plane.render_federated()
+        parsed = parse_prom_text(text)
+        series = parsed["tpu_serve_x_total"]
+        assert (("instance", "w1"), ("status", "ok")) in series
+        assert (("instance", "w2"),) in series
+        assert parsed["bare_metric"][(("instance", "w1"),)] == 1.0
+
+    def test_skew_normalized_merge_is_one_ordered_tree(self):
+        plane = FleetObservability()
+        sup = TraceBuffer()
+        # Control plane records the prefill hop and the wire hop at its
+        # own clock; the worker's decode hop arrives with a +100s skew
+        # and an estimated offset of exactly +100.
+        pre = sup.record("req-1", "hop.prefill", 10.0, 10.4)
+        wire = sup.record("req-1", "hop.wire", 10.4, 10.6,
+                          parent_id=pre.span_id)
+        plane.ingest("w1", self._worker_doc("w1", spans=[{
+            "trace_id": "req-1", "span_id": "w1.decode.1",
+            "parent_id": wire.span_id, "name": "hop.decode",
+            "t0": 110.6, "t1": 111.0,
+        }]), clock_offset_s=100.0)
+        doc = plane.fleet_traces_doc(trace_id="req-1", traces=sup)
+        (tree,) = doc["traces"]
+        assert tree["instances"] == [O.SUPERVISOR, "w1"]
+        (root,) = tree["roots"]
+        assert root["name"] == "hop.prefill"
+        (wire_node,) = root["children"]
+        (decode_node,) = wire_node["children"]
+        assert decode_node["instance"] == "w1"
+        # Skew-normalized into the supervisor's domain: 110.6 - 100.
+        assert decode_node["t0"] == pytest.approx(10.6)
+        assert root["t0"] <= wire_node["t0"] <= decode_node["t0"]
+
+    def test_orphan_spans_become_extra_roots_not_losses(self):
+        plane = FleetObservability()
+        plane.ingest("w1", self._worker_doc("w1", spans=[{
+            "trace_id": "req-2", "span_id": "w1.s1",
+            "parent_id": "never-federated", "name": "hop.decode",
+            "t0": 1.0, "t1": 2.0,
+        }]))
+        doc = plane.fleet_traces_doc(trace_id="req-2", traces=TraceBuffer())
+        (tree,) = doc["traces"]
+        assert [r["name"] for r in tree["roots"]] == ["hop.decode"]
+
+    def test_dead_hop_attribution_lands_in_tree(self):
+        plane = FleetObservability()
+        buf = TraceBuffer()
+        span = buf.record("req-3", "hop.wire", 0.0, 0.5)
+        plane.note_hop(3, "req-3", span.span_id, instance="w1")
+        plane.attribute_dead_hop(3, "w1", reason="peer_reset", traces=buf)
+        assert plane.hop_ctx(3) is None  # consumed
+        doc = plane.fleet_traces_doc(trace_id="req-3", traces=buf)
+        (tree,) = doc["traces"]
+        (root,) = tree["roots"]
+        (dead,) = root["children"]
+        assert dead["name"] == "hop.dead"
+        assert dead["attrs"]["instance"] == "w1"
+        assert dead["attrs"]["reason"] == "peer_reset"
+
+    def test_fleet_journal_merges_instance_tagged_and_filters(self):
+        plane = FleetObservability()
+        plane.ingest("w1", self._worker_doc("w1", journal=[
+            {"component": "serve", "event": "admit", "ts_s": 2.0,
+             "correlation": "req-9"},
+        ]))
+        plane.ingest("w2", self._worker_doc("w2", journal=[
+            {"component": "transport", "event": "kv.installed", "ts_s": 1.0},
+        ]))
+        doc = plane.fleet_journal_doc()
+        assert doc["instances"] == ["w1", "w2"]
+        assert [e["instance"] for e in doc["events"]] == ["w2", "w1"]  # ts order
+        only = plane.fleet_journal_doc(instance="w1")
+        assert [e["event"] for e in only["events"]] == ["admit"]
+        corr = plane.fleet_journal_doc(correlation="req-9")
+        assert len(corr["events"]) == 1
+
+    def test_bundle_doc_keeps_dead_instances(self):
+        plane = FleetObservability()
+        plane.ingest("corpse", self._worker_doc(
+            "corpse", metrics="x 1",
+            journal=[{"component": "serve", "event": "admit", "ts_s": 1.0}]))
+        doc = plane.bundle_doc()
+        assert doc["instances"]["corpse"]["metrics"] == "x 1"
+        assert doc["instances"]["corpse"]["journal_tail"][0]["event"] == "admit"
+
+
+class TestBurnMonitor:
+    def test_classify_tier_matches_workload_defaults(self):
+        m = SloBurnRateMonitor
+        assert m.classify_tier(1.0) == O.INTERACTIVE
+        assert m.classify_tier(3.0) == O.STANDARD
+        assert m.classify_tier(10.0) == O.BATCH
+
+    def test_multi_window_guard_and_journaled_transitions(self):
+        jr = Journal()
+        m = SloBurnRateMonitor(journal=jr, timeline_every_s=10.0)
+        # An hour of clean traffic: no burn anywhere.
+        for t in range(0, 3600, 5):
+            m.observe(float(t), O.INTERACTIVE, True, count=4)
+        burn = m.tick(3600.0)
+        assert not m.alerting
+        assert burn[O.INTERACTIVE]["5m"] == 0.0
+        # A hot five minutes: the 5m window burns far past threshold but
+        # the 1h window still holds the alert back (multi-window guard).
+        for t in range(3600, 3900, 5):
+            m.observe(float(t), O.INTERACTIVE, False, count=4)
+        burn = m.tick(3900.0)
+        assert burn[O.INTERACTIVE]["5m"] > m.alert_threshold
+        if burn[O.INTERACTIVE]["1h"] <= m.alert_threshold:
+            assert not m.alerting
+        # Keep burning until BOTH windows agree.
+        t = 3900
+        while not m.alerting and t < 3600 * 3:
+            m.observe(float(t), O.INTERACTIVE, False, count=4)
+            m.tick(float(t))
+            t += 5
+        assert m.alerting and m.alerting_tiers == [O.INTERACTIVE]
+        fired = [e for e in jr.tail() if e["event"] == "slo.burn.fired"]
+        assert fired and fired[0]["correlation"] == "slo-interactive"
+        # Recovery: clean traffic long enough clears every window.
+        while m.alerting and t < 3600 * 6:
+            m.observe(float(t), O.INTERACTIVE, True, count=40)
+            m.tick(float(t))
+            t += 5
+        assert not m.alerting
+        assert any(e["event"] == "slo.burn.cleared" for e in jr.tail())
+        assert m.stats()["transitions"] == 2
+        assert m.timeline()  # sampled along the way
+        gauges = _metric("tpu_slo_burn_rate")
+        assert any(("window", "5m") in labels and ("tier", "interactive")
+                   in labels for labels in gauges)
+
+    def test_ingest_federated_bucket_diff_is_idempotent(self):
+        plane = FleetObservability()
+        reg = Registry()
+        h = reg.histogram("tpu_serve_ttft_seconds", "ttft",
+                          buckets=(0.5, 1.0, 2.0))
+        for v in (0.2, 0.3, 1.7, 1.9):  # 2 ok (<=1.0 SLO), 2 miss
+            h.observe(v)
+        m = SloBurnRateMonitor()
+        plane.ingest("w1", {"instance": "w1", "metrics": reg.render()})
+        assert m.ingest_federated(10.0, fleet=plane, slo_s=1.0) == 4
+        # Same cumulative snapshot again: the diff is zero, not double.
+        plane.ingest("w1", {"instance": "w1", "metrics": reg.render()})
+        assert m.ingest_federated(11.0, fleet=plane, slo_s=1.0) == 0
+        h.observe(0.1)  # one more ok
+        plane.ingest("w1", {"instance": "w1", "metrics": reg.render()})
+        assert m.ingest_federated(12.0, fleet=plane, slo_s=1.0) == 1
+        burn = m.tick(12.0)
+        # 2 misses out of 5 → miss fraction .4 / budget .05 = burn 8.
+        assert burn[O.FLEET_TIER]["5m"] == pytest.approx(8.0)
+
+
+class _StubAlert:
+    def __init__(self, alerting):
+        self.alerting = alerting
+        self.alerting_tiers = ["interactive"] if alerting else []
+
+
+class TestControlLoopCoupling:
+    def _fleet(self):
+        from k8s_dra_driver_tpu.models import workload
+        from k8s_dra_driver_tpu.models.fleet import FleetRouter
+
+        clock = workload.SimClock()
+        sink = workload.SimSink()
+
+        def factory():
+            return workload.SimEngine(clock=clock, sink=sink, n_slots=4)
+
+        return FleetRouter([factory()], clock=clock), factory, clock
+
+    def test_burn_alert_forces_scale_up(self):
+        from k8s_dra_driver_tpu.models.autoscaler import (
+            AutoscalerPolicy,
+            FleetAutoscaler,
+        )
+
+        router, factory, clock = self._fleet()
+        asc = FleetAutoscaler(
+            router, engine_factory=factory, clock=clock,
+            policy=AutoscalerPolicy(
+                min_replicas=1, max_replicas=4, up_ticks=1, cooldown_s=0.0,
+            ),
+            burn_monitor=_StubAlert(True),
+        )
+        decision = asc.tick()
+        assert decision["action"] == "up"
+        assert decision["reason"] == "slo_burn"
+        assert decision["burn_alert"] is True
+
+    def test_no_alert_leaves_idle_fleet_alone(self):
+        from k8s_dra_driver_tpu.models.autoscaler import (
+            AutoscalerPolicy,
+            FleetAutoscaler,
+        )
+
+        router, factory, clock = self._fleet()
+        asc = FleetAutoscaler(
+            router, engine_factory=factory, clock=clock,
+            policy=AutoscalerPolicy(
+                min_replicas=1, max_replicas=4, up_ticks=1, cooldown_s=0.0,
+            ),
+            burn_monitor=_StubAlert(False),
+        )
+        decision = asc.tick()
+        assert decision["action"] != "up"
+        assert decision["burn_alert"] is False
+
+    def test_rebalancer_burn_alert_drops_hysteresis(self):
+        from k8s_dra_driver_tpu.models.autoscaler import (
+            PoolRebalancer,
+            RebalancePolicy,
+        )
+
+        class _Disagg:
+            def take_stage_attribution(self):
+                # Decode dominates prefill 10x with plenty of samples.
+                return {
+                    "prefill": {"n": 20, "mean_s": 0.1},
+                    "decode": {"n": 20, "mean_s": 1.0},
+                }
+
+        class _Scaler:
+            def __init__(self):
+                self.router = object()
+                self.reasons = []
+
+            def scale_move(self, taker, reason=""):
+                self.reasons.append(reason)
+                return "corr-1"
+
+        pre, dec = _Scaler(), _Scaler()
+        calm = PoolRebalancer(
+            _Disagg(), pre, dec, policy=RebalancePolicy(vote_ticks=3),
+            clock=lambda: 0.0, burn_monitor=_StubAlert(False),
+        )
+        calm.tick()
+        assert calm.moves == 0  # hysteresis holds at one vote
+        hot = PoolRebalancer(
+            _Disagg(), pre, dec, policy=RebalancePolicy(vote_ticks=3),
+            clock=lambda: 0.0, burn_monitor=_StubAlert(True),
+        )
+        decision = hot.tick()
+        assert hot.moves == 1  # burn alert: act on the first vote
+        assert decision["burn_alert"] is True
+        assert pre.reasons == ["ttft_to_decode"]
+
+
+class TestEndpointsAndBundles:
+    def _populate_fleet(self):
+        FLEET.ingest("w1", {
+            "instance": "w1", "mono": 1.0,
+            "journal": [{"component": "serve", "event": "admit",
+                         "ts_s": 1.0, "correlation": "req-1"}],
+            "spans": [{"trace_id": "req-1", "span_id": "w1.s1",
+                       "parent_id": "", "name": "hop.decode",
+                       "t0": 1.0, "t1": 2.0}],
+            "metrics": "tpu_serve_x_total 5",
+        })
+
+    def test_fleet_endpoints_and_federated_metrics(self):
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        self._populate_fleet()
+        srv = DiagnosticsServer(port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            jd = json.loads(urllib.request.urlopen(
+                base + "/debug/fleet-journal?instance=w1").read())
+            assert jd["instances"] == ["w1"]
+            assert jd["events"][-1]["event"] == "admit"
+            td = json.loads(urllib.request.urlopen(
+                base + "/debug/fleet-traces?trace_id=req-1").read())
+            assert td["traces"][0]["instances"] == ["w1"]
+            metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+        finally:
+            srv.stop()
+        parsed = parse_prom_text(metrics)
+        # Local registry renders label-free; the worker's copy rides the
+        # SAME scrape under its instance label.
+        assert parsed["tpu_obs_instances"][()] == 1.0
+        assert parsed["tpu_serve_x_total"][(("instance", "w1"),)] == 5.0
+
+    def test_plain_metrics_when_fleet_is_empty(self):
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        srv = DiagnosticsServer(port=0)
+        srv.start()
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+        finally:
+            srv.stop()
+        assert text == REGISTRY.render()
+
+    def test_diag_bundle_fleet_mode(self):
+        import diag_bundle
+
+        from k8s_dra_driver_tpu.utils.diagnostics import DiagnosticsServer
+
+        self._populate_fleet()
+        srv = DiagnosticsServer(port=0)
+        srv.start()
+        try:
+            bundle, answered = diag_bundle.build_bundle(
+                f"http://127.0.0.1:{srv.port}", fleet=True)
+        finally:
+            srv.stop()
+        assert answered == len(diag_bundle.ENDPOINTS) + len(
+            diag_bundle.FLEET_ENDPOINTS)
+        assert bundle["kind"] == "tpu-dra-fleet-diag-bundle"
+        assert bundle["fleet_journal"]["instances"] == ["w1"]
+        assert bundle["fleet_traces"]["instances"] == ["w1"]
+        assert 'instance="w1"' in bundle["metrics"]
+
+    def test_mp_harness_death_report_carries_fleet_telemetry(self, tmp_path):
+        import os
+
+        from tests.mp_harness import SupervisedWorker, wait_ready
+
+        self._populate_fleet()
+        env = dict(os.environ)
+        crasher = SupervisedWorker(
+            "crasher",
+            [sys.executable, "-c",
+             "import sys; sys.stderr.write('pre-ready boom\\n'); sys.exit(7)"],
+            env,
+        )
+        with pytest.raises(AssertionError) as exc:
+            wait_ready([crasher], lambda: False, timeout=30,
+                       bundle_dir=tmp_path)
+        msg = str(exc.value)
+        assert "before its ready handshake" in msg
+        assert "pre-ready boom" in msg  # stderr tail ALWAYS attached
+        bundle_path = msg.split("diag bundle: ")[1].split(" ---")[0].strip()
+        bundle = json.loads(open(bundle_path).read())
+        assert bundle["workers"]["crasher"]["returncode"] == 7
+        assert "pre-ready boom" in bundle["workers"]["crasher"]["stderr_tail"]
+        # The surviving fleet's federated snapshots ride the death report.
+        assert bundle["fleet_telemetry"]["instances"]["w1"]["metrics"]
+
+    def test_wait_ready_returns_probe_value(self):
+        from tests.mp_harness import wait_ready
+
+        assert wait_ready([], lambda: "link", timeout=1) == "link"
+
+
+class TestInProcessFederation:
+    def test_poolworker_ships_and_fleet_ingests_with_skew(self):
+        """LoopbackConn federation rig: a PoolWorker with a -5s-skewed
+        clock and a private trace ring ships TELEM every pump; the
+        supervisor's RemotePool drains it into FLEET with the PING/PONG
+        offset estimate, so the federated view lands under the worker's
+        instance label with a recovered clock offset."""
+        from k8s_dra_driver_tpu.models import transport as T
+        from k8s_dra_driver_tpu.models import workload
+        from k8s_dra_driver_tpu.models.fleet import FleetRouter
+
+        clock = workload.SimClock()
+        sink = workload.SimSink()
+        import time as _time
+
+        skew = lambda: _time.monotonic() - 5.0  # noqa: E731
+        a, b = T.LoopbackConn.pair()
+        worker = T.PoolWorker(
+            b, FleetRouter([workload.SimEngine(clock=clock, sink=sink)]),
+            role="decode", name="obs-w", clock=skew,
+            telem_interval_s=0.0, traces=TraceBuffer(),
+        )
+        link = T.PeerLink("obs-w", a, heartbeat_interval_s=0.0)
+        pool = T.RemotePool(link, peer_pump=worker.pump_once)
+        for _ in range(20):
+            pool.tick()
+        assert "obs-w" in FLEET.stats()["instances"]
+        assert worker.shipper.shipped_frames > 0
+        assert link.clock_offset_s is not None
+        assert link.clock_offset_s == pytest.approx(-5.0, abs=0.5)
+        assert 'instance="obs-w"' in FLEET.render_federated()
+        # CONTROL telem_flush forces a stack-bearing snapshot through.
+        link.send_json(T.CONTROL, {"op": "telem_flush"})
+        for _ in range(5):
+            pool.tick()
+        assert FLEET.bundle_doc()["instances"]["obs-w"]["stacks"]

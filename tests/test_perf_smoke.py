@@ -94,6 +94,20 @@ def test_plan_scale_stays_within_perf_budgets():
     assert stats["audit_failures"] == 0 and stats["leaked_claims"] == 0
 
 
+def test_obs_plane_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_obs_plane_overhead()
+    assert stats["requests_shipped"] == 8
+    # The observability plane's contract: a telemetry tick is cursor
+    # exports + a registry render over host-resident rings — the router
+    # with a force-every-tick shipper attached pays EXACTLY the bare
+    # router's host syncs, every TELEM frame fits the 48 KiB ceiling,
+    # and the snapshots really land in the fleet merger.
+    assert stats["host_syncs_shipped"] == stats["host_syncs_bare"]
+    assert stats["telem_frames"] > 0
+    assert stats["telem_max_frame_bytes"] <= stats["telem_budget_bytes"]
+    assert stats["instances_federated"] == ["perf-w"]
+
+
 def test_autoscaler_overhead_stays_within_perf_budgets():
     stats = perf_smoke.check_autoscaler_overhead()
     assert stats["requests_scaled"] == 8

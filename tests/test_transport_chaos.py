@@ -45,7 +45,12 @@ from k8s_dra_driver_tpu.models.serve import ServeEngine
 from k8s_dra_driver_tpu.utils.faults import FaultInjector
 from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
 from k8s_dra_driver_tpu.utils.retry import CircuitBreaker
-from tests.mp_harness import REPO_ROOT, SupervisedWorker, supervise
+from tests.mp_harness import (
+    REPO_ROOT,
+    SupervisedWorker,
+    supervise,
+    wait_ready,
+)
 
 CFG = burnin.ModelConfig(
     vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
@@ -336,7 +341,7 @@ class TestHarnessHardening:
         assert sleeper.poll() is not None, "sibling was left running"
 
 
-def _worker_cfg(tmp_path, name, port, hold_ticks):
+def _worker_cfg(tmp_path, name, port, hold_ticks, peer="decode-w"):
     path = tmp_path / f"{name}.json"
     path.write_text(json.dumps({
         "cfg": CFG_DOC,
@@ -344,7 +349,7 @@ def _worker_cfg(tmp_path, name, port, hold_ticks):
         "seed": 0,
         "host": "127.0.0.1",
         "port": port,
-        "name": "decode-w",
+        "name": peer,
         "role": "decode",
         "hold_ticks": hold_ticks,
     }))
@@ -473,3 +478,251 @@ class TestTwoProcessTransport:
                 if w is not None:
                     w.kill()
             hub.close()
+
+
+def _spans_of(tree):
+    """Flatten one fleet_traces_doc tree into its span node list."""
+    out, stack = [], list(tree["roots"])
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        stack.extend(node["children"])
+    return out
+
+
+class TestObservabilityFederation:
+    def test_sigkill_federation_merged_tree_and_dead_hop(self, params,
+                                                         reference,
+                                                         tmp_path):
+        """PR 16's keystone: two REAL worker processes federate their
+        journals/spans/metrics over TELEM frames, and a SIGKILL mid-hold
+        loses neither the pre-death spans nor the attribution.  Wave 1
+        serves remotely on decode-w and its hop.decode spans federate into
+        ONE merged tree with the supervisor's hop.prefill/hop.wire (both
+        processes' clocks skew-normalized); decode-w is then held (streams
+        placed, undecoded) and SIGKILLed with wave 2 resident: wave 2
+        recovers bit-equal locally, every lost hop is attributed to
+        decode-w as a synthetic hop.dead span under its wire span, and
+        the dead worker's wave-1 spans are STILL in the fleet view.  A
+        second worker, decode-w2, federates the whole time — the
+        federated /metrics render carries both instance labels."""
+        from k8s_dra_driver_tpu.models.obs_plane import FLEET
+
+        hub = T.TransportHub(
+            heartbeat_interval_s=0.1, liveness_timeout_s=3.0,
+            ack_timeout_s=5.0,
+        )
+        w1 = _spawn_worker(
+            "decode-w", _worker_cfg(tmp_path, "w1", hub.port, False))
+        w2 = _spawn_worker(
+            "decode-w2",
+            _worker_cfg(tmp_path, "w2", hub.port, False, peer="decode-w2"))
+        workers = [w1, w2]
+        try:
+            link = wait_ready(
+                workers,
+                lambda: (hub.poll(), hub.links.get("decode-w"))[1],
+                timeout=120, bundle_dir=tmp_path,
+            )
+            link2 = wait_ready(
+                workers,
+                lambda: (hub.poll(), hub.links.get("decode-w2"))[1],
+                timeout=120, bundle_dir=tmp_path,
+            )
+            channel = T.TransportChannel(
+                link,
+                claim=ChannelClaim(
+                    bandwidth_gbps=1000.0, transfer_deadline_s=10.0
+                ),
+            )
+            pool = T.RemotePool(link, name="fed-pool")
+            # decode-w2 serves nothing; its RemotePool exists to drain the
+            # TELEM frames it ships on its own cadence.
+            pool2 = T.RemotePool(link2, name="fed-pool2")
+            dis = DisaggRouter(prefill=[_dense(params)], decode=pool,
+                               channel=channel)
+
+            rids1 = [dis.submit(r["prompt"], r["max_tokens"],
+                                seed=r["seed"],
+                                temperature=r.get("temperature", 0.0))
+                     for r in REQS]
+            done1 = []
+
+            def _wave1_served():
+                hub.poll()
+                dis.tick()
+                pool2.tick()
+                done1.extend(dis.completions())
+                return len(done1) == len(REQS)
+
+            wait_ready(workers, _wave1_served, timeout=120,
+                       bundle_dir=tmp_path)
+            _assert_no_lost_or_dup(done1, reference)
+
+            # Completions beat the 0.25s telemetry cadence — keep pumping
+            # until BOTH workers' snapshots federate and wave 1's remote
+            # decode hop is in the merged tree.
+            def _federated():
+                hub.poll()
+                dis.tick()
+                pool2.tick()
+                if "decode-w2" not in FLEET.stats()["instances"]:
+                    return False
+                doc = FLEET.fleet_traces_doc(trace_id=f"req-{rids1[0]}")
+                return any(
+                    s["name"] == "hop.decode" and s["instance"] == "decode-w"
+                    for tree in doc["traces"] for s in _spans_of(tree)
+                )
+
+            wait_ready(workers, _federated, timeout=60, bundle_dir=tmp_path)
+
+            # Every wave-1 request merged into ONE tree spanning both
+            # processes, skew-normalized: the worker's decode hop starts
+            # after the supervisor's wire hop within the offset-estimate
+            # error (shared CLOCK_MONOTONIC epoch keeps it near zero).
+            for rid in rids1:
+                doc = FLEET.fleet_traces_doc(trace_id=f"req-{rid}")
+                (tree,) = doc["traces"]
+                assert {"supervisor", "decode-w"} <= set(tree["instances"])
+                spans = _spans_of(tree)
+                wires = {s["span_id"]: s for s in spans
+                         if s["name"] == "hop.wire"}
+                pres = {s["span_id"]: s for s in spans
+                        if s["name"] == "hop.prefill"}
+                (dec,) = [s for s in spans if s["name"] == "hop.decode"]
+                assert dec["instance"] == "decode-w"
+                wire = wires[dec["parent_id"]]
+                pre = pres[wire["parent_id"]]
+                assert pre["t0"] <= wire["t0"] + 1e-6
+                assert dec["t0"] >= wire["t0"] - 0.5
+
+            # Pin wave 2 resident on decode-w (placed, undecoded), then
+            # SIGKILL it mid-hold.
+            link.send_json(T.CONTROL, {"op": "hold"})
+            rids2 = [dis.submit(r["prompt"], r["max_tokens"],
+                                seed=r["seed"]) for r in WAVE2]
+            wait_ready(
+                workers,
+                lambda: (hub.poll(), dis.tick(),
+                         len(pool._resident) >= len(WAVE2))[2],
+                timeout=120, bundle_dir=tmp_path,
+            )
+            w1.proc.kill()
+
+            ref2 = _by_prompt(_dense(params).pump([dict(r) for r in WAVE2]))
+            done2 = []
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                hub.poll()
+                dis.tick()
+                pool2.tick()
+                done2 += dis.completions()
+                if len(done2) == len(WAVE2):
+                    break
+                time.sleep(0.005)
+            assert len(done2) == len(WAVE2)
+            assert _by_prompt(done2) == ref2
+
+            # The corpse's hops are attributed: every wave-2 stream gets a
+            # synthetic hop.dead span naming decode-w, stitched under the
+            # wire span that delivered it.
+            for rid in rids2:
+                doc = FLEET.fleet_traces_doc(trace_id=f"req-{rid}")
+                (tree,) = doc["traces"]
+                spans = _spans_of(tree)
+                (dead,) = [s for s in spans if s["name"] == "hop.dead"]
+                assert dead["attrs"]["instance"] == "decode-w"
+                parents = {s["span_id"]: s for s in spans}
+                assert parents[dead["parent_id"]]["name"] == "hop.wire"
+            # Pre-death spans survive the death: wave 1's decode hops are
+            # still in the fleet view after decode-w was SIGKILLed.
+            doc = FLEET.fleet_traces_doc(trace_id=f"req-{rids1[0]}")
+            assert any(
+                s["name"] == "hop.decode" and s["instance"] == "decode-w"
+                for tree in doc["traces"] for s in _spans_of(tree)
+            )
+            # Federated /metrics: both workers under distinct instance
+            # labels in one render (the /metrics federation body).
+            text = FLEET.render_federated()
+            assert 'instance="decode-w"' in text
+            assert 'instance="decode-w2"' in text
+            assert sorted(FLEET.stats()["instances"]) == [
+                "decode-w", "decode-w2",
+            ]
+            # The serving worker's flight recorder merged into the fleet
+            # journal (idle decode-w2 has nothing to journal — its
+            # federation is proven by the instance set above).
+            jd = FLEET.fleet_journal_doc(limit=4096)
+            assert "decode-w" in {e["instance"] for e in jd["events"]}
+        finally:
+            for w in (w1, w2):
+                if w is not None:
+                    w.kill()
+            hub.close()
+
+    def test_latency_storm_skew_normalization_keeps_spans_ordered(
+            self, params, reference):
+        """In-process skew rig: the decode worker's clock runs 5 SECONDS
+        behind the supervisor's while a seeded sock_latency_ms storm
+        batters the link.  PING/PONG half-rtt estimation recovers the
+        offset, and the fleet merger's normalization keeps the merged
+        span trees causally ordered — unnormalized, every decode hop
+        would appear to START ~5s before the wire hop that delivered
+        it."""
+        from k8s_dra_driver_tpu.models.obs_plane import FLEET
+        from k8s_dra_driver_tpu.utils.tracing import TraceBuffer
+
+        inj = FaultInjector.from_env("sock_latency_ms=800,limit=5,seed=7")
+        a, b = T.LoopbackConn.pair(fault_injector=inj)
+        worker = T.PoolWorker(
+            b, FleetRouter([_dense(params)]), role="decode",
+            name="skew-w", clock=lambda: time.monotonic() - 5.0,
+            telem_interval_s=0.0, traces=TraceBuffer(),
+        )
+        link = T.PeerLink(
+            "skew-w", a,
+            heartbeat_interval_s=0.02,
+            liveness_timeout_s=5.0,
+            ack_timeout_s=0.5,
+            breaker=CircuitBreaker(
+                endpoint="transport/skew-w", reset_timeout_s=0.01
+            ),
+        )
+        channel = T.TransportChannel(
+            link, peer_pump=worker.pump_once,
+            claim=ChannelClaim(
+                bandwidth_gbps=1000.0, transfer_deadline_s=10.0
+            ),
+            fault_injector=inj,
+        )
+        pool = T.RemotePool(link, peer_pump=worker.pump_once)
+        router = DisaggRouter(prefill=[_dense(params)], decode=pool,
+                              channel=channel, fault_injector=inj)
+        done = router.pump([dict(r) for r in REQS])
+        _assert_no_lost_or_dup(done, reference)
+        assert inj.stats().get("sock_latency", 0) == 5  # the storm fired
+        # The NTP half-rtt estimate recovered the injected -5s skew.
+        assert link.clock_offset_s is not None
+        assert abs(link.clock_offset_s + 5.0) < 1.0
+        assert "skew-w" in FLEET.stats()["instances"]
+        # Worker spans live in a PRIVATE ring — they reached the fleet
+        # view only through TELEM frames, and arrive skew-normalized.
+        decode_spans = 0
+        for tree in FLEET.fleet_traces_doc()["traces"]:
+            spans = _spans_of(tree)
+            wires = {s["span_id"]: s for s in spans
+                     if s["name"] == "hop.wire"}
+            pres = {s["span_id"]: s for s in spans
+                    if s["name"] == "hop.prefill"}
+            for dec in spans:
+                if dec["name"] != "hop.decode":
+                    continue
+                assert dec["instance"] == "skew-w"
+                decode_spans += 1
+                wire = wires[dec["parent_id"]]
+                pre = pres[wire["parent_id"]]
+                assert pre["t0"] <= wire["t0"] + 1e-6
+                # Normalized causal order, to within the EWMA estimate
+                # error; the RAW timestamps would put dec ~5s earlier.
+                assert dec["t0"] >= wire["t0"] - 1.0
+        assert decode_spans == len(REQS)

@@ -17,6 +17,16 @@ stall — one artifact to attach to a bug report either way:
                   serving load-signal contract; empty when the process
                   hosts no serving engine)
 
+``--fleet`` widens the snapshot to the observability plane: the target
+is a CONTROL PLANE whose DiagnosticsServer federates its supervised
+workers' telemetry (models/obs_plane.py), so one bundle captures every
+worker's journal tail, spans and metrics — the metrics section already
+carries each worker's registry under its ``instance=`` label, and two
+more sections land alongside it:
+
+  /debug/fleet-journal  merged, instance-tagged journal across workers
+  /debug/fleet-traces   merged, skew-normalized span trees
+
 Per-endpoint failures are recorded in the bundle as ``"error: ..."``
 strings rather than aborting: a half-wedged process is EXACTLY the one
 worth snapshotting, and whatever still answers must land in the bundle.
@@ -24,6 +34,7 @@ worth snapshotting, and whatever still answers must land in the bundle.
 Usage:
     python tools/diag_bundle.py --url http://127.0.0.1:8080 [--out DIR]
     python tools/diag_bundle.py --port 8080   # shorthand for localhost
+    python tools/diag_bundle.py --port 8080 --fleet   # + fleet sections
 
 Prints the bundle path on success; exits 1 when NO endpoint answered
 (nothing listening is the one case with nothing to bundle).
@@ -50,6 +61,11 @@ ENDPOINTS = {
     "serve": "/debug/serve?limit=16",
 }
 
+FLEET_ENDPOINTS = {
+    "fleet_journal": "/debug/fleet-journal?limit=500",
+    "fleet_traces": "/debug/fleet-traces?limit=100",
+}
+
 TEXT_SECTIONS = {"healthz", "metrics"}  # not JSON on the wire
 
 
@@ -58,11 +74,13 @@ def fetch(url: str, timeout_s: float):
         return resp.read().decode()
 
 
-def collect(base_url: str, timeout_s: float = 5.0) -> tuple[dict, int]:
+def collect(base_url: str, timeout_s: float = 5.0,
+            fleet: bool = False) -> tuple[dict, int]:
     """Pull every endpoint; returns (sections, n_answered)."""
     sections: dict = {}
     answered = 0
-    for name, path in ENDPOINTS.items():
+    endpoints = {**ENDPOINTS, **(FLEET_ENDPOINTS if fleet else {})}
+    for name, path in endpoints.items():
         try:
             body = fetch(base_url.rstrip("/") + path, timeout_s)
             sections[name] = body if name in TEXT_SECTIONS else json.loads(body)
@@ -72,10 +90,11 @@ def collect(base_url: str, timeout_s: float = 5.0) -> tuple[dict, int]:
     return sections, answered
 
 
-def build_bundle(base_url: str, timeout_s: float = 5.0) -> tuple[dict, int]:
-    sections, answered = collect(base_url, timeout_s)
+def build_bundle(base_url: str, timeout_s: float = 5.0,
+                 fleet: bool = False) -> tuple[dict, int]:
+    sections, answered = collect(base_url, timeout_s, fleet=fleet)
     bundle = {
-        "kind": "tpu-dra-diag-bundle",
+        "kind": "tpu-dra-fleet-diag-bundle" if fleet else "tpu-dra-diag-bundle",
         "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "reason": f"diag_bundle.py snapshot of {base_url}",
         "source": base_url,
@@ -96,12 +115,18 @@ def main(argv: list[str] | None = None) -> int:
         help="output directory (default: $TPU_DRA_DIAG_DIR or $TMPDIR/tpu-dra-diag)",
     )
     parser.add_argument("--timeout-s", type=float, default=5.0)
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="also pull the observability plane's federated sections "
+             "(/debug/fleet-journal, /debug/fleet-traces); the metrics "
+             "section then carries every worker under instance= labels",
+    )
     args = parser.parse_args(argv)
     if bool(args.url) == bool(args.port):
         parser.error("exactly one of --url or --port is required")
     base_url = args.url or f"http://127.0.0.1:{args.port}"
 
-    bundle, answered = build_bundle(base_url, args.timeout_s)
+    bundle, answered = build_bundle(base_url, args.timeout_s, fleet=args.fleet)
     if answered == 0:
         print(f"diag_bundle: nothing listening at {base_url}", file=sys.stderr)
         return 1
@@ -113,7 +138,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     out_dir.mkdir(parents=True, exist_ok=True)
     stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
-    out = out_dir / f"diag-bundle-{stamp}-remote.json"
+    kind = "fleet" if args.fleet else "remote"
+    out = out_dir / f"diag-bundle-{stamp}-{kind}.json"
     out.write_text(json.dumps(bundle, indent=1, default=str))
     print(out)
     return 0

@@ -364,6 +364,7 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
             prefixes += (
                 "tpu_serve_", "tpu_fleet_", "tpu_disagg_",
                 "tpu_autoscale_", "tpu_transport_",
+                "tpu_obs_", "tpu_slo_",
             )
         try:
             tree = ast.parse(path.read_text(), filename=str(path))
@@ -429,10 +430,17 @@ METRIC_LABEL_KEYS = frozenset({
     # multi-objective plan scoring (scheduler/objectives.py): objective
     # names are the closed PlanScore component set
     "objective",
+    # observability plane (models/obs_plane.py): burn-rate windows and
+    # request tiers are closed sets declared in obs_plane; TELEM byte
+    # direction reuses the existing "direction" key with the {tx, rx} set
+    "window", "tier",
+    # federation identity: instance names come from operator-declared
+    # worker configs (same cardinality class as node/endpoint)
+    "instance",
 })
 METRIC_LABEL_PREFIXES = (
     "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",
-    "tpu_transport_", "dra_",
+    "tpu_transport_", "tpu_obs_", "tpu_slo_", "dra_",
 )
 _METRIC_CALL_ATTRS = {"inc", "observe", "set"}
 # First positionals of Counter.inc/Histogram.observe/Gauge.set when passed by

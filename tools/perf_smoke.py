@@ -745,6 +745,130 @@ def check_autoscaler_overhead() -> dict:
     return stats
 
 
+# The observability plane is pumped from the SAME loop the engines
+# already run on: a cadence tick exports the journal/span rings via seq
+# cursors and re-renders the metrics registry — pure host work over
+# already-host-resident state, never a device readback — so an engine
+# with the federation shipper attached pays EXACTLY the bare engine's
+# host syncs.  Frame bodies are hard-capped by TelemetryShipper._fit at
+# TELEM_BUDGET_BYTES (48 KiB) per burst, the documented ceiling that
+# keeps a telemetry tick two orders of magnitude under a paged-KV layer
+# shard on the shared socket.
+OBS_PLANE_OVERHEAD_FRAC = 0.50
+OBS_PLANE_OVERHEAD_FLOOR_S = 0.25
+
+
+def check_obs_plane_overhead() -> dict:
+    """Budget guard for the fleet observability plane (PR 16 tentpole):
+    a DisaggRouter driven tick-by-tick with a TelemetryShipper force-
+    shipping EVERY tick (the worst cadence possible) must dispatch
+    exactly the device work of the same router without one, every TELEM
+    frame must fit the byte ceiling, and the snapshots must actually
+    federate into a FleetObservability merger."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, disagg, obs_plane, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(8)
+    ]
+
+    def engine():
+        return serve.ServeEngine(
+            params=params, cfg=cfg, n_slots=4, prompt_bucket=16, sync_interval=8
+        )
+
+    reqs = [{"prompt": p, "max_tokens": 16} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    def drive(router, shipper=None):
+        rids = [router.submit(r["prompt"], r["max_tokens"]) for r in reqs]
+        done = []
+        for _ in range(5000):
+            router.tick()
+            done += router.completions()
+            if shipper is not None:
+                shipper.maybe_ship(force=True)
+            if len(done) == len(rids):
+                break
+        return done
+
+    pre_b, dec_b = engine(), engine()
+    bare = disagg.DisaggRouter(prefill=[pre_b], decode=[dec_b])
+    start = time.perf_counter()
+    done_bare = drive(bare)
+    bare_wall = time.perf_counter() - start
+
+    plane = obs_plane.FleetObservability()
+    frame_sizes = []
+
+    def send(body: bytes) -> None:
+        frame_sizes.append(len(body))
+        plane.ingest_wire("perf-w", body)
+
+    shipper = obs_plane.TelemetryShipper(send, "perf-w", interval_s=0.0)
+    pre_o, dec_o = engine(), engine()
+    shipped = disagg.DisaggRouter(prefill=[pre_o], decode=[dec_o])
+    start = time.perf_counter()
+    done_shipped = drive(shipped, shipper)
+    shipped_wall = time.perf_counter() - start
+
+    bare_syncs = pre_b.host_syncs + dec_b.host_syncs
+    shipped_syncs = pre_o.host_syncs + dec_o.host_syncs
+    budget = bare_wall * (1 + OBS_PLANE_OVERHEAD_FRAC) + OBS_PLANE_OVERHEAD_FLOOR_S
+    stats = {
+        "requests_bare": len(done_bare),
+        "requests_shipped": len(done_shipped),
+        "host_syncs_bare": bare_syncs,
+        "host_syncs_shipped": shipped_syncs,
+        "telem_frames": shipper.shipped_frames,
+        "telem_bytes": shipper.shipped_bytes,
+        "telem_max_frame_bytes": max(frame_sizes, default=0),
+        "telem_budget_bytes": obs_plane.TELEM_BUDGET_BYTES,
+        "instances_federated": plane.stats()["instances"],
+        "bare_s": round(bare_wall, 3),
+        "shipped_s": round(shipped_wall, 3),
+        "budget_frac": OBS_PLANE_OVERHEAD_FRAC,
+        "floor_s": OBS_PLANE_OVERHEAD_FLOOR_S,
+    }
+    if len(done_shipped) != len(reqs) or len(done_bare) != len(reqs):
+        raise PerfBudgetError(
+            f"obs-plane overhead run drained {len(done_shipped)}/{len(reqs)} "
+            f"shipped vs {len(done_bare)} bare"
+        )
+    if shipper.shipped_frames == 0 or plane.stats()["instances"] != ["perf-w"]:
+        raise PerfBudgetError(
+            f"federation never happened: {shipper.shipped_frames} frames, "
+            f"instances {plane.stats()['instances']} — the twin-run proved "
+            f"nothing"
+        )
+    if max(frame_sizes, default=0) > obs_plane.TELEM_BUDGET_BYTES:
+        raise PerfBudgetError(
+            f"a TELEM frame hit {max(frame_sizes)} bytes > the "
+            f"{obs_plane.TELEM_BUDGET_BYTES} ceiling — the shipper's shed "
+            f"order is not enforcing the budget"
+        )
+    if shipped_syncs != bare_syncs:
+        raise PerfBudgetError(
+            f"federation added device work: {shipped_syncs} host syncs with "
+            f"the shipper attached vs {bare_syncs} bare — a telemetry tick "
+            f"must be cursor exports + a registry render, never a readback"
+        )
+    if shipped_wall > budget:
+        raise PerfBudgetError(
+            f"shipped pump took {shipped_wall:.3f}s > {budget:.3f}s "
+            f"({bare_wall:.3f}s bare + {OBS_PLANE_OVERHEAD_FRAC:.0%} + "
+            f"{OBS_PLANE_OVERHEAD_FLOOR_S}s floor): per-tick export/encode "
+            f"is no longer cheap host work"
+        )
+    return stats
+
+
 # plan() at cluster scale (PR 15 tentpole): the allocation index keeps
 # per-node device groups and an incrementally-maintained consumed set, so
 # a single placement query against a 1k-node inventory is sub-millisecond
@@ -815,6 +939,7 @@ def main() -> int:
         stats["handoff_overhead"] = check_handoff_overhead()
         stats["transport_overhead"] = check_transport_overhead()
         stats["autoscaler_overhead"] = check_autoscaler_overhead()
+        stats["obs_plane_overhead"] = check_obs_plane_overhead()
         stats["plan_scale"] = check_plan_scale()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
