@@ -520,10 +520,45 @@ def _paged_throughput(
     dense_tok_s = timed(
         lambda: _chained_dense(params, prompt, steps, cfg, chain)
     )
+    # GQA-paged leg: the grouped-contraction gather path (PR 17) on the
+    # same config — the XLA path the quantized pools decode through.
+    gqa_leg: dict | float
+    try:
+        gqa_leg = timed(
+            lambda: paged.paged_greedy_decode(
+                params, prompt, steps, cfg, block_size=block_size,
+                cache_dtype=jnp.bfloat16, attn_impl="xla", chain=chain,
+            )
+        )
+    except Exception as exc:  # noqa: BLE001
+        gqa_leg = {"error": f"{type(exc).__name__}: {exc}"}
+    # kv_dtype x block_size sweep: every swept config is validated
+    # against the kernel's TPU block-size invariant FIRST, so a config
+    # that benches green here can never be TPU-invalid (the guard raises
+    # on any backend when called directly).
+    from k8s_dra_driver_tpu.ops.paged_attention import check_kernel_block_size
+
+    sweep: dict = {}
+    for bs in (128, 256):
+        check_kernel_block_size(bs)
+        for kvd, impl in ((None, "kernel"), ("int8", "xla"), ("int4", "xla")):
+            key = f"bs{bs}_{kvd or 'bf16'}"
+            try:
+                sweep[key] = timed(
+                    lambda bs=bs, kvd=kvd, impl=impl: paged.paged_greedy_decode(
+                        params, prompt, steps, cfg, block_size=bs,
+                        cache_dtype=jnp.bfloat16, attn_impl=impl,
+                        chain=chain, kv_dtype=kvd,
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001
+                sweep[key] = {"error": f"{type(exc).__name__}: {exc}"}
     return {
         "tokens_per_s": paged_tok_s,
         "dense_tokens_per_s": dense_tok_s,
         "vs_dense": round(paged_tok_s / dense_tok_s, 2),
+        "gqa_xla_tokens_per_s": gqa_leg,
+        "kv_dtype_sweep": sweep,
         "batch": batch,
         "context": prompt_len + steps,
         "prompt_len": prompt_len,
@@ -1165,6 +1200,14 @@ def _data_plane_degraded(sink: dict | None = None) -> dict:
         out["serving_autoscale"] = _autoscale_benchmark_cpu(headline=False)
     except Exception as exc:  # noqa: BLE001
         out["serving_autoscale"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # Paged-decode legs (PR 17): the degraded bodies are what actually
+        # populate results when the TPU tunnel is down (r04/r05), so the
+        # GQA-paged A/B, the kv_dtype sweep, and the capacity ratio all
+        # need CPU coverage — not just the full-chip body.
+        out["decode_paged"] = _paged_decode_cpu()
+    except Exception as exc:  # noqa: BLE001
+        out["decode_paged"] = {"error": f"{type(exc).__name__}: {exc}"}
     cfg = burnin.ModelConfig(
         vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
         max_seq=128,
@@ -1182,6 +1225,134 @@ def _data_plane_degraded(sink: dict | None = None) -> dict:
     out["burnin_step_ms"] = round((time.perf_counter() - start) / steps * 1000, 2)
     out["burnin_loss"] = round(last_loss, 4)
     out["reduced"] = "degraded body: small burn-in + serving A/B only"
+    return out
+
+
+def _paged_decode_cpu() -> dict:
+    """Degraded-body coverage for the PR 17 decode_paged legs, CPU-sized:
+
+    - GQA-paged vs reference paged attention A/B at EQUAL config — the
+      grouped-contraction path must be strictly faster (the reference
+      materializes two sequence-major pool copies per call; the GQA path
+      contracts on the gathered block layout) with a ``bit_equal``
+      honesty field at the serving bf16 pool dtype.
+    - a ``kv_dtype`` x ``block_size`` sweep of the paged decode loop,
+      each config pre-validated against the kernel's TPU block-size
+      invariant (``kernel_valid``) so a CPU-green sweep config can't be
+      TPU-invalid.
+    - the int8-KV capacity ratio at equal HBM budget — the
+      ``reservable_blocks`` number the KV-demand ledger admits on.
+
+    Attaches ``tunnel_probe.LAST_ERROR`` as ``degraded_reason`` (the PR
+    14 serving convention) so the artifact says WHY this body ran."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tools.tunnel_probe as tp
+    from k8s_dra_driver_tpu.models import burnin, decode, paged
+    from k8s_dra_driver_tpu.ops import paged_attention as pattn
+
+    out: dict = {"degraded_reason": getattr(tp, "LAST_ERROR", "")}
+
+    # -- A/B: GQA-paged vs reference paged attention, equal config -------
+    # window=1 is THE decode-step shape (one new token per resident row):
+    # there the reference path's two sequence-major pool copies are the
+    # largest per-call term, which is exactly what the GQA path deletes.
+    b, nq, hq, hkv, d, bs, mb = 4, 1, 8, 2, 64, 128, 16
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, nq, hq, d), jnp.bfloat16)
+    n_pool = 1 + b * mb
+    k_pool = jax.random.normal(kk, (n_pool, hkv, d, bs), jnp.bfloat16)
+    v_pool = jax.random.normal(kv_, (n_pool, hkv, d, bs), jnp.bfloat16)
+    table = (1 + jnp.arange(b * mb, dtype=jnp.int32)).reshape(b, mb)
+    pos = jnp.full((b,), mb * bs - nq, jnp.int32)
+    ref_fn = jax.jit(pattn.paged_window_attention_xla)
+    gqa_fn = jax.jit(pattn.paged_window_attention_xla_gqa)
+
+    def best_of(fn, reps=3, iters=30):
+        fn(q, k_pool, v_pool, table, pos).block_until_ready()  # compile
+        best = float("inf")
+        for _ in range(reps):
+            start = time.perf_counter()
+            for _ in range(iters):
+                r = fn(q, k_pool, v_pool, table, pos)
+            r.block_until_ready()
+            best = min(best, (time.perf_counter() - start) / iters)
+        return best
+
+    ref_t = best_of(ref_fn)
+    gqa_t = best_of(gqa_fn)
+    bit_equal = bool(
+        np.array_equal(
+            np.asarray(ref_fn(q, k_pool, v_pool, table, pos)),
+            np.asarray(gqa_fn(q, k_pool, v_pool, table, pos)),
+        )
+    )
+    out["gqa_ab"] = {
+        "ref_us": round(ref_t * 1e6, 1),
+        "gqa_us": round(gqa_t * 1e6, 1),
+        "speedup": round(ref_t / gqa_t, 2),
+        "gqa_faster": gqa_t < ref_t,
+        "bit_equal": bit_equal,
+        "kv_dtype": "bf16",
+        "shape": {"b": b, "window": nq, "heads": f"{hq}/{hkv}", "d": d,
+                  "block_size": bs, "blocks_per_row": mb},
+    }
+
+    # -- kv_dtype x block_size sweep over the decode loop ----------------
+    cfg = burnin.ModelConfig(
+        vocab_size=89, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq=128,
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = burnin.sample_tokens(jax.random.PRNGKey(1), cfg, batch=2, seq=8)
+    steps = 24
+    dense_ref = np.asarray(decode.greedy_decode(
+        params, prompt, steps, cfg, batch_prefill=True
+    ))
+    sweep: dict = {}
+    for sbs in (16, 32):
+        try:
+            pattn.check_kernel_block_size(sbs)
+            kernel_valid = True
+        except ValueError:
+            kernel_valid = False
+        for kvd in (None, "int8", "int4"):
+            key = f"bs{sbs}_{kvd or 'f32'}"
+            run = lambda: paged.paged_greedy_decode(  # noqa: E731
+                params, prompt, steps, cfg, block_size=sbs,
+                n_blocks=40, attn_impl="xla", kv_dtype=kvd,
+            )
+            first = np.asarray(run())
+            start = time.perf_counter()
+            np.asarray(run())
+            elapsed = time.perf_counter() - start
+            sweep[key] = {
+                "tokens_per_s": round(prompt.shape[0] * steps / elapsed, 1),
+                "kernel_valid": kernel_valid,
+                "bit_equal_dense": bool(np.array_equal(first, dense_ref)),
+            }
+    out["kv_dtype_sweep"] = sweep
+
+    # -- capacity: int8 pool vs bf16 pool at equal HBM budget ------------
+    hbm = 64 * paged.kv_block_bytes(cfg, 16, "bfloat16")
+    mk = lambda **kw: paged.PagedServeEngine(  # noqa: E731
+        params=params, cfg=cfg, n_slots=2, block_size=16, prompt_bucket=16,
+        attn_impl="xla", pool_hbm_bytes=hbm, **kw,
+    ).reservable_blocks
+    cap_bf16 = mk(cache_dtype="bfloat16")
+    cap_int8 = mk(kv_dtype="int8")
+    cap_int4 = mk(kv_dtype="int4")
+    out["capacity"] = {
+        "pool_hbm_bytes": hbm,
+        "reservable_bf16": cap_bf16,
+        "reservable_int8": cap_int8,
+        "reservable_int4": cap_int4,
+        "int8_ratio": round(cap_int8 / cap_bf16, 2),
+        "int4_ratio": round(cap_int4 / cap_bf16, 2),
+    }
     return out
 
 

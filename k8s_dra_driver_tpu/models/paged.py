@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from k8s_dra_driver_tpu.models import decode
+from k8s_dra_driver_tpu.models import quant
 from k8s_dra_driver_tpu.models.burnin import (
     ModelConfig,
     mlp_residual,
@@ -66,6 +67,16 @@ _M_PREEMPTIONS = REGISTRY.counter(
     "tpu_serve_preemptions_total",
     "requests evicted under pool pressure for later recompute-resume",
 )
+# Paged KV data plane (ARCHITECTURE.md "Paged KV data plane"): pool
+# residency in BYTES, labeled by storage dtype, so capacity dashboards see
+# the int8/int4 block win in the same unit HBM budgets are written in.
+_M_KV_BYTES = REGISTRY.gauge(
+    "tpu_serve_kv_bytes", "resident KV pool bytes (values + scales), by pool dtype"
+)
+_M_KV_DEQUANT = REGISTRY.counter(
+    "tpu_serve_kv_dequant_total",
+    "per-layer fused KV block dequantizations on the decode path",
+)
 
 
 class PagedKVCache(NamedTuple):
@@ -73,14 +84,40 @@ class PagedKVCache(NamedTuple):
     (head-major and TRANSPOSED — positions on the minormost/lane axis, so
     the pallas kernel's manual DMA tiles are exact lane multiples and K
     arrives in VMEM already in K^T form; see
-    ops/paged_attention.paged_window_attention)."""
+    ops/paged_attention.paged_window_attention).
+
+    QUANTIZED pool mode: ``k``/``v`` may store int8 (or packed-int4 uint8,
+    two lane positions per byte — the lane axis then holds
+    ``block_size // 2`` bytes) with ONE f32 scale per (layer, block,
+    kv-head) in ``k_scale``/``v_scale`` (``[L, n_blocks, Hkv]``; see
+    models/quant.quantize_kv_blocks).  Quantized-ness is derived from the
+    ARRAY dtype, never carried as pytree metadata, and the scale fields
+    default to None so the float pool's pytree structure (and every
+    sharded spec built against it) is unchanged."""
 
     k: jax.Array
     v: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return jnp.dtype(self.k.dtype) in (np.dtype(np.int8), np.dtype(np.uint8))
+
+    @property
+    def kv_dtype(self) -> str | None:
+        """Storage-mode name ("int8"/"int4") or None for float pools."""
+        if jnp.dtype(self.k.dtype) == np.dtype(np.int8):
+            return "int8"
+        if jnp.dtype(self.k.dtype) == np.dtype(np.uint8):
+            return "int4"
+        return None
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[4]
+        bs = self.k.shape[4]
+        # packed int4 holds two positions per lane byte
+        return bs * 2 if jnp.dtype(self.k.dtype) == np.dtype(np.uint8) else bs
 
     @property
     def n_blocks(self) -> int:
@@ -88,10 +125,65 @@ class PagedKVCache(NamedTuple):
 
 
 def init_paged_cache(
-    cfg: ModelConfig, n_blocks: int, block_size: int, dtype=jnp.float32
+    cfg: ModelConfig, n_blocks: int, block_size: int, dtype=jnp.float32,
+    kv_dtype: str | None = None,
 ) -> PagedKVCache:
-    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, cfg.head_dim, block_size)
-    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    if kv_dtype is None:
+        shape = (cfg.n_layers, n_blocks, cfg.kv_heads, cfg.head_dim, block_size)
+        return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    quant.kv_dtype_bits(kv_dtype)  # validates the name
+    if kv_dtype == "int4" and block_size % 2:
+        raise ValueError(f"int4 pools need an even block_size, got {block_size}")
+    lanes = block_size if kv_dtype == "int8" else block_size // 2
+    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, cfg.head_dim, lanes)
+    sshape = (cfg.n_layers, n_blocks, cfg.kv_heads)
+    if kv_dtype == "int8":
+        zero = lambda: jnp.zeros(shape, jnp.int8)
+    else:
+        # packed zero: both nibbles hold biased 0 (+8) -> 0x88 per byte
+        zero = lambda: jnp.full(shape, 0x88, jnp.uint8)
+    return PagedKVCache(
+        k=zero(), v=zero(),
+        # scale 1.0 matches quantize_kv_blocks' all-zero-block convention
+        k_scale=jnp.ones(sshape, jnp.float32),
+        v_scale=jnp.ones(sshape, jnp.float32),
+    )
+
+
+def kv_block_bytes(cfg: ModelConfig, block_size: int, kv_dtype=jnp.float32) -> int:
+    """Bytes ONE pool block costs across all layers, k + v, per-block
+    scales included — the unit a ``pool_hbm_bytes`` budget divides by.
+    ``kv_dtype`` is "int8"/"int4" or any float dtype."""
+    l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+    if isinstance(kv_dtype, str) and kv_dtype in quant.KV_DTYPES:
+        bits = quant.kv_dtype_bits(kv_dtype)
+        per_head = hd * block_size * bits // 8 + 4  # values + one f32 scale
+    else:
+        per_head = hd * block_size * jnp.dtype(kv_dtype).itemsize
+    return 2 * l * hkv * per_head
+
+
+def _quantized_block_write(pool, scale, li, bids, offs, vals, kv_dtype):
+    """Insert one new [Hkv, hd] vector per row into its frontier block of
+    a QUANTIZED pool at layer ``li``: gather block + scale, dequantize,
+    lane-select the new value in at ``offs``, zero every lane PAST it,
+    re-quantize, scatter block + scale back.
+
+    The zero-tail is the determinism invariant: a recycled block's stale
+    lane bytes must never fold into the fresh block's scale, so block
+    content stays a pure function of the token history — which is what
+    makes same-seed restore/handoff bit-exact and capture's clip to the
+    used blocks lossless.  Duplicate ``bids`` only ever occur at the NULL
+    block (inactive rows), which is never attended."""
+    blk = pool[li, bids]                       # [B, Hkv, hd, lanes]
+    sc = scale[li, bids]                       # [B, Hkv]
+    deq = quant.dequant_kv_blocks(blk, sc)     # [B, Hkv, hd, bs] f32
+    lane = jax.lax.broadcasted_iota(jnp.int32, deq.shape, 3)
+    off = offs[:, None, None, None]
+    deq = jnp.where(lane == off, vals.astype(jnp.float32)[..., None], deq)
+    deq = jnp.where(lane <= off, deq, 0.0)
+    qb, qs = quant.quantize_kv_blocks(deq, kv_dtype)
+    return pool.at[li, bids].set(qb), scale.at[li, bids].set(qs)
 
 
 class OutOfBlocks(RuntimeError):
@@ -277,6 +369,41 @@ def paged_decode_chunk(
         # (a duplicate-index scatter against the new owner is unordered)
         block_ids = jnp.where(active[:, None], block_ids, NULL_BLOCK)
 
+    if cache.quantized:
+        # Quantized pools: the per-token write is a gather -> dequant ->
+        # insert -> ZERO-TAIL -> requant -> scatter of each touched block
+        # (_quantized_block_write documents why the tail must zero), done
+        # sequentially over the S window positions so two writes into the
+        # same frontier block compose deterministically.  Attention then
+        # reads the int-sized pool with dequant fused into the operand
+        # load (paged_window_attention_xla_gqa).
+        kv_dtype = cache.kv_dtype
+        k_pool, v_pool = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
+        for li, p in enumerate(params["blocks"]):
+            delta = layer_delta(li)
+            q, k, v = qkv_proj(x, p, cfg, positions=positions, delta=delta)
+            for si in range(s):
+                k_pool, k_sc = _quantized_block_write(
+                    k_pool, k_sc, li, block_ids[:, si], offs[:, si],
+                    k[:, si], kv_dtype,
+                )
+                v_pool, v_sc = _quantized_block_write(
+                    v_pool, v_sc, li, block_ids[:, si], offs[:, si],
+                    v[:, si], kv_dtype,
+                )
+            attn = paged_attention.paged_window_attention_xla_gqa(
+                q, k_pool[li], v_pool[li], block_table, pos,
+                k_scale=k_sc[li], v_scale=v_sc[li],
+            )
+            attn = attn.reshape(b, s, cfg.d_model)
+            x = x + _mm(attn, p["attn_out"])
+            if delta is not None:
+                x = x + delta("attn_out", attn)
+            x = mlp_residual(x, p, delta=delta, top_k=cfg.moe_top_k)
+        cache = PagedKVCache(k=k_pool, v=v_pool, k_scale=k_sc, v_scale=v_sc)
+        return tied_logits(x, params), cache
+
     new_k, new_v = cache.k, cache.v
     for li, p in enumerate(params["blocks"]):
         delta = layer_delta(li)
@@ -284,7 +411,10 @@ def paged_decode_chunk(
         new_k = new_k.at[li, block_ids, :, :, offs].set(k.astype(new_k.dtype))
         new_v = new_v.at[li, block_ids, :, :, offs].set(v.astype(new_v.dtype))
         cache = PagedKVCache(k=new_k, v=new_v)
-        attn = paged_attention.paged_window_attention_xla(
+        # the GQA block-layout gather path: bit-equal to
+        # paged_window_attention_xla (tested) without its two materialized
+        # sequence-major pool copies per layer
+        attn = paged_attention.paged_window_attention_xla_gqa(
             q, cache.k[li], cache.v[li], block_table, pos
         )
         attn = attn.reshape(b, s, cfg.d_model)
@@ -318,7 +448,8 @@ def paged_prefill(
     nb = blocks_needed(p_len, bs)
     p_pad = nb * bs
     dense, last_logits = decode.prefill(
-        params, prompt, cfg, max_seq=p_pad, cache_dtype=cache.k.dtype,
+        params, prompt, cfg, max_seq=p_pad,
+        cache_dtype=jnp.float32 if cache.quantized else cache.k.dtype,
         adapters=adapters,
     )
     # [L, B, p_pad, Hkv, hd] -> blocks, then head-major TRANSPOSED to match
@@ -327,6 +458,21 @@ def paged_prefill(
     kb = dense.k.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
     vb = dense.v.reshape(l, b, nb, bs, hkv, hd).transpose(0, 1, 2, 4, 5, 3)
     ids = block_table[:, :nb]
+    if cache.quantized:
+        # whole-block quantization of the prefilled stripes (per-block
+        # scales over (hd, bs)); the dense scratch stays f32 and is freed
+        # by XLA after the scatter, exactly like the float path
+        qk, ksc = quant.quantize_kv_blocks(kb, cache.kv_dtype)
+        qv, vsc = quant.quantize_kv_blocks(vb, cache.kv_dtype)
+        return (
+            PagedKVCache(
+                k=cache.k.at[:, ids].set(qk),
+                v=cache.v.at[:, ids].set(qv),
+                k_scale=cache.k_scale.at[:, ids].set(ksc),
+                v_scale=cache.v_scale.at[:, ids].set(vsc),
+            ),
+            last_logits,
+        )
     return (
         PagedKVCache(k=cache.k.at[:, ids].set(kb), v=cache.v.at[:, ids].set(vb)),
         last_logits,
@@ -383,8 +529,14 @@ def paged_prefill_chunk(
     # any query from attending past its own position, so they are inert.
     ids = block_table_row[0, :mbp]
     # pool [L, N, Hkv, hd, bs] -> [L, mbp, Hkv, hd, bs] -> seq-major
-    pre_k = cache.k[:, ids].transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
-    pre_v = cache.v[:, ids].transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
+    # (quantized pools dequantize the done blocks into the f32 scratch row
+    # — the attended history is the dequantized one, same as decode)
+    kb_g, vb_g = cache.k[:, ids], cache.v[:, ids]
+    if cache.quantized:
+        kb_g = quant.dequant_kv_blocks(kb_g, cache.k_scale[:, ids])
+        vb_g = quant.dequant_kv_blocks(vb_g, cache.v_scale[:, ids])
+    pre_k = kb_g.transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
+    pre_v = vb_g.transpose(0, 1, 4, 2, 3).reshape(l, 1, p_pad, hkv, hd)
     row = decode.KVCache(k=pre_k, v=pre_v)
     chunk = jax.lax.dynamic_slice(prompt, (0, done_len), (1, chunk_len))
     _, row = decode.decode_chunk(
@@ -396,6 +548,14 @@ def paged_prefill_chunk(
     kb = jax.lax.dynamic_slice_in_dim(kb, done_blocks, chunk_blocks, axis=2)
     vb = jax.lax.dynamic_slice_in_dim(vb, done_blocks, chunk_blocks, axis=2)
     ids = jax.lax.dynamic_slice(block_table_row, (0, done_blocks), (1, chunk_blocks))
+    if cache.quantized:
+        qk, ksc = quant.quantize_kv_blocks(kb, cache.kv_dtype)
+        qv, vsc = quant.quantize_kv_blocks(vb, cache.kv_dtype)
+        return PagedKVCache(
+            k=cache.k.at[:, ids].set(qk), v=cache.v.at[:, ids].set(qv),
+            k_scale=cache.k_scale.at[:, ids].set(ksc),
+            v_scale=cache.v_scale.at[:, ids].set(vsc),
+        )
     return PagedKVCache(
         k=cache.k.at[:, ids].set(kb), v=cache.v.at[:, ids].set(vb)
     )
@@ -481,9 +641,10 @@ def _paged_pipelined_burst(
     readback per K tokens.  Rows the host left inactive (stalled or free)
     stay frozen; rows that retire on device go inactive for the rest of
     the burst and their writes divert to the null block.  Returns
-    (trace_tok [K,B], trace_active [K,B], trace_bad [K,B], cache, last,
-    pos, active); ``trace_bad``/``poison`` are the quarantine detector and
-    the injected-NaN mask, as in serve._pipelined_burst."""
+    (trace [3, K, B] i32 — token/active/bad planes STACKED on device so
+    the host pays ONE readback for the whole burst, not one per plane —
+    cache, last, pos, active); the bad plane/``poison`` are the quarantine
+    detector and the injected-NaN mask, as in serve._pipelined_burst."""
 
     def body(carry, _):
         cache, last, pos, active = carry
@@ -495,12 +656,17 @@ def _paged_pipelined_burst(
         new_last, new_pos, new_active = decode.advance_decode_state(
             next_tok, last, pos, active, stop_pos, eos_id
         )
-        return (cache, new_last, new_pos, new_active), (next_tok, active, bad)
+        step_trace = jnp.stack(
+            [next_tok, active.astype(jnp.int32), bad.astype(jnp.int32)]
+        )
+        return (cache, new_last, new_pos, new_active), step_trace
 
-    (cache, last, pos, active), (trace_tok, trace_act, trace_bad) = jax.lax.scan(
+    (cache, last, pos, active), trace = jax.lax.scan(
         body, (cache, tokens, pos, active), None, length=k
     )
-    return trace_tok, trace_act, trace_bad, cache, last, pos, active
+    # [K, 3, B] -> [3, K, B]: plane-major, so the host's single readback
+    # slices token/active/bad views without touching the device again
+    return trace.transpose(1, 0, 2), cache, last, pos, active
 
 
 def _paged_first_token(
@@ -630,6 +796,22 @@ class PagedServeEngine:
     block_size: int = 16
     prompt_bucket: int = 64
     cache_dtype: object = jnp.float32
+    # KV pool storage mode: None stores blocks in ``cache_dtype``; "int8"
+    # / "int4" store quantized blocks + per-block scales (models/quant),
+    # doubling / quadrupling the tokens a fixed HBM budget holds while
+    # per-step pool reads stay int-sized (dequant fuses into the
+    # attention operand load).  A FLOAT dtype name ("bfloat16") is also
+    # accepted and routed to cache_dtype, so sweeps can treat kv_dtype as
+    # one axis.  Quantized pools require attn_impl="xla" (the pallas
+    # kernel's DMA pipeline moves raw blocks and has no dequant stage)
+    # and an unsharded engine.
+    kv_dtype: str | None = None
+    # Size the pool by BYTES instead of blocks: when set, n_blocks is
+    # derived as pool_hbm_bytes // kv_block_bytes(cfg, block_size,
+    # kv_dtype or cache_dtype) — the equal-HBM-budget knob that makes the
+    # int8/int4 capacity win visible to ``reservable_blocks`` and through
+    # it to the disagg KV-demand ledger's admission headroom.
+    pool_hbm_bytes: int | None = None
     eos_id: int | None = None
     top_k: int = 0
     attn_impl: str | None = None  # None = kernel on TPU, xla elsewhere
@@ -771,6 +953,40 @@ class PagedServeEngine:
                 "kernel path; use a 128-multiple or attn_impl='xla'"
             )
         bs = self.block_size
+        # kv_dtype normalization: float NAMES route to cache_dtype (the
+        # dense axis value sweeps pass), int modes stay and are guarded.
+        if self.kv_dtype is not None and self.kv_dtype not in quant.KV_DTYPES:
+            self.cache_dtype = jnp.zeros((), self.kv_dtype).dtype  # raises on junk
+            self.kv_dtype = None
+        if self.kv_dtype is not None:
+            if self.attn_impl != "xla":
+                raise ValueError(
+                    f"kv_dtype={self.kv_dtype!r} needs attn_impl='xla' "
+                    f"(got {self.attn_impl!r}): the pallas kernel moves raw "
+                    "blocks with no dequant stage"
+                )
+            if self.mesh is not None:
+                raise ValueError(
+                    f"kv_dtype={self.kv_dtype!r} is single-shard only: "
+                    "quantized pools carry scale arrays the sharded specs "
+                    "do not cover"
+                )
+            if self.kv_dtype == "int4" and bs % 2:
+                raise ValueError(
+                    f"int4 pools need an even block_size, got {bs}"
+                )
+        if self.pool_hbm_bytes is not None:
+            per_block = kv_block_bytes(
+                cfg, bs, self.kv_dtype or self.cache_dtype
+            )
+            derived = self.pool_hbm_bytes // per_block
+            if derived < 2:
+                raise ValueError(
+                    f"pool_hbm_bytes={self.pool_hbm_bytes} holds {derived} "
+                    f"blocks of {per_block} bytes — need >= 2 (one is the "
+                    "null block)"
+                )
+            self.n_blocks = int(derived)
         self._mb = blocks_needed(cfg.max_seq, bs)        # table width
         self._mbp = blocks_needed(self.prompt_bucket, bs)  # prefill width
         self._axis_size = 1
@@ -817,7 +1033,8 @@ class PagedServeEngine:
         self._n_adapters = 0
         if self.mesh is None:
             self._cache = init_paged_cache(
-                cfg, self.n_blocks, bs, dtype=self.cache_dtype
+                cfg, self.n_blocks, bs, dtype=self.cache_dtype,
+                kv_dtype=self.kv_dtype,
             )
             self._table = jnp.asarray(self._table_np)
             self._last = jnp.zeros((self.n_slots,), jnp.int32)
@@ -1597,6 +1814,8 @@ class PagedServeEngine:
         bads = self._readback(bad)
         self.host_syncs += 1
         serve._M_HOST_SYNCS.inc()
+        if self._cache.quantized:
+            _M_KV_DEQUANT.inc(self.cfg.n_layers)
         committed = 0
         for slot, st in enumerate(self._slots):
             if st is None or not active[slot]:
@@ -1674,17 +1893,22 @@ class PagedServeEngine:
         self.telemetry.burst_begin(k, self._step_no)
         with WATCHDOG.guard("serve.paged_step_burst"):
             (
-                trace_t, trace_a, trace_b, self._cache,
+                trace, self._cache,
                 self._last, self._pos, active_j,
             ) = self._burst_fn(k)(
                 self.params, self._cache, self._table, self._last,
                 self._pos, active_j, self._temps, self._keys,
                 self._stop_pos, self._adapters(), self._slot_device(poison),
             )
-            trace_t = self._readback(trace_t)
-            trace_a = self._readback(trace_a)
-            trace_b = self._readback(trace_b)
+            # the burst's ONE device->host transfer: token/active/bad
+            # planes arrive stacked [3, K, B] (on-device sampling + stop
+            # masks mean nothing else ever needs to cross per step)
+            trace_t, trace_a, trace_b = self._readback(trace)
+            trace_a = trace_a.astype(bool)
+            trace_b = trace_b.astype(bool)
         self.host_syncs += 1
+        if self._cache.quantized:
+            _M_KV_DEQUANT.inc(k * self.cfg.n_layers)
         serve._M_HOST_SYNCS.inc()
         stepped = int(active.sum())
         # first poisoned step per slot: tokens before it are sound, the
@@ -1788,7 +2012,17 @@ class PagedServeEngine:
         [L, nb, Hkv, hd, bs], move positions off the lane axis, flatten
         and clip.  Bit-identical to a dense capture of the same stream by
         the paged-prefill construction (dense prefill then block
-        scatter).  One counted device sync, like the dense twin."""
+        scatter).  One counted device sync, like the dense twin.
+
+        QUANTIZED pools carry the RAW quantized values + per-block scales
+        VERBATIM (dequantizing to floats would not round-trip: requantize
+        of (127*s)/127 is not bit-stable in f32), over the PADDED
+        ``nb * bs`` extent rather than clipped to valid_len — the restore
+        scatter then reproduces the exact pool bytes, which is what makes
+        same-seed continuation after restore/handoff bit-exact.  int4
+        payloads repack the lane-axis nibbles onto the head_dim axis so
+        the wire form is seq-major like every other payload; the repack
+        is pure integer ops (exact)."""
         from k8s_dra_driver_tpu.models import serve
 
         bs = self.block_size
@@ -1796,10 +2030,32 @@ class PagedServeEngine:
         ids = np.asarray(self._owned[slot][:nb], np.int32)
         kb = self._readback(self._cache.k[:, jnp.asarray(ids)])
         vb = self._readback(self._cache.v[:, jnp.asarray(ids)])
-        self.host_syncs += 1
-        serve._M_HOST_SYNCS.inc()
         cfg = self.cfg
         l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        if self._cache.quantized:
+            kv_dtype = self._cache.kv_dtype
+            ksc = self._readback(self._cache.k_scale[:, jnp.asarray(ids)])
+            vsc = self._readback(self._cache.v_scale[:, jnp.asarray(ids)])
+            self.host_syncs += 1
+            serve._M_HOST_SYNCS.inc()
+            if kv_dtype == "int4":
+                kb = np.asarray(quant.unpack_int4(kb, axis=-1))
+                vb = np.asarray(quant.unpack_int4(vb, axis=-1))
+            k = np.transpose(kb, (0, 1, 4, 2, 3)).reshape(l, nb * bs, hkv, hd)
+            v = np.transpose(vb, (0, 1, 4, 2, 3)).reshape(l, nb * bs, hkv, hd)
+            if kv_dtype == "int4":
+                k = np.asarray(quant.pack_int4(k, axis=-1))
+                v = np.asarray(quant.pack_int4(v, axis=-1))
+            return serve.KVSlice(
+                k=np.ascontiguousarray(k), v=np.ascontiguousarray(v),
+                valid_len=valid_len, n_layers=l, kv_heads=hkv, head_dim=hd,
+                dtype=kv_dtype,
+                k_scale=np.ascontiguousarray(ksc),
+                v_scale=np.ascontiguousarray(vsc),
+                block_size=bs,
+            )
+        self.host_syncs += 1
+        serve._M_HOST_SYNCS.inc()
         k = np.transpose(kb, (0, 1, 4, 2, 3)).reshape(l, nb * bs, hkv, hd)
         v = np.transpose(vb, (0, 1, 4, 2, 3)).reshape(l, nb * bs, hkv, hd)
         k = np.ascontiguousarray(k[:, :valid_len])
@@ -1867,23 +2123,53 @@ class PagedServeEngine:
             l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
             nb = blocks_needed(kv.valid_len, bs)
             pad = nb * bs
-            k_p = np.zeros((l, pad, hkv, hd), kv.k.dtype)
-            v_p = np.zeros((l, pad, hkv, hd), kv.v.dtype)
-            k_p[:, : kv.valid_len] = kv.k
-            v_p[:, : kv.valid_len] = kv.v
-            # inverse of the capture gather: [L, nb*bs, Hkv, hd] -> block
-            # stripes [L, nb, Hkv, hd, bs] (positions back onto the lane axis)
-            kb = np.transpose(k_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
-            vb = np.transpose(v_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
             ids_j = jnp.asarray(np.asarray(ids[:nb], np.int32))
-            self._cache = PagedKVCache(
-                k=self._cache.k.at[:, ids_j].set(
-                    jnp.asarray(kb, self._cache.k.dtype)
-                ),
-                v=self._cache.v.at[:, ids_j].set(
-                    jnp.asarray(vb, self._cache.v.dtype)
-                ),
-            )
+            if self._cache.quantized:
+                # inverse of the quantized capture: payloads already carry
+                # the padded extent of RAW values, so the scatter below
+                # reproduces the origin pool bytes exactly (the geometry
+                # gate guaranteed matching kv_dtype and block_size)
+                k_p, v_p = kv.k, kv.v
+                if kv.dtype == "int4":
+                    k_p = np.asarray(quant.unpack_int4(k_p, axis=-1))
+                    v_p = np.asarray(quant.unpack_int4(v_p, axis=-1))
+                kb = np.transpose(k_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+                vb = np.transpose(v_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+                if kv.dtype == "int4":
+                    kb = np.asarray(quant.pack_int4(kb, axis=-1))
+                    vb = np.asarray(quant.pack_int4(vb, axis=-1))
+                self._cache = PagedKVCache(
+                    k=self._cache.k.at[:, ids_j].set(
+                        jnp.asarray(kb, self._cache.k.dtype)
+                    ),
+                    v=self._cache.v.at[:, ids_j].set(
+                        jnp.asarray(vb, self._cache.v.dtype)
+                    ),
+                    k_scale=self._cache.k_scale.at[:, ids_j].set(
+                        jnp.asarray(kv.k_scale, jnp.float32)
+                    ),
+                    v_scale=self._cache.v_scale.at[:, ids_j].set(
+                        jnp.asarray(kv.v_scale, jnp.float32)
+                    ),
+                )
+            else:
+                k_p = np.zeros((l, pad, hkv, hd), kv.k.dtype)
+                v_p = np.zeros((l, pad, hkv, hd), kv.v.dtype)
+                k_p[:, : kv.valid_len] = kv.k
+                v_p[:, : kv.valid_len] = kv.v
+                # inverse of the capture gather: [L, nb*bs, Hkv, hd] ->
+                # block stripes [L, nb, Hkv, hd, bs] (positions back onto
+                # the lane axis)
+                kb = np.transpose(k_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+                vb = np.transpose(v_p.reshape(l, nb, bs, hkv, hd), (0, 1, 3, 4, 2))
+                self._cache = PagedKVCache(
+                    k=self._cache.k.at[:, ids_j].set(
+                        jnp.asarray(kb, self._cache.k.dtype)
+                    ),
+                    v=self._cache.v.at[:, ids_j].set(
+                        jnp.asarray(vb, self._cache.v.dtype)
+                    ),
+                )
             self._owned[slot] = ids
             self._table_np[slot, :] = NULL_BLOCK
             self._table_np[slot, :need] = ids
@@ -2124,7 +2410,8 @@ class PagedServeEngine:
             ax = self.slot_axis
             cache_p = PagedKVCache(k=P(None, ax), v=P(None, ax))
             row_p = P(ax)
-            trace_p = P(None, ax)  # [K, n_slots]: slots shard, steps don't
+            # [3, K, n_slots]: slots shard, planes and steps don't
+            trace_p = P(None, None, ax)
             ad_p = (P(), P(ax)) if self.adapter_bank is not None else P()
             fn = jax.jit(
                 jax.shard_map(
@@ -2134,8 +2421,7 @@ class PagedServeEngine:
                     mesh=self.mesh,
                     in_specs=(P(), cache_p, row_p, row_p, row_p, row_p,
                               row_p, row_p, row_p, ad_p, row_p),
-                    out_specs=(trace_p, trace_p, trace_p, cache_p, row_p,
-                               row_p, row_p),
+                    out_specs=(trace_p, cache_p, row_p, row_p, row_p),
                 ),
                 donate_argnums=(1,),
             )
@@ -2379,18 +2665,25 @@ class PagedServeEngine:
 
         serve._M_OCCUPANCY.set(self.n_slots - self.free_slots())
         _M_POOL_FREE.set(self.free_blocks)
+        kv_dtype = self.kv_dtype or str(jnp.zeros((), self.cache_dtype).dtype)
+        _M_KV_BYTES.set(
+            self.n_blocks * kv_block_bytes(
+                self.cfg, self.block_size, self.kv_dtype or self.cache_dtype
+            ),
+            dtype=kv_dtype,
+        )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "steps", "cfg", "block_size", "n_blocks", "cache_dtype",
-        "attn_impl", "interpret", "chain",
+        "attn_impl", "interpret", "chain", "kv_dtype",
     ),
 )
 def _paged_greedy_jit(
     params, prompt, table, *, steps, cfg, block_size, n_blocks,
-    cache_dtype, attn_impl, interpret, chain,
+    cache_dtype, attn_impl, interpret, chain, kv_dtype=None,
 ):
     """Whole paged greedy pass (cache init + prefill scatter + decode scan)
     as ONE compiled program — on tunneled devices the eager prefill's
@@ -2415,7 +2708,9 @@ def _paged_greedy_jit(
 
     out = prompt
     for _ in range(chain):
-        cache = init_paged_cache(cfg, n_blocks, block_size, dtype=cache_dtype)
+        cache = init_paged_cache(
+            cfg, n_blocks, block_size, dtype=cache_dtype, kv_dtype=kv_dtype
+        )
         cache, last_logits = paged_prefill(params, out, cache, table, cfg=cfg)
         first = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
         tokens = jnp.concatenate(
@@ -2442,6 +2737,7 @@ def paged_greedy_decode(
     attn_impl: str = "xla",
     interpret: bool = False,
     chain: int = 1,
+    kv_dtype: str | None = None,
 ):
     """Greedy continuation over a paged cache: [B, P] -> [B, P+steps]
     (of the LAST chained pass; chain > 1 is the bench's RTT amortization).
@@ -2450,7 +2746,10 @@ def paged_greedy_decode(
     row's blocks up front (static table -> one compiled program), prefills,
     then scans :func:`paged_decode_step`.  Token-exact vs
     ``decode.greedy_decode(..., batch_prefill=True)`` -- tests pin it.
+    ``kv_dtype`` "int8"/"int4" runs the quantized-pool mode (xla only).
     """
+    if kv_dtype is not None and attn_impl != "xla":
+        raise ValueError(f"kv_dtype={kv_dtype!r} needs attn_impl='xla'")
     b, p_len = prompt.shape
     total = p_len + steps
     mb = blocks_needed(total, block_size)
@@ -2464,5 +2763,5 @@ def paged_greedy_decode(
         params, prompt, jnp.asarray(table), steps=steps, cfg=cfg,
         block_size=block_size, n_blocks=n_blocks,
         cache_dtype=jnp.dtype(cache_dtype), attn_impl=attn_impl,
-        interpret=interpret, chain=chain,
+        interpret=interpret, chain=chain, kv_dtype=kv_dtype,
     )

@@ -158,6 +158,93 @@ class Quantized4Matrix:
 _QUANTIZED = (QuantizedMatrix, Quantized4Matrix)
 
 
+# -- KV-cache block quantization ---------------------------------------------
+# The paged engine's pool blocks (models/paged.py) can store k/v as int8 (or
+# packed int4) with ONE f32 scale per (layer, block, kv-head): decode is
+# HBM-bound on the cache read exactly like it is on the weight read, so
+# halving/quartering the bytes per pooled key doubles/quadruples both the
+# per-step read bandwidth AND the blocks a fixed HBM budget can hold.  Same
+# symmetric recipe as the weight path; the per-BLOCK granularity is what
+# keeps the scatter-on-write cheap (a write re-quantizes one block, never a
+# whole row).  Layout contract: the quantized axis pair is the TRAILING
+# (head_dim, block_size) of the pool stripe [..., Hkv, hd, bs]; int4 packs
+# two POSITIONS per byte along the lane axis, half-split like
+# Quantized4Matrix (byte j holds positions j and j + bs/2).
+
+KV_DTYPES = ("int8", "int4")
+
+
+def kv_dtype_bits(kv_dtype: str) -> int:
+    """Stored bits per pooled k/v element for a quantized pool mode."""
+    if kv_dtype == "int8":
+        return 8
+    if kv_dtype == "int4":
+        return 4
+    raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+
+
+def quantize_kv_blocks(x: jax.Array, kv_dtype: str):
+    """Symmetric per-block quantization of pool block stripes.
+
+    ``x``: float ``[..., hd, bs]`` (any leading axes — typically
+    ``[L, n_blocks, Hkv]``).  Returns ``(q, scale)`` where ``scale`` is f32
+    with shape ``x.shape[:-2]`` (one scale per block per kv-head) and ``q``
+    is int8 ``[..., hd, bs]`` for int8, or packed uint8 ``[..., hd, bs//2]``
+    for int4 (two positions per byte, half-split along the lane axis).
+    All-zero blocks quantize against scale 1.0 so dequant is exact zero."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=(-2, -1))
+    if kv_dtype == "int8":
+        scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+        q = jnp.clip(
+            jnp.round(x32 / scale[..., None, None]), -127, 127
+        ).astype(jnp.int8)
+        return q, scale
+    if kv_dtype == "int4":
+        scale = jnp.where(amax == 0, 1.0, amax / 7.0)
+        q = jnp.clip(
+            jnp.round(x32 / scale[..., None, None]), -7, 7
+        ).astype(jnp.int8)
+        return pack_int4(q, axis=-1), scale
+    raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+
+
+def dequant_kv_blocks(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_blocks`: ``q`` int8 ``[..., hd, bs]``
+    or packed uint8 ``[..., hd, bs//2]`` plus per-block ``scale``
+    ``q.shape[:-2]`` back to float blocks ``[..., hd, bs]``.  Inside jit the
+    convert+scale fuses into the consuming attention dot's operand load —
+    the pool's HBM read stays int-sized (the weight-path contract)."""
+    if q.dtype == jnp.uint8:  # packed int4
+        q = unpack_int4(q, axis=-1)
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
+
+
+def pack_int4(q: jax.Array, axis: int = -1) -> jax.Array:
+    """Pack int8 values in [-8, 7] two-per-byte along ``axis`` (even size),
+    HALF-SPLIT like Quantized4Matrix: byte ``i`` holds element ``i`` (low
+    nibble) and element ``i + n/2`` (high), both biased by +8 — unpack is
+    two mask chains and one contiguous concat, no element shuffle.  Pure
+    integer ops: pack/unpack round-trips bit-exactly."""
+    axis = axis % q.ndim
+    n = q.shape[axis]
+    if n % 2:
+        raise ValueError(f"int4 pack axis must be even, got {n}")
+    half = n // 2
+    biased = (q + 8).astype(jnp.uint8)
+    lo = jax.lax.slice_in_dim(biased, 0, half, axis=axis)
+    hi = jax.lax.slice_in_dim(biased, half, n, axis=axis)
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed: jax.Array, axis: int = -1) -> jax.Array:
+    """Inverse of :func:`pack_int4`: uint8 nibble pairs back to int8 in
+    [-8, 7], doubling ``axis``."""
+    lo = (packed & 0xF).astype(jnp.int8) - 8
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    return jnp.concatenate([lo, hi], axis=axis)
+
+
 def mat(w):
     """Matmul-operand view: dequantized for quantized weights, identity
     for plain arrays — the one helper every weight-consuming einsum goes

@@ -54,6 +54,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernel path works across the versions the fleet actually runs.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _paged_kernel(
     *refs,
@@ -92,27 +98,67 @@ def _paged_kernel(
     length = lens_ref[b]
 
     def fetches(bi, ii, slot):
-        """The 2*pages async copies filling buffer half ``slot`` with
-        superblock ``ii`` of row ``bi``.  Each page moves one contiguous
-        ``[Hkv, d, bs]`` stripe (positions on LANES — the transposed pool
-        layout keeps every copy's minormost dim an exact lane-tile
-        multiple, which Mosaic requires of manual DMAs).  Page indices
-        past the row's last used block clamp to it (their keys mask off)
-        so table reads never go out of bounds and the tail DMA stays
-        well-defined."""
+        """Per-page fetch descriptors ``(live, k_copy, v_copy, dst)`` for
+        filling buffer half ``slot`` with superblock ``ii`` of row ``bi``.
+        Each page moves one contiguous ``[Hkv, d, bs]`` stripe (positions
+        on LANES — the transposed pool layout keeps every copy's minormost
+        dim an exact lane-tile multiple, which Mosaic requires of manual
+        DMAs).  Pages past the row's last used block are DEAD — every key
+        they could carry masks off — so they issue NO DMA at all: the old
+        scheme clamped them to a redundant re-fetch of the tail block,
+        which made a 3-live-block row pay ``pages`` HBM copies and pushed
+        the next row's prefetch out from under the current step's compute.
+        With dead pages skipped, prefetch traffic follows the RAGGED
+        lengths and the short-tail prefetch always rides under compute.
+        Live tail pages still clamp their index to the table bound so
+        reads never go out of range."""
         last = jnp.maximum((lens_ref[bi] - 1) // block_size, 0)
         cps = []
         for p in range(pages):
+            live = ii * pages + p <= last
             j = jnp.minimum(ii * pages + p, jnp.minimum(last, max_blocks - 1))
             idx = table_ref[bi * max_blocks + j]
             dst = pl.ds(p * block_size, block_size)
-            cps.append(pltpu.make_async_copy(
-                page(k_hbm, idx), k_buf.at[slot, :, :, dst], k_sem.at[slot]
-            ))
-            cps.append(pltpu.make_async_copy(
-                page(v_hbm, idx), v_buf.at[slot, :, :, dst], v_sem.at[slot]
+            cps.append((
+                live,
+                pltpu.make_async_copy(
+                    page(k_hbm, idx), k_buf.at[slot, :, :, dst], k_sem.at[slot]
+                ),
+                pltpu.make_async_copy(
+                    page(v_hbm, idx), v_buf.at[slot, :, :, dst], v_sem.at[slot]
+                ),
+                dst,
             ))
         return cps
+
+    def start_fetches(bi, ii, slot):
+        for live, ck, cv, dst in fetches(bi, ii, slot):
+            @pl.when(live)
+            def _go(ck=ck, cv=cv):
+                ck.start()
+                cv.start()
+
+            # Dead pages zero their V lanes instead (a VMEM memset, off the
+            # HBM path): their softmax weights are exactly 0, but 0 * stale
+            # lane would poison the PV dot when the leftover bytes are a
+            # previously-fetched row's NaN-poisoned blocks.  K lanes may
+            # stay stale — dead-lane scores are overwritten by the -inf
+            # mask before anything reads them.
+            @pl.when(jnp.logical_not(live))
+            def _zero(slot=slot, dst=dst):
+                v_buf[slot, :, :, dst] = jnp.zeros(
+                    (v_buf.shape[1], v_buf.shape[2], block_size), v_buf.dtype
+                )
+
+    def wait_fetches(bi, ii, slot):
+        # conds are a pure function of (bi, ii) via lens_ref, so the waits
+        # here pair exactly with the starts issued by the PREVIOUS step's
+        # prefetch (or this step's own first fetch).
+        for live, ck, cv, _dst in fetches(bi, ii, slot):
+            @pl.when(live)
+            def _done(ck=ck, cv=cv):
+                ck.wait()
+                cv.wait()
 
     def writebacks(slot):
         """The (at most 2 per k/v) copies flushing blended frontier pages
@@ -153,8 +199,7 @@ def _paged_kernel(
 
         @pl.when(first == 1)
         def _fetch_own():  # very first alive step: nobody prefetched for us
-            for c in fetches(b, i, slot):
-                c.start()
+            start_fetches(b, i, slot)
 
         @pl.when(i == 0)
         def _init_state():
@@ -173,12 +218,10 @@ def _paged_kernel(
         @pl.when(next_b < batch)
         def _prefetch_next():  # rides under THIS step's compute
             nslot = 1 - slot
-            for c in fetches(next_b, next_i, nslot):
-                c.start()
+            start_fetches(next_b, next_i, nslot)
             buf_ref[0] = nslot
 
-        for c in fetches(b, i, slot):
-            c.wait()
+        wait_fetches(b, i, slot)
         q = q_ref[0]             # [Hkv, G*nq, d] — every head in one step
         hkv, gnq, _d = q.shape
         k = k_buf[slot]          # [Hkv, d, span] — K^T, the MXU-native form
@@ -248,6 +291,24 @@ def _paged_kernel(
         )
 
 
+def check_kernel_block_size(block_size: int) -> None:
+    """The pool-geometry invariant of the TPU DMA kernel path, as a
+    callable validator: manual Mosaic DMAs need the minormost (lane) dim
+    to be an exact lane-tile multiple, so ``block_size % 128 == 0``.
+
+    The runtime guards in :func:`paged_window_attention` /
+    :func:`paged_append_attention` only raise on the TPU backend (CPU
+    tests legitimately run tiny blocks through interpret/XLA paths) —
+    which means a CPU-green sweep config can silently be TPU-invalid.
+    Sweeps and tests that claim TPU validity for a config must call this
+    directly so the invariant is asserted on EVERY backend."""
+    if block_size % 128:
+        raise ValueError(
+            f"the TPU DMA path needs block_size % 128 == 0, got {block_size} "
+            "(smaller blocks: use the XLA gather path)"
+        )
+
+
 def default_pages_per_step(
     block_size: int, max_blocks: int, hkv: int, d: int, itemsize: int
 ) -> int:
@@ -293,11 +354,8 @@ def paged_window_attention(
     n_pool, hkv, _d, block_size = k_pool.shape
     if hq % hkv:
         raise ValueError(f"query heads {hq} must be a multiple of kv heads {hkv}")
-    if not interpret and jax.default_backend() == "tpu" and block_size % 128:
-        raise ValueError(
-            f"the TPU DMA path needs block_size % 128 == 0, got {block_size} "
-            "(smaller blocks: use the XLA gather path)"
-        )
+    if not interpret and jax.default_backend() == "tpu":
+        check_kernel_block_size(block_size)
     groups = hq // hkv
     max_blocks = block_table.shape[1]
     pages = pages_per_step or default_pages_per_step(
@@ -351,7 +409,7 @@ def paged_window_attention(
         out_shape=jax.ShapeDtypeStruct((b, hkv, groups * nq, d), q.dtype),
         # the cross-row prefetch chain (last superblock of row r fetches
         # row r+1's first) makes BOTH axes order-dependent
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
@@ -405,11 +463,8 @@ def paged_append_attention(
             f"append window {nq} exceeds block_size {block_size} "
             "(new positions must span at most two blocks)"
         )
-    if not interpret and jax.default_backend() == "tpu" and block_size % 128:
-        raise ValueError(
-            f"the TPU DMA path needs block_size % 128 == 0, got {block_size} "
-            "(smaller blocks: use the XLA gather path)"
-        )
+    if not interpret and jax.default_backend() == "tpu":
+        check_kernel_block_size(block_size)
     groups = hq // hkv
     max_blocks = block_table.shape[1]
     pages = pages_per_step or default_pages_per_step(
@@ -479,7 +534,7 @@ def paged_append_attention(
         # inputs are (table, lens, wmask, layer, buf, init, qg, nk, nv,
         # k_pools, v_pools): thread the pools through in place
         input_output_aliases={9: 1, 10: 2},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
@@ -535,6 +590,68 @@ def paged_window_attention_xla(q, k_pool, v_pool, block_table, pos):
     qpos = pos[:, None] + jnp.arange(nq)[None, :]
     mask = (k_pos[None, None, :] <= qpos[:, :, None])[:, None]
     return _masked_attention(q, k, v, mask)
+
+
+def paged_window_attention_xla_gqa(
+    q, k_pool, v_pool, block_table, pos, *, k_scale=None, v_scale=None
+):
+    """GQA-aware gather path: same semantics as
+    :func:`paged_window_attention_xla`, but the grouped einsums contract
+    DIRECTLY on the gathered block layout ``[B, mb, Hkv, d, bs]`` — the
+    reference path's ``transpose(0, 1, 4, 2, 3).reshape`` materializes two
+    full sequence-major copies of the gathered pool per call (on CPU that
+    copy dominates the whole decode step), while here only the f32 score
+    tensor is reshaped (free: the ``(mb, bs)`` pair is already contiguous
+    in key order).  Numerics mirror ``decode._masked_attention``'s grouped
+    branch op-for-op (same contraction dims, f32 accumulation, mask value,
+    softmax) so a bf16/f32 pool stays BIT-equal to the reference path —
+    tests pin that, and bench reports it as the ``bit_equal`` honesty
+    field.
+
+    ``k_scale``/``v_scale`` (``[n_blocks, Hkv]`` f32, per-block symmetric
+    scales from ``models.quant.quantize_kv_blocks``) switch on the
+    quantized-pool mode: pools arrive int8 ``[n_blocks, Hkv, d, bs]`` or
+    packed-int4 uint8 ``[n_blocks, Hkv, d, bs//2]``, the gather moves
+    int-sized bytes, and dequant happens AFTER the gather on the block
+    operands (fused by XLA into the dot's operand load) — per-step HBM
+    traffic stays int8/int4-sized."""
+    from k8s_dra_driver_tpu.models import quant
+
+    b, nq, hq, d = q.shape
+    hkv = k_pool.shape[1]
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} must be a multiple of kv heads {hkv}")
+    groups = hq // hkv
+    kb = k_pool[block_table]  # [B, mb, Hkv, d, bs] (bs//2 bytes if int4)
+    vb = v_pool[block_table]
+    if k_scale is not None:
+        kb = quant.dequant_kv_blocks(kb, k_scale[block_table])
+        vb = quant.dequant_kv_blocks(vb, v_scale[block_table])
+    qg = q.reshape(b, nq, hkv, groups, d)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scores = (
+        jnp.einsum(
+            "bqhgd,bmhds->bhgqms",
+            qg.astype(kb.dtype),
+            kb,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    mb, bs = scores.shape[-2:]
+    scores = scores.reshape(b, hkv, groups, nq, mb * bs)
+    k_pos = jnp.arange(mb * bs)
+    qpos = pos[:, None] + jnp.arange(nq)[None, :]
+    mask = (k_pos[None, None, :] <= qpos[:, :, None])[:, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgqms,bmhds->bqhgd",
+        probs.reshape(b, hkv, groups, nq, mb, bs).astype(vb.dtype),
+        vb,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype).reshape(b, nq, hq, d)
 
 
 def paged_attention_xla(q, k_pool, v_pool, block_table, lengths):

@@ -299,6 +299,27 @@ class TestHandoffChannel:
         assert ch.complete(t, kv) == "corrupt"
 
 
+def _quant_kv(kv_dtype="int8", seed=5):
+    """Synthetic QUANTIZED slice with the padded-extent geometry the
+    engine captures: 2 blocks of 4, 6 valid positions (2-token tail)."""
+    L, hkv, hd, bs, nb = 1, 2, 16, 4, 2
+    padded = nb * bs
+    r = np.random.RandomState(seed)
+    if kv_dtype == "int8":
+        k = r.randint(-127, 128, (L, padded, hkv, hd)).astype(np.int8)
+        v = r.randint(-127, 128, (L, padded, hkv, hd)).astype(np.int8)
+    else:  # packed int4: two positions per byte along the trailing dim
+        k = r.randint(0, 256, (L, padded, hkv, hd // 2)).astype(np.uint8)
+        v = r.randint(0, 256, (L, padded, hkv, hd // 2)).astype(np.uint8)
+    return KVSlice(
+        k=k, v=v, valid_len=6, n_layers=L, kv_heads=hkv, head_dim=hd,
+        dtype=kv_dtype,
+        k_scale=r.rand(L, nb, hkv).astype(np.float32),
+        v_scale=r.rand(L, nb, hkv).astype(np.float32),
+        block_size=bs,
+    )
+
+
 def _assert_wire_roundtrip(kv: KVSlice, rid: int) -> bytes:
     wire = kv.to_wire(rid)
     got_rid, got = KVSlice.from_wire(wire)
@@ -309,6 +330,13 @@ def _assert_wire_roundtrip(kv: KVSlice, rid: int) -> bytes:
         kv.valid_len, kv.n_layers, kv.kv_heads, kv.head_dim
     )
     assert got.dtype == kv.dtype
+    assert got.block_size == kv.block_size
+    if kv.quantized:
+        assert np.array_equal(np.asarray(got.k_scale), np.asarray(kv.k_scale))
+        assert np.array_equal(np.asarray(got.v_scale), np.asarray(kv.v_scale))
+        assert got.k.dtype == kv.k.dtype  # int8 / packed-uint8 storage
+    else:
+        assert got.k_scale is None and got.v_scale is None
     assert got.checksum() == kv.checksum()
     return wire
 
@@ -363,6 +391,56 @@ class TestWireFormat:
         with pytest.raises(WireFormatError) as exc:
             KVSlice.from_wire(bytes(wire[:6]))
         assert exc.value.request_id == -1
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_roundtrip_identity_quantized_synthetic(self, kv_dtype):
+        """Quantized frames carry four payload segments (k, v, k_scale,
+        v_scale) plus block geometry — identity must cover all of them."""
+        _assert_wire_roundtrip(_quant_kv(kv_dtype), rid=77)
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_roundtrip_identity_quantized_real_capture(self, params, kv_dtype):
+        (p,) = _prompts(1, rng=23, lo=9, hi=10)
+        eng = _paged(params, kv_dtype=kv_dtype)
+        eng.submit(p, max_tokens=5, handoff=True)
+        eng.run_until_drained()
+        (entry,) = eng.take_handoffs()
+        kv = entry["kv"]
+        assert kv.quantized and kv.dtype == kv_dtype and kv.block_size == 4
+        _assert_wire_roundtrip(kv, rid=2000)
+
+    def test_quantized_truncation_at_every_byte_is_typed(self):
+        wire = _assert_wire_roundtrip(_quant_kv("int4"), rid=8)
+        for cut in range(len(wire)):
+            with pytest.raises(WireFormatError):
+                KVSlice.from_wire(wire[:cut])
+
+    def test_quantized_flips_at_every_offset_are_typed(self):
+        """Every byte of an int4 frame — header, sizes, packed nibbles,
+        and BOTH scale segments — is under some checksum."""
+        wire = bytearray(_quant_kv("int4").to_wire(9))
+        for off in range(len(wire)):
+            for flip in (0x01, 0x80):
+                mutated = bytes(
+                    wire[:off] + bytes([wire[off] ^ flip]) + wire[off + 1:]
+                )
+                try:
+                    got_rid, got = KVSlice.from_wire(mutated)
+                except WireFormatError:
+                    continue
+                pytest.fail(
+                    f"flip 0x{flip:02x} at offset {off} decoded "
+                    f"silently (rid={got_rid})"
+                )
+
+    def test_scale_corruption_attributed_to_request(self):
+        kv = _quant_kv("int8")
+        wire = bytearray(kv.to_wire(55))
+        # the scale segments are the LAST bytes of the frame
+        wire[-3] ^= 0x40
+        with pytest.raises(WireFormatError) as exc:
+            KVSlice.from_wire(bytes(wire))
+        assert exc.value.request_id == 55
 
 
 class TestChannelClaim:
@@ -678,3 +756,143 @@ class TestObservability:
         # first token, e2e spans both pools
         assert tr.ttft_s() is not None and tr.e2e_s() is not None
         assert tr.e2e_s() >= tr.ttft_s()
+
+
+class TestQuantizedHandoff:
+    """kv_dtype axis over the handoff matrix: bf16 pools stay bit-equal to
+    the dense reference on every path; int8/int4 are same-seed
+    deterministic across the router (router streams == unified same-dtype
+    engine); cross-dtype mismatches fall back to re-prefill, never decode
+    against misinterpreted bytes.  Plus the acceptance criterion that the
+    int8 capacity win is VISIBLE to the KV-demand ledger: >= 1.9x
+    reservable blocks at equal HBM, and admission decisions flip on it."""
+
+    def _reqs(self, rng=29):
+        return [{"prompt": p, "max_tokens": 5} for p in _prompts(3, rng=rng)]
+
+    def test_bf16_pools_bit_equal_to_dense_reference(self, params):
+        reqs = self._reqs()
+        ref = _by_prompt(
+            _dense(params, cache_dtype="bfloat16").pump(
+                [dict(r) for r in reqs]
+            )
+        )
+        pre = _paged(params, cache_dtype="bfloat16")
+        dec = _paged(params, cache_dtype="bfloat16")
+        router = DisaggRouter(prefill=[pre], decode=[dec])
+        done = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(done) == ref
+        assert router.fallbacks == 0
+        assert router.handoffs == len(reqs)
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_same_dtype_router_matches_unified_engine(self, params, kv_dtype):
+        """Quantized handoff injects raw block bytes + scales: the routed
+        streams must be IDENTICAL to a unified engine of the same
+        kv_dtype (deterministic), with zero re-prefill fallbacks."""
+        reqs = self._reqs(rng=31)
+        ref = _by_prompt(
+            _paged(params, kv_dtype=kv_dtype).pump([dict(r) for r in reqs])
+        )
+        pre = _paged(params, kv_dtype=kv_dtype)
+        dec = _paged(params, kv_dtype=kv_dtype)
+        router = DisaggRouter(prefill=[pre], decode=[dec])
+        incompat0 = REGISTRY.counter("tpu_disagg_fallback_total").value(
+            reason="incompatible"
+        )
+        done = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(done) == ref
+        assert router.fallbacks == 0
+        assert router.handoffs == len(reqs)
+        assert REGISTRY.counter("tpu_disagg_fallback_total").value(
+            reason="incompatible"
+        ) == incompat0
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_quantized_divergence_from_float_is_bounded(self, params, kv_dtype):
+        """Same seed, same prompts: quantized streams may drift from the
+        float reference (lossy KV), but prompts echo intact and streams
+        stay well-formed full-length generations."""
+        reqs = FEATURES["greedy"]["reqs"]()  # the reference's prompt set
+        ref = _reference(params, "greedy", None)
+        got = _by_prompt(
+            _paged(params, kv_dtype=kv_dtype).pump([dict(r) for r in reqs])
+        )
+        assert set(got) == {
+            tuple(r["prompt"]) for r in reqs
+        }  # prompts intact => keys align
+        for prompt, gen in got.items():
+            assert len(gen) == 5
+            assert all(0 <= t < CFG.vocab_size for t in gen)
+        # bounded divergence: at this tiny model most greedy tokens agree
+        agree = sum(
+            t1 == t2
+            for p in got
+            for t1, t2 in zip(got[p], ref[tuple(p)])
+        )
+        total = sum(len(g) for g in got.values())
+        assert agree / total >= 0.5, (agree, total, got, ref)
+
+    def test_cross_dtype_handoff_falls_back_to_reprefill(self, params):
+        """int8 prefill -> float decode: geometry gate refuses the inject
+        (the float pool cannot hold int8 bytes), the stream re-prefills
+        and finishes EXACTLY like the float unified reference."""
+        from k8s_dra_driver_tpu.models import serve as serve_mod
+
+        reqs = FEATURES["greedy"]["reqs"]()  # the reference's prompt set
+        pre = _paged(params, kv_dtype="int8")
+        dec = _paged(params)
+        router = DisaggRouter(prefill=[pre], decode=[dec])
+        incompat0 = serve_mod._M_DISAGG_FALLBACK.value(reason="incompatible")
+        done = router.pump([dict(r) for r in reqs])
+        assert _by_prompt(done) == _reference(params, "greedy", None)
+        assert serve_mod._M_DISAGG_FALLBACK.value(
+            reason="incompatible"
+        ) == incompat0 + len(reqs)
+
+    def test_int8_capacity_reaches_the_admission_ledger(self, params):
+        """THE acceptance assertion: at the same pool_hbm_bytes budget an
+        int8 decode pool reports >= 1.9x reservable_blocks, the router's
+        headroom sees those blocks, and a full-stream demand sized between
+        the two pools is REFUSED by the bf16 router but ADMITTED by the
+        int8 router — capacity flows budget -> blocks -> ledger ->
+        admission decision."""
+        hbm = 64 * paged.kv_block_bytes(CFG, 16, "bfloat16")
+        engines = {}
+        routers = {}
+        for kd, cache in (("bf16", "bfloat16"), ("int8", "bfloat16")):
+            dec = _paged(
+                params,
+                cache_dtype=cache,
+                kv_dtype=None if kd == "bf16" else "int8",
+                block_size=16,
+                n_blocks=None,
+                pool_hbm_bytes=hbm,
+            )
+            engines[kd] = dec
+            routers[kd] = DisaggRouter(
+                prefill=[_paged(params, block_size=16)], decode=[dec],
+                admission_control=True,
+            )
+        assert engines["bf16"].pool_hbm_bytes == engines["int8"].pool_hbm_bytes
+        lo = engines["bf16"].reservable_blocks
+        hi = engines["int8"].reservable_blocks
+        assert hi / lo >= 1.9, (hi, lo)
+        # the ledger's headroom IS reservable_blocks while nothing is
+        # committed
+        assert routers["bf16"]._decode_headroom_blocks() == lo
+        assert routers["int8"]._decode_headroom_blocks() == hi
+        # a demand strictly between the two pools flips the decision
+        mid_blocks = (lo + hi) // 2
+        entry = {
+            "request_id": 9001,
+            "prompt_len": 4,
+            "max_tokens": mid_blocks * 16 - 4,
+            "tokens": [1, 2, 3, 4],
+        }
+        assert routers["bf16"]._admit_handoff({"entry": dict(entry)}) is False
+        assert routers["int8"]._admit_handoff({"entry": dict(entry)}) is True
+        # the admitted reservation is committed against the headroom
+        assert routers["int8"]._decode_headroom_blocks() == hi - mid_blocks
+        # and released again when the bf16 router refused
+        assert routers["bf16"]._decode_headroom_blocks() == lo

@@ -343,6 +343,15 @@ class TestMetricLabels:
         src = 'M = REGISTRY.counter("tpu_serve_x_total", "h")\nM.inc(flavor="a")\n'
         assert self.checks(tmp_path, src) == ["metric-labels"]
 
+    def test_kv_dtype_key_in_vocabulary(self, tmp_path):
+        # the paged KV data plane's tpu_serve_kv_bytes{dtype=} split: pool
+        # dtype is a closed set, so the key belongs to the vocabulary
+        src = (
+            'M = REGISTRY.gauge("tpu_serve_kv_bytes", "h")\n'
+            'M.set(128, dtype="int8")\n'
+        )
+        assert self.checks(tmp_path, src) == []
+
     def test_fstring_value_flagged(self, tmp_path):
         src = (
             'M = REGISTRY.counter("tpu_fleet_x_total", "h")\n'

@@ -118,3 +118,25 @@ def test_autoscaler_overhead_stays_within_perf_budgets():
     assert stats["host_syncs_scaled"] == stats["host_syncs_bare"]
     assert stats["autoscaler_actions"] == 0
     assert stats["autoscaler_ticks"] > 0
+
+
+def test_quantized_decode_stays_within_perf_budgets():
+    stats = perf_smoke.check_quantized_decode()
+    assert stats["requests"] == 4
+    # The quantized pool's host-axis contract: dequant is fused into the
+    # attention operand load on-device, so the int8-KV engine pays
+    # EXACTLY the float pool's host syncs for the same workload.
+    assert stats["host_syncs_int8"] == stats["host_syncs_float"]
+    # And the reason the feature exists: >= 1.9x reservable blocks at an
+    # equal HBM budget — the capacity the KV-demand ledger admits on.
+    assert stats["capacity_ratio"] >= stats["capacity_ratio_floor"]
+
+
+def test_ondevice_sampling_stays_within_perf_budgets():
+    stats = perf_smoke.check_ondevice_sampling()
+    assert stats["sync_interval"] == 32
+    # On-device sampling's contract: sampling + stop masks live inside
+    # the scanned burst and the trace planes ride ONE stacked array, so a
+    # sync_interval=32 burst is 1 dispatch + 1 readback on BOTH engines.
+    assert stats["dense_dispatches"] == 1 and stats["dense_readbacks"] == 1
+    assert stats["paged_dispatches"] == 1 and stats["paged_readbacks"] == 1

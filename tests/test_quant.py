@@ -195,3 +195,112 @@ class TestInt4:
 
         with pytest.raises(ValueError, match="divisible"):
             Quantized4Matrix.quantize(jnp.zeros((66, 8)), group_size=64)
+
+
+class TestKVBlockQuant:
+    """Per-block symmetric KV quantization — the primitives behind the
+    kv_dtype pool modes (zero-tail requant invariant, pack/unpack
+    exactness, scale conventions)."""
+
+    def _blocks(self, seed=7, shape=(2, 3, 2, 16, 8)):
+        from k8s_dra_driver_tpu.models.quant import quantize_kv_blocks
+
+        x = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+        return x, quantize_kv_blocks
+
+    def test_kv_dtype_bits(self):
+        from k8s_dra_driver_tpu.models.quant import kv_dtype_bits
+
+        assert kv_dtype_bits("int8") == 8
+        assert kv_dtype_bits("int4") == 4
+        import pytest
+
+        with pytest.raises(ValueError, match="kv_dtype"):
+            kv_dtype_bits("int2")
+
+    def test_int8_scale_convention(self):
+        """scale = amax/127, values clipped to +-127, amax maps exactly."""
+        from k8s_dra_driver_tpu.models.quant import quantize_kv_blocks
+
+        x, _ = self._blocks()
+        q, scale = quantize_kv_blocks(x, "int8")
+        assert q.dtype == jnp.int8 and q.shape == x.shape
+        assert scale.dtype == jnp.float32 and scale.shape == x.shape[:-2]
+        amax = np.max(np.abs(np.asarray(x)), axis=(-2, -1))
+        np.testing.assert_allclose(np.asarray(scale), amax / 127.0, rtol=1e-6)
+        assert np.abs(np.asarray(q)).max() <= 127
+
+    def test_int4_packs_half_lanes(self):
+        from k8s_dra_driver_tpu.models.quant import quantize_kv_blocks
+
+        x, _ = self._blocks()
+        q, scale = quantize_kv_blocks(x, "int4")
+        assert q.dtype == jnp.uint8
+        assert q.shape == x.shape[:-1] + (x.shape[-1] // 2,)
+        amax = np.max(np.abs(np.asarray(x)), axis=(-2, -1))
+        np.testing.assert_allclose(np.asarray(scale), amax / 7.0, rtol=1e-6)
+
+    def test_zero_block_dequants_to_exact_zero(self):
+        """All-zero blocks use scale 1.0 — dequant is exact 0, so untouched
+        pool blocks stay bitwise zero across requant cycles."""
+        from k8s_dra_driver_tpu.models.quant import (
+            dequant_kv_blocks,
+            quantize_kv_blocks,
+        )
+
+        z = jnp.zeros((1, 2, 2, 8, 8), jnp.float32)
+        for kd in ("int8", "int4"):
+            q, scale = quantize_kv_blocks(z, kd)
+            np.testing.assert_array_equal(np.asarray(scale), 1.0)
+            np.testing.assert_array_equal(
+                np.asarray(dequant_kv_blocks(q, scale)), 0.0
+            )
+
+    def test_requant_is_stable(self):
+        """quant -> dequant -> quant is a fixed point: block bytes stay a
+        pure function of the written history (the zero-tail invariant the
+        engine's _quantized_block_write depends on)."""
+        from k8s_dra_driver_tpu.models.quant import (
+            dequant_kv_blocks,
+            quantize_kv_blocks,
+        )
+
+        x, _ = self._blocks(seed=11)
+        for kd in ("int8", "int4"):
+            q1, s1 = quantize_kv_blocks(x, kd)
+            q2, s2 = quantize_kv_blocks(dequant_kv_blocks(q1, s1), kd)
+            np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+            np.testing.assert_allclose(
+                np.asarray(s1), np.asarray(s2), rtol=1e-6
+            )
+
+    def test_pack_unpack_roundtrip_exact(self):
+        from k8s_dra_driver_tpu.models.quant import pack_int4, unpack_int4
+
+        q = jnp.asarray(
+            np.random.default_rng(3).integers(-8, 8, (2, 5, 16), np.int8)
+        )
+        packed = pack_int4(q)
+        assert packed.dtype == jnp.uint8 and packed.shape == (2, 5, 8)
+        np.testing.assert_array_equal(np.asarray(unpack_int4(packed)), np.asarray(q))
+
+    def test_pack_odd_axis_rejected(self):
+        from k8s_dra_driver_tpu.models.quant import pack_int4
+
+        import pytest
+
+        with pytest.raises(ValueError):
+            pack_int4(jnp.zeros((2, 7), jnp.int8))
+
+    def test_dequant_error_bounded_by_half_step(self):
+        from k8s_dra_driver_tpu.models.quant import (
+            dequant_kv_blocks,
+            quantize_kv_blocks,
+        )
+
+        x, _ = self._blocks(seed=13)
+        for kd, levels in (("int8", 127.0), ("int4", 7.0)):
+            q, scale = quantize_kv_blocks(x, kd)
+            err = np.abs(np.asarray(dequant_kv_blocks(q, scale)) - np.asarray(x))
+            half_step = np.asarray(scale)[..., None, None] / 2 + 1e-6
+            assert (err <= half_step).all()

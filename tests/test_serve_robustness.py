@@ -336,3 +336,129 @@ class TestRobustnessMetrics:
             assert f"# HELP {name} " in text
         # hygiene: counters end _total, the gauge must not
         assert "tpu_serve_queue_depth_total" not in text
+
+
+class TestKVDtypeRestore:
+    """kv_dtype axis of the restore matrix: a bf16 pool restores bit-equal
+    to an uninterrupted DENSE bf16 engine (paged == dense holds across the
+    snapshot boundary); int8/int4 pools restore bit-equal to an
+    uninterrupted same-dtype engine (include_kv carries raw block bytes +
+    per-block scales, so the continuation is deterministic, not
+    re-quantized-approximate); a cross-dtype restore falls back to
+    re-prefill without losing the stream; quantized greedy streams stay
+    within bounded divergence of the float reference."""
+
+    REQS = [
+        {"prompt": [5, 6, 7], "max_tokens": 8, "temperature": 0.8, "seed": 3},
+        {"prompt": [9, 1], "max_tokens": 8, "temperature": 1.1, "seed": 11},
+    ]
+
+    def _paged(self, params, **kw):
+        kw.setdefault("n_slots", 3)
+        kw.setdefault("n_blocks", 33)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("prompt_bucket", 16)
+        kw.setdefault("attn_impl", "xla")
+        return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+    def _snapshot_restore(self, make):
+        """Submit, run 2 mid-flight steps, snapshot WITH KV payloads,
+        restore into a fresh engine, drain."""
+        eng = make()
+        for r in self.REQS:
+            eng.submit(**dict(r))
+        for _ in range(2):
+            eng.step()
+        snap = eng.snapshot_active(include_kv=True)
+        assert snap["requests"], "nothing in flight to snapshot"
+        fresh = make()
+        restored = fresh.restore(snap)
+        assert sorted(restored) == sorted(
+            r["request_id"] for r in snap["requests"]
+        )
+        fresh.run_until_drained()
+        return {c.request_id: tuple(c.tokens) for c in fresh.completions()}
+
+    def test_bf16_pool_restores_bit_equal_to_dense(self, params):
+        ref = ServeEngine(
+            params=params, cfg=CFG, n_slots=3, prompt_bucket=16,
+            cache_dtype="bfloat16",
+        )
+        expected = {
+            c.request_id: tuple(c.tokens)
+            for c in ref.pump([dict(r) for r in self.REQS])
+        }
+        got = self._snapshot_restore(
+            lambda: self._paged(params, cache_dtype="bfloat16")
+        )
+        assert set(got) == set(expected)
+        for rid, stream in got.items():
+            assert stream == expected[rid], rid
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_quantized_restore_bit_equal_to_unified(self, params, kv_dtype):
+        ref = self._paged(params, kv_dtype=kv_dtype)
+        expected = {
+            c.request_id: tuple(c.tokens)
+            for c in ref.pump([dict(r) for r in self.REQS])
+        }
+        got = self._snapshot_restore(
+            lambda: self._paged(params, kv_dtype=kv_dtype)
+        )
+        assert set(got) == set(expected)
+        for rid, stream in got.items():
+            assert stream == expected[rid], (kv_dtype, rid)
+
+    def test_cross_dtype_restore_falls_back_not_lost(self, params):
+        """int8 snapshot into a float pool: the geometry gate refuses the
+        inject (typed 'incompatible' fallback), the stream re-prefills
+        from its token history and still finishes every request."""
+        eng = self._paged(params, kv_dtype="int8")
+        for r in self.REQS:
+            eng.submit(**dict(r))
+        for _ in range(2):
+            eng.step()
+        snap = eng.snapshot_active(include_kv=True)
+        assert all(r.get("kv") is not None for r in snap["requests"])
+        incompat0 = serve._M_DISAGG_FALLBACK.value(reason="incompatible")
+        fresh = self._paged(params)  # float pool
+        restored = fresh.restore(snap)
+        assert sorted(restored) == sorted(
+            r["request_id"] for r in snap["requests"]
+        )
+        assert serve._M_DISAGG_FALLBACK.value(
+            reason="incompatible"
+        ) == incompat0 + len(snap["requests"])
+        fresh.run_until_drained()
+        got = {c.request_id: c for c in fresh.completions()}
+        assert set(got) == {r["request_id"] for r in snap["requests"]}
+        for c in got.values():
+            assert c.status == "ok"
+            assert len(c.generated) == 8
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_quantized_greedy_divergence_bounded(self, params, kv_dtype):
+        """Lossy KV may drift from the float stream, but on this tiny
+        model most greedy tokens must still agree."""
+        reqs = [
+            {"prompt": [5, 6, 7], "max_tokens": 8},
+            {"prompt": [9, 1], "max_tokens": 8},
+        ]
+        ref = {
+            c.request_id: tuple(c.generated)
+            for c in self._paged(params).pump([dict(r) for r in reqs])
+        }
+        got = {
+            c.request_id: tuple(c.generated)
+            for c in self._paged(params, kv_dtype=kv_dtype).pump(
+                [dict(r) for r in reqs]
+            )
+        }
+        assert set(got) == set(ref)
+        agree = sum(
+            t1 == t2
+            for rid in got
+            for t1, t2 in zip(got[rid], ref[rid])
+        )
+        total = sum(len(g) for g in got.values())
+        assert agree / total >= 0.5, (kv_dtype, agree, total, got, ref)

@@ -437,6 +437,10 @@ METRIC_LABEL_KEYS = frozenset({
     # federation identity: instance names come from operator-declared
     # worker configs (same cardinality class as node/endpoint)
     "instance",
+    # paged KV data plane (models/paged.py): pool dtype is the closed
+    # {bf16/f32 names, int8, int4} set — tpu_serve_kv_bytes{dtype=} splits
+    # resident pool bytes by quantization format, never per-request
+    "dtype",
 })
 METRIC_LABEL_PREFIXES = (
     "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",

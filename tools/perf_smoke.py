@@ -929,6 +929,182 @@ def check_plan_scale() -> dict:
     return stats
 
 
+# Quantized KV pools must be free on the HOST axis: dequant is fused into
+# the attention operand load on-device, so an int8-KV engine pays exactly
+# the bf16/f32 path's host syncs for the same workload.  The capacity
+# ratio is the feature's reason to exist — int8 blocks (values + f32
+# scale) are under half a bf16 block's bytes, so an equal-HBM pool holds
+# >= 1.9x reservable blocks.
+QUANTIZED_CAPACITY_RATIO_FLOOR = 1.9
+
+
+def check_quantized_decode() -> dict:
+    """Budget guard for quantized KV-cache blocks (PR 17 tentpole): the
+    int8 pool's dequant must ride inside the decode dispatch — ZERO extra
+    host syncs vs the float-pool twin — and the equal-HBM capacity
+    multiplier must hold at the `reservable_blocks` level the KV-demand
+    ledger admits on."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, paged
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=1,
+        d_ff=64, max_seq=64,
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        list(map(int, burnin.sample_tokens(jax.random.PRNGKey(s), cfg, batch=1, seq=8)[0]))
+        for s in range(4)
+    ]
+
+    def pump(kv_dtype):
+        eng = paged.PagedServeEngine(
+            params=params, cfg=cfg, n_slots=2, n_blocks=24, block_size=16,
+            prompt_bucket=16, attn_impl="xla", sync_interval=8,
+            kv_dtype=kv_dtype,
+        )
+        eng.pump([(prompts[0], 8)])  # compile off the clock
+        eng.host_syncs = 0
+        done = eng.pump([(p, 8) for p in prompts])
+        return eng, done
+
+    base_eng, base_done = pump(None)
+    q_eng, q_done = pump("int8")
+    hbm = 24 * paged.kv_block_bytes(cfg, 16, "bfloat16")
+    cap_bf16 = paged.PagedServeEngine(
+        params=params, cfg=cfg, n_slots=2, block_size=16, prompt_bucket=16,
+        attn_impl="xla", cache_dtype="bfloat16", pool_hbm_bytes=hbm,
+    ).reservable_blocks
+    cap_int8 = paged.PagedServeEngine(
+        params=params, cfg=cfg, n_slots=2, block_size=16, prompt_bucket=16,
+        attn_impl="xla", kv_dtype="int8", pool_hbm_bytes=hbm,
+    ).reservable_blocks
+    ratio = cap_int8 / cap_bf16
+    stats = {
+        "requests": len(q_done),
+        "host_syncs_float": base_eng.host_syncs,
+        "host_syncs_int8": q_eng.host_syncs,
+        "reservable_bf16": cap_bf16,
+        "reservable_int8": cap_int8,
+        "capacity_ratio": round(ratio, 3),
+        "capacity_ratio_floor": QUANTIZED_CAPACITY_RATIO_FLOOR,
+    }
+    if len(q_done) != len(prompts) or len(base_done) != len(prompts):
+        raise PerfBudgetError(
+            f"quantized decode drained {len(q_done)}/{len(prompts)} requests"
+        )
+    if q_eng.host_syncs != base_eng.host_syncs:
+        raise PerfBudgetError(
+            f"int8-KV decode paid {q_eng.host_syncs} host syncs vs "
+            f"{base_eng.host_syncs} on the float pool — dequant leaked out "
+            f"of the fused attention load onto the host axis"
+        )
+    if ratio < QUANTIZED_CAPACITY_RATIO_FLOOR:
+        raise PerfBudgetError(
+            f"int8-KV capacity ratio {ratio:.2f}x < "
+            f"{QUANTIZED_CAPACITY_RATIO_FLOOR}x at equal HBM "
+            f"({cap_int8} vs {cap_bf16} reservable blocks) — the "
+            f"bytes-per-block win is not reaching the admission ledger"
+        )
+    return stats
+
+
+# On-device sampling lets sync_interval grow past 16 for free: one burst
+# is ONE compiled dispatch and ONE stacked-trace readback regardless of K.
+ONDEVICE_SAMPLING_INTERVAL = 32
+
+
+def check_ondevice_sampling() -> dict:
+    """Budget guard for the on-device sampling burst (PR 17 tentpole): at
+    ``sync_interval=32`` one ``step_burst`` on EACH engine kind pays
+    exactly 1 burst dispatch + 1 device->host readback — sampling and the
+    stop mask live in the scanned program, and the token/active/bad
+    planes ride one stacked array."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, paged, serve
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = list(map(
+        int, burnin.sample_tokens(jax.random.PRNGKey(3), cfg, batch=1, seq=8)[0]
+    ))
+    stats: dict = {"sync_interval": ONDEVICE_SAMPLING_INTERVAL}
+    k = ONDEVICE_SAMPLING_INTERVAL
+
+    def burst_counts(eng, wrap_dispatch):
+        # submit + warm one full burst so compiles are off the books, then
+        # count the readbacks and dispatches of ONE burst.
+        eng.submit(prompt, max_tokens=k + 4, temperature=0.8, seed=11)
+        eng.step_burst()
+        counts = {"readbacks": 0, "dispatches": 0}
+        orig_rb = eng._readback
+
+        def counting_rb(x):
+            counts["readbacks"] += 1
+            return orig_rb(x)
+
+        eng._readback = counting_rb
+        wrap_dispatch(eng, counts)
+        stepped = eng.step_burst()
+        eng._readback = orig_rb
+        return counts, stepped
+
+    def wrap_dense(eng, counts):
+        orig = eng._pipe_fn
+
+        def counting_pipe(*a, **kw):
+            counts["dispatches"] += 1
+            return orig(*a, **kw)
+
+        eng._pipe_fn = counting_pipe
+
+    def wrap_paged(eng, counts):
+        orig = eng._burst_fn
+
+        def counting_burst(kk):
+            fn = orig(kk)
+
+            def call(*a, **kw):
+                counts["dispatches"] += 1
+                return fn(*a, **kw)
+
+            return call
+
+        eng._burst_fn = counting_burst
+
+    dense = serve.ServeEngine(
+        params=params, cfg=cfg, n_slots=2, prompt_bucket=16, sync_interval=k
+    )
+    d_counts, d_stepped = burst_counts(dense, wrap_dense)
+    pag = paged.PagedServeEngine(
+        params=params, cfg=cfg, n_slots=2, n_blocks=24, block_size=16,
+        prompt_bucket=16, attn_impl="xla", sync_interval=k,
+    )
+    p_counts, p_stepped = burst_counts(pag, wrap_paged)
+    stats.update(
+        dense_readbacks=d_counts["readbacks"],
+        dense_dispatches=d_counts["dispatches"],
+        paged_readbacks=p_counts["readbacks"],
+        paged_dispatches=p_counts["dispatches"],
+    )
+    if d_stepped < 1 or p_stepped < 1:
+        raise PerfBudgetError(
+            "on-device sampling burst had no active slots to measure"
+        )
+    for kind, c in (("dense", d_counts), ("paged", p_counts)):
+        if c["dispatches"] != 1 or c["readbacks"] != 1:
+            raise PerfBudgetError(
+                f"{kind} sync_interval={k} burst paid {c['dispatches']} "
+                f"dispatches + {c['readbacks']} readbacks, not 1 + 1 — "
+                f"sampling or the stop mask fell back to the host"
+            )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
@@ -941,6 +1117,8 @@ def main() -> int:
         stats["autoscaler_overhead"] = check_autoscaler_overhead()
         stats["obs_plane_overhead"] = check_obs_plane_overhead()
         stats["plan_scale"] = check_plan_scale()
+        stats["quantized_decode"] = check_quantized_decode()
+        stats["ondevice_sampling"] = check_ondevice_sampling()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
