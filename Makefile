@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance sim-cluster demo dryrun lint analyze perf-smoke helm-template clean
+.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance sim-cluster sim-contention demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -89,6 +89,16 @@ chaos-rebalance:
 # latency.
 sim-cluster:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cluster_sim.py tests/test_gang_alloc.py -q
+
+# Multi-scheduler contention suite (<60s, CPU, seeded; includes the
+# slow-marked 10k-pool acceptance run tier-1 skips): N scheduler threads
+# race plan()/allocate_gang() against one store with real CAS + admission
+# semantics — exactly-once commits under 409 storms and concurrent gang
+# unwinds, the naive-vs-conflict-aware fairness/waste A/B, and the
+# starvation detector firing (diag bundle + journal) for a blackout
+# victim while staying silent on the fixed path.
+sim-contention:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_contention.py -q
 
 # Closed-loop quickstart walkthrough.
 demo:

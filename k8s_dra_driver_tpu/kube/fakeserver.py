@@ -84,6 +84,29 @@ class InMemoryAPIServer:
 
             fault_injector = FaultInjector.from_env(os.environ["DRA_FAULTS"])
         self.faults = fault_injector
+        # Admission-time invariant checks, the in-process analog of a
+        # validating admission plugin: per kind, ``fn(current, updated)``
+        # runs under the store lock BETWEEN the resourceVersion CAS check
+        # and the mutation, and may raise (typically ``Conflict``) to
+        # reject the write atomically.  The multi-scheduler contention
+        # harness installs a device-marker non-overlap validator here so
+        # two schedulers committing DIFFERENT claims onto the same chip
+        # lose the race with a 409 instead of silently double-booking.
+        self._update_validators: dict[str, list] = {}
+
+    def add_update_validator(self, kind: str, fn) -> Callable[[], None]:
+        """Register ``fn(current, updated)`` to vet every update() of
+        ``kind`` under the store lock; returns a remover callable."""
+        with self._lock:
+            self._update_validators.setdefault(kind, []).append(fn)
+
+        def _remove() -> None:
+            with self._lock:
+                fns = self._update_validators.get(kind, [])
+                if fn in fns:
+                    fns.remove(fn)
+
+        return _remove
 
     def _maybe_fault(self, verb: str, kind: str) -> None:
         # Outside the lock: injected latency must not serialize the server.
@@ -157,6 +180,8 @@ class InMemoryAPIServer:
                     f"{key[0]} {key[2]!r}: resourceVersion {obj.metadata.resource_version} "
                     f"!= {current.metadata.resource_version}"
                 )
+            for validate in self._update_validators.get(key[0], ()):
+                validate(current, obj)  # may raise: write rejected atomically
             self._rv += 1
             obj.metadata.uid = current.metadata.uid
             obj.metadata.resource_version = str(self._rv)

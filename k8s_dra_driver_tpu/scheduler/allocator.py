@@ -69,6 +69,23 @@ class AllocationError(Exception):
     pass
 
 
+class GangConflictError(AllocationError):
+    """A gang commit lost an optimistic-concurrency race mid-flight: some
+    member's status write failed (stale resourceVersion, injected 409, an
+    admission validator rejecting a double-booked device) after zero or
+    more siblings had already committed.  The already-committed siblings
+    were unwound in reverse order before this was raised, so the store is
+    balanced and the whole gang is safe to retry from a fresh refetch.
+
+    ``unwound`` carries the claim names rolled back (commit order), so
+    callers can account for the wasted work without string-matching the
+    message."""
+
+    def __init__(self, message: str, unwound: tuple[str, ...] = ()):
+        super().__init__(message)
+        self.unwound = tuple(unwound)
+
+
 @dataclass(frozen=True)
 class _Candidate:
     driver: str
@@ -233,15 +250,31 @@ class Allocator:
     # fails loudly instead of spinning.
     GANG_UNWIND_ATTEMPTS = 100
 
-    def __init__(self, server: InMemoryAPIServer):
+    def __init__(
+        self,
+        server: InMemoryAPIServer,
+        index: Optional[AllocationIndex] = None,
+    ):
         self._server = server
-        self._index = AllocationIndex(server)
+        # N racing schedulers against one in-process store may share one
+        # watch-maintained index (each keeps its own Allocator for journal
+        # correlation and gang sequencing): in-process watches are delivered
+        # synchronously under the store lock, so a private index would be
+        # exactly as fresh — the real staleness window is plan()-to-commit
+        # in both designs — while costing an extra full inventory replay
+        # per scheduler.  The contention harness passes a shared index; a
+        # caller-owned index is never closed by this allocator.
+        self._index = index if index is not None else AllocationIndex(server)
+        self._owns_index = index is None
         self._gang_seq = 0
 
     def close(self) -> None:
         """Detach the allocation index's watches (long-lived processes that
-        create throwaway Allocators against one server should call this)."""
-        self._index.close()
+        create throwaway Allocators against one server should call this).
+        A shared index passed into ``__init__`` stays attached — whoever
+        built it closes it."""
+        if self._owns_index:
+            self._index.close()
 
     def view(self, node_name: str = "", node_labels: Optional[dict] = None):
         """One node's indexed :class:`~k8s_dra_driver_tpu.scheduler.index.PlanView`
@@ -503,12 +536,14 @@ class Allocator:
                     claim=m.claim.metadata.name, node=m.node_name,
                     error=f"{type(exc).__name__}: {exc}",
                 )
+                unwound_names = tuple(c.metadata.name for c in committed)
                 self._unwind_gang(corr, committed)
                 _GANG_PLANS.inc(outcome="unwound")
-                raise AllocationError(
+                raise GangConflictError(
                     f"gang commit failed at {m.claim.metadata.name!r} on "
                     f"{m.node_name!r} ({type(exc).__name__}: {exc}); "
-                    f"{len(committed)} sibling(s) unwound"
+                    f"{len(committed)} sibling(s) unwound",
+                    unwound=unwound_names,
                 ) from exc
             committed.append(updated)
             out.append(updated)

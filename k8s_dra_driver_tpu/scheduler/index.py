@@ -36,6 +36,7 @@ tools/perf_smoke.py can prove selector evaluations stay O(changed pools).
 from __future__ import annotations
 
 import threading
+import zlib
 from dataclasses import dataclass, field
 
 from k8s_dra_driver_tpu.kube.fakeserver import InMemoryAPIServer
@@ -410,3 +411,17 @@ class AllocationIndex:
         self._in_use = set(in_use_refs)
         self._used_markers = set(marker_refs)
         self._consumed_dirty = False
+
+
+def stable_shard(name: str, n_shards: int) -> int:
+    """Deterministic shard id for per-scheduler pool sharding.
+
+    The contention harness partitions both pools (nodes) and work items
+    across N racing schedulers: scheduler ``i`` prefers names where
+    ``stable_shard(name, N) == i`` and spills over to the rest only when
+    its shard can't satisfy.  CRC32 rather than ``hash()`` because Python
+    string hashing is salted per process — shards must agree across
+    schedulers, runs and (future) subprocess workers."""
+    if n_shards <= 1:
+        return 0
+    return zlib.crc32(name.encode("utf-8")) % n_shards

@@ -302,3 +302,30 @@ def score_plan(plan, weights: dict[str, float] | None = None,
         _PLAN_SCORE.set(value, objective=name)
     _PLAN_SCORE.set(score.total, objective="total")
     return score
+
+
+def shuffle_equal_scores(ranked: list, rng) -> list:
+    """Conflict-aware candidate shuffling: permute *within* equal-score runs.
+
+    The extender wire quantizes plan scores to an integer 0..10 band, so at
+    cluster scale many candidates tie — and a deterministic ``(-score,
+    name)`` sort makes every scheduler chase the same pool, turning ties
+    into optimistic-concurrency conflicts when N schedulers race one store.
+    Given a list already sorted best-first whose first tuple element is the
+    (quantized) score, this reshuffles each maximal run of equal scores
+    with the caller's seeded ``rng`` and returns a new list.  Score order
+    across runs is untouched: a strictly better candidate is still tried
+    first; only the arbitrary tie-break stops being globally synchronized.
+    Each scheduler seeds its own rng, so the permutations decorrelate."""
+    out: list = []
+    i = 0
+    while i < len(ranked):
+        j = i
+        while j < len(ranked) and ranked[j][0] == ranked[i][0]:
+            j += 1
+        run = list(ranked[i:j])
+        if len(run) > 1:
+            rng.shuffle(run)
+        out.extend(run)
+        i = j
+    return out

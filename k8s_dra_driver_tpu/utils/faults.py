@@ -153,6 +153,18 @@ class FaultProfile:
     sock_reset_rate: float = 0.0  # probability the peer resets mid-transfer
     sock_latency_s: float = 0.0  # simulated seconds added per frame
     peer_hang: int = 0  # next N receiver polls stall silently
+    # scheduler-scoped (multi-scheduler contention harness) kinds:
+    # consulted by the ContentionSim once per commit attempt, BEFORE the
+    # status write is issued.  ``sched_conflict_rate`` injects a 409 at
+    # the commit seam — a seeded 409 storm independent of (and on top of)
+    # genuine resourceVersion CAS races; ``sched_commit_latency_s`` sleeps
+    # there, widening the plan-to-commit window so real races get more
+    # likely.  Scope by ``schedulers`` (worker indexes); the shared
+    # ``limit`` budget caps both, so an adversarial profile that pins one
+    # scheduler eventually exhausts and the run still converges.
+    sched_conflict_rate: float = 0.0  # probability a commit attempt 409s
+    sched_commit_latency_s: float = 0.0  # seconds slept before each commit
+    schedulers: tuple = ()  # e.g. (0,); empty = all schedulers
     limit: int = 0  # total-injection cap, 0 = unlimited
     injected: int = field(default=0, compare=False)
 
@@ -201,6 +213,30 @@ class FaultInjector:
                 raise Conflict(f"fault injected by profile {p.name!r}")
             if p.error_rate and self._roll(p, p.error_rate, "error", verb, kind):
                 raise APIError(p.error_code, f"fault injected by profile {p.name!r}")
+
+    def before_sched_commit(self, scheduler: int) -> None:
+        """Scheduler hook: consulted by the contention harness once per
+        commit attempt, before the claim-status write goes to the store.
+        Sleeps the scoped commit latency (budget-accounted, same shape as
+        :meth:`take_step_latency`) and may raise an injected 409 Conflict
+        attributable to the profile — the seeded storm the contention
+        acceptance run converges under."""
+        from k8s_dra_driver_tpu.kube.fakeserver import Conflict
+
+        for p in self._matching_sched(scheduler):
+            if p.sched_commit_latency_s > 0:
+                with self._lock:
+                    if not self._budget_ok(p):
+                        continue
+                    self._record(p, "sched_commit_latency", "PUT", "scheduler")
+                time.sleep(p.sched_commit_latency_s)
+            if p.sched_conflict_rate and self._roll(
+                p, p.sched_conflict_rate, "sched_conflict", "PUT", "scheduler"
+            ):
+                raise Conflict(
+                    f"fault injected by profile {p.name!r} "
+                    f"(scheduler {scheduler})"
+                )
 
     def take_drop(self, verb: str, kind: str) -> bool:
         """HTTP-only: should this response be truncated mid-body?"""
@@ -534,6 +570,17 @@ class FaultInjector:
                 and (not p.steps or tick in p.steps)
             ]
 
+    def _matching_sched(self, scheduler: int) -> list[FaultProfile]:
+        """Profiles matching a contention-harness scheduler by worker
+        index — the scheduler twin of :meth:`_matching_engine` (empty
+        scope matches every scheduler)."""
+        with self._lock:
+            return [
+                p
+                for p in self._profiles
+                if not p.schedulers or scheduler in p.schedulers
+            ]
+
     def _take_counted(self, kind: str, attr: str) -> bool:
         for p in self._matching("GET", kind):
             with self._lock:
@@ -606,6 +653,8 @@ class FaultInjector:
                 fields["sock_truncate_rate"] = float(value)
             elif key == "sock_reset":
                 fields["sock_reset_rate"] = float(value)
+            elif key == "sched_commit_latency_ms":
+                fields["sched_commit_latency_s"] = float(value) / 1000.0
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
                          "watch_hang_s", "nan_logits_rate", "step_raise_rate",
                          "step_latency_s", "replica_crash_rate",
@@ -614,7 +663,8 @@ class FaultInjector:
                          "handoff_corrupt_rate", "spawn_fail_rate",
                          "spawn_latency_s", "sock_truncate_rate",
                          "sock_reset_rate", "sock_latency_s",
-                         "channel_down_rate"):
+                         "channel_down_rate", "sched_conflict_rate",
+                         "sched_commit_latency_s"):
                 fields[key] = float(value)
             elif key in ("error_code", "watch_gone", "watch_error_frames",
                          "watch_hangs", "peer_hang", "limit"):
@@ -625,7 +675,7 @@ class FaultInjector:
                 fields["kinds"] = tuple(value.split("+"))
             elif key == "channels":
                 fields["channels"] = tuple(value.split("+"))
-            elif key in ("slots", "steps", "replicas"):
+            elif key in ("slots", "steps", "replicas", "schedulers"):
                 fields[key] = tuple(int(v) for v in value.split("+"))
             else:
                 raise ValueError(f"{ENV_VAR}: unknown fault key {key!r}")

@@ -32,6 +32,7 @@ import http.client
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -134,6 +135,87 @@ class Backoff:
 
     def sleep(self) -> None:
         self._sleep(self.next_delay())
+
+
+class ContentionBackoff:
+    """Contention-adaptive backoff shaping for optimistic-concurrency loops.
+
+    A 409 Conflict is deliberately NOT retryable-in-place (``is_retryable``):
+    the caller must re-get and replan.  This class shapes how long it waits
+    *before* that replan.  Two signals drive the delay:
+
+    * **observed 409 density** — the conflict fraction over a sliding
+      window of recent attempts.  When N schedulers race one store, high
+      density means the replan will likely collide again, so everyone
+      should spread out; near-zero density means conflicts are isolated
+      blips and waiting is pure latency.
+    * **consecutive-conflict streak** — classic exponential growth, but
+      ``on_success()`` resets the streak (the reset-on-success contract
+      ``Backoff`` documents) so one bad burst never becomes a permanently
+      slow scheduler.  The never-reset variant is exactly the naive
+      baseline the contention bench A/B quantifies: early losers inherit
+      compounding delays and starve.
+
+    Delay = ``base * 2^streak``, scaled by density (a near-idle store pays
+    ~0), capped at ``max_delay_s``, with full downward jitter — jitter is
+    what desynchronizes schedulers that conflicted at the same instant.
+    rng and sleep are injectable for deterministic tests, same as
+    ``Backoff``."""
+
+    def __init__(
+        self,
+        base_delay_s: float = 0.001,
+        max_delay_s: float = 0.1,
+        window: int = 32,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._base = base_delay_s
+        self._max = max_delay_s
+        self._window = window
+        self._rng = rng or random
+        self._sleep = sleep
+        self._outcomes: deque = deque(maxlen=window)  # True per conflict
+        self._streak = 0
+
+    @property
+    def streak(self) -> int:
+        return self._streak
+
+    @property
+    def density(self) -> float:
+        """Conflict fraction over the sliding window (0.0 when no attempt
+        has been observed yet — an idle loop has no evidence of contention)."""
+        if not self._outcomes:
+            return 0.0
+        return sum(self._outcomes) / len(self._outcomes)
+
+    def on_conflict(self) -> None:
+        self._outcomes.append(True)
+        self._streak += 1
+
+    def on_success(self) -> None:
+        """Reset the streak; the density window keeps its history so a
+        single success amid a storm doesn't zero the shaping signal."""
+        self._outcomes.append(False)
+        self._streak = 0
+
+    def next_delay(self) -> float:
+        if self._streak == 0:
+            return 0.0
+        grown = self._base * (2.0 ** min(self._streak - 1, 16))
+        # Density scaling: a lone conflict on a quiet store waits ~base;
+        # the same streak under a dense 409 storm waits the full grown
+        # delay.  The +base floor keeps a conflicted loop from busy-spinning.
+        delay = min(self._max, self._base + grown * self.density)
+        return delay * (1.0 - 0.5 * self._rng.random())
+
+    def sleep(self) -> None:
+        d = self.next_delay()
+        if d > 0:
+            self._sleep(d)
 
 
 class RetryBudget:

@@ -1,0 +1,189 @@
+"""The ``make sim-contention`` chaos suite (PR 18 acceptance gate).
+
+Races N real scheduler loops (``Allocator.plan``/``allocate_gang``) as
+threads against ONE ``InMemoryAPIServer`` with genuine optimistic-
+concurrency semantics — resourceVersion CAS plus a device-marker
+admission validator — and pins the contention-plane invariants:
+
+* **Exactly-once commits** — zero lost claims, zero double-committed
+  items, zero device-marker overlaps, audited against the STORE, under
+  seeded 409 storms and concurrent gang unwinds.
+* **Fairness A/B** — the conflict-aware allocator (shuffled score ties,
+  sharded work/pools with spill-over, density-shaped backoff that
+  resets on success) holds Jain's index >= 0.8 where the naive policy
+  (deterministic ordering, head-of-line pickup, never-reset exponential
+  backoff) collapses below 0.5 under the same asymmetric 409 burst.
+* **Wasted work** — under a symmetric storm the aware policy at least
+  halves the wasted-attempt ratio.
+* **Starvation detector** — ARMED -> COUNTING -> FIRED fires (diag
+  bundle + journal + metric) for a blackout victim and stays silent on
+  the fixed path under the default storm.
+
+Budget: everything except the 10k-pool acceptance test is tier-1; the
+whole file (the ``make sim-contention`` target) must stay under 60s.
+"""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.scheduler.cluster_sim import (
+    ContentionConfig,
+    default_contention_storm,
+    run_contention,
+    run_contention_ab,
+    uniform_contention_storm,
+)
+from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
+
+
+def _exactly_once(report):
+    assert report.lost_claims == 0, "claims planned but never committed"
+    assert report.double_committed == 0, "work item won by two schedulers"
+    assert report.marker_overlaps == 0, "device marker held by two claims"
+    assert report.committed_claims == report.claims_total
+
+
+class TestContentionAB:
+    """Naive vs conflict-aware on one shared cluster."""
+
+    def test_small_ab_converges_exactly_once(self):
+        base = ContentionConfig(
+            seed=5, n_nodes=300, n_schedulers=4, work_items=48,
+            gang_items=6, storm=default_contention_storm(4),
+        )
+        naive, aware = run_contention_ab(base)
+        _exactly_once(naive)
+        _exactly_once(aware)
+        assert naive.conflicts_total > 0, "storm never produced a 409"
+        assert aware.fairness >= naive.fairness
+        # Metrics land in the shared registry with bounded labels.
+        doc = parse_prom_text(REGISTRY.render())
+        conflicts = doc["dra_sched_conflicts_total"]
+        assert any(k == (("scheduler", "sched-0"),) for k in conflicts)
+        assert doc["dra_sched_fairness"][()] == aware.fairness
+        assert doc["dra_sched_retry_seconds_count"][()] > 0
+        # Reports serialize for bench/CI artifacts.
+        assert json.loads(aware.to_json())["conflict_aware"] is True
+
+    def test_wasted_work_halved_under_uniform_storm(self):
+        base = ContentionConfig(
+            seed=7, n_nodes=600, n_schedulers=8, work_items=120,
+            gang_items=12, storm=uniform_contention_storm(),
+        )
+        naive, aware = run_contention_ab(base)
+        _exactly_once(naive)
+        _exactly_once(aware)
+        assert naive.wasted_work_ratio > 0
+        assert aware.wasted_work_ratio * 2 <= naive.wasted_work_ratio, (
+            f"aware waste {aware.wasted_work_ratio} not at least half of "
+            f"naive {naive.wasted_work_ratio}"
+        )
+        assert aware.gang_conflicts + naive.gang_conflicts >= 0  # typed path
+
+    @pytest.mark.slow
+    def test_acceptance_10k_pools_8_schedulers(self):
+        """The headline gate: at 10k pools / 8 schedulers under the
+        seeded asymmetric 409 storm, conflict-aware converges with
+        exactly-once commits and Jain fairness >= 0.8 where naive
+        collapses below 0.5."""
+        base = ContentionConfig(
+            seed=7, n_nodes=10_000, n_schedulers=8, work_items=160,
+            gang_items=16, storm=default_contention_storm(8),
+        )
+        naive, aware = run_contention_ab(base)
+        _exactly_once(naive)
+        _exactly_once(aware)
+        assert naive.fairness < 0.5, (
+            f"naive policy unexpectedly fair: J={naive.fairness}"
+        )
+        assert aware.fairness >= 0.8, (
+            f"conflict-aware allocator lost fairness: J={aware.fairness}"
+        )
+        assert aware.convergence_s < naive.convergence_s
+        assert aware.starved == [], "fixed path must not trip the detector"
+        assert naive.injected_conflicts <= 100  # per-run budget respected
+        assert aware.injected_conflicts <= 100
+
+
+class TestStarvationDetector:
+    def test_fires_for_blackout_victim_with_bundle(self):
+        cfg = ContentionConfig(
+            seed=5, n_nodes=200, n_schedulers=4, work_items=120,
+            gang_items=8, conflict_aware=False, starvation_budget=8,
+            naive_base_delay_s=0.002, naive_max_delay_s=0.02,
+            storm=(
+                FaultProfile(
+                    name="sched-blackout", sched_conflict_rate=1.0,
+                    schedulers=(0,), limit=400,
+                ),
+            ),
+        )
+        report = run_contention(cfg)
+        _exactly_once(report)
+        assert report.starved == ["sched-0"], (
+            "detector must fire exactly once, for the blackout victim only"
+        )
+        assert len(report.starvation_bundles) == 1
+        assert os.path.isfile(report.starvation_bundles[0])
+        fired = [
+            e for e in JOURNAL.tail(limit=500, component="cluster_sim")
+            if e["event"] == "sched.starved"
+        ]
+        assert len(fired) == 1
+        assert fired[0]["correlation"] == "sched-0"
+        assert fired[0]["attrs"]["commits"] == 0
+        doc = parse_prom_text(REGISTRY.render())
+        assert doc["dra_sched_starvation_total"][
+            (("scheduler", "sched-0"),)
+        ] == 1
+
+    def test_silent_on_fixed_path_under_default_storm(self):
+        cfg = ContentionConfig(
+            seed=5, n_nodes=200, n_schedulers=4, work_items=60,
+            gang_items=6, conflict_aware=True,
+            storm=default_contention_storm(4),
+        )
+        report = run_contention(cfg)
+        _exactly_once(report)
+        assert report.starved == []
+        assert report.starvation_bundles == []
+        assert "dra_sched_starvation_total" not in parse_prom_text(
+            REGISTRY.render()
+        )
+
+
+class TestSchedulerFaultGrammar:
+    def test_from_env_parses_scheduler_scoped_faults(self):
+        inj = FaultInjector.from_env(
+            "sched_conflict_rate=0.5,schedulers=0+2,limit=5,seed=3"
+        )
+        (storm,) = inj._profiles
+        (latency,) = FaultInjector.from_env(
+            "sched_commit_latency_ms=2.5"
+        )._profiles
+        assert storm.sched_conflict_rate == 0.5
+        assert storm.schedulers == (0, 2)
+        assert storm.limit == 5
+        assert latency.sched_commit_latency_s == pytest.approx(0.0025)
+        assert latency.schedulers == ()  # empty scope = every scheduler
+
+    def test_scoped_conflict_respects_budget_and_scope(self):
+        from k8s_dra_driver_tpu.kube.fakeserver import Conflict
+
+        inj = FaultInjector(seed=1)
+        inj.arm(FaultProfile(
+            name="blackout", sched_conflict_rate=1.0, schedulers=(1,),
+            limit=3,
+        ))
+        inj.before_sched_commit(0)  # out of scope: never raises
+        hits = 0
+        for _ in range(10):
+            try:
+                inj.before_sched_commit(1)
+            except Conflict:
+                hits += 1
+        assert hits == 3, "shared budget cap must bound injections"

@@ -32,6 +32,7 @@ from k8s_dra_driver_tpu.plugin.deviceinfo import AllocatableDevices
 from k8s_dra_driver_tpu.scheduler.allocator import (
     AllocationError,
     Allocator,
+    GangConflictError,
     GangMember,
 )
 from k8s_dra_driver_tpu.tpuinfo.binding import enumerate_topology
@@ -232,3 +233,98 @@ class TestDeterminism:
 
     def test_identical_worlds_plan_identically(self):
         assert self._run() == self._run()
+
+
+class TestGangConflictError:
+    def test_mid_gang_conflict_is_typed_and_carries_unwound_names(self):
+        """A stale member mid-commit raises GangConflictError (an
+        AllocationError, so existing catches still work) naming exactly
+        the siblings that were rolled back, in commit order — no caller
+        ever needs to string-match the message again."""
+        server, alloc = build_cluster(n_nodes=2)
+        members = gang_of(server, "g", ["node-0", "node-0", "node-1"])
+        # The THIRD member's held copy goes stale: the first two commit,
+        # then the gang must unwind both.
+        server.update(server.get(ResourceClaim.KIND, "g-2", "default"))
+        with pytest.raises(GangConflictError) as err:
+            alloc.allocate_gang(members)
+        assert isinstance(err.value, AllocationError)
+        assert err.value.unwound == ("g-0", "g-1")
+        assert allocated_names(server) == set(), "unwind must balance the store"
+        unwound = [
+            e["attrs"]["claim"]
+            for e in JOURNAL.tail(limit=200)
+            if e["event"] == "gang.unwound"
+        ]
+        assert unwound == ["g-1", "g-0"], "unwind must run in reverse order"
+
+
+class TestConcurrentGangUnwind:
+    def test_overlapping_gangs_commit_exactly_once(self):
+        """Two scheduler loops race overlapping gangs (they share the
+        claim ``x``, committed last) against one store: claim-level CAS
+        picks exactly one winner, the loser unwinds its committed
+        sibling in reverse, and the store ends balanced — the winner's
+        claims allocated on disjoint devices, the loser's claim and
+        nothing else rolled back."""
+        import threading
+
+        # Injected PUT latency (GIL-releasing sleep at the commit seam)
+        # guarantees the two commit sequences genuinely interleave
+        # instead of racing GIL scheduling luck.
+        inj = FaultInjector(seed=3)
+        inj.arm(FaultProfile(
+            name="slow-put", latency_s=0.01,
+            verbs=("PUT",), kinds=(ResourceClaim.KIND,),
+        ))
+        server, _ = build_cluster(n_nodes=2, injector=inj)
+        shared = subslice_claim(server, "x")
+        gangs = {
+            "a": [
+                GangMember(claim=subslice_claim(server, "a-0"), node_name="node-0"),
+                GangMember(claim=shared, node_name="node-1"),
+            ],
+            "b": [
+                GangMember(claim=subslice_claim(server, "b-0"), node_name="node-0"),
+                GangMember(
+                    claim=server.get(ResourceClaim.KIND, "x", "default"),
+                    node_name="node-1",
+                ),
+            ],
+        }
+        results: dict = {}
+        barrier = threading.Barrier(2)
+
+        def race(tag):
+            alloc = Allocator(server)
+            try:
+                barrier.wait()
+                results[tag] = ("won", alloc.allocate_gang(gangs[tag]))
+            except GangConflictError as exc:
+                results[tag] = ("lost", exc)
+            finally:
+                alloc.close()
+
+        threads = [
+            threading.Thread(target=race, args=(t,)) for t in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        outcomes = sorted(kind for kind, _ in results.values())
+        assert outcomes == ["lost", "won"], f"exactly one winner: {results}"
+        winner = next(t for t, (k, _) in results.items() if k == "won")
+        loser = "b" if winner == "a" else "a"
+        assert allocated_names(server) == {f"{winner}-0", "x"}
+        loss = results[loser][1]
+        assert loss.unwound == (f"{loser}-0",)
+        # The winner's two members must sit on genuinely disjoint devices.
+        winner_claims = results[winner][1]
+        picks = [
+            (c.metadata.name, r.pool, r.device)
+            for c in winner_claims
+            for r in c.status.allocation.devices.results
+        ]
+        assert len({(p, d) for _, p, d in picks}) == len(picks)

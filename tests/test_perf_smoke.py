@@ -94,6 +94,19 @@ def test_plan_scale_stays_within_perf_budgets():
     assert stats["audit_failures"] == 0 and stats["leaked_claims"] == 0
 
 
+def test_contention_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_contention_overhead()
+    # The conflict-aware allocator's contract: with one scheduler and no
+    # storm, every avoidance lever (tie shuffling, shard routing,
+    # per-attempt refetch, backoff bookkeeping) is free — same plan()
+    # ceilings as the naive-path churn slice, zero conflicts.
+    assert stats["n_schedulers"] == 1
+    assert stats["plan_samples"] >= 100
+    assert stats["conflicts_total"] == 0
+    assert stats["plan_p50_ms"] <= stats["plan_p50_ceiling_ms"]
+    assert stats["plan_p90_ms"] <= stats["plan_p90_ceiling_ms"]
+
+
 def test_obs_plane_overhead_stays_within_perf_budgets():
     stats = perf_smoke.check_obs_plane_overhead()
     assert stats["requests_shipped"] == 8

@@ -359,6 +359,7 @@ def check_metric_docs(paths: list[Path], arch_text: str) -> list[Finding]:
         # observability surface (PR 15) is policed wherever it is declared.
         prefixes = (
             "dra_plan_", "dra_gang_", "dra_sim_", "dra_extender_",
+            "dra_sched_",
         )
         if in_models:
             prefixes += (
@@ -441,6 +442,11 @@ METRIC_LABEL_KEYS = frozenset({
     # {bf16/f32 names, int8, int4} set — tpu_serve_kv_bytes{dtype=} splits
     # resident pool bytes by quantization format, never per-request
     "dtype",
+    # multi-scheduler contention harness (scheduler/cluster_sim.py):
+    # scheduler labels are the bounded "sched-<idx>" set, one per racing
+    # scheduler thread (N <= 8 in every harness config), precomputed at
+    # worker construction — never formatted at the call site
+    "scheduler",
 })
 METRIC_LABEL_PREFIXES = (
     "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",

@@ -929,6 +929,61 @@ def check_plan_scale() -> dict:
     return stats
 
 
+def check_contention_overhead() -> dict:
+    """Budget guard for the conflict-aware allocator (PR 18 tentpole):
+    every conflict-avoidance lever — seeded tie shuffling, shard
+    routing, per-attempt refetch, ContentionBackoff bookkeeping — must
+    be free when there is nothing to avoid.  One scheduler, no storm:
+    plan() latency must sit inside the SAME ceilings check_plan_scale
+    pins, with zero conflicts and zero backoff stalls."""
+    from k8s_dra_driver_tpu.scheduler.cluster_sim import (
+        ContentionConfig,
+        run_contention,
+    )
+
+    report = run_contention(ContentionConfig(
+        seed=17, n_nodes=PLAN_SCALE_NODES, n_schedulers=1,
+        work_items=96, gang_items=12, conflict_aware=True,
+    ))
+    stats = {
+        "n_nodes": report.n_nodes,
+        "n_schedulers": report.n_schedulers,
+        "plan_samples": report.plan_samples,
+        "plan_p50_ms": report.plan_p50_ms,
+        "plan_p50_ceiling_ms": PLAN_P50_CEILING_MS,
+        "plan_p90_ms": report.plan_p90_ms,
+        "plan_p90_ceiling_ms": PLAN_P90_CEILING_MS,
+        "committed_claims": report.committed_claims,
+        "conflicts_total": report.conflicts_total,
+        "wasted_attempts": report.wasted_attempts,
+        "convergence_s": report.convergence_s,
+    }
+    if report.plan_samples < 100 or report.committed_claims < 50:
+        raise PerfBudgetError(
+            f"contention slice exercised only {report.plan_samples} plans / "
+            f"{report.committed_claims} commits — not a meaningful sample"
+        )
+    if report.conflicts_total or report.lost_claims or report.double_committed:
+        raise PerfBudgetError(
+            f"uncontended run was not conflict-free: "
+            f"{report.conflicts_total} conflicts, {report.lost_claims} lost, "
+            f"{report.double_committed} double-committed"
+        )
+    if report.plan_p50_ms > PLAN_P50_CEILING_MS:
+        raise PerfBudgetError(
+            f"conflict-aware plan() p50 {report.plan_p50_ms}ms > "
+            f"{PLAN_P50_CEILING_MS}ms at {PLAN_SCALE_NODES} nodes: "
+            f"avoidance levers are taxing the uncontended path"
+        )
+    if report.plan_p90_ms > PLAN_P90_CEILING_MS:
+        raise PerfBudgetError(
+            f"conflict-aware plan() p90 {report.plan_p90_ms}ms > "
+            f"{PLAN_P90_CEILING_MS}ms at {PLAN_SCALE_NODES} nodes: "
+            f"avoidance levers are taxing the uncontended tail"
+        )
+    return stats
+
+
 # Quantized KV pools must be free on the HOST axis: dequant is fused into
 # the attention operand load on-device, so an int8-KV engine pays exactly
 # the bf16/f32 path's host syncs for the same workload.  The capacity
@@ -1117,6 +1172,7 @@ def main() -> int:
         stats["autoscaler_overhead"] = check_autoscaler_overhead()
         stats["obs_plane_overhead"] = check_obs_plane_overhead()
         stats["plan_scale"] = check_plan_scale()
+        stats["contention_overhead"] = check_contention_overhead()
         stats["quantized_decode"] = check_quantized_decode()
         stats["ondevice_sampling"] = check_ondevice_sampling()
     except PerfBudgetError as exc:
