@@ -4,7 +4,7 @@
 PYTHON ?= python
 CPP_DIR := k8s_dra_driver_tpu/tpuinfo/cpp
 
-.PHONY: all native test asan-test bench chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance sim-cluster sim-contention demo dryrun lint analyze perf-smoke helm-template clean
+.PHONY: all native test asan-test bench bench-prefix chaos chaos-serve chaos-fleet chaos-disagg chaos-autoscale chaos-transport chaos-rebalance sim-cluster sim-contention demo dryrun lint analyze perf-smoke helm-template clean
 
 all: native
 
@@ -26,6 +26,13 @@ asan-test:
 # Headline benchmark (claim-to-running p50 + live data-plane proof).
 bench:
 	$(PYTHON) bench.py
+
+# Fleet prefix-cache macrobench (<4min, CPU, seeded): shared-prefix trace
+# replayed through a 4-replica sim fleet, per-engine caches vs the
+# FleetPrefixIndex (depth-aware routing + modeled cross-replica pulls) —
+# one JSON line with the TTFT/attainment A/B and hit provenance.
+bench-prefix:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py prefix_fleet
 
 # Chaos suite (<10s): the allocator→prepare→unprepare loop under injected
 # API faults (utils/faults.py) — error storms, conflict storms, dropped

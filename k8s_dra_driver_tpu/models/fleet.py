@@ -175,6 +175,16 @@ class FleetPolicy:
     # when the spread is real.
     affinity_bonus: float = 1.25
     max_affinity_entries: int = 1024  # bound on the routing-history maps
+    # Fleet prefix-cache tier (models/fleet_prefix.py): when a prefix
+    # index is attached, depth-aware affinity replaces the flat bonus —
+    # every whole cached block the candidate owns earns
+    # ``prefix_depth_bonus_per_block``, capped.  0.6/block keeps the same
+    # shape as the flat bonus but proportional: one cached block still
+    # loses to a one-slot load imbalance, two blocks beat one slot, four
+    # beat two — a deeper cached prefix wins a proportionally larger
+    # imbalance, never an unbounded one.
+    prefix_depth_bonus_per_block: float = 0.6
+    prefix_depth_bonus_max: float = 4.0
 
 
 class Replica:
@@ -271,6 +281,11 @@ class FleetRouter:
         # be cheap and must not dispatch device work (the perf-smoke
         # autoscaler guard pins that).
         self.tick_hooks: list = []
+        # Fleet prefix-cache tier (models/fleet_prefix.py): both default
+        # None — routing/scoring behavior is byte-identical until a tier
+        # or index is attached.
+        self.prefix_tier = None
+        self.prefix_index = None
         for item in engines:
             if isinstance(item, tuple):
                 name, engine = item
@@ -310,6 +325,8 @@ class FleetRouter:
             merge=True,
         )
         self.replicas.append(rep)
+        if self.prefix_tier is not None:
+            self.prefix_tier.bind_engine(rep.name, rep.engine)
         JOURNAL.record(
             "fleet", "replica.add", correlation=name,
             engine=type(engine).__name__, n_slots=engine.n_slots,
@@ -317,6 +334,24 @@ class FleetRouter:
         )
         self._publish_states()
         return rep
+
+    def attach_prefix_index(self, index) -> None:
+        """Depth-aware prefix scoring only (no pull machinery) — what the
+        workload simulator uses: engines consult/publish the index
+        themselves and the router just routes-to-home by cached depth."""
+        self.prefix_index = index
+
+    def attach_prefix_tier(self, tier) -> None:
+        """Full fleet prefix-cache tier: depth-aware scoring, engine
+        publish hooks, and admission-time remote pulls (tier.prepare runs
+        inside ``_submit_to``).  The tier's TTL sweep rides the tick hooks
+        (host-only dict work — no device dispatch, per the tick_hooks
+        contract)."""
+        self.prefix_tier = tier
+        self.prefix_index = tier.index
+        for rep in self.replicas:
+            tier.bind_engine(rep.name, rep.engine)
+        self.tick_hooks.append(tier.tick)
 
     def replica(self, name: str) -> Replica:
         for rep in self.replicas:
@@ -345,7 +380,9 @@ class FleetRouter:
         contract as a bare engine's submit)."""
         req = {"prompt": list(prompt), "max_tokens": max_tokens, **kwargs}
         last_err: Exception | None = None
-        for rep in self._candidates(req["prompt"], int(req.get("adapter", 0))):
+        for rep in self._candidates(
+            req["prompt"], int(req.get("adapter", 0)), req
+        ):
             try:
                 return self._submit_to(rep, req)
             except RuntimeError as exc:  # capacity race (e.g. out of blocks)
@@ -353,13 +390,23 @@ class FleetRouter:
                 continue
         raise last_err or RuntimeError("no admittable replica with capacity")
 
-    def _candidates(self, prompt, adapter: int) -> list[Replica]:
+    def _candidates(self, prompt, adapter: int, req: dict | None = None) -> list[Replica]:
         """Admittable replicas, best placement first.  Gate: state
         ``healthy`` AND the breaker admits (suspect/evacuating/drained
         replicas take no new work).  Score: free slots dominate (least
         loaded), free blocks break slot ties on paged replicas, and the
-        prefix/adapter home earns ``affinity_bonus``."""
+        prefix/adapter home earns ``affinity_bonus``.  With a fleet
+        prefix index attached, the flat prefix bonus gives way to
+        depth-aware scoring: each whole cached block the candidate owns
+        earns ``prefix_depth_bonus_per_block`` (capped), so a deeper
+        cached prefix beats a proportionally larger load imbalance."""
         pkey = self._prefix_key(prompt)
+        survey = None
+        if self.prefix_index is not None:
+            chain = req.get("prefix_chain") if req else None
+            if chain is None:
+                chain = self.prefix_index.chain_for_tokens(prompt, adapter)
+            survey = self.prefix_index.survey(chain, adapter)
         scored = []
         for idx, rep in enumerate(self.replicas):
             if rep.state != HEALTHY or not rep.breaker.allow():
@@ -371,7 +418,14 @@ class FleetRouter:
             st = rep.last_stats
             if st is not None and st.free_blocks is not None:
                 score += min(0.99, st.free_blocks / (100.0 * max(1, st.n_slots)))
-            if pkey is not None and self._prefix_home.get(pkey) == rep.name:
+            if survey is not None:
+                owned = survey.get(rep.name)
+                if owned is not None:
+                    score += min(
+                        self.policy.prefix_depth_bonus_max,
+                        self.policy.prefix_depth_bonus_per_block * owned[1],
+                    )
+            elif pkey is not None and self._prefix_home.get(pkey) == rep.name:
                 score += self.policy.affinity_bonus
             if adapter and self._adapter_home.get(adapter) == rep.name:
                 score += self.policy.affinity_bonus
@@ -390,6 +444,16 @@ class FleetRouter:
         }
         if "queued_at" in rep.submit_params:
             kw.setdefault("queued_at", req.get("_enqueued_at"))
+        if self.prefix_tier is not None:
+            # Warm the chosen replica before admission: local hit, remote
+            # pull-and-inject, or nothing (cold).  prepare() contains its
+            # own failures — the tier can cost an admission, never fail it.
+            self.prefix_tier.prepare(
+                rep.name, rep.engine, req["prompt"],
+                max_tokens=req.get("max_tokens"),
+                adapter=int(req.get("adapter", 0)),
+                chain=req.get("prefix_chain"),
+            )
         rid = rep.engine.submit(**kw)
         self._owner[rid] = rep
         pkey = self._prefix_key(req["prompt"])
@@ -496,7 +560,7 @@ class FleetRouter:
             req = queue[0]
             placed = False
             for rep in self._candidates(
-                req["prompt"], int(req.get("adapter", 0))
+                req["prompt"], int(req.get("adapter", 0)), req
             ):
                 try:
                     self._submit_to(rep, req)
@@ -737,6 +801,10 @@ class FleetRouter:
         self.replicas.remove(rep)
         for rid in [r for r, own in self._owner.items() if own is rep]:
             self._owner.pop(rid, None)
+        if self.prefix_tier is not None:
+            self.prefix_tier.on_replica_gone(rep.name, rep.engine)
+        elif self.prefix_index is not None:
+            self.prefix_index.invalidate_owner(rep.name)
         JOURNAL.record(
             "fleet", "replica.remove", correlation=name,
             engine=type(rep.engine).__name__,
@@ -761,6 +829,10 @@ class FleetRouter:
                 replica=rep.name, error=f"{type(exc).__name__}: {exc}",
             )
             _M_EVAC.inc(reason="snapshot_failed")
+            if self.prefix_tier is not None:
+                self.prefix_tier.on_replica_gone(rep.name, rep.engine)
+            elif self.prefix_index is not None:
+                self.prefix_index.invalidate_owner(rep.name)
             self._set_state(rep, DRAINED, f"snapshot failed ({reason})")
             rep.evac_corr = ""
             return []
@@ -781,6 +853,13 @@ class FleetRouter:
         moved = self._place_entries(entries, corr, skip=rep)
         rep.evacuations += 1
         _M_EVAC.inc(reason=reason)
+        # A drained replica's prefix blocks are unreachable: purge its
+        # fleet-index entries (pinned ones die at unpin — an in-flight
+        # pull is never raced) and stop publishing for it.
+        if self.prefix_tier is not None:
+            self.prefix_tier.on_replica_gone(rep.name, rep.engine)
+        elif self.prefix_index is not None:
+            self.prefix_index.invalidate_owner(rep.name)
         self._set_state(rep, DRAINED, reason)
         JOURNAL.record(
             "fleet", "evac.resumed", correlation=corr, replica=rep.name,

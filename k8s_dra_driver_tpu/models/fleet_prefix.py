@@ -1,0 +1,638 @@
+"""Fleet-wide KV prefix cache: distributed prefix index + cross-replica pull.
+
+At fleet scale the same system prompts and conversation prefixes hit every
+replica, but the prefix caches in `models/paged.py` (`_prefix_stores`) and
+`models/serve.py` (dense `_prefix_store`) are strictly per-engine: a warm
+cache on replica A does nothing for a request admitted on replica B.  This
+module adds the fleet tier on top of them:
+
+- `FleetPrefixIndex` — hash-of-token-prefix -> owning replica + KVSlice
+  geometry (block_size, kv_dtype, adapter).  Engines publish as they store
+  prefix blocks (via `on_prefix_store` / `on_prefix_evict` hooks) and the
+  router consults it at admission.  TTL + capacity eviction with
+  block-ledger accounting; pinned-while-pulling refcounts so eviction never
+  races an in-flight pull; `invalidate_owner()` on scale-down/rebalance.
+- `LocalPrefixSource` / `RemotePrefixSource` — the pull legs.  Local pulls
+  (owner in the same process) still round-trip `KVSlice.to_wire()` /
+  `from_wire()` so the exact wire-v2 validation (CRCs, quantized geometry)
+  guards both paths.  Remote pulls ride the existing `models/transport.py`
+  framed link: PREFIXREQ out, PREFIXKV / PREFIXMISS back, bounded by the
+  link's breaker + heartbeat liveness.
+- `FleetPrefixTier` — admission-time consumer bound to a `FleetRouter`.
+  Routes-to-home wins when affinity is free (depth-aware scoring lives in
+  `fleet._candidates`); otherwise `prepare()` pulls the prefix KV from the
+  owner and injects it via the engine's cached-blocks path so the
+  subsequent `submit()` takes the *existing* prefix-hit ladder — which is
+  what makes remote-pull decode bit-equal to cold prefill.
+
+Fallback ladder (cost, never correctness): geometry mismatch, breaker
+open, PREFIXMISS, mid-pull owner death, or inject failure all land on cold
+prefill; a dead owner is invalidated from the index on the way down.
+
+Like `models/fleet.py`, this module stays importable without jax — the
+engines bring jax; KVSlice is imported lazily at pull time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu.utils.journal import JOURNAL
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY
+
+_M_PREFIX_HITS = REGISTRY.counter(
+    "tpu_fleet_prefix_hits_total",
+    "Admissions served from the fleet prefix-cache tier by source: "
+    "local = the admitting replica already held the prefix blocks, "
+    "remote = prefix KV was pulled from the owning replica over the "
+    "transport wire and injected before prefill.",
+)
+_M_PREFIX_PULL = REGISTRY.histogram(
+    "tpu_fleet_prefix_pull_seconds",
+    "Wall seconds per cross-replica prefix-KV pull attempt, measured from "
+    "the PREFIXREQ send to injected blocks (misses and failed pulls that "
+    "fell back to cold prefill included).",
+)
+_M_PREFIX_EVICT = REGISTRY.counter(
+    "tpu_fleet_prefix_evictions_total",
+    "Fleet prefix-index entries dropped by reason: ttl (expired sweep), "
+    "capacity (index LRU), owner_evicted (the owning engine LRU-dropped "
+    "the blocks), invalidated (owner drained/removed/rebalanced away).",
+)
+
+
+def prefix_digest(material, adapter: int = 0) -> str:
+    """Stable digest of prefix key material (a token tuple, or any
+    deterministic hashable stand-in — the workload simulator uses block
+    identity tuples).  The index stores digests, not token content, so a
+    4096-entry index over 1k-token prefixes stays tens of KiB."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((int(adapter), tuple(material))).encode("utf-8"))
+    return h.hexdigest()
+
+
+@dataclass
+class PrefixEntry:
+    """One published prefix: deepest token depth `n_tokens` at `owner`,
+    plus the KVSlice geometry a puller must match (or fall back)."""
+
+    key: str
+    owner: str
+    n_tokens: int
+    block_size: int
+    kv_dtype: str
+    n_layers: int = 0
+    kv_heads: int = 0
+    head_dim: int = 0
+    adapter: int = 0
+    blocks: int = 1  # ledger blocks this entry accounts for on the owner
+    expires_at: float = 0.0
+    pins: int = 0
+    dead: bool = False  # owner invalidated while pinned; drop at unpin
+
+
+@dataclass(frozen=True)
+class PrefixLedger:
+    """Balanced-accounting snapshot: published blocks per owner."""
+
+    blocks: dict = field(default_factory=dict)
+    entries: int = 0
+    pinned: int = 0
+
+
+class FleetPrefixIndex:
+    """Fleet-scoped map: digest(adapter, token-prefix) -> PrefixEntry.
+
+    Not thread-safe by design — it lives on the admission path of one
+    router (same single-threaded discipline as `FleetRouter` itself).
+    Entries are hints: the owner re-validates on PREFIXREQ, so a stale
+    entry costs one miss round-trip, never correctness.
+    """
+
+    def __init__(
+        self,
+        *,
+        ttl_s: float = 300.0,
+        max_entries: int = 4096,
+        clock=time.monotonic,
+    ) -> None:
+        self.ttl_s = float(ttl_s)
+        self.max_entries = int(max_entries)
+        self._clock = clock
+        self._entries: dict[str, PrefixEntry] = {}  # insertion order = LRU
+        self._block_sizes: set[int] = set()
+        self.published_total = 0
+        self.evicted_total = 0
+
+    # -- publish / withdraw -------------------------------------------------
+
+    def publish(
+        self,
+        material,
+        owner: str,
+        *,
+        n_tokens: int,
+        block_size: int,
+        kv_dtype: str,
+        n_layers: int = 0,
+        kv_heads: int = 0,
+        head_dim: int = 0,
+        adapter: int = 0,
+        blocks: int = 1,
+    ) -> PrefixEntry:
+        key = prefix_digest(material, adapter)
+        now = self._clock()
+        ent = self._entries.get(key)
+        if ent is not None and not ent.dead:
+            # Refresh: newest publisher wins the owner slot (rebalance moves
+            # blocks around); bump expiry and LRU position.
+            ent.owner = owner
+            ent.expires_at = now + self.ttl_s
+            ent.kv_dtype = str(kv_dtype)
+            ent.block_size = int(block_size)
+            ent.blocks = int(blocks)
+            self._entries[key] = self._entries.pop(key)
+            return ent
+        ent = PrefixEntry(
+            key=key,
+            owner=str(owner),
+            n_tokens=int(n_tokens),
+            block_size=int(block_size),
+            kv_dtype=str(kv_dtype),
+            n_layers=int(n_layers),
+            kv_heads=int(kv_heads),
+            head_dim=int(head_dim),
+            adapter=int(adapter),
+            blocks=int(blocks),
+            expires_at=now + self.ttl_s,
+        )
+        self._entries[key] = ent
+        self._block_sizes.add(int(block_size))
+        self.published_total += 1
+        self._evict_over_capacity()
+        return ent
+
+    def withdraw(self, material, adapter: int = 0, *, owner: str | None = None,
+                 reason: str = "owner_evicted") -> bool:
+        """The owning engine LRU-dropped these blocks (on_prefix_evict)."""
+        key = prefix_digest(material, adapter)
+        ent = self._entries.get(key)
+        if ent is None or (owner is not None and ent.owner != owner):
+            return False
+        self._drop(ent, reason)
+        return True
+
+    def _drop(self, ent: PrefixEntry, reason: str) -> None:
+        if ent.pins > 0:
+            # Never race an in-flight pull: keep the entry until unpin.
+            ent.dead = True
+            return
+        self._entries.pop(ent.key, None)
+        self.evicted_total += 1
+        _M_PREFIX_EVICT.inc(reason=reason)
+
+    def _evict_over_capacity(self) -> None:
+        while len(self._entries) > self.max_entries:
+            victim = None
+            for ent in self._entries.values():  # oldest first
+                if ent.pins == 0:
+                    victim = ent
+                    break
+            if victim is None:
+                return  # everything pinned; capacity is advisory then
+            self._drop(victim, "capacity")
+
+    # -- lookup -------------------------------------------------------------
+
+    def block_sizes(self):
+        return sorted(self._block_sizes)
+
+    def chain_for_tokens(self, tokens, adapter: int = 0):
+        """Candidate chain [(n_tokens, material)] shallow->deep for a real
+        token prompt, one rung per whole block at every granularity the
+        fleet has published (paged block sizes and dense buckets alike)."""
+        n = len(tokens)
+        depths: set[int] = set()
+        for bs in self._block_sizes:
+            if bs <= 0:
+                continue
+            # A usable prefix must leave >= 1 token to prefill from.
+            d = bs
+            while d < n:
+                depths.add(d)
+                d += bs
+        return [(d, tuple(tokens[:d])) for d in sorted(depths)]
+
+    def _live(self, ent: PrefixEntry | None, now: float) -> PrefixEntry | None:
+        if ent is None or ent.dead:
+            return None
+        if ent.expires_at <= now:
+            self._drop(ent, "ttl")
+            return None
+        return ent
+
+    def deepest(self, chain, adapter: int = 0, *, compatible=None):
+        """Deepest live entry along the chain that passes `compatible(ent)`.
+        Chain rungs are independent candidates (contiguity is the owner's
+        problem — it re-walks its own store on PREFIXREQ)."""
+        now = self._clock()
+        for n_tokens, material in reversed(list(chain)):
+            ent = self._live(self._entries.get(prefix_digest(material, adapter)), now)
+            if ent is None or ent.n_tokens != n_tokens:
+                continue
+            if compatible is not None and not compatible(ent):
+                continue
+            return ent
+        return None
+
+    def survey(self, chain, adapter: int = 0) -> dict:
+        """Per-owner deepest published depth along the chain, as
+        {owner: (n_tokens, blocks)} — the router's depth-aware affinity
+        signal."""
+        now = self._clock()
+        out: dict[str, tuple[int, int]] = {}
+        for n_tokens, material in chain:
+            ent = self._live(self._entries.get(prefix_digest(material, adapter)), now)
+            if ent is None:
+                continue
+            best = out.get(ent.owner)
+            if best is None or n_tokens > best[0]:
+                depth_blocks = (
+                    n_tokens // ent.block_size if ent.block_size > 0 else 1
+                )
+                out[ent.owner] = (n_tokens, max(1, depth_blocks))
+        return out
+
+    # -- pin / sweep / invalidate ------------------------------------------
+
+    def pin(self, key: str) -> bool:
+        ent = self._entries.get(key)
+        if ent is None or ent.dead:
+            return False
+        ent.pins += 1
+        return True
+
+    def unpin(self, key: str) -> None:
+        ent = self._entries.get(key)
+        if ent is None:
+            return
+        ent.pins = max(0, ent.pins - 1)
+        if ent.dead and ent.pins == 0:
+            self._entries.pop(ent.key, None)
+            self.evicted_total += 1
+            _M_PREFIX_EVICT.inc(reason="invalidated")
+
+    def sweep(self, now: float | None = None) -> int:
+        """TTL sweep; returns entries dropped.  Pinned entries survive."""
+        now = self._clock() if now is None else now
+        expired = [e for e in self._entries.values() if e.expires_at <= now]
+        dropped = 0
+        for ent in expired:
+            before = len(self._entries)
+            self._drop(ent, "ttl")
+            dropped += before - len(self._entries)
+        return dropped
+
+    def invalidate_owner(self, owner: str, *, reason: str = "invalidated") -> int:
+        """Owner drained / removed / rebalanced: its entries are garbage.
+        Unpinned entries drop now; pinned ones are marked dead and drop at
+        unpin (never under an in-flight pull)."""
+        victims = [e for e in self._entries.values() if e.owner == owner]
+        dropped = 0
+        for ent in victims:
+            before = len(self._entries)
+            self._drop(ent, reason)
+            dropped += before - len(self._entries)
+        if victims:
+            JOURNAL.record(
+                "fleet", "prefix.invalidate",
+                owner=owner,
+                entries=len(victims),
+                dropped=dropped,
+                reason=reason,
+            )
+        return dropped
+
+    # -- accounting ---------------------------------------------------------
+
+    def ledger(self) -> PrefixLedger:
+        blocks: dict[str, int] = {}
+        pinned = 0
+        for ent in self._entries.values():
+            blocks[ent.owner] = blocks.get(ent.owner, 0) + max(1, ent.blocks)
+            if ent.pins > 0:
+                pinned += 1
+        return PrefixLedger(blocks=blocks, entries=len(self._entries), pinned=pinned)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def note_hit(self, source: str) -> None:
+        _M_PREFIX_HITS.inc(source=source)
+
+
+class LocalPrefixSource:
+    """Pull leg for an owner replica in the same process.  Still round-trips
+    the wire encoding so CRC + quantized-geometry validation is identical to
+    the socket path (a corrupt export surfaces as WireFormatError -> cold
+    prefill, exactly like a corrupt frame would)."""
+
+    def __init__(self, name: str, engine) -> None:
+        self.name = name
+        self.engine = engine
+
+    def pull(self, tokens, *, max_tokens=None, adapter: int = 0, nonce: int = 0):
+        export = getattr(self.engine, "export_prefix_kv", None)
+        if export is None:
+            return None
+        kv = export(tokens, max_tokens=max_tokens, adapter=adapter)
+        if kv is None:
+            return None
+        from k8s_dra_driver_tpu.models.serve import KVSlice, WireFormatError
+
+        try:
+            _, out = KVSlice.from_wire(kv.to_wire(nonce))
+        except WireFormatError:
+            return None
+        return out
+
+
+class RemotePrefixSource:
+    """Pull leg over a transport `PeerLink`: PREFIXREQ out, PREFIXKV or
+    PREFIXMISS back, bounded by the link's breaker, heartbeat liveness, and
+    a pull deadline.  Every failure mode returns None (cold prefill)."""
+
+    def __init__(self, name: str, link, *, peer_pump=None,
+                 pull_timeout_s: float = 5.0, clock=time.monotonic) -> None:
+        self.name = name
+        self.link = link
+        self.peer_pump = peer_pump
+        self.pull_timeout_s = float(pull_timeout_s)
+        self._clock = clock
+
+    def pull(self, tokens, *, max_tokens=None, adapter: int = 0, nonce: int = 0):
+        import struct
+
+        from k8s_dra_driver_tpu.models import transport as T
+        from k8s_dra_driver_tpu.models.serve import KVSlice, WireFormatError
+
+        decode_errors = (WireFormatError, struct.error, ValueError,
+                         KeyError, UnicodeDecodeError)
+
+        link = self.link
+        if link.dead or not link.breaker.allow():
+            return None
+        try:
+            link.send_json(
+                T.PREFIXREQ,
+                {
+                    "nonce": int(nonce),
+                    "tokens": [int(t) for t in tokens],
+                    "max_tokens": None if max_tokens is None else int(max_tokens),
+                    "adapter": int(adapter),
+                },
+            )
+        except (T.TransportDownError, T.PeerDiedError, OSError):
+            return None
+        deadline = self._clock() + self.pull_timeout_s
+        while True:
+            try:
+                link.pump()
+                if self.peer_pump is not None and not link.dead:
+                    self.peer_pump()
+            except (T.TransportDownError, T.PeerDiedError, OSError):
+                return None
+            body = link.take(T.PREFIXKV)
+            if body is not None:
+                try:
+                    meta, wire = T.decode_meta_frame(body)
+                    if int(meta.get("nonce", -1)) != int(nonce):
+                        continue  # stale reply from a timed-out earlier pull
+                    rid, kv = KVSlice.from_wire(wire)
+                except decode_errors:
+                    return None
+                if rid != int(nonce):
+                    continue
+                return kv
+            body = link.take(T.PREFIXMISS)
+            if body is not None:
+                try:
+                    meta, _ = T.decode_meta_frame(body)
+                except decode_errors:
+                    return None
+                if int(meta.get("nonce", -1)) == int(nonce):
+                    return None
+                continue
+            if link.dead or self._clock() >= deadline:
+                return None
+            if self.peer_pump is None:
+                # Not a retry loop: the except arm above RETURNS (cold-
+                # prefill fallback) — this is the deadline-bounded socket
+                # poll, same cadence as transport.py's recv waits.
+                time.sleep(0.002)  # lint: ignore[sleep-retry]
+
+    @property
+    def dead(self) -> bool:
+        return bool(self.link.dead)
+
+
+class FleetPrefixTier:
+    """Admission-time consumer bound to one `FleetRouter` (via
+    `router.attach_prefix_tier`).  `prepare()` runs just before
+    `engine.submit()`: it classifies the admission as a local hit, pulls
+    remote prefix KV into the engine's cached-blocks path, or leaves the
+    request to cold prefill.  Any exception inside prepare is contained —
+    the tier can only ever cost, never fail, an admission."""
+
+    def __init__(
+        self,
+        index: FleetPrefixIndex | None = None,
+        *,
+        clock=time.monotonic,
+        pull_timeout_s: float = 5.0,
+        min_remote_tokens: int = 1,
+    ) -> None:
+        self.index = index if index is not None else FleetPrefixIndex(clock=clock)
+        self._clock = clock
+        self.pull_timeout_s = float(pull_timeout_s)
+        self.min_remote_tokens = int(min_remote_tokens)
+        self._sources: dict[str, object] = {}
+        self._nonce = 0
+        self.counts = {"local": 0, "remote": 0, "cold": 0}
+        self.fallbacks: dict[str, int] = {}
+
+    # -- wiring -------------------------------------------------------------
+
+    def add_source(self, name: str, source) -> None:
+        self._sources[name] = source
+
+    def remove_source(self, name: str) -> None:
+        self._sources.pop(name, None)
+
+    def bind_engine(self, name: str, engine) -> None:
+        """Attach publish/evict hooks so the engine feeds the index as it
+        stores prefix blocks, and register a local pull source for it."""
+        geom_fn = getattr(engine, "prefix_geometry", None)
+        if geom_fn is None:
+            return
+        geom = dict(geom_fn())
+        index = self.index
+
+        def _on_store(material, n_tokens, adapter=0):
+            index.publish(
+                material,
+                name,
+                n_tokens=int(n_tokens),
+                block_size=int(geom.get("block_size", 0)),
+                kv_dtype=str(geom.get("kv_dtype", "")),
+                n_layers=int(geom.get("n_layers", 0)),
+                kv_heads=int(geom.get("kv_heads", 0)),
+                head_dim=int(geom.get("head_dim", 0)),
+                adapter=int(adapter),
+                blocks=1,  # one store block per published depth rung
+            )
+
+        def _on_evict(material, adapter=0):
+            index.withdraw(material, adapter, owner=name)
+
+        engine.on_prefix_store = _on_store
+        engine.on_prefix_evict = _on_evict
+        if getattr(engine, "export_prefix_kv", None) is not None:
+            self.add_source(name, LocalPrefixSource(name, engine))
+
+    def unbind_engine(self, name: str, engine=None) -> None:
+        if engine is not None:
+            if getattr(engine, "on_prefix_store", None) is not None:
+                engine.on_prefix_store = None
+            if getattr(engine, "on_prefix_evict", None) is not None:
+                engine.on_prefix_evict = None
+        self.remove_source(name)
+
+    def on_replica_gone(self, name: str, engine=None) -> None:
+        """Scale-down / rebalance / death: invalidate everything it owned."""
+        self.unbind_engine(name, engine)
+        self.index.invalidate_owner(name)
+
+    def tick(self) -> None:
+        """Router tick hook: TTL sweep (pure dict work, no device syncs)."""
+        self.index.sweep()
+
+    # -- admission ----------------------------------------------------------
+
+    def _note_fallback(self, reason: str) -> None:
+        self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+
+    def _compatible(self, geom: dict, rep_name: str, local_depth: int):
+        quantized_dtypes = ("int8", "int4")
+
+        def check(ent: PrefixEntry) -> bool:
+            if ent.owner == rep_name:
+                return False
+            if ent.n_tokens <= max(local_depth, self.min_remote_tokens - 1):
+                return False
+            if geom.get("n_layers") and ent.n_layers and ent.n_layers != geom["n_layers"]:
+                return False
+            if geom.get("kv_heads") and ent.kv_heads and ent.kv_heads != geom["kv_heads"]:
+                return False
+            if geom.get("head_dim") and ent.head_dim and ent.head_dim != geom["head_dim"]:
+                return False
+            # Bit-equality rule: pool dtypes must match exactly (cross-dtype
+            # conversion is not bit-stable); quantized pools additionally
+            # require the same block granularity because scales are
+            # per-block.  Float payloads may re-block: the receiver installs
+            # whole receiver-blocks, so it needs at least one.
+            if ent.kv_dtype != geom.get("kv_dtype"):
+                return False
+            if ent.kv_dtype in quantized_dtypes:
+                if ent.block_size != geom.get("block_size"):
+                    return False
+            else:
+                bs = int(geom.get("block_size", 0) or 0)
+                if bs > 0 and ent.n_tokens // bs < 1:
+                    return False
+            return True
+
+        return check
+
+    def prepare(self, rep_name: str, engine, prompt, *, max_tokens=None,
+                adapter: int = 0, chain=None) -> str:
+        """Classify + warm one admission.  Returns 'local' | 'remote' |
+        'cold'.  Never raises past itself."""
+        try:
+            return self._prepare(rep_name, engine, prompt,
+                                 max_tokens=max_tokens, adapter=adapter,
+                                 chain=chain)
+        except Exception as exc:  # containment: tier failures cost, not fail
+            JOURNAL.record("fleet", "prefix.prepare_error", replica=rep_name,
+                           error=f"{type(exc).__name__}: {exc}")
+            self._note_fallback("error")
+            self.counts["cold"] += 1
+            return "cold"
+
+    def _prepare(self, rep_name, engine, prompt, *, max_tokens, adapter, chain):
+        depth_fn = getattr(engine, "local_prefix_depth", None)
+        geom_fn = getattr(engine, "prefix_geometry", None)
+        inject = getattr(engine, "inject_prefix_kv", None)
+        local_depth = int(depth_fn(prompt, adapter)) if depth_fn is not None else 0
+        if geom_fn is None or inject is None:
+            if local_depth > 0:
+                self.index.note_hit("local")
+                self.counts["local"] += 1
+                return "local"
+            self.counts["cold"] += 1
+            return "cold"
+        geom = dict(geom_fn())
+        if chain is None:
+            chain = self.index.chain_for_tokens(prompt, adapter)
+        ent = self.index.deepest(
+            chain, adapter,
+            compatible=self._compatible(geom, rep_name, local_depth))
+        if ent is None:
+            if local_depth > 0:
+                self.index.note_hit("local")
+                self.counts["local"] += 1
+                return "local"
+            self.counts["cold"] += 1
+            return "cold"
+        source = self._sources.get(ent.owner)
+        if source is None:
+            self._note_fallback("no_source")
+            return self._after_failed_pull(local_depth)
+        self._nonce += 1
+        nonce = self._nonce
+        pinned = self.index.pin(ent.key)
+        t0 = self._clock()
+        injected = 0
+        try:
+            kv = source.pull(prompt, max_tokens=max_tokens, adapter=adapter,
+                             nonce=nonce)
+            if kv is None:
+                if getattr(source, "dead", False):
+                    # Owner died mid-pull: its whole index footprint is
+                    # garbage now, not just this entry.
+                    self.on_replica_gone(ent.owner)
+                    self._note_fallback("owner_dead")
+                else:
+                    self._note_fallback("miss")
+                return self._after_failed_pull(local_depth)
+            injected = int(inject(prompt, kv, adapter=adapter) or 0)
+        finally:
+            if pinned:
+                self.index.unpin(ent.key)
+            _M_PREFIX_PULL.observe(max(0.0, self._clock() - t0))
+        if injected <= 0:
+            self._note_fallback("inject")
+            return self._after_failed_pull(local_depth)
+        self.index.note_hit("remote")
+        self.counts["remote"] += 1
+        return "remote"
+
+    def _after_failed_pull(self, local_depth: int) -> str:
+        if local_depth > 0:
+            self.index.note_hit("local")
+            self.counts["local"] += 1
+            return "local"
+        self.counts["cold"] += 1
+        return "cold"

@@ -1159,6 +1159,12 @@ class PagedServeEngine:
         self._prefix_store = self._prefix_stores[0]  # group-0 view
         self.prefix_hits = 0     # blocks reused across submits
         self.prefix_misses = 0   # storable blocks computed fresh
+        # fleet prefix-cache tier hooks (models/fleet_prefix.py binds them):
+        # on_prefix_store(tokens, n_tokens, adapter) after a block lands in
+        # the store, on_prefix_evict(tokens, adapter) after an LRU drop —
+        # host-only callbacks, no device work on either path
+        self.on_prefix_store = None
+        self.on_prefix_evict = None
         # chunked-admission queue: FIFO of dicts, head advances one chunk
         # per step() (see prefill_chunk_blocks)
         self._admitting: list[dict] = []
@@ -2205,6 +2211,226 @@ class PagedServeEngine:
         self._update_gauges()
         return True
 
+    # -- fleet prefix-cache tier surface (models/fleet_prefix.py) ----------
+
+    def prefix_geometry(self) -> dict:
+        """KVSlice geometry a fleet-tier puller must match to inject here.
+        ``kv_dtype`` is the pool *storage* label ("int8"/"int4" for
+        quantized pools, else the float dtype string) — the bit-equality
+        contract only holds when payload and pool bytes are the same
+        representation."""
+        label = self.kv_dtype or str(jnp.zeros((), self.cache_dtype).dtype)
+        return {
+            "block_size": self.block_size,
+            "kv_dtype": label,
+            "n_layers": self.cfg.n_layers,
+            "kv_heads": self.cfg.kv_heads,
+            "head_dim": self.cfg.head_dim,
+        }
+
+    def local_prefix_depth(self, prompt, adapter: int = 0) -> int:
+        """Deepest contiguous cached-prefix run (in TOKENS) any shard's
+        store already holds for this prompt.  Read-only: no LRU touch, no
+        refs taken, no device work."""
+        if self.prefix_cache_blocks <= 0:
+            return 0
+        prompt = [int(t) for t in prompt]
+        bs = self.block_size
+        limit = min((len(prompt) - 1) // bs, (self.prompt_bucket - 1) // bs)
+        best = 0
+        for store in self._prefix_stores:
+            depth = 0
+            for i in range(limit):
+                if self._prefix_key(prompt, i, adapter) not in store:
+                    break
+                depth = i + 1
+            best = max(best, depth)
+        return best * bs
+
+    def export_prefix_kv(self, prompt, max_tokens=None, adapter: int = 0):
+        """Fleet-tier pull source: capture the deepest contiguous cached
+        prefix run for ``prompt`` as a canonical KVSlice (valid_len =
+        depth * block_size), or None when nothing is cached.  Same gather
+        + readback construction as :meth:`_capture_kv` — which is what
+        makes a remote-injected prefix bit-equal to computing it locally.
+        One counted device sync when something is exported."""
+        del max_tokens  # advisory in the wire request; depth caps it
+        if self.prefix_cache_blocks <= 0:
+            return None
+        from k8s_dra_driver_tpu.models import serve
+
+        prompt = [int(t) for t in prompt]
+        bs = self.block_size
+        limit = min((len(prompt) - 1) // bs, (self.prompt_bucket - 1) // bs)
+        best_ids: list[int] = []
+        for store in self._prefix_stores:
+            ids: list[int] = []
+            for i in range(limit):
+                key = self._prefix_key(prompt, i, adapter)
+                bid = store.get(key)
+                if bid is None:
+                    break
+                ids.append(int(bid))
+            if len(ids) > len(best_ids):
+                best_ids = ids
+        nb = len(best_ids)
+        if nb == 0:
+            return None
+        valid_len = nb * bs
+        cfg = self.cfg
+        l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        ids_j = jnp.asarray(np.asarray(best_ids, np.int32))
+        kb = self._readback(self._cache.k[:, ids_j])
+        vb = self._readback(self._cache.v[:, ids_j])
+        if self._cache.quantized:
+            kv_dtype = self._cache.kv_dtype
+            ksc = self._readback(self._cache.k_scale[:, ids_j])
+            vsc = self._readback(self._cache.v_scale[:, ids_j])
+            self.host_syncs += 1
+            serve._M_HOST_SYNCS.inc()
+            if kv_dtype == "int4":
+                kb = np.asarray(quant.unpack_int4(kb, axis=-1))
+                vb = np.asarray(quant.unpack_int4(vb, axis=-1))
+            k = np.transpose(kb, (0, 1, 4, 2, 3)).reshape(l, valid_len, hkv, hd)
+            v = np.transpose(vb, (0, 1, 4, 2, 3)).reshape(l, valid_len, hkv, hd)
+            if kv_dtype == "int4":
+                k = np.asarray(quant.pack_int4(k, axis=-1))
+                v = np.asarray(quant.pack_int4(v, axis=-1))
+            return serve.KVSlice(
+                k=np.ascontiguousarray(k), v=np.ascontiguousarray(v),
+                valid_len=valid_len, n_layers=l, kv_heads=hkv, head_dim=hd,
+                dtype=kv_dtype,
+                k_scale=np.ascontiguousarray(ksc),
+                v_scale=np.ascontiguousarray(vsc),
+                block_size=bs,
+            )
+        self.host_syncs += 1
+        serve._M_HOST_SYNCS.inc()
+        k = np.transpose(kb, (0, 1, 4, 2, 3)).reshape(l, valid_len, hkv, hd)
+        v = np.transpose(vb, (0, 1, 4, 2, 3)).reshape(l, valid_len, hkv, hd)
+        return serve.KVSlice(
+            k=np.ascontiguousarray(k), v=np.ascontiguousarray(v),
+            valid_len=valid_len, n_layers=l, kv_heads=hkv, head_dim=hd,
+            dtype=str(k.dtype),
+        )
+
+    def inject_prefix_kv(self, prompt, kv, adapter: int = 0) -> int:
+        """Fleet-tier pull sink: scatter a pulled prefix payload into
+        fresh pool blocks and insert them into the prefix store, so the
+        next ``submit()`` for this prompt takes the EXISTING prefix-hit
+        admission path (``_pick_slot`` -> ``_run_prefill_suffix``) — the
+        path whose bit-equality vs cold prefill is already pinned by the
+        serve/disagg test matrices.  Returns tokens installed; 0 means the
+        caller must cold-prefill (geometry mismatch, nothing new to add,
+        or no free blocks — never an error).  Quantized pools require the
+        exact kv_dtype AND block_size (scales are per-block); float
+        payloads may re-block onto our granularity, installing the whole
+        receiver-blocks they cover."""
+        from k8s_dra_driver_tpu.models import serve
+
+        if self.prefix_cache_blocks <= 0 or not isinstance(kv, serve.KVSlice):
+            return 0
+        cfg = self.cfg
+        bs = self.block_size
+        l, hkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        if (kv.n_layers, kv.kv_heads, kv.head_dim) != (l, hkv, hd):
+            return 0
+        label = self.kv_dtype or str(jnp.zeros((), self.cache_dtype).dtype)
+        if self._cache.quantized:
+            if (not kv.quantized or kv.dtype != label or kv.block_size != bs
+                    or kv.valid_len % bs != 0 or kv.k_scale is None):
+                return 0
+        elif kv.quantized or kv.dtype != label:
+            return 0
+        prompt = [int(t) for t in prompt]
+        limit = min((len(prompt) - 1) // bs, (self.prompt_bucket - 1) // bs)
+        depth = min(kv.valid_len // bs, limit)
+        if depth < 1:
+            return 0
+        # One target shard: the one with the most free blocks (prefix hits
+        # are shard-local, so spreading a prefix across shards helps no
+        # admission).
+        g = max(range(len(self._allocs)),
+                key=lambda i: self._allocs[i].free_blocks)
+        store = self._prefix_stores[g]
+        missing: list[int] = []
+        for i in range(depth):
+            key = self._prefix_key(prompt, i, adapter)
+            if key in store:
+                store.move_to_end(key)
+            else:
+                missing.append(i)
+        if not missing:
+            return 0
+        try:
+            ids = self._allocs[g].alloc(len(missing))
+        except OutOfBlocks:
+            return 0
+        try:
+            sel = np.asarray(missing, np.int64)
+            ids_j = jnp.asarray(np.asarray(ids, np.int32))
+            if self._cache.quantized:
+                k_p, v_p = np.asarray(kv.k), np.asarray(kv.v)
+                nb_total = kv.valid_len // bs
+                if kv.dtype == "int4":
+                    k_p = np.asarray(quant.unpack_int4(k_p, axis=-1))
+                    v_p = np.asarray(quant.unpack_int4(v_p, axis=-1))
+                kb = np.transpose(
+                    k_p.reshape(l, nb_total, bs, hkv, hd), (0, 1, 3, 4, 2)
+                )[:, sel]
+                vb = np.transpose(
+                    v_p.reshape(l, nb_total, bs, hkv, hd), (0, 1, 3, 4, 2)
+                )[:, sel]
+                if kv.dtype == "int4":
+                    kb = np.asarray(quant.pack_int4(kb, axis=-1))
+                    vb = np.asarray(quant.pack_int4(vb, axis=-1))
+                self._cache = PagedKVCache(
+                    k=self._cache.k.at[:, ids_j].set(
+                        jnp.asarray(kb, self._cache.k.dtype)
+                    ),
+                    v=self._cache.v.at[:, ids_j].set(
+                        jnp.asarray(vb, self._cache.v.dtype)
+                    ),
+                    k_scale=self._cache.k_scale.at[:, ids_j].set(
+                        jnp.asarray(np.asarray(kv.k_scale)[:, sel], jnp.float32)
+                    ),
+                    v_scale=self._cache.v_scale.at[:, ids_j].set(
+                        jnp.asarray(np.asarray(kv.v_scale)[:, sel], jnp.float32)
+                    ),
+                )
+            else:
+                k_p = np.asarray(kv.k)[:, : depth * bs]
+                v_p = np.asarray(kv.v)[:, : depth * bs]
+                kb = np.transpose(
+                    k_p.reshape(l, depth, bs, hkv, hd), (0, 1, 3, 4, 2)
+                )[:, sel]
+                vb = np.transpose(
+                    v_p.reshape(l, depth, bs, hkv, hd), (0, 1, 3, 4, 2)
+                )[:, sel]
+                self._cache = PagedKVCache(
+                    k=self._cache.k.at[:, ids_j].set(
+                        jnp.asarray(kb, self._cache.k.dtype)
+                    ),
+                    v=self._cache.v.at[:, ids_j].set(
+                        jnp.asarray(vb, self._cache.v.dtype)
+                    ),
+                )
+            for i, bid in zip(missing, ids):
+                key = self._prefix_key(prompt, i, adapter)
+                store[key] = int(bid)
+                if self.on_prefix_store is not None:
+                    n = (i + 1) * bs
+                    self.on_prefix_store(tuple(prompt[:n]), n, adapter)
+        except BaseException:
+            # a failed scatter must refund: no store entry owns these yet,
+            # so no retire/evict path would ever free them (the
+            # partial-pull-unpinned chaos invariant)
+            self._allocs[g].free(ids)
+            raise
+        self._trim_prefix_store(store, g)
+        self._update_gauges()
+        return len(missing) * bs
+
     def snapshot_active(self, include_kv: bool = False) -> dict:
         """Graceful drain over the pool: capture every in-flight request —
         resident slots, slots still mid-chunked-admission (their history
@@ -2639,9 +2865,24 @@ class PagedServeEngine:
                 store.move_to_end(key)
                 continue
             store[key] = self._allocs[g].share(int(self._table_np[slot, i]))
+            if self.on_prefix_store is not None:
+                n = (i + 1) * self.block_size
+                self.on_prefix_store(tuple(prompt[:n]), n, adapter)
+        self._trim_prefix_store(store, g)
+
+    def _trim_prefix_store(self, store, g: int) -> None:
         while len(store) > self.prefix_cache_blocks:
-            _, old = store.popitem(last=False)  # LRU evict
+            old_key, old = store.popitem(last=False)  # LRU evict
             self._allocs[g].free([old])
+            if self.on_prefix_evict is not None:
+                ad, toks = self._split_prefix_key(old_key)
+                self.on_prefix_evict(toks, ad)
+
+    def _split_prefix_key(self, key):
+        """Inverse of :meth:`_prefix_key`: -> (adapter, token tuple)."""
+        if self.adapter_bank is not None:
+            return int(key[0]), key[1]
+        return 0, key
 
     def _retire(self, slot: int) -> None:
         from k8s_dra_driver_tpu.models import serve

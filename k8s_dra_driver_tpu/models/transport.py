@@ -124,6 +124,9 @@ HANDOFF = 10    # worker→supervisor: a prefill handoff entry (meta + wire)
 COMPLETION = 11
 CONTROL = 12
 TELEM = 13      # worker→supervisor: CRC'd telemetry snapshot (obs_plane)
+PREFIXREQ = 14  # puller→owner: request prefix KV for a token prefix
+PREFIXKV = 15   # owner→puller: meta {nonce, n_tokens} + KVSlice wire bytes
+PREFIXMISS = 16  # owner→puller: meta {nonce, reason} — nothing exportable
 
 _FRAME_HEADER = struct.Struct("!IB")
 MAX_FRAME_BYTES = 1 << 30  # sanity bound: a length beyond this is garbage
@@ -1776,6 +1779,36 @@ class PoolWorker:
                     "rid": rid if rid >= 0 else exc.request_id,
                     "outcome": CORRUPT, "error": str(exc),
                 })
+        elif ftype == PREFIXREQ:
+            # Fleet prefix-cache pull: export the deepest cached prefix run
+            # any local engine holds for these tokens.  The index entry that
+            # pointed here is only a hint — this re-walk is the truth, so a
+            # stale entry costs one PREFIXMISS round-trip, never a wrong KV.
+            doc = json.loads(body.decode())
+            nonce = int(doc.get("nonce", 0))
+            tokens = [int(t) for t in doc.get("tokens", ())]
+            max_tokens = doc.get("max_tokens")
+            adapter = int(doc.get("adapter", 0))
+            kv = None
+            for rep in getattr(self.router, "replicas", ()):
+                export = getattr(rep.engine, "export_prefix_kv", None)
+                if export is None:
+                    continue
+                try:
+                    kv = export(tokens, max_tokens=max_tokens, adapter=adapter)
+                except WireFormatError:
+                    kv = None
+                if kv is not None:
+                    break
+            if kv is None:
+                self._send(PREFIXMISS, encode_meta_frame(
+                    PREFIXMISS, {"nonce": nonce, "reason": "miss"},
+                )[_FRAME_HEADER.size:])
+            else:
+                self._send(PREFIXKV, encode_meta_frame(
+                    PREFIXKV, {"nonce": nonce, "n_tokens": int(kv.valid_len)},
+                    kv.to_wire(nonce),
+                )[_FRAME_HEADER.size:])
 
     def _note_trace(self, rid: int, meta: dict) -> None:
         """Capture the trace context a PLACE/KV frame carried, starting

@@ -285,6 +285,145 @@ def _token_fn(prompt: list[int], vocab: int):
     return tok_at
 
 
+# -- the shared-prefix trace family ------------------------------------------
+
+
+@dataclass(frozen=True)
+class SharedPrefixSpec:
+    """A trace family where prompts share prefixes the way production
+    chat fleets do: a small Zipf-distributed pool of system prompts
+    (everyone hits the head of the distribution), and per-user
+    conversations whose prompt at turn *t* is a strict prefix-extension
+    of turn *t-1* (append-only history).  This is the workload the fleet
+    prefix-cache tier (``models/fleet_prefix.py``) exists for — a
+    uniform-random trace has no cross-request prefix reuse to exploit.
+
+    Arrival times, stream lengths and SLO tiers come from ``base``
+    unchanged; only prompt structure is rewritten.  Deterministic given
+    ``base.seed``.
+    """
+
+    base: WorkloadSpec = WorkloadSpec()
+    n_system_prompts: int = 8
+    system_zipf_alpha: float = 1.2
+    system_len_tokens: int = 48
+    n_users: int = 64
+    turn_tokens: int = 16
+    max_turns: int = 8
+
+
+class PrefixArrival(NamedTuple):
+    """An :class:`Arrival` superset carrying prefix-structure identity.
+    Field order keeps the Arrival fields first, so anything that reads
+    arrivals positionally or by the shared attribute names (``replay``
+    does the latter) works on both."""
+
+    t: float
+    rid: int
+    prompt_len: int
+    max_tokens: int
+    ttft_slo_s: float
+    tpot_slo_s: float
+    system_id: int
+    user_id: int
+    turn: int
+    system_len: int   # tokens of system prompt at the head
+    shared_len: int   # system + conversation history shared with turn-1
+
+
+def generate_shared_prefix(spec: SharedPrefixSpec) -> Iterator[PrefixArrival]:
+    """Yield the shared-prefix trace.  Each base arrival is assigned a
+    system prompt (Zipf over the pool: rank ``i`` has weight
+    ``1/(i+1)^alpha``) and a user; the (system, user) pair's turn
+    counter advances, so the prompt is ``system_len + turn*turn_tokens``
+    tokens of which all but the last ``turn_tokens`` are shared with
+    the conversation's previous turn.  A second RNG seeded from the base
+    seed drives the assignment, so the arrival process itself replays
+    byte-identically with or without the prefix structure."""
+    rng = random.Random(spec.base.seed ^ 0x5F1EE7)
+    weights = [
+        1.0 / (i + 1) ** spec.system_zipf_alpha
+        for i in range(max(1, spec.n_system_prompts))
+    ]
+    total = sum(weights)
+    cum = []
+    acc = 0.0
+    for i, w in enumerate(weights):
+        acc += w / total
+        cum.append((acc, i))
+    turns: dict[tuple[int, int], int] = {}
+    for a in generate(spec.base):
+        u = rng.random()
+        sid = cum[-1][1]
+        for edge, i in cum:
+            if u <= edge:
+                sid = i
+                break
+        uid = rng.randrange(max(1, spec.n_users))
+        turn = min(turns.get((sid, uid), 0) + 1, spec.max_turns)
+        turns[(sid, uid)] = turn
+        shared = spec.system_len_tokens + (turn - 1) * spec.turn_tokens
+        yield PrefixArrival(
+            t=a.t, rid=a.rid,
+            prompt_len=shared + spec.turn_tokens,
+            max_tokens=a.max_tokens,
+            ttft_slo_s=a.ttft_slo_s, tpot_slo_s=a.tpot_slo_s,
+            system_id=sid, user_id=uid, turn=turn,
+            system_len=spec.system_len_tokens, shared_len=shared,
+        )
+
+
+def shared_prefix_tokens(
+    arrival: PrefixArrival, vocab: int = 64, limit: int | None = None,
+) -> list[int]:
+    """Materialize a shared-prefix prompt.  Token at position ``i`` is a
+    pure function of ``(system_id, i)`` inside the system prompt and of
+    ``(system_id, user_id, i)`` in the conversation body — so two
+    arrivals with the same system prompt share those tokens byte-for-
+    byte, and turn *t*'s prompt is a literal prefix-extension of turn
+    *t-1*'s.  That is what lets the REAL engines' prefix stores (keyed
+    by token content) hit across requests in this trace, not just the
+    identity-keyed simulator."""
+    n = arrival.prompt_len if limit is None else min(arrival.prompt_len, limit)
+    sys_n = min(arrival.system_len, n)
+    sys_base = (arrival.system_id + 1) * 2_654_435_761
+    conv_base = (
+        (arrival.system_id + 1) * 1_000_003 + (arrival.user_id + 1)
+    ) * 2_246_822_519
+    out = [(sys_base + (i + 1) * 40_503) % vocab for i in range(sys_n)]
+    out.extend(
+        (conv_base + (i + 1) * 2_654_435_761) % vocab
+        for i in range(sys_n, n)
+    )
+    return out
+
+
+def sim_prefix_chain(arrival: PrefixArrival, block_tokens: int):
+    """The simulator's candidate chain ``[(n_tokens, material)]`` for an
+    arrival: one rung per whole block, shallow->deep, leaving >= 1 token
+    to prefill.  Materials are tuples of BLOCK IDENTITIES rather than
+    token content — ``("sys", system_id, i)`` for blocks inside the
+    system prompt, ``("conv", system_id, user_id, i)`` after it — which
+    is safe because :func:`shared_prefix_tokens` makes content a pure
+    function of exactly that identity.  A million-request sim never
+    materializes token tuples just to hash them."""
+    bs = int(block_tokens)
+    if bs <= 0:
+        return []
+    blocks: list[tuple] = []
+    chain = []
+    d = bs
+    while d < arrival.prompt_len:
+        i = len(blocks)
+        if d <= arrival.system_len:
+            blocks.append(("sys", arrival.system_id, i))
+        else:
+            blocks.append(("conv", arrival.system_id, arrival.user_id, i))
+        chain.append((d, tuple(blocks)))
+        d += bs
+    return chain
+
+
 # -- the simulated engine ----------------------------------------------------
 
 
@@ -359,6 +498,11 @@ class SimEngine:
         vocab: int = 64,
         sink: SimSink | None = None,
         step_dt: float = 0.05,
+        name: str = "sim",
+        prefix_block_tokens: int = 0,
+        prefix_cache_blocks: int = 64,
+        prefix_index=None,
+        pull_gbps: float = 8.0,
     ):
         self.clock = clock
         self.n_slots = int(n_slots)
@@ -372,6 +516,20 @@ class SimEngine:
         self.vocab = int(vocab)
         self.sink = sink
         self.step_dt = float(step_dt)
+        # -- fleet prefix-cache model (ROADMAP item 3 / fleet_prefix.py).
+        # prefix_block_tokens > 0 turns it on: submit() then accepts a
+        # `prefix_chain` of (n_tokens, material) rungs (sim_prefix_chain),
+        # keeps an identity-keyed LRU standing in for the engine's prefix
+        # store, and — when a FleetPrefixIndex is attached — publishes
+        # rungs as kv_dtype="sim" entries and models cross-replica pulls
+        # as wire time added to prefill_s.
+        self.name = str(name)
+        self.prefix_block_tokens = int(prefix_block_tokens)
+        self.prefix_cache_blocks = int(prefix_cache_blocks)
+        self.prefix_index = prefix_index
+        self.pull_gbps = float(pull_gbps)
+        self._prefix_store: dict = {}  # material -> n_tokens, dict order = LRU
+        self.prefix_hits = {"local": 0, "remote": 0, "cold": 0}
         self._next_id = 0
         self._active: dict[int, dict] = {}
         self._completions: list = []
@@ -409,6 +567,7 @@ class SimEngine:
         queued_at: float | None = None,
         handoff: bool = False,
         sim_prompt_len: int | None = None,
+        prefix_chain=None,
     ) -> int:
         if self.free_slots() <= 0:
             raise RuntimeError("no free slot")
@@ -419,6 +578,11 @@ class SimEngine:
             raise RuntimeError(
                 f"out of blocks ({need} needed, {self._free_blocks} free)"
             )
+        cached, pull_s = 0, 0.0
+        if self.prefix_block_tokens > 0 and prefix_chain:
+            cached, pull_s = self._prefix_lookup(prefix_chain)
+            cached = min(cached, plen - 1)  # >= 1 token always prefills
+            self._prefix_publish(prefix_chain)
         rid = self._next_id
         self._next_id += 1
         now = self.clock()
@@ -429,7 +593,7 @@ class SimEngine:
             "generated": [],
             "max_tokens": int(max_tokens),
             "prompt_len": plen,
-            "prefill_s": plen / self.prefill_tps,
+            "prefill_s": (plen - cached) / self.prefill_tps + pull_s,
             "credit": 0.0,
             "blocks": need,
             "handoff": bool(handoff),
@@ -441,6 +605,63 @@ class SimEngine:
         }
         self._last_progress_t = now
         return rid
+
+    # -- the prefix-cache model --------------------------------------------
+
+    def _prefix_lookup(self, chain) -> tuple[int, float]:
+        """(cached_tokens, pull_seconds) for a chain: deepest local rung
+        first (free), else the deepest compatible remote owner in the
+        attached index, costing the prefix bytes over a ``pull_gbps``
+        wire.  Mirrors FleetPrefixTier.prepare's ladder in analytic
+        form — every miss lands on cold prefill."""
+        for d, material in reversed(list(chain)):
+            if material in self._prefix_store:
+                # LRU touch: re-insert at the back.
+                self._prefix_store[material] = self._prefix_store.pop(material)
+                self.prefix_hits["local"] += 1
+                if self.prefix_index is not None:
+                    self.prefix_index.note_hit("local")
+                return d, 0.0
+        index = self.prefix_index
+        if index is None:
+            self.prefix_hits["cold"] += 1
+            return 0, 0.0
+        ent = index.deepest(
+            chain, 0,
+            compatible=lambda e: e.kv_dtype == "sim" and e.owner != self.name,
+        )
+        if ent is None:
+            self.prefix_hits["cold"] += 1
+            return 0, 0.0
+        pull_s = ent.n_tokens * self.kv_bytes_per_token * 8.0 / (
+            self.pull_gbps * 1e9
+        )
+        self.prefix_hits["remote"] += 1
+        index.note_hit("remote")
+        return ent.n_tokens, pull_s
+
+    def _prefix_publish(self, chain) -> None:
+        """After admission every rung is (or will be, once this prompt
+        prefills) resident here — the sim collapses that to publish-at-
+        admission, the same simplification as its analytic prefill.
+        Each rung is one store block; LRU overflow withdraws from the
+        index exactly like the real engines' on_prefix_evict hook."""
+        store = self._prefix_store
+        for d, material in chain:
+            if material in store:
+                store[material] = store.pop(material)
+            else:
+                store[material] = d
+            if self.prefix_index is not None:
+                self.prefix_index.publish(
+                    material, self.name, n_tokens=d,
+                    block_size=self.prefix_block_tokens, kv_dtype="sim",
+                )
+        while len(store) > self.prefix_cache_blocks:
+            material = next(iter(store))
+            del store[material]
+            if self.prefix_index is not None:
+                self.prefix_index.withdraw(material, owner=self.name)
 
     # -- stepping ----------------------------------------------------------
 
@@ -656,7 +877,7 @@ class SimEngine:
         out: list = []
         allowed = {
             "prompt", "max_tokens", "ttft_slo_s", "tpot_slo_s",
-            "queued_at", "handoff", "sim_prompt_len",
+            "queued_at", "handoff", "sim_prompt_len", "prefix_chain",
         }
         for _ in range(max_steps):
             while queue:
@@ -787,6 +1008,8 @@ def replay(
     prompt_limit: int | None = 24,
     on_completion=None,
     burn_monitor=None,
+    tokens_fn=None,
+    submit_extra=None,
 ) -> ReplayReport:
     """Drive ``router`` (FleetRouter or DisaggRouter) through a trace in
     simulated time.  Per tick: advance the clock, move due arrivals into
@@ -802,7 +1025,14 @@ def replay(
     completion objects.  ``burn_monitor`` (an
     ``obs_plane.SloBurnRateMonitor``) is fed every scored verdict in
     simulated time and ticked per replay tick, so the burn-rate windows
-    evaluate against the same clock the SLOs are scored on."""
+    evaluate against the same clock the SLOs are scored on.
+    ``tokens_fn(arrival, vocab, limit)`` overrides prompt
+    materialization (shared-prefix traces use
+    :func:`shared_prefix_tokens`); ``submit_extra(arrival)`` returns
+    extra ``router.submit`` kwargs per arrival — the fleet prefix bench
+    threads ``prefix_chain`` through it."""
+    if tokens_fn is None:
+        tokens_fn = prompt_tokens
     rep = ReplayReport()
     wall0 = time.perf_counter()
     arrivals = iter(trace)
@@ -833,9 +1063,10 @@ def replay(
             a = backlog[0]
             try:
                 rid = router.submit(
-                    prompt_tokens(a, vocab, prompt_limit), a.max_tokens,
+                    tokens_fn(a, vocab, prompt_limit), a.max_tokens,
                     ttft_slo_s=a.ttft_slo_s, tpot_slo_s=a.tpot_slo_s,
                     queued_at=a.t, sim_prompt_len=a.prompt_len,
+                    **(submit_extra(a) if submit_extra is not None else {}),
                 )
             except RuntimeError:
                 break  # no admittable capacity: the head waits

@@ -1,0 +1,358 @@
+"""Fleet prefix-cache tier (models/fleet_prefix.py): index semantics,
+cross-replica pulls, geometry fallbacks, and the bit-equality contract.
+
+* FleetPrefixIndex: TTL via injected clock, LRU capacity eviction that
+  skips pinned entries, pinned-while-pulling refcounts (invalidation of
+  a pinned entry defers to unpin), owner invalidation, ledger balance.
+* Bit-equality: a prefix exported from a warm owner, round-tripped
+  through the KVSlice wire encoding and injected into a cold peer,
+  decodes BYTE-IDENTICAL to cold prefill — at bfloat16, int8 and int4.
+* Geometry fallbacks: dtype or quantized-block-size mismatches inject
+  nothing (cold prefill), float payloads re-block across block sizes.
+* The full tier flow on a FleetRouter: depth-aware routing sends a
+  request home (local hit); a full home forces a neighbor admission
+  that pulls the prefix over the LocalPrefixSource wire round-trip
+  (remote hit), with pins back to zero and metrics observable through
+  the parse_prom_text round-trip.
+
+The two-process owner-death chaos test lives in
+tests/test_transport_chaos.py (`make chaos-transport`).
+"""
+
+import jax
+import pytest
+
+from k8s_dra_driver_tpu.models import burnin, fleet, fleet_prefix, paged
+from k8s_dra_driver_tpu.models.serve import KVSlice, ServeEngine
+from k8s_dra_driver_tpu.models.workload import SimClock
+from k8s_dra_driver_tpu.utils.metrics import REGISTRY, parse_prom_text
+
+CFG = burnin.ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+)
+BS = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    return burnin.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _paged(params, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("n_blocks", 64)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("attn_impl", "xla")
+    kw.setdefault("prefix_cache_blocks", 24)
+    return paged.PagedServeEngine(params=params, cfg=CFG, **kw)
+
+
+def _run(eng, prompt, max_tokens=6, seed=3):
+    (c,) = eng.pump([{"prompt": list(prompt), "max_tokens": max_tokens,
+                      "seed": seed}])
+    assert c.status == "ok"
+    return c.generated
+
+
+# -- the index ---------------------------------------------------------------
+
+
+class TestPrefixIndex:
+    def _index(self, **kw):
+        clock = SimClock()
+        kw.setdefault("ttl_s", 10.0)
+        kw.setdefault("clock", clock)
+        return fleet_prefix.FleetPrefixIndex(**kw), clock
+
+    def test_publish_deepest_survey(self):
+        idx, _ = self._index()
+        toks = list(range(12))
+        idx.publish(tuple(toks[:4]), "A", n_tokens=4, block_size=4, kv_dtype="f")
+        idx.publish(tuple(toks[:8]), "B", n_tokens=8, block_size=4, kv_dtype="f")
+        chain = idx.chain_for_tokens(toks)
+        assert [d for d, _ in chain] == [4, 8]  # >= 1 token left to prefill
+        ent = idx.deepest(chain)
+        assert ent.owner == "B" and ent.n_tokens == 8
+        survey = idx.survey(chain)
+        assert survey == {"A": (4, 1), "B": (8, 2)}
+        # compatible= filters: rejecting B falls back to A's rung
+        ent = idx.deepest(chain, compatible=lambda e: e.owner != "B")
+        assert ent.owner == "A" and ent.n_tokens == 4
+
+    def test_ttl_expiry_on_read_and_sweep(self):
+        idx, clock = self._index(ttl_s=5.0)
+        idx.publish((1, 2), "A", n_tokens=2, block_size=2, kv_dtype="f")
+        idx.publish((3, 4), "A", n_tokens=2, block_size=2, kv_dtype="f")
+        clock.advance(6.0)
+        chain = [(2, (1, 2))]
+        assert idx.deepest(chain) is None  # dropped on read
+        assert len(idx) == 1
+        assert idx.sweep() == 1
+        assert len(idx) == 0
+        m = parse_prom_text(REGISTRY.render())
+        assert m["tpu_fleet_prefix_evictions_total"][(("reason", "ttl"),)] == 2.0
+
+    def test_refresh_extends_ttl_and_moves_owner(self):
+        idx, clock = self._index(ttl_s=5.0)
+        idx.publish((1,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        clock.advance(4.0)
+        idx.publish((1,), "B", n_tokens=1, block_size=1, kv_dtype="f")
+        clock.advance(4.0)  # 8s after first publish, 4s after refresh
+        ent = idx.deepest([(1, (1,))])
+        assert ent is not None and ent.owner == "B"
+
+    def test_capacity_eviction_lru_skips_pinned(self):
+        idx, _ = self._index(max_entries=2)
+        e1 = idx.publish((1,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        assert idx.pin(e1.key)
+        idx.publish((2,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        idx.publish((3,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        # oldest entry is pinned: the NEXT oldest was evicted instead
+        assert len(idx) == 2
+        assert idx.deepest([(1, (1,))]) is not None
+        assert idx.deepest([(1, (2,))]) is None
+        idx.unpin(e1.key)
+
+    def test_invalidate_owner_defers_pinned_to_unpin(self):
+        idx, _ = self._index()
+        e1 = idx.publish((1,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        idx.publish((2,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        idx.publish((3,), "B", n_tokens=1, block_size=1, kv_dtype="f")
+        assert idx.pin(e1.key)
+        assert idx.invalidate_owner("A") == 1  # unpinned entry drops now
+        assert len(idx) == 2
+        # dead entries are invisible to lookups and unpinnable-only
+        assert idx.deepest([(1, (1,))]) is None
+        assert not idx.pin(e1.key)
+        idx.unpin(e1.key)
+        assert len(idx) == 1  # deferred drop landed
+        assert idx.deepest([(1, (3,))]).owner == "B"
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_evictions_total"][(("reason", "invalidated"),)]
+            == 2.0
+        )
+
+    def test_withdraw_respects_owner(self):
+        idx, _ = self._index()
+        idx.publish((1,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        assert not idx.withdraw((1,), owner="B")  # stale evict from a loser
+        assert idx.withdraw((1,), owner="A")
+        assert len(idx) == 0
+
+    def test_ledger_balance(self):
+        idx, _ = self._index()
+        e1 = idx.publish((1,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        idx.publish((2,), "A", n_tokens=1, block_size=1, kv_dtype="f")
+        idx.publish((3,), "B", n_tokens=1, block_size=1, kv_dtype="f")
+        idx.pin(e1.key)
+        led = idx.ledger()
+        assert led.blocks == {"A": 2, "B": 1}
+        assert led.entries == 3 and led.pinned == 1
+        idx.unpin(e1.key)
+        assert idx.ledger().pinned == 0
+
+    def test_chain_mixed_granularities(self):
+        idx, _ = self._index()
+        idx.publish((0,) * 4, "A", n_tokens=4, block_size=4, kv_dtype="f")
+        idx.publish((0,) * 16, "B", n_tokens=16, block_size=16, kv_dtype="d")
+        chain = idx.chain_for_tokens(list(range(17)))
+        assert [d for d, _ in chain] == [4, 8, 12, 16]
+
+    def test_hit_metric_roundtrip(self):
+        idx, _ = self._index()
+        idx.note_hit("local")
+        idx.note_hit("local")
+        idx.note_hit("remote")
+        m = parse_prom_text(REGISTRY.render())
+        hits = m["tpu_fleet_prefix_hits_total"]
+        assert hits[(("source", "local"),)] == 2.0
+        assert hits[(("source", "remote"),)] == 1.0
+
+
+# -- bit-equality across the wire --------------------------------------------
+
+
+class TestRemotePullBitEquality:
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8", "int4"])
+    def test_export_wire_inject_bit_equal(self, params, kv_dtype):
+        owner = _paged(params, kv_dtype=kv_dtype)
+        peer = _paged(params, kv_dtype=kv_dtype)
+        prompt = list(range(1, 15))  # 14 tokens -> 3 storable blocks of 4
+        ref = _run(owner, prompt)  # cold prefill; warms owner's store
+        kv = owner.export_prefix_kv(prompt)
+        assert kv is not None and kv.valid_len == 12
+        rid, kv2 = KVSlice.from_wire(kv.to_wire(7))  # the exact wire path
+        assert rid == 7
+        injected = peer.inject_prefix_kv(prompt, kv2)
+        assert injected == 12
+        assert peer.local_prefix_depth(prompt) == 12
+        before = peer.prefix_hits
+        assert _run(peer, prompt) == ref  # decode from pulled KV == cold
+        assert peer.prefix_hits > before  # it really took the hit path
+
+    def test_inject_accounts_blocks_and_survives_eviction(self, params):
+        owner = _paged(params)
+        peer = _paged(params)
+        prompt = list(range(1, 15))
+        ref = _run(owner, prompt)
+        free0 = peer.free_blocks
+        kv = owner.export_prefix_kv(prompt)
+        assert peer.inject_prefix_kv(prompt, kv) == 12
+        assert peer.free_blocks == free0 - 3  # 3 blocks of 4 now cached
+        # an idempotent re-inject allocates nothing new
+        assert peer.inject_prefix_kv(prompt, kv) == 0
+        assert peer.free_blocks == free0 - 3
+        assert _run(peer, prompt) == ref
+
+
+class TestGeometryFallbacks:
+    def test_quantized_dtype_mismatch_injects_nothing(self, params):
+        owner = _paged(params, kv_dtype="int8")
+        peer = _paged(params, kv_dtype="int4")
+        prompt = list(range(1, 15))
+        _run(owner, prompt)
+        kv = owner.export_prefix_kv(prompt)
+        assert peer.inject_prefix_kv(prompt, kv) == 0
+        assert peer.local_prefix_depth(prompt) == 0
+
+    def test_quantized_block_size_mismatch_injects_nothing(self, params):
+        owner = _paged(params, kv_dtype="int8", block_size=4, n_blocks=64)
+        peer = _paged(params, kv_dtype="int8", block_size=8, n_blocks=32)
+        prompt = list(range(1, 15))
+        _run(owner, prompt)
+        kv = owner.export_prefix_kv(prompt)
+        assert kv.quantized and kv.block_size == 4
+        assert peer.inject_prefix_kv(prompt, kv) == 0
+
+    def test_float_payload_reblocks_across_block_sizes(self, params):
+        owner = _paged(params, block_size=4, n_blocks=64)
+        peer = _paged(params, block_size=8, n_blocks=32, prefix_cache_blocks=8)
+        prompt = list(range(1, 15))
+        ref = _run(owner, prompt)
+        kv = owner.export_prefix_kv(prompt)  # 12 tokens at bs=4
+        # the receiver installs whole bs=8 blocks: 12 -> 8 tokens
+        assert peer.inject_prefix_kv(prompt, kv) == 8
+        assert peer.local_prefix_depth(prompt) == 8
+        assert _run(peer, prompt) == ref
+
+    def test_dense_export_feeds_paged_receiver(self, params):
+        owner = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=32,
+                            prefix_bucket=16, prefix_cache_entries=4)
+        peer = _paged(params, prompt_bucket=32)
+        prompt = list(range(1, 21))  # > prefix_bucket so dense stores
+        ref = _run(owner, prompt)
+        assert owner.local_prefix_depth(prompt) == 16
+        kv = owner.export_prefix_kv(prompt)
+        assert kv is not None and kv.valid_len == 16 and not kv.quantized
+        assert peer.inject_prefix_kv(prompt, kv) == 16  # 4 whole bs=4 blocks
+        assert _run(peer, prompt) == ref
+
+    def test_dense_inject_requires_full_bucket(self, params):
+        owner = _paged(params)
+        peer = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=32,
+                           prefix_bucket=16, prefix_cache_entries=4)
+        prompt = list(range(1, 15))
+        _run(owner, prompt)
+        kv = owner.export_prefix_kv(prompt)  # 12 tokens < bucket 16
+        assert peer.inject_prefix_kv(prompt, kv) == 0
+
+    def test_dense_to_dense_roundtrip(self, params):
+        owner = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=32,
+                            prefix_bucket=16, prefix_cache_entries=4)
+        peer = ServeEngine(params=params, cfg=CFG, n_slots=2, prompt_bucket=32,
+                           prefix_bucket=16, prefix_cache_entries=4)
+        prompt = list(range(1, 21))
+        ref = _run(owner, prompt)
+        rid, kv = KVSlice.from_wire(owner.export_prefix_kv(prompt).to_wire(3))
+        assert peer.inject_prefix_kv(prompt, kv) == 16
+        assert _run(peer, prompt) == ref
+
+
+# -- the full tier on a router -----------------------------------------------
+
+
+class TestFleetPrefixTier:
+    def _fleet(self, params, **eng_kw):
+        clock = SimClock()
+        A = _paged(params, **eng_kw)
+        B = _paged(params, **eng_kw)
+        router = fleet.FleetRouter([("A", A), ("B", B)], clock=clock)
+        tier = fleet_prefix.FleetPrefixTier(
+            fleet_prefix.FleetPrefixIndex(clock=clock), clock=clock)
+        router.attach_prefix_tier(tier)
+        return router, tier, A, B, clock
+
+    def _drain_engines(self, *engines, steps=400):
+        out = []
+        for _ in range(steps):
+            for eng in engines:
+                eng.step()
+                out.extend(eng.completions())
+            if all(e.free_slots() == e.n_slots for e in engines):
+                return out
+        raise AssertionError("engines did not drain")
+
+    def test_publish_on_store_and_depth_routed_local_hit(self, params):
+        router, tier, A, B, _ = self._fleet(params)
+        prompt = list(range(1, 15))
+        ref = _run(A, prompt)  # warm A through its own pump: hooks publish
+        assert len(tier.index) == 3  # one rung per stored block
+        assert tier.index.ledger().blocks == {"A": 3}
+        # depth-aware scoring routes the shared prefix home to A
+        router.submit(prompt, 6, seed=3)
+        (c,) = self._drain_engines(A, B)
+        assert c.generated == ref
+        assert tier.counts["local"] == 1 and tier.counts["remote"] == 0
+        m = parse_prom_text(REGISTRY.render())
+        assert m["tpu_fleet_prefix_hits_total"][(("source", "local"),)] == 1.0
+
+    def test_remote_pull_when_home_is_full(self, params):
+        router, tier, A, B, _ = self._fleet(params)
+        prompt = list(range(1, 15))
+        ref = _run(A, prompt)
+        for i in range(A.n_slots):  # fill A so the router must pick B
+            A.submit([40 + i, 41 + i, 42 + i], max_tokens=4, seed=3)
+        router.submit(prompt, 6, seed=3)
+        done = self._drain_engines(A, B)
+        mine = [c for c in done if c.generated == ref]
+        assert len(mine) == 1
+        assert tier.counts["remote"] == 1
+        assert B.local_prefix_depth(prompt) == 12  # pulled blocks landed
+        assert tier.index.ledger().pinned == 0  # pin released after pull
+        m = parse_prom_text(REGISTRY.render())
+        assert m["tpu_fleet_prefix_hits_total"][(("source", "remote"),)] == 1.0
+        assert m["tpu_fleet_prefix_pull_seconds_count"][()] >= 1.0
+
+    def test_drain_invalidates_owner_entries(self, params):
+        router, tier, A, B, _ = self._fleet(params)
+        prompt = list(range(1, 15))
+        _run(A, prompt)
+        assert tier.index.ledger().blocks == {"A": 3}
+        router.drain("A")
+        router.tick()
+        assert tier.index.ledger().blocks.get("A") is None
+        # subsequent admissions of the same prefix are cold, not wedged
+        router.submit(prompt, 6, seed=3)
+        (c,) = self._drain_engines(A, B)
+        assert c.status == "ok"
+        assert tier.counts["remote"] == 0
+
+    def test_cross_dtype_fleet_falls_back_cold(self, params):
+        clock = SimClock()
+        A = _paged(params, kv_dtype="int8")
+        B = _paged(params, kv_dtype="int4")
+        router = fleet.FleetRouter([("A", A), ("B", B)], clock=clock)
+        tier = fleet_prefix.FleetPrefixTier(
+            fleet_prefix.FleetPrefixIndex(clock=clock), clock=clock)
+        router.attach_prefix_tier(tier)
+        prompt = list(range(1, 15))
+        ref_b = _run(B, prompt)  # B's own cold decode at int4
+        _run(A, prompt)
+        for i in range(A.n_slots):
+            A.submit([40 + i, 41 + i, 42 + i], max_tokens=4, seed=3)
+        router.submit(prompt, 6, seed=3)
+        done = self._drain_engines(A, B)
+        assert any(c.generated == ref_b for c in done)
+        assert tier.counts["remote"] == 0  # geometry-gated: no cross-dtype pull

@@ -153,3 +153,16 @@ def test_ondevice_sampling_stays_within_perf_budgets():
     # sync_interval=32 burst is 1 dispatch + 1 readback on BOTH engines.
     assert stats["dense_dispatches"] == 1 and stats["dense_readbacks"] == 1
     assert stats["paged_dispatches"] == 1 and stats["paged_readbacks"] == 1
+
+
+def test_prefix_fleet_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_prefix_fleet_overhead()
+    assert stats["requests_tiered"] == 8
+    # The fleet prefix tier's contract: index publish and admission-time
+    # lookup are host-side dict/digest work riding hooks the engine
+    # already fires — the tier-attached fleet on all-miss traffic pays
+    # EXACTLY the bare fleet's host syncs, entries really landed in the
+    # index, and the miss-path prepare() stays under its p50 ceiling.
+    assert stats["host_syncs_tiered"] == stats["host_syncs_bare"]
+    assert stats["published_total"] > 0
+    assert stats["lookup_p50_s"] <= stats["lookup_p50_ceiling_s"]

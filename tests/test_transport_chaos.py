@@ -726,3 +726,110 @@ class TestObservabilityFederation:
                 # error; the RAW timestamps would put dec ~5s earlier.
                 assert dec["t0"] >= wire["t0"] - 1.0
         assert decode_spans == len(REQS)
+
+
+# -- fleet prefix pull under owner death -------------------------------------
+
+
+PREFIX_PROMPT = list(range(1, 15))  # 14 tokens -> 3 storable blocks of 4
+
+
+def _prefix_worker_cfg(tmp_path, name, port, peer="prefix-w"):
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps({
+        "cfg": CFG_DOC,
+        "engines": [{
+            "kind": "paged", "n_slots": 3, "n_blocks": 41, "block_size": 4,
+            "prompt_bucket": 16, "attn_impl": "xla",
+            "prefix_cache_blocks": 24,
+        }],
+        "seed": 0,
+        "host": "127.0.0.1",
+        "port": port,
+        "name": peer,
+        "role": "decode",
+        "hold_ticks": False,
+    }))
+    return path
+
+
+class TestTwoProcessPrefixPull:
+    def test_owner_sigkill_mid_pull_walks_fallback_ladder(self, params,
+                                                          tmp_path):
+        """Fleet prefix tier over REAL sockets and a REAL SIGKILL.  The
+        worker serves the shared prompt once (warming ITS paged prefix
+        store), the supervisor publishes the rungs as index hints, and a
+        cold local engine remote-pulls the prefix over PREFIXREQ/PREFIXKV
+        — decoding BIT-EQUAL to the worker's own cold prefill.  Then the
+        owner is SIGKILLed and the next admission's pull walks the
+        fallback ladder: owner-death detected mid-pull, its index
+        footprint invalidated, nothing left pinned, and the stream
+        completes via cold prefill — degraded, never lost."""
+        from k8s_dra_driver_tpu.models import fleet_prefix as FP
+
+        hub = T.TransportHub(
+            heartbeat_interval_s=0.1, liveness_timeout_s=3.0,
+            ack_timeout_s=5.0,
+        )
+        w = _spawn_worker("prefix-w1",
+                          _prefix_worker_cfg(tmp_path, "pw", hub.port))
+        try:
+            link = hub.link_for("prefix-w", timeout_s=120.0)
+            pool = T.RemotePool(link, name="prefix-pool")
+            # 1. Warm the owner through a REAL remote serve of the prompt.
+            pool.submit(PREFIX_PROMPT, 6, seed=3)
+            done = []
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline and not done:
+                hub.poll()
+                pool.tick()
+                done += pool.completions()
+                time.sleep(0.005)
+            assert len(done) == 1 and done[0].status == "ok"
+            ref = list(done[0].generated)  # the owner's own cold decode
+
+            # 2. Publish the owner's rungs as index hints.  In-process
+            # tiers publish through engine hooks; across processes the
+            # supervisor publishes on placement — entries are HINTS, the
+            # owner re-walks its store on PREFIXREQ (a stale hint is one
+            # PREFIXMISS, never a wrong KV).
+            index = FP.FleetPrefixIndex()
+            tier = FP.FleetPrefixTier(index, pull_timeout_s=8.0)
+            tier.add_source(
+                "prefix-w",
+                FP.RemotePrefixSource("prefix-w", link, pull_timeout_s=8.0),
+            )
+            for d in (4, 8, 12):
+                index.publish(
+                    tuple(PREFIX_PROMPT[:d]), "prefix-w", n_tokens=d,
+                    block_size=4, kv_dtype="float32",
+                    n_layers=CFG.n_layers, kv_heads=CFG.n_heads,
+                    head_dim=CFG.d_model // CFG.n_heads,
+                )
+
+            # 3. Happy path: remote pull over the wire, bit-equal decode.
+            puller = _paged(params, prefix_cache_blocks=24)
+            got = tier.prepare("local", puller, PREFIX_PROMPT, max_tokens=6)
+            assert got == "remote"
+            assert puller.local_prefix_depth(PREFIX_PROMPT) == 12
+            assert index.ledger().pinned == 0
+            (c,) = puller.pump([{"prompt": list(PREFIX_PROMPT),
+                                 "max_tokens": 6, "seed": 3}])
+            assert list(c.generated) == ref  # bit-equal across the socket
+
+            # 4. SIGKILL the owner; the next pull discovers death mid-pull.
+            w.proc.kill()
+            cold = _paged(params, prefix_cache_blocks=24)
+            got = tier.prepare("local2", cold, PREFIX_PROMPT, max_tokens=6)
+            assert got == "cold"
+            assert tier.fallbacks.get("owner_dead") == 1
+            assert len(index) == 0          # owner footprint invalidated
+            assert index.ledger().pinned == 0  # partial pull left no pins
+            assert "prefix-w" not in tier._sources
+            # 5. The stream itself is never lost: cold prefill serves it.
+            (c,) = cold.pump([{"prompt": list(PREFIX_PROMPT),
+                               "max_tokens": 6, "seed": 3}])
+            assert c.status == "ok" and list(c.generated) == ref
+        finally:
+            w.kill()
+            hub.close()

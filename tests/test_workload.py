@@ -225,3 +225,187 @@ class TestReplay:
         rep = self._run(seed=12, queue_limit=4)
         assert rep.offered == rep.completed + rep.shed
         assert rep.lost == 0
+
+
+class TestSharedPrefixTrace:
+    def _spec(self, **kw):
+        kw.setdefault("base", _spec(seed=7, duration_s=90.0, base_rate_rps=10.0))
+        kw.setdefault("n_system_prompts", 4)
+        kw.setdefault("system_len_tokens", 48)
+        kw.setdefault("n_users", 16)
+        kw.setdefault("turn_tokens", 16)
+        return W.SharedPrefixSpec(**kw)
+
+    def test_deterministic_and_rides_the_base_trace(self):
+        spec = self._spec()
+        a = list(W.generate_shared_prefix(spec))
+        b = list(W.generate_shared_prefix(spec))
+        assert a == b
+        base = list(W.generate(spec.base))
+        assert [(x.t, x.rid, x.max_tokens) for x in a] == [
+            (x.t, x.rid, x.max_tokens) for x in base
+        ]
+
+    def test_zipf_skews_toward_head_system_prompt(self):
+        trace = list(W.generate_shared_prefix(self._spec()))
+        counts = [0] * 4
+        for a in trace:
+            counts[a.system_id] += 1
+        # rank-0 weight is 1/sum(1/(i+1)^1.2) ~ 0.39 of traffic; the head
+        # must strictly dominate the tail.
+        assert counts[0] > counts[1] > counts[3]
+        assert counts[0] / len(trace) > 0.3
+
+    def test_turn_prompts_are_prefix_extensions(self):
+        trace = list(W.generate_shared_prefix(self._spec()))
+        by_conv: dict = {}
+        for a in trace:
+            by_conv.setdefault((a.system_id, a.user_id), []).append(a)
+        checked = 0
+        for conv in by_conv.values():
+            for prev, cur in zip(conv, conv[1:]):
+                if cur.turn != prev.turn + 1:
+                    continue  # turn counter capped at max_turns
+                tp = W.shared_prefix_tokens(prev, 64, None)
+                tc = W.shared_prefix_tokens(cur, 64, None)
+                assert tc[: len(tp)] == tp
+                assert cur.shared_len == prev.prompt_len
+                checked += 1
+        assert checked > 10
+
+    def test_cross_user_shares_system_prompt_only(self):
+        trace = list(W.generate_shared_prefix(self._spec()))
+        picks: dict = {}
+        for a in trace:
+            if a.system_id == 0 and a.user_id not in picks:
+                picks[a.user_id] = a
+            if len(picks) >= 2:
+                break
+        a, b = list(picks.values())[:2]
+        ta, tb = (W.shared_prefix_tokens(x, 64, None) for x in (a, b))
+        assert ta[:48] == tb[:48]          # the system prompt is shared...
+        assert ta[48:64] != tb[48:64]      # ...the conversation body is not
+
+    def test_sim_chain_block_identities(self):
+        trace = W.generate_shared_prefix(self._spec())
+        a = next(x for x in trace if x.turn == 1)
+        chain = W.sim_prefix_chain(a, 16)
+        # 48 sys + 16 tail = 64 tokens -> rungs at 16/32/48 (>=1 left)
+        assert [d for d, _ in chain] == [16, 32, 48]
+        assert chain[-1][1] == (
+            ("sys", a.system_id, 0),
+            ("sys", a.system_id, 1),
+            ("sys", a.system_id, 2),
+        )
+        assert W.sim_prefix_chain(a, 0) == []
+
+
+class TestSimEnginePrefixModel:
+    def _engine(self, clock, index=None, name="sim", **kw):
+        kw.setdefault("n_slots", 4)
+        kw.setdefault("n_blocks", 512)
+        kw.setdefault("prefill_tps", 100.0)
+        kw.setdefault("prefix_block_tokens", 16)
+        kw.setdefault("prefix_cache_blocks", 8)
+        return W.SimEngine(clock=clock, name=name, prefix_index=index, **kw)
+
+    def _chain(self, sid=0, uid=0, turn=1):
+        a = W.PrefixArrival(
+            t=0.0, rid=0, prompt_len=48 + turn * 16, max_tokens=4,
+            ttft_slo_s=1.0, tpot_slo_s=1.0, system_id=sid, user_id=uid,
+            turn=turn, system_len=48, shared_len=48 + (turn - 1) * 16,
+        )
+        return a, W.sim_prefix_chain(a, 16)
+
+    def test_local_hit_skips_prefill_time(self):
+        clock = W.SimClock()
+        eng = self._engine(clock)
+        a, chain = self._chain()
+        r1 = eng.submit([1, 2, 3], 4, sim_prompt_len=a.prompt_len,
+                        prefix_chain=chain)
+        cold_prefill = eng._active[r1]["prefill_s"]
+        r2 = eng.submit([1, 2, 3], 4, sim_prompt_len=a.prompt_len,
+                        prefix_chain=chain)
+        warm_prefill = eng._active[r2]["prefill_s"]
+        assert cold_prefill == pytest.approx(64 / 100.0)
+        assert warm_prefill == pytest.approx((64 - 48) / 100.0)
+        assert eng.prefix_hits == {"local": 1, "remote": 0, "cold": 1}
+
+    def test_remote_hit_costs_wire_time_not_prefill(self):
+        from k8s_dra_driver_tpu.models.fleet_prefix import FleetPrefixIndex
+
+        clock = W.SimClock()
+        index = FleetPrefixIndex(clock=clock)
+        owner = self._engine(clock, index, name="A")
+        peer = self._engine(clock, index, name="B", pull_gbps=8.0)
+        a, chain = self._chain()
+        owner.submit([1], 4, sim_prompt_len=a.prompt_len, prefix_chain=chain)
+        rid = peer.submit([1], 4, sim_prompt_len=a.prompt_len,
+                          prefix_chain=chain)
+        wire_s = 48 * peer.kv_bytes_per_token * 8.0 / 8e9
+        assert peer._active[rid]["prefill_s"] == pytest.approx(
+            (64 - 48) / 100.0 + wire_s
+        )
+        assert peer.prefix_hits["remote"] == 1
+        # the pull landed the rungs locally: the next one is a local hit
+        peer.submit([1], 4, sim_prompt_len=a.prompt_len, prefix_chain=chain)
+        assert peer.prefix_hits["local"] == 1
+
+    def test_lru_eviction_withdraws_from_index(self):
+        from k8s_dra_driver_tpu.models.fleet_prefix import FleetPrefixIndex
+
+        clock = W.SimClock()
+        index = FleetPrefixIndex(clock=clock)
+        eng = self._engine(clock, index, prefix_cache_blocks=3)
+        for sid in range(3):
+            _, chain = self._chain(sid=sid)
+            eng.submit([1], 4, sim_prompt_len=64, prefix_chain=chain)
+        # 3 rungs per prompt at cap 3: each admission evicts the previous
+        # prompt's rungs, and the index never outlives the store
+        assert len(eng._prefix_store) == 3
+        assert len(index) == 3
+        _, chain0 = self._chain(sid=0)
+        eng.submit([1], 4, sim_prompt_len=64, prefix_chain=chain0)
+        assert index.deepest(chain0).n_tokens == 48
+
+    def test_prefix_replay_improves_ttft(self):
+        spec = W.SharedPrefixSpec(
+            base=_spec(seed=7, duration_s=120.0, base_rate_rps=6.0),
+            n_system_prompts=4, system_len_tokens=48, n_users=16,
+        )
+
+        def run(with_index):
+            from k8s_dra_driver_tpu.models.fleet_prefix import FleetPrefixIndex
+
+            clock = W.SimClock()
+            sink = W.SimSink()
+            index = FleetPrefixIndex(clock=clock, ttl_s=600.0) if with_index else None
+            engines = [
+                (n, W.SimEngine(clock=clock, sink=sink, n_slots=8,
+                                n_blocks=2048, prefill_tps=400.0,
+                                decode_tps=60.0, name=n,
+                                prefix_block_tokens=16,
+                                prefix_cache_blocks=256,
+                                prefix_index=index))
+                for n in ("A", "B")
+            ]
+            router = fleet.FleetRouter(engines, clock=clock)
+            if index is not None:
+                router.attach_prefix_index(index)
+            rep = W.replay(
+                W.generate_shared_prefix(spec), router, clock=clock,
+                sink=sink, tokens_fn=W.shared_prefix_tokens,
+                submit_extra=lambda a: {"prefix_chain": W.sim_prefix_chain(a, 16)},
+            )
+            hits: dict = {"local": 0, "remote": 0, "cold": 0}
+            for _, e in engines:
+                for k in hits:
+                    hits[k] += e.prefix_hits[k]
+            return rep, hits
+
+        solo_rep, _ = run(False)
+        fleet_rep, fleet_hits = run(True)
+        assert solo_rep.lost == 0 and fleet_rep.lost == 0
+        assert fleet_hits["remote"] > 0          # cross-replica pulls happened
+        assert fleet_rep.ttft_p50_s < solo_rep.ttft_p50_s
+        assert fleet_rep.slo_attainment >= solo_rep.slo_attainment

@@ -447,6 +447,10 @@ METRIC_LABEL_KEYS = frozenset({
     # scheduler thread (N <= 8 in every harness config), precomputed at
     # worker construction — never formatted at the call site
     "scheduler",
+    # fleet prefix-cache tier (models/fleet_prefix.py): hit provenance is
+    # the closed {local, remote} set — tpu_fleet_prefix_hits_total{source=}
+    # splits reuse by where the KV came from, never by prefix identity
+    "source",
 })
 METRIC_LABEL_PREFIXES = (
     "tpu_serve_", "tpu_fleet_", "tpu_disagg_", "tpu_autoscale_",

@@ -1160,6 +1160,121 @@ def check_ondevice_sampling() -> dict:
     return stats
 
 
+# The fleet prefix tier's bet (models/fleet_prefix.py): index publish and
+# lookup are pure host-side dict/digest work riding hooks the engines
+# already fire — a tier-attached fleet on DISTINCT prompts (all misses,
+# nothing to pull) dispatches EXACTLY the bare fleet's device work, and
+# the miss-path prepare() itself stays sub-millisecond at p50.
+PREFIX_OVERHEAD_FRAC = 0.50
+PREFIX_OVERHEAD_FLOOR_S = 0.25
+PREFIX_LOOKUP_P50_CEILING_S = 0.002
+
+
+def check_prefix_fleet_overhead() -> dict:
+    """Budget guard for the fleet prefix-cache tier: zero added host
+    syncs on the miss path (publish/lookup are host-only), bounded wall
+    overhead, and a p50 ceiling on the admission-time lookup itself."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, fleet, fleet_prefix, paged
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return paged.PagedServeEngine(
+            params=params, cfg=cfg, n_slots=4, n_blocks=64, block_size=4,
+            prompt_bucket=16, attn_impl="xla", sync_interval=8,
+            prefix_cache_blocks=16,
+        )
+
+    # DISTINCT prompts: no cross-request reuse, so every admission is a
+    # pure index miss — the tier may classify, never warm.
+    prompts = [[(17 * i + 3 * j + 1) % 63 + 1 for j in range(10)]
+               for i in range(8)]
+    reqs = [{"prompt": p, "max_tokens": 8} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    bare_eng = engine()
+    bare = fleet.FleetRouter([bare_eng])
+    start = time.perf_counter()
+    done_bare = bare.pump([dict(r) for r in reqs])
+    bare_wall = time.perf_counter() - start
+
+    tiered_eng = engine()
+    tiered = fleet.FleetRouter([tiered_eng])
+    tier = fleet_prefix.FleetPrefixTier()
+    tiered.attach_prefix_tier(tier)
+    start = time.perf_counter()
+    done_tiered = tiered.pump([dict(r) for r in reqs])
+    tiered_wall = time.perf_counter() - start
+
+    # Time the miss-path lookup alone: fresh distinct prompts against the
+    # now-populated index (each pumped prompt published its rungs).
+    samples = []
+    for i in range(200):
+        p = [(29 * i + 5 * j + 2) % 63 + 1 for j in range(10)]
+        t0 = time.perf_counter()
+        verdict = tier.prepare("probe", tiered_eng, p, max_tokens=8)
+        samples.append(time.perf_counter() - t0)
+        if verdict != "cold":
+            raise PerfBudgetError(
+                f"distinct-prompt probe classified {verdict!r}, not 'cold' — "
+                f"the miss-path timing sample is contaminated"
+            )
+    samples.sort()
+    lookup_p50 = samples[len(samples) // 2]
+
+    budget = bare_wall * (1 + PREFIX_OVERHEAD_FRAC) + PREFIX_OVERHEAD_FLOOR_S
+    stats = {
+        "requests_bare": len(done_bare),
+        "requests_tiered": len(done_tiered),
+        "host_syncs_bare": bare_eng.host_syncs,
+        "host_syncs_tiered": tiered_eng.host_syncs,
+        "index_entries": len(tier.index),
+        "published_total": tier.index.published_total,
+        "bare_s": round(bare_wall, 3),
+        "tiered_s": round(tiered_wall, 3),
+        "lookup_p50_s": round(lookup_p50, 6),
+        "lookup_p50_ceiling_s": PREFIX_LOOKUP_P50_CEILING_S,
+        "budget_frac": PREFIX_OVERHEAD_FRAC,
+        "floor_s": PREFIX_OVERHEAD_FLOOR_S,
+    }
+    if len(done_tiered) != len(reqs) or len(done_bare) != len(reqs):
+        raise PerfBudgetError(
+            f"prefix overhead run drained {len(done_tiered)}/{len(reqs)} "
+            f"tiered vs {len(done_bare)} bare"
+        )
+    if tiered_eng.host_syncs != bare_eng.host_syncs:
+        raise PerfBudgetError(
+            f"prefix tier added device work on the miss path: "
+            f"{tiered_eng.host_syncs} host syncs tiered vs "
+            f"{bare_eng.host_syncs} bare — publish/lookup must stay "
+            f"host-side dict work"
+        )
+    if tier.index.published_total == 0:
+        raise PerfBudgetError(
+            "tier-attached fleet published nothing — the on_prefix_store "
+            "hook came unwired, so the overhead being measured is not the "
+            "tier's"
+        )
+    if tiered_wall > budget:
+        raise PerfBudgetError(
+            f"tiered pump took {tiered_wall:.3f}s > {budget:.3f}s "
+            f"({bare_wall:.3f}s bare + {PREFIX_OVERHEAD_FRAC:.0%} + "
+            f"{PREFIX_OVERHEAD_FLOOR_S}s floor)"
+        )
+    if lookup_p50 > PREFIX_LOOKUP_P50_CEILING_S:
+        raise PerfBudgetError(
+            f"prefix lookup p50 {lookup_p50 * 1e3:.3f}ms > "
+            f"{PREFIX_LOOKUP_P50_CEILING_S * 1e3:.1f}ms ceiling — the "
+            f"admission-time miss path stopped being cheap host work"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
@@ -1175,6 +1290,7 @@ def main() -> int:
         stats["contention_overhead"] = check_contention_overhead()
         stats["quantized_decode"] = check_quantized_decode()
         stats["ondevice_sampling"] = check_ondevice_sampling()
+        stats["prefix_fleet_overhead"] = check_prefix_fleet_overhead()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
