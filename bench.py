@@ -1881,6 +1881,154 @@ def run_prefix_fleet(sink: dict | None = None) -> dict:
     return out
 
 
+def run_prefix_fleet_real(sink: dict | None = None) -> dict:
+    """Real-worker leg of the prefix_fleet bench (PR 20): two gossiping
+    owner PROCESSES behind a TransportHub, fronted by
+    ``RemoteWorkerEngine`` pools, with a supervisor-side
+    ``FleetPrefixTier`` fed ONLY by epoch-stamped PREFIXPUB wire gossip.
+    Warm serves run through the real pools; cold local engines then
+    remote-pull each prefix over PREFIXREQ/PREFIXKV and must decode
+    BIT-EQUAL to the owners' own cold prefills.  The sim body above stays
+    the TTFT/attainment evidence (and the degraded fallback when this
+    leg cannot run); this leg proves the wire plane carries it."""
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, paged
+    from k8s_dra_driver_tpu.models import fleet_prefix as FP
+    from k8s_dra_driver_tpu.models import transport as T
+
+    out = sink if sink is not None else {}
+    cfg_doc = {"vocab_size": 64, "d_model": 32, "n_heads": 2, "n_layers": 1,
+               "d_ff": 64, "max_seq": 64}
+    cfg = burnin.ModelConfig(**cfg_doc)
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+    # Two disjoint shared prefixes per owner: 14 tokens -> 3 blocks of 4.
+    owner_prompts = {
+        "bench-a": [list(range(1, 15)), list(range(21, 35))],
+        "bench-b": [list(range(41, 55)), list(range(61, 75))],
+    }
+
+    hub = T.TransportHub(heartbeat_interval_s=0.2, liveness_timeout_s=30.0,
+                         ack_timeout_s=15.0)
+    tmp = tempfile.mkdtemp(prefix="bench-prefix-")
+    procs = []
+
+    def spawn(name):
+        path = os.path.join(tmp, f"{name}.json")
+        with open(path, "w") as fh:
+            json.dump({
+                "cfg": cfg_doc,
+                "engines": [{
+                    "kind": "paged", "n_slots": 3, "n_blocks": 41,
+                    "block_size": 4, "prompt_bucket": 16, "attn_impl": "xla",
+                    "prefix_cache_blocks": 24,
+                }],
+                "seed": 0, "host": "127.0.0.1", "port": hub.port,
+                "name": name, "role": "decode", "hold_ticks": False,
+            }, fh)
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("DRA_FAULTS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.models.transport",
+             path],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(proc)
+        return proc
+
+    try:
+        for name in owner_prompts:
+            spawn(name)
+        engines = {}
+        tier = FP.FleetPrefixTier(FP.FleetPrefixIndex(), pull_timeout_s=10.0)
+        for name in owner_prompts:
+            link = hub.link_for(name, timeout_s=120.0)
+            engines[name] = T.RemoteWorkerEngine(link, n_slots=3, name=name)
+            tier.attach_remote_owner(name, link, pull_timeout_s=10.0)
+        index = tier.index
+
+        def drive(cond, timeout_s, what):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                hub.poll()
+                for eng in engines.values():
+                    eng.step_burst()
+                tier.tick()
+                if cond():
+                    return
+                time.sleep(0.005)
+            raise RuntimeError(f"real-worker leg stalled: {what}")
+
+        # 1. Warm each owner through REAL remote serves; the completions
+        # are the bit-equality references.
+        refs = {}
+        for name, prompts in owner_prompts.items():
+            for prompt in prompts:
+                engines[name].submit(prompt, 6, seed=3)
+                got = []
+                drive(lambda: bool(got) or bool(
+                    got.extend(engines[name].completions()) or got),
+                    120.0, f"warm serve on {name}")
+                assert got[0].status == "ok"
+                refs[tuple(prompt)] = list(got[0].generated)
+
+        # 2. Gossip convergence: every prefix's deepest rung (12 tokens)
+        # lands in the index over PREFIXPUB, stamped with the owner epoch.
+        def deep_entries():
+            return [e for e in index._entries.values() if e.n_tokens >= 12]
+
+        drive(lambda: len(deep_entries()) >= len(refs), 60.0,
+              "gossip never delivered the deepest rungs")
+        out["gossip_entries"] = len(deep_entries())
+        out["owner_epochs"] = dict(index.owner_epoch)
+        assert all(
+            e.epoch == index.owner_epoch[e.owner] for e in deep_entries()
+        )
+
+        # 3. Tiered: cold local engines remote-pull each prefix over the
+        # wire and decode.  4. Untiered twins cold-prefill the same
+        # prompts.  Bit-equality ties all three decodes together.
+        ttft_tiered, ttft_cold = [], []
+        bit_equal = True
+        for prompt in (p for ps in owner_prompts.values() for p in ps):
+            puller = paged.PagedServeEngine(
+                params=params, cfg=cfg, n_slots=3, n_blocks=41, block_size=4,
+                prompt_bucket=16, attn_impl="xla", prefix_cache_blocks=24)
+            t0 = time.perf_counter()
+            verdict = tier.prepare("local", puller, prompt, max_tokens=6)
+            (c,) = puller.pump([{"prompt": list(prompt), "max_tokens": 6,
+                                 "seed": 3}])
+            ttft_tiered.append(time.perf_counter() - t0)
+            if verdict != "remote" or list(c.generated) != refs[tuple(prompt)]:
+                bit_equal = False
+            cold = paged.PagedServeEngine(
+                params=params, cfg=cfg, n_slots=3, n_blocks=41, block_size=4,
+                prompt_bucket=16, attn_impl="xla", prefix_cache_blocks=24)
+            t0 = time.perf_counter()
+            (c,) = cold.pump([{"prompt": list(prompt), "max_tokens": 6,
+                               "seed": 3}])
+            ttft_cold.append(time.perf_counter() - t0)
+            if list(c.generated) != refs[tuple(prompt)]:
+                bit_equal = False
+        ttft_tiered.sort()
+        ttft_cold.sort()
+        out["bit_equal"] = bit_equal
+        out["remote_pulls"] = tier.counts["remote"]
+        out["pulls_pinned_after"] = index.ledger().pinned
+        out["ttft_p50_tiered_s"] = round(
+            ttft_tiered[len(ttft_tiered) // 2], 5)
+        out["ttft_p50_cold_s"] = round(ttft_cold[len(ttft_cold) // 2], 5)
+        return out
+    finally:
+        for proc in procs:
+            proc.kill()
+        hub.close()
+
+
 def main_prefix_fleet() -> int:
     """``python bench.py prefix_fleet``: one JSON line, watchdog-guarded
     like the other sim benches.  The sim legs are pure host-side event
@@ -1907,13 +2055,45 @@ def main_prefix_fleet() -> int:
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         result["degraded"] = True
         result["degraded_reason"] = (
-            "sim-only body on JAX_PLATFORMS=cpu: TTFT/attainment deltas "
+            "sim-only TTFT/attainment deltas on JAX_PLATFORMS=cpu: they "
             "come from the seeded event simulation, not chip decode"
         )
+    # Real-worker leg (PR 20): spawned gossiping owner processes behind
+    # RemoteWorkerEngine pools.  Watchdog-guarded like the sim body; on
+    # any failure the sim body above IS the degraded fallback — report
+    # the error, keep the artifact.
+    real: dict = {}
+
+    def real_worker():
+        try:
+            run_prefix_fleet_real(sink=real)
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            real["error"] = f"{type(exc).__name__}: {exc}"
+
+    if os.environ.get("BENCH_PREFIX_FLEET_REAL", "1") != "0":
+        rt = threading.Thread(target=real_worker, daemon=True)
+        rt.start()
+        rt.join(float(os.environ.get(
+            "BENCH_PREFIX_FLEET_REAL_TIMEOUT_S", "300")))
+        if rt.is_alive():
+            real["error"] = "real-worker leg timed out"
+        if "error" in real:
+            real["degraded_fallback"] = (
+                "sim body carries the acceptance deltas for this run"
+            )
+        result["real_workers"] = real
     print(json.dumps({"metric": "prefix_fleet", **result}))
     if "error" in result or "fleet_index" not in result:
         return 1
-    return 1 if result["regressed"] or result["remote_pulls"] == 0 else 0
+    if result["regressed"] or result["remote_pulls"] == 0:
+        return 1
+    # When the real leg ran, its own acceptance bits gate too: every
+    # pulled-KV decode bit-equal, at least one real wire pull, no pins.
+    if real and "error" not in real:
+        if (not real.get("bit_equal") or not real.get("remote_pulls")
+                or real.get("pulls_pinned_after")):
+            return 1
+    return 0
 
 
 def main() -> int:

@@ -1059,6 +1059,28 @@ class DisaggRouter:
     def _ledger_release(self, rid) -> None:
         self._ledger.pop(int(rid), None)
 
+    def reserve_pull(self, nonce: int, blocks: int) -> "bool | None":
+        """Ledger-gate one remote prefix pull (FleetPrefixTier.pull_gate):
+        a pull is KV demand like any stream, so it reserves its receiver
+        blocks for the transfer window under NEGATIVE ledger keys (pull
+        nonces can never collide with request ids, and the reservation
+        automatically weighs on `_decode_headroom_blocks`, so stream
+        admission and pull admission contend over one number).  Returns
+        True (reserved), False (over-demand: caller falls back to cold
+        prefill), or None (capacity unaccountable — bypass, the same
+        stand-aside stream admission takes)."""
+        headroom = self._decode_headroom_blocks()
+        if headroom is None:
+            return None
+        if int(blocks) > headroom:
+            return False
+        self._ledger_commit(-int(nonce), int(blocks))
+        return True
+
+    def release_pull(self, nonce: int) -> None:
+        """Release a pull-window reservation made by `reserve_pull`."""
+        self._ledger_release(-int(nonce))
+
     def _admit_handoff(self, item: dict) -> bool:
         """True iff the decode pool can commit the entry's full-stream KV
         demand (or capacity is not accountable, in which case admission

@@ -420,7 +420,13 @@ class FleetRouter:
                 score += min(0.99, st.free_blocks / (100.0 * max(1, st.n_slots)))
             if survey is not None:
                 owned = survey.get(rep.name)
-                if owned is not None:
+                if owned is not None and (
+                    self.prefix_tier is None
+                    or self.prefix_tier.owner_available(rep.name)
+                ):
+                    # Breaker-open/dead owners earn no depth bonus:
+                    # placement degrades to plain load balance (local-only)
+                    # instead of chasing an unreachable cache.
                     score += min(
                         self.policy.prefix_depth_bonus_max,
                         self.policy.prefix_depth_bonus_per_block * owned[1],

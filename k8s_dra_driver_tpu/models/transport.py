@@ -127,6 +127,8 @@ TELEM = 13      # worker→supervisor: CRC'd telemetry snapshot (obs_plane)
 PREFIXREQ = 14  # puller→owner: request prefix KV for a token prefix
 PREFIXKV = 15   # owner→puller: meta {nonce, n_tokens} + KVSlice wire bytes
 PREFIXMISS = 16  # owner→puller: meta {nonce, reason} — nothing exportable
+PREFIXPUB = 17  # owner→supervisor: CRC'd gossip batch of prefix publishes
+PREFIXWDL = 18  # owner→supervisor: CRC'd gossip batch of prefix withdraws
 
 _FRAME_HEADER = struct.Struct("!IB")
 MAX_FRAME_BYTES = 1 << 30  # sanity bound: a length beyond this is garbage
@@ -215,6 +217,7 @@ class LoopbackConn:
         self._out: deque | None = None  # peer's inbox
         self._in: deque = deque()
         self.closed = False
+        self._sent_frames = 0  # steps= scope for sock_partition
         self._peer_conn: "LoopbackConn | None" = None
 
     @staticmethod
@@ -242,6 +245,12 @@ class LoopbackConn:
                 self._out.append(bytes(data[: max(1, len(data) // 2)]))
                 self.close()
                 raise PeerDiedError(self.peer, TRUNCATED, request_id)
+            self._sent_frames += 1
+            if inj.take_sock_partition(self.peer, self._sent_frames):
+                # One-way partition: the frame vanishes but the conn stays
+                # open — the sender believes it delivered, the peer sees
+                # silence.  The OTHER direction keeps flowing.
+                return latency
         self._out.append(bytes(data))
         return latency
 
@@ -270,6 +279,7 @@ class SocketConn:
         self.fault_injector = fault_injector
         self.send_timeout_s = send_timeout_s
         self.closed = False
+        self._sent_frames = 0  # steps= scope for sock_partition
         sock.setblocking(False)
 
     def send(self, data: bytes, request_id: int = -1) -> float:
@@ -290,6 +300,10 @@ class SocketConn:
                     pass
                 self.close()
                 raise PeerDiedError(self.peer, TRUNCATED, request_id)
+            self._sent_frames += 1
+            if inj.take_sock_partition(self.peer, self._sent_frames):
+                # One-way partition: drop the frame, keep the socket open.
+                return latency
         try:
             self.sock.settimeout(self.send_timeout_s)
             self.sock.sendall(data)
@@ -1573,7 +1587,7 @@ class PoolWorker:
                  name: str = "", clock=time.monotonic,
                  telem_interval_s: float | None = None,
                  telem_budget_bytes: int = TELEM_BUDGET_BYTES,
-                 traces=None):
+                 traces=None, prefix_gossip: bool = False):
         self.conn = conn
         self.router = router
         self.role = role
@@ -1603,6 +1617,24 @@ class PoolWorker:
                 budget_bytes=telem_budget_bytes,
                 traces=self.traces,
             )
+        # Prefix-gossip publisher: CRC'd PREFIXPUB/PREFIXWDL batches ride
+        # the same pump cadence as telemetry.  Epoch 0 means "never
+        # resynced" — the supervisor hands the real epoch over CONTROL
+        # {"op": "prefix_resync"} and every frame is stamped with it so
+        # stale owners are fenced, never trusted.
+        self.gossip = None
+        self.prefix_epoch = 0
+        if prefix_gossip:
+            from k8s_dra_driver_tpu.models.fleet_prefix import PrefixGossip
+
+            self.gossip = PrefixGossip(
+                lambda kind, body: self._send(
+                    PREFIXPUB if kind == "pub" else PREFIXWDL, body,
+                ),
+                clock=clock,
+            )
+            for rep in getattr(self.router, "replicas", ()):
+                self.gossip.bind_engine(rep.engine)
 
     def pump_once(self) -> int:
         from k8s_dra_driver_tpu.models.serve import KVSlice, WireFormatError
@@ -1670,6 +1702,8 @@ class PoolWorker:
             # Cadence-paced: ships even while hold_ticks parks the router,
             # so spans recorded before a SIGKILL still reach the fleet.
             self.shipper.maybe_ship()
+        if self.gossip is not None and not self.dead:
+            self.gossip.maybe_ship()
         return n
 
     def _handle(self, ftype, body, KVSlice, WireFormatError) -> None:
@@ -1704,6 +1738,13 @@ class PoolWorker:
             elif doc.get("op") == "release":
                 for rep in getattr(self.router, "replicas", ()):
                     rep.engine.release_active()
+            elif doc.get("op") == "prefix_resync":
+                # Supervisor assigned (or bumped) our owner epoch: adopt
+                # it and arm a full anti-entropy digest so the index can
+                # drop whatever we no longer hold.
+                self.prefix_epoch = int(doc.get("epoch", 0))
+                if self.gossip is not None:
+                    self.gossip.resync(self.prefix_epoch)
             elif doc.get("op") == "reseed":
                 # The supervisor fleet reserved ONE id stride for this
                 # worker (RemoteWorkerEngine is one replica up there), so
@@ -1789,6 +1830,16 @@ class PoolWorker:
             tokens = [int(t) for t in doc.get("tokens", ())]
             max_tokens = doc.get("max_tokens")
             adapter = int(doc.get("adapter", 0))
+            req_epoch = int(doc.get("epoch", 0))
+            if req_epoch and self.prefix_epoch and req_epoch != self.prefix_epoch:
+                # The index entry that routed this pull was published by a
+                # PREVIOUS incarnation of this owner name — a typed miss,
+                # never someone else's KV.
+                self._send(PREFIXMISS, encode_meta_frame(
+                    PREFIXMISS, {"nonce": nonce, "reason": "epoch",
+                                 "epoch": self.prefix_epoch},
+                )[_FRAME_HEADER.size:])
+                return
             kv = None
             for rep in getattr(self.router, "replicas", ()):
                 export = getattr(rep.engine, "export_prefix_kv", None)
@@ -1802,11 +1853,13 @@ class PoolWorker:
                     break
             if kv is None:
                 self._send(PREFIXMISS, encode_meta_frame(
-                    PREFIXMISS, {"nonce": nonce, "reason": "miss"},
+                    PREFIXMISS, {"nonce": nonce, "reason": "miss",
+                                 "epoch": self.prefix_epoch},
                 )[_FRAME_HEADER.size:])
             else:
                 self._send(PREFIXKV, encode_meta_frame(
-                    PREFIXKV, {"nonce": nonce, "n_tokens": int(kv.valid_len)},
+                    PREFIXKV, {"nonce": nonce, "n_tokens": int(kv.valid_len),
+                               "epoch": self.prefix_epoch},
                     kv.to_wire(nonce),
                 )[_FRAME_HEADER.size:])
 
@@ -2010,12 +2063,48 @@ def worker_main(argv) -> int:
         telem_budget_bytes=int(
             config.get("telem_budget_bytes", TELEM_BUDGET_BYTES)
         ),
+        # Gossip defaults ON too: a real worker process is the only
+        # party that knows what prefixes it holds.
+        prefix_gossip=bool(config.get("prefix_gossip", True)),
     )
     print(json.dumps({"ready": True, "pid": os.getpid()}), flush=True)
-    while not worker.dead:
+    # A partitioned/reset link kills the conn but not the process; with
+    # redial_attempts > 0 the worker survives it — dial the hub again,
+    # the supervisor's PeerLink adopts the new conn as a reconnect, and
+    # a prefix_resync (epoch bump + anti-entropy digest) heals the index.
+    redials_left = int(config.get("redial_attempts", 0))
+    while True:
         if worker.pump_once() == 0:
             time.sleep(0.002)
+        if not worker.dead:
+            continue
+        if redials_left <= 0:
+            break
+        redials_left -= 1
+        conn = _worker_redial(config, fault_injector)
+        if conn is None:
+            break
+        worker.conn = conn
+        worker.frames = FrameBuffer()
+        worker.dead = False
     return 0
+
+
+def _worker_redial(config, fault_injector, deadline_s: float = 10.0):
+    """Backoff-paced redial for a worker whose conn (not process) died."""
+    backoff = Backoff(RetryPolicy(base_delay_s=0.05, max_delay_s=1.0))
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            return dial(
+                config.get("host", "127.0.0.1"), int(config["port"]),
+                name=config.get("name", "worker"),
+                role=config.get("role", "decode"),
+                fault_injector=fault_injector,
+            )
+        except OSError:
+            backoff.sleep()
+    return None
 
 
 # -- observability ------------------------------------------------------------

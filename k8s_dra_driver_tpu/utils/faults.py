@@ -153,6 +153,15 @@ class FaultProfile:
     sock_reset_rate: float = 0.0  # probability the peer resets mid-transfer
     sock_latency_s: float = 0.0  # simulated seconds added per frame
     peer_hang: int = 0  # next N receiver polls stall silently
+    # ``sock_partition`` is the one-way network partition: a matching
+    # SENT frame is silently dropped — nothing arrives, the connection
+    # stays open, and the OTHER direction keeps flowing.  Scope the
+    # direction by arming it on the side whose sends should vanish, the
+    # victims by ``peers`` (peer names), the window by ``steps`` (the
+    # sender's per-conn frame counter); the shared ``limit`` budget
+    # bounds the partition so every run eventually heals.
+    sock_partition_rate: float = 0.0  # probability a sent frame vanishes
+    peers: tuple = ()  # e.g. ("decode-w",); empty = all peers
     # scheduler-scoped (multi-scheduler contention harness) kinds:
     # consulted by the ContentionSim once per commit attempt, BEFORE the
     # status write is issued.  ``sched_conflict_rate`` injects a 409 at
@@ -502,6 +511,20 @@ class FaultInjector:
                 total += p.sock_latency_s
         return total
 
+    def take_sock_partition(self, peer: str, step: int | None = None) -> bool:
+        """Transport send seam: should this frame silently vanish (one-way
+        partition)?  Unlike reset/truncate the connection stays OPEN — the
+        peer keeps talking to us, we just never land anything on it.  Only
+        liveness (heartbeat expiry) or anti-entropy on reconnect may heal
+        the divergence; the data path must never wedge on it."""
+        for p in self._matching_peer(peer, step):
+            if p.sock_partition_rate and self._roll(
+                p, p.sock_partition_rate, "sock_partition",
+                f"peer-{peer}", "transport",
+            ):
+                return True
+        return False
+
     def take_peer_hang(self) -> bool:
         """Transport recv seam: should the receiver stall silently this
         poll (frames buffered but not processed, heartbeats unanswered)?
@@ -568,6 +591,19 @@ class FaultInjector:
                 for p in self._profiles
                 if (not p.replicas or replica in p.replicas)
                 and (not p.steps or tick in p.steps)
+            ]
+
+    def _matching_peer(self, peer: str, step: int | None) -> list[FaultProfile]:
+        """Profiles matching a transport peer by name — the partition twin
+        of :meth:`_matching_channel` (empty scope matches every peer;
+        ``steps`` doubles as the sender's per-conn frame counter so a
+        partition window can be pinned to specific frames)."""
+        with self._lock:
+            return [
+                p
+                for p in self._profiles
+                if (not p.peers or peer in p.peers)
+                and (step is None or not p.steps or step in p.steps)
             ]
 
     def _matching_sched(self, scheduler: int) -> list[FaultProfile]:
@@ -653,6 +689,8 @@ class FaultInjector:
                 fields["sock_truncate_rate"] = float(value)
             elif key == "sock_reset":
                 fields["sock_reset_rate"] = float(value)
+            elif key == "sock_partition":
+                fields["sock_partition_rate"] = float(value)
             elif key == "sched_commit_latency_ms":
                 fields["sched_commit_latency_s"] = float(value) / 1000.0
             elif key in ("error_rate", "conflict_rate", "drop_rate", "latency_s",
@@ -663,6 +701,7 @@ class FaultInjector:
                          "handoff_corrupt_rate", "spawn_fail_rate",
                          "spawn_latency_s", "sock_truncate_rate",
                          "sock_reset_rate", "sock_latency_s",
+                         "sock_partition_rate",
                          "channel_down_rate", "sched_conflict_rate",
                          "sched_commit_latency_s"):
                 fields[key] = float(value)
@@ -675,6 +714,8 @@ class FaultInjector:
                 fields["kinds"] = tuple(value.split("+"))
             elif key == "channels":
                 fields["channels"] = tuple(value.split("+"))
+            elif key == "peers":
+                fields["peers"] = tuple(value.split("+"))
             elif key in ("slots", "steps", "replicas", "schedulers"):
                 fields[key] = tuple(int(v) for v in value.split("+"))
             else:

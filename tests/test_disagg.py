@@ -443,6 +443,82 @@ class TestWireFormat:
         assert exc.value.request_id == 55
 
 
+def _gossip_doc():
+    return {
+        "events": [
+            {"key": "a" * 32, "n_tokens": 12, "block_size": 4,
+             "kv_dtype": "float32", "n_layers": 2, "kv_heads": 2,
+             "head_dim": 16, "adapter": 0, "blocks": 3},
+            {"key": "b" * 32, "n_tokens": 4, "block_size": 4,
+             "kv_dtype": "int8", "adapter": 1, "blocks": 1},
+        ],
+        "full": True,
+    }
+
+
+class TestPrefixGossipWireFormat:
+    """Property tests for the PREFIXPUB/PREFIXWDL gossip codec
+    (models/fleet_prefix.py; the frames PoolWorker ships to the fleet
+    index) — same contract as the KVSlice codec above: decode(encode(doc))
+    is identity, and EVERY truncation point and EVERY single-byte flip is
+    a typed ``PrefixGossipError`` — a corrupt batch drops whole, never a
+    partially-applied index update."""
+
+    def test_roundtrip_identity(self):
+        from k8s_dra_driver_tpu.models.fleet_prefix import (
+            decode_prefix_gossip, encode_prefix_gossip)
+
+        doc = _gossip_doc()
+        body = encode_prefix_gossip(doc, epoch=7, seq=19)
+        got, epoch, seq = decode_prefix_gossip(body)
+        assert got == doc and epoch == 7 and seq == 19
+
+    def test_truncation_at_every_byte_is_typed_never_partial(self):
+        from k8s_dra_driver_tpu.models.fleet_prefix import (
+            PrefixGossipError, decode_prefix_gossip, encode_prefix_gossip)
+
+        body = encode_prefix_gossip(_gossip_doc(), epoch=7, seq=19)
+        for cut in range(len(body)):
+            with pytest.raises(PrefixGossipError):
+                decode_prefix_gossip(body[:cut])
+
+    def test_single_bit_flips_at_every_offset_are_typed(self):
+        from k8s_dra_driver_tpu.models.fleet_prefix import (
+            PrefixGossipError, decode_prefix_gossip, encode_prefix_gossip)
+
+        body = bytearray(encode_prefix_gossip(_gossip_doc(), epoch=7, seq=19))
+        for off in range(len(body)):
+            for flip in (0x01, 0x80):
+                mutated = bytes(
+                    body[:off] + bytes([body[off] ^ flip]) + body[off + 1:]
+                )
+                try:
+                    got, epoch, seq = decode_prefix_gossip(mutated)
+                except PrefixGossipError:
+                    continue
+                pytest.fail(
+                    f"flip 0x{flip:02x} at offset {off} decoded "
+                    f"silently (epoch={epoch}, seq={seq})"
+                )
+
+    def test_error_carries_epoch_and_seq_once_header_is_readable(self):
+        from k8s_dra_driver_tpu.models.fleet_prefix import (
+            _GOSSIP_HEADER_BYTES, PrefixGossipError, decode_prefix_gossip,
+            encode_prefix_gossip)
+
+        body = bytearray(encode_prefix_gossip(_gossip_doc(), epoch=9, seq=42))
+        body[-1] ^= 0x10  # corrupt the last payload byte, header intact
+        with pytest.raises(PrefixGossipError) as exc:
+            decode_prefix_gossip(bytes(body))
+        assert exc.value.epoch == 9 and exc.value.seq == 42
+        # truncated before the fixed header completes: attribution
+        # unknowable, -1 (the WireFormatError.request_id contract)
+        for cut in range(_GOSSIP_HEADER_BYTES):
+            with pytest.raises(PrefixGossipError) as exc:
+                decode_prefix_gossip(bytes(body[:cut]))
+            assert exc.value.epoch == -1 and exc.value.seq == -1
+
+
 class TestChannelClaim:
     """DRA binding: the channel's capacity parameters come from the
     interconnect device the topology daemon publishes."""

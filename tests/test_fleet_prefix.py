@@ -356,3 +356,262 @@ class TestFleetPrefixTier:
         done = self._drain_engines(A, B)
         assert any(c.generated == ref_b for c in done)
         assert tier.counts["remote"] == 0  # geometry-gated: no cross-dtype pull
+
+
+# -- gossip ingest, epoch fences, and the pull-admission gate -----------------
+
+
+def _ev(key="k1", n_tokens=8, **kw):
+    """One wire publish event as PrefixGossip ships it (digest-keyed)."""
+    ev = {"key": key, "n_tokens": n_tokens, "block_size": BS,
+          "kv_dtype": "bfloat16", "n_layers": 1, "kv_heads": 2,
+          "head_dim": 16, "adapter": 0, "blocks": 2}
+    ev.update(kw)
+    return ev
+
+
+class TestEpochFencing:
+    """Epoch-fenced ownership: entries stamped with a superseded owner
+    epoch are typed misses, never pulls at the wrong process."""
+
+    def _index(self):
+        clock = SimClock()
+        return fleet_prefix.FleetPrefixIndex(clock=clock), clock
+
+    def test_stale_epoch_publish_is_fenced(self):
+        idx, _ = self._index()
+        assert idx.ingest_publish("W", 2, _ev())
+        fenced0 = idx.fenced_total
+        assert not idx.ingest_publish("W", 1, _ev(key="k2"))
+        assert idx.fenced_total == fenced0 + 1
+        assert len(idx) == 1  # the stale publish never landed
+        m = parse_prom_text(REGISTRY.render())
+        assert m["tpu_fleet_prefix_epoch_fences_total"][()] >= 1.0
+        assert m["tpu_fleet_prefix_pub_total"][(("outcome", "fenced"),)] >= 1.0
+
+    def test_set_owner_epoch_fences_older_entries(self):
+        idx, _ = self._index()
+        idx.ingest_publish("W", 1, _ev(key="a"))
+        idx.ingest_publish("W", 1, _ev(key="b"))
+        idx.ingest_publish("X", 1, _ev(key="c"))
+        assert idx.set_owner_epoch("W", 2) == 2
+        assert idx.owner_epoch["W"] == 2
+        assert set(idx._entries) == {"c"}  # X's entry survives the fence
+
+    def test_newer_epoch_publish_fences_implicitly(self):
+        idx, _ = self._index()
+        idx.ingest_publish("W", 1, _ev(key="a"))
+        assert idx.ingest_publish("W", 2, _ev(key="b"))
+        assert idx.owner_epoch["W"] == 2
+        assert set(idx._entries) == {"b"}  # the bump fenced epoch-1 "a"
+
+    def test_epoch_ok_drops_superseded_entry_at_pull_time(self):
+        idx, _ = self._index()
+        idx.ingest_publish("W", 1, _ev(key="a"))
+        ent = idx._entries["a"]
+        # a fence raced past this entry (e.g. it sat pinned): the pull-time
+        # check is the last line of defense
+        idx.owner_epoch["W"] = 2
+        assert not idx.epoch_ok(ent)
+        assert len(idx) == 0
+
+    def test_ingest_withdraw_owner_and_epoch_guarded(self):
+        idx, _ = self._index()
+        idx.ingest_publish("W", 2, _ev(key="a"))
+        assert not idx.ingest_withdraw("X", 2, {"key": "a"})  # wrong owner
+        assert not idx.ingest_withdraw("W", 1, {"key": "a"})  # stale epoch
+        assert idx.ingest_withdraw("W", 2, {"key": "a"})
+        assert len(idx) == 0
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_pub_total"][(("outcome", "withdrawn"),)] >= 1.0
+        )
+
+    def test_anti_entropy_digest_drops_unnamed_entries(self):
+        idx, _ = self._index()
+        idx.ingest_publish("W", 1, _ev(key="a"))
+        idx.ingest_publish("W", 1, _ev(key="b"))
+        idx.ingest_publish("X", 1, _ev(key="c"))
+        res = idx.ingest_digest("W", 1, [_ev(key="b"), _ev(key="d")])
+        assert res == {"ingested": 2, "dropped": 1}  # "a" diverged: dropped
+        assert set(idx._entries) == {"b", "c", "d"}
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_evictions_total"][(("reason", "anti_entropy"),)]
+            >= 1.0
+        )
+
+
+class TestGossipWireIngest:
+    """Tier-side PREFIXPUB/PREFIXWDL ingest: decoded frames apply whole,
+    corrupt frames drop whole (typed, counted), never partially."""
+
+    def _tier(self):
+        clock = SimClock()
+        return fleet_prefix.FleetPrefixTier(
+            fleet_prefix.FleetPrefixIndex(clock=clock), clock=clock)
+
+    def test_pub_and_wdl_frames_apply(self):
+        tier = self._tier()
+        body = fleet_prefix.encode_prefix_gossip(
+            {"events": [_ev(key="a"), _ev(key="b", n_tokens=12)]},
+            epoch=1, seq=1)
+        assert tier._ingest_pub("W", body) == 2
+        assert tier.index.owner_epoch["W"] == 1
+        wdl = fleet_prefix.encode_prefix_gossip(
+            {"events": [{"key": "a"}]}, epoch=1, seq=2)
+        assert tier._ingest_wdl("W", wdl) == 1
+        assert set(tier.index._entries) == {"b"}
+        m = parse_prom_text(REGISTRY.render())
+        assert m["tpu_fleet_prefix_pub_total"][(("outcome", "ingested"),)] >= 2.0
+
+    def test_full_digest_frame_runs_anti_entropy(self):
+        tier = self._tier()
+        tier._ingest_pub("W", fleet_prefix.encode_prefix_gossip(
+            {"events": [_ev(key="a"), _ev(key="b")]}, epoch=1, seq=1))
+        body = fleet_prefix.encode_prefix_gossip(
+            {"events": [_ev(key="b")], "full": True}, epoch=1, seq=2)
+        assert tier._ingest_pub("W", body) == 1
+        assert set(tier.index._entries) == {"b"}
+
+    def test_corrupt_frame_dropped_whole_and_counted(self):
+        tier = self._tier()
+        good = fleet_prefix.encode_prefix_gossip(
+            {"events": [_ev()]}, epoch=1, seq=1)
+        corrupt = good[:-1] + bytes([good[-1] ^ 0x01])
+        assert tier._ingest_pub("W", corrupt) == 0
+        assert tier.gossip_decode_drops == 1
+        assert len(tier.index) == 0  # nothing partially applied
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_pub_total"][(("outcome", "decode_drop"),)] >= 1.0
+        )
+
+
+class _Gate:
+    """reserve_pull/release_pull stub with a scripted verdict."""
+
+    def __init__(self, verdict):
+        self.verdict = verdict
+        self.reserved = {}
+        self.released = []
+
+    def reserve_pull(self, nonce, blocks):
+        if self.verdict is True:
+            self.reserved[nonce] = blocks
+        return self.verdict
+
+    def release_pull(self, nonce):
+        self.released.append(nonce)
+        self.reserved.pop(nonce, None)
+
+
+class TestPullAdmissionGate:
+    """Ledger-gated pull admission: a remote pull is KV demand like any
+    stream — it reserves receiver blocks for the transfer window or falls
+    back to a reason-coded cold prefill, and the reservation contends
+    with stream admission over ONE headroom number."""
+
+    def _warm_pair(self, params):
+        clock = SimClock()
+        A = _paged(params)
+        B = _paged(params)
+        tier = fleet_prefix.FleetPrefixTier(
+            fleet_prefix.FleetPrefixIndex(clock=clock), clock=clock)
+        tier.bind_engine("A", A)
+        tier.bind_engine("B", B)
+        prompt = list(range(1, 15))
+        _run(A, prompt)  # warm A: hooks publish 3 rungs
+        return tier, A, B, prompt
+
+    def test_refused_pull_falls_back_cold_reason_coded(self, params):
+        tier, _A, B, prompt = self._warm_pair(params)
+        gate = _Gate(False)
+        tier.pull_gate = gate
+        refused0 = fleet_prefix._M_PULL_ADMISSION.value(outcome="refused")
+        assert tier.prepare("B", B, prompt, max_tokens=6) == "cold"
+        assert tier.fallbacks["pull_admission"] == 1
+        assert B.local_prefix_depth(prompt) == 0  # no transfer happened
+        assert tier.index.ledger().pinned == 0
+        assert gate.released == []  # nothing reserved, nothing to release
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_pull_admission_total"][
+                (("outcome", "refused"),)
+            ] == refused0 + 1.0
+        )
+
+    def test_admitted_pull_reserves_for_the_window_then_releases(self, params):
+        tier, _A, B, prompt = self._warm_pair(params)
+        gate = _Gate(True)
+        tier.pull_gate = gate
+        assert tier.prepare("B", B, prompt, max_tokens=6) == "remote"
+        assert B.local_prefix_depth(prompt) == 12
+        assert gate.reserved == {}  # released when the window closed
+        assert len(gate.released) == 1
+        assert tier.index.ledger().pinned == 0
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_pull_admission_total"][
+                (("outcome", "admitted"),)
+            ] >= 1.0
+        )
+
+    def test_unaccountable_headroom_bypasses_like_stream_admission(
+            self, params):
+        tier, _A, B, prompt = self._warm_pair(params)
+        gate = _Gate(None)
+        tier.pull_gate = gate
+        assert tier.prepare("B", B, prompt, max_tokens=6) == "remote"
+        assert gate.released == []  # bypass holds no reservation
+        m = parse_prom_text(REGISTRY.render())
+        assert (
+            m["tpu_fleet_prefix_pull_admission_total"][
+                (("outcome", "bypass"),)
+            ] >= 1.0
+        )
+
+    def test_pull_reservation_flips_stream_admission(self, params):
+        """THE acceptance assertion: at the same decode capacity, an
+        admitted pull reservation shrinks the one headroom number stream
+        admission budgets against — a stream that fits the bare pool is
+        REFUSED while the pull window is open and admitted again after
+        release — and refusals never fire the deadlock detector."""
+        from k8s_dra_driver_tpu.models.disagg import DisaggRouter
+
+        dec = _paged(params)
+        router = DisaggRouter(prefill=[_paged(params)], decode=[dec],
+                              admission_control=True)
+        cap = dec.reservable_blocks
+        assert router._decode_headroom_blocks() == cap
+        entry = {"request_id": 7001, "prompt_len": 4,
+                 "max_tokens": cap * BS - 4, "tokens": [1, 2, 3, 4]}
+        assert router._admit_handoff({"entry": dict(entry)}) is True
+        router.release_pull(-7001)  # rewind the probe reservation
+        # an admitted pull shrinks the SAME headroom stream admission uses
+        assert router.reserve_pull(55, 8) is True
+        assert router._decode_headroom_blocks() == cap - 8
+        fired0 = router.deadlock_fired
+        assert router._admit_handoff({"entry": dict(entry)}) is False
+        # over-demand pulls are refused without touching the ledger...
+        for nonce in range(100, 120):
+            assert router.reserve_pull(nonce, cap) is False
+        assert router._decode_headroom_blocks() == cap - 8
+        # ...and a refused pull is a cold-prefill fallback, not a parked
+        # stream: the ARMED->COUNTING->FIRED detector never trips
+        for _ in range(router.deadlock_ticks + 5):
+            router._deadlock_tick()
+        assert router.deadlock_fired == fired0
+        router.release_pull(55)
+        assert router._decode_headroom_blocks() == cap  # balanced ledger
+        assert router._admit_handoff({"entry": dict(entry)}) is True
+
+    def test_bypass_when_capacity_unaccountable(self, params):
+        from k8s_dra_driver_tpu.models.disagg import DisaggRouter
+        from k8s_dra_driver_tpu.models.serve import ServeEngine as _SE
+
+        dense = _SE(params=params, cfg=CFG, n_slots=2, prompt_bucket=32)
+        router = DisaggRouter(prefill=[_paged(params)], decode=[dense],
+                              admission_control=True)
+        assert router.reserve_pull(1, 4) is None  # dense pool: stand aside
+        assert router._ledger == {}

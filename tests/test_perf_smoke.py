@@ -166,3 +166,19 @@ def test_prefix_fleet_overhead_stays_within_perf_budgets():
     assert stats["host_syncs_tiered"] == stats["host_syncs_bare"]
     assert stats["published_total"] > 0
     assert stats["lookup_p50_s"] <= stats["lookup_p50_ceiling_s"]
+
+
+def test_prefix_gossip_overhead_stays_within_perf_budgets():
+    stats = perf_smoke.check_prefix_gossip_overhead()
+    assert stats["requests_gossiped"] == 8
+    # The gossip plane's contract: PREFIXPUB/PREFIXWDL publishing is
+    # host-side dict/json work riding hooks and cadence the worker pump
+    # already pays for — a gossip-attached engine dispatches EXACTLY the
+    # bare engine's device work, every shipped frame fits the TELEM-style
+    # byte budget, and a publish storm sheds the shallow tail (accounted)
+    # without ever losing an event.
+    assert stats["host_syncs_gossiped"] == stats["host_syncs_bare"]
+    assert stats["shipped_frames"] > 0
+    assert stats["max_frame_bytes"] <= stats["budget_bytes"]
+    assert stats["storm_shed_total"] > 0
+    assert stats["storm_max_frame_bytes"] <= 2048

@@ -734,7 +734,8 @@ class TestObservabilityFederation:
 PREFIX_PROMPT = list(range(1, 15))  # 14 tokens -> 3 storable blocks of 4
 
 
-def _prefix_worker_cfg(tmp_path, name, port, peer="prefix-w"):
+def _prefix_worker_cfg(tmp_path, name, port, peer="prefix-w",
+                       redial_attempts=0):
     path = tmp_path / f"{name}.json"
     path.write_text(json.dumps({
         "cfg": CFG_DOC,
@@ -749,6 +750,7 @@ def _prefix_worker_cfg(tmp_path, name, port, peer="prefix-w"):
         "name": peer,
         "role": "decode",
         "hold_ticks": False,
+        "redial_attempts": redial_attempts,
     }))
     return path
 
@@ -758,13 +760,14 @@ class TestTwoProcessPrefixPull:
                                                           tmp_path):
         """Fleet prefix tier over REAL sockets and a REAL SIGKILL.  The
         worker serves the shared prompt once (warming ITS paged prefix
-        store), the supervisor publishes the rungs as index hints, and a
-        cold local engine remote-pulls the prefix over PREFIXREQ/PREFIXKV
-        — decoding BIT-EQUAL to the worker's own cold prefill.  Then the
-        owner is SIGKILLed and the next admission's pull walks the
-        fallback ladder: owner-death detected mid-pull, its index
-        footprint invalidated, nothing left pinned, and the stream
-        completes via cold prefill — degraded, never lost."""
+        store) and GOSSIPS the rungs over PREFIXPUB frames — the index
+        learns the wire way, no supervisor-side hints — and a cold local
+        engine remote-pulls the prefix over PREFIXREQ/PREFIXKV, decoding
+        BIT-EQUAL to the worker's own cold prefill.  Then the owner is
+        SIGKILLed and the next admission's pull walks the fallback
+        ladder: owner-death detected mid-pull, its index footprint
+        invalidated, nothing left pinned, and the stream completes via
+        cold prefill — degraded, never lost."""
         from k8s_dra_driver_tpu.models import fleet_prefix as FP
 
         hub = T.TransportHub(
@@ -776,6 +779,11 @@ class TestTwoProcessPrefixPull:
         try:
             link = hub.link_for("prefix-w", timeout_s=120.0)
             pool = T.RemotePool(link, name="prefix-pool")
+            # 2-before-1: attach the tier FIRST so the resync handshake
+            # assigns the owner epoch before the warm serve publishes.
+            index = FP.FleetPrefixIndex()
+            tier = FP.FleetPrefixTier(index, pull_timeout_s=8.0)
+            tier.attach_remote_owner("prefix-w", link, pull_timeout_s=8.0)
             # 1. Warm the owner through a REAL remote serve of the prompt.
             pool.submit(PREFIX_PROMPT, 6, seed=3)
             done = []
@@ -783,29 +791,33 @@ class TestTwoProcessPrefixPull:
             while time.monotonic() < deadline and not done:
                 hub.poll()
                 pool.tick()
+                tier.tick()
                 done += pool.completions()
                 time.sleep(0.005)
             assert len(done) == 1 and done[0].status == "ok"
             ref = list(done[0].generated)  # the owner's own cold decode
 
-            # 2. Publish the owner's rungs as index hints.  In-process
-            # tiers publish through engine hooks; across processes the
-            # supervisor publishes on placement — entries are HINTS, the
-            # owner re-walks its store on PREFIXREQ (a stale hint is one
+            # 2. The owner gossips its rungs over the wire (PREFIXPUB,
+            # CRC'd, epoch-stamped) — entries are still HINTS: the owner
+            # re-walks its store on PREFIXREQ (a stale entry is one
             # PREFIXMISS, never a wrong KV).
-            index = FP.FleetPrefixIndex()
-            tier = FP.FleetPrefixTier(index, pull_timeout_s=8.0)
-            tier.add_source(
-                "prefix-w",
-                FP.RemotePrefixSource("prefix-w", link, pull_timeout_s=8.0),
-            )
-            for d in (4, 8, 12):
-                index.publish(
-                    tuple(PREFIX_PROMPT[:d]), "prefix-w", n_tokens=d,
-                    block_size=4, kv_dtype="float32",
-                    n_layers=CFG.n_layers, kv_heads=CFG.n_heads,
-                    head_dim=CFG.d_model // CFG.n_heads,
-                )
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                hub.poll()
+                link.pump()
+                tier.tick()
+                ents = [e for e in index._entries.values()
+                        if e.owner == "prefix-w"]
+                if any(e.n_tokens >= 12 for e in ents):
+                    break
+                time.sleep(0.005)
+            ents = [e for e in index._entries.values()
+                    if e.owner == "prefix-w"]
+            assert any(e.n_tokens >= 12 for e in ents), \
+                "gossip never delivered the deepest rung"
+            epoch = index.owner_epoch["prefix-w"]
+            assert epoch >= 1
+            assert all(e.epoch == epoch for e in ents)
 
             # 3. Happy path: remote pull over the wire, bit-equal decode.
             puller = _paged(params, prefix_cache_blocks=24)
@@ -832,4 +844,197 @@ class TestTwoProcessPrefixPull:
             assert c.status == "ok" and list(c.generated) == ref
         finally:
             w.kill()
+            hub.close()
+
+
+# -- three-process leg: partition, owner replacement, stale-hint storm -------
+
+
+PROMPT_B = list(range(21, 35))  # 14 tokens, disjoint from PREFIX_PROMPT
+
+
+class TestThreeProcessPrefixGossip:
+    def test_partition_epoch_fence_and_stale_storm(self, params, tmp_path):
+        """The tentpole proof on REAL processes: supervisor + two gossiping
+        owner workers.  (a) A one-way ``sock_partition`` mid-gossip kills
+        supervisor→A frames: liveness expires, the breaker opens, placement
+        degrades to local-only (reason-coded, stream served cold — never
+        lost); on heal the worker redials, the owner epoch bumps, and the
+        anti-entropy digest reconverges the index — pulls resume
+        bit-equal.  (b) Owner B is SIGKILLed mid-pull and REPLACED by a
+        fresh process under the same name: the epoch bump + empty digest
+        fence every stale entry (zero wrong-KV injections), and a
+        stale-hint storm at the dead epoch bounces off whole.  Balanced
+        ledgers and one journal correlation per pull throughout."""
+        from k8s_dra_driver_tpu.models import fleet_prefix as FP
+        from k8s_dra_driver_tpu.utils.faults import FaultInjector, FaultProfile
+        from k8s_dra_driver_tpu.utils.journal import JOURNAL
+
+        inj = FaultInjector()  # armed mid-test; hub conns hold the reference
+        hub = T.TransportHub(
+            heartbeat_interval_s=0.1, liveness_timeout_s=10.0,
+            ack_timeout_s=5.0, fault_injector=inj,
+        )
+        wa = _spawn_worker("prefix-a1", _prefix_worker_cfg(
+            tmp_path, "pa1", hub.port, peer="prefix-a", redial_attempts=5))
+        wb = _spawn_worker("prefix-b1", _prefix_worker_cfg(
+            tmp_path, "pb1", hub.port, peer="prefix-b"))
+        wb2 = None
+        journal_cursor = JOURNAL.export_since(0)[0]
+        try:
+            link_a = hub.link_for("prefix-a", timeout_s=120.0)
+            link_b = hub.link_for("prefix-b", timeout_s=120.0)
+            # B's process startup can exceed the liveness window while A
+            # sits unpumped — restart both pong clocks now that both links
+            # exist, so neither starts life already expired.
+            link_a._last_pong_at = link_a.clock()
+            link_b._last_pong_at = link_b.clock()
+            pool_a = T.RemotePool(link_a, name="prefix-pool-a")
+            pool_b = T.RemotePool(link_b, name="prefix-pool-b")
+            index = FP.FleetPrefixIndex()
+            tier = FP.FleetPrefixTier(index, pull_timeout_s=8.0)
+            tier.attach_remote_owner("prefix-a", link_a, pull_timeout_s=8.0)
+            tier.attach_remote_owner("prefix-b", link_b, pull_timeout_s=8.0)
+
+            def drive(cond, timeout=60.0, msg=""):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    hub.poll()
+                    for p in (pool_a, pool_b):
+                        p.tick()
+                    tier.tick()
+                    if cond():
+                        return
+                    time.sleep(0.005)
+                raise AssertionError(f"drive timed out: {msg}")
+
+            def deepest(owner):
+                return max([e.n_tokens for e in index._entries.values()
+                            if e.owner == owner], default=0)
+
+            # Warm both owners via REAL remote serves; refs are each
+            # owner's own cold decode of its prompt.
+            done_a, done_b = [], []
+            pool_a.submit(PREFIX_PROMPT, 6, seed=3)
+            pool_b.submit(PROMPT_B, 6, seed=7)
+            drive(lambda: (done_a.extend(pool_a.completions()) or
+                           done_b.extend(pool_b.completions()) or
+                           (done_a and done_b)), 120.0, "warm serves")
+            ref_a = list(done_a[0].generated)
+            ref_b = list(done_b[0].generated)
+            # ... and the wire gossip populates the index (mid-gossip from
+            # here on: publishes are still in flight when the partition
+            # lands).
+            drive(lambda: deepest("prefix-a") >= 12 and
+                  deepest("prefix-b") >= 12, 60.0, "gossip warm-up")
+            epoch_a1 = index.owner_epoch["prefix-a"]
+            epoch_b1 = index.owner_epoch["prefix-b"]
+
+            # (a) One-way partition supervisor→A mid-gossip: A's frames
+            # still arrive, ours silently vanish -> liveness expiry.
+            inj.arm(FaultProfile(sock_partition_rate=1.0,
+                                 peers=("prefix-a",)))
+            drive(lambda: link_a.dead, 60.0, "partition liveness expiry")
+            assert link_a.breaker.state == CircuitBreaker.OPEN
+            assert not tier.owner_available("prefix-a")
+            # Degraded, reason-coded, never lost: placement skips the
+            # unreachable owner and the stream serves cold bit-equal.
+            part = _paged(params, prefix_cache_blocks=24)
+            got = tier.prepare("local-p", part, PREFIX_PROMPT, max_tokens=6)
+            assert got == "cold"
+            assert tier.fallbacks.get("breaker_open", 0) >= 1
+            (c,) = part.pump([{"prompt": list(PREFIX_PROMPT),
+                               "max_tokens": 6, "seed": 3}])
+            assert c.status == "ok" and list(c.generated) == ref_a
+
+            # Heal: disarm the partition; the worker survived (only its
+            # conn died), redials, and the reconnect bumps the epoch and
+            # requests the anti-entropy digest.
+            inj.disarm()
+            drive(lambda: not link_a.dead and
+                  index.owner_epoch["prefix-a"] > epoch_a1 and
+                  deepest("prefix-a") >= 12 and
+                  all(e.epoch == index.owner_epoch["prefix-a"]
+                      for e in index._entries.values()
+                      if e.owner == "prefix-a"),
+                  60.0, "anti-entropy heal")
+            assert link_a.reconnects >= 1
+            assert index.fenced_total > 0  # stale epoch-1 entries fenced
+            # Pulls resume bit-equal across the healed link.
+            healed = _paged(params, prefix_cache_blocks=24)
+            got = tier.prepare("local-h", healed, PREFIX_PROMPT, max_tokens=6)
+            assert got == "remote"
+            assert healed.local_prefix_depth(PREFIX_PROMPT) == 12
+            (c,) = healed.pump([{"prompt": list(PREFIX_PROMPT),
+                                 "max_tokens": 6, "seed": 3}])
+            assert list(c.generated) == ref_a  # bit-equal after heal
+
+            # (b) SIGKILL owner B; the next pull discovers death mid-pull
+            # and walks the ladder — degraded, never lost.
+            wb.proc.kill()
+            coldb = _paged(params, prefix_cache_blocks=24)
+            got = tier.prepare("local-c", coldb, PROMPT_B, max_tokens=6)
+            assert got == "cold"
+            assert tier.fallbacks.get("owner_dead", 0) >= 1
+            assert deepest("prefix-b") == 0  # footprint invalidated
+            (c,) = coldb.pump([{"prompt": list(PROMPT_B),
+                                "max_tokens": 6, "seed": 7}])
+            assert c.status == "ok" and list(c.generated) == ref_b
+
+            # Replacement process, SAME name, EMPTY store: reconnect bumps
+            # the epoch and its empty digest keeps the index clean.
+            wb2 = _spawn_worker("prefix-b2", _prefix_worker_cfg(
+                tmp_path, "pb2", hub.port, peer="prefix-b"))
+            drive(lambda: not link_b.dead and
+                  index.owner_epoch["prefix-b"] > epoch_b1, 120.0,
+                  "replacement reconnect")
+            epoch_b2 = index.owner_epoch["prefix-b"]
+
+            # Stale-hint storm at the dead epoch: every event fences off
+            # the index whole — zero wrong-KV routes possible.
+            fenced_before = index.fenced_total
+            for i in range(50):
+                ok = index.ingest_publish("prefix-b", epoch_b1, {
+                    "key": f"stale-{i}", "n_tokens": 12, "block_size": 4,
+                    "kv_dtype": "float32",
+                })
+                assert ok is False
+            assert index.fenced_total == fenced_before + 50
+            assert deepest("prefix-b") == 0
+            doc = parse_prom_text(REGISTRY.render())
+            assert doc["tpu_fleet_prefix_epoch_fences_total"][()] >= 50.0
+            assert doc["tpu_fleet_prefix_pub_total"][
+                (("outcome", "fenced"),)] >= 50.0
+
+            # The replacement serves and gossips at the NEW epoch; a pull
+            # from it is bit-equal to the dead owner's decode (same params
+            # and seed — the epoch fences state, not determinism).
+            done_b2 = []
+            pool_b.submit(PROMPT_B, 6, seed=7)
+            drive(lambda: (done_b2.extend(pool_b.completions()) or
+                           done_b2), 120.0, "replacement warm serve")
+            assert list(done_b2[0].generated) == ref_b
+            drive(lambda: deepest("prefix-b") >= 12, 60.0,
+                  "replacement gossip")
+            replaced = _paged(params, prefix_cache_blocks=24)
+            got = tier.prepare("local-r", replaced, PROMPT_B, max_tokens=6)
+            assert got == "remote"
+            (c,) = replaced.pump([{"prompt": list(PROMPT_B),
+                                   "max_tokens": 6, "seed": 7}])
+            assert list(c.generated) == ref_b
+
+            # Balanced ledgers: nothing pinned, nothing leaked.
+            assert index.ledger().pinned == 0
+            # One journal correlation per pull: every prefix.pull event
+            # this test produced carries a unique prefix-pull-N correlation.
+            _, since = JOURNAL.export_since(journal_cursor)
+            pulls = [e for e in since if e["event"] == "prefix.pull"]
+            assert pulls, "pulls left no journal trail"
+            corrs = [e["correlation"] for e in pulls]
+            assert all(c.startswith("prefix-pull-") for c in corrs)
+            assert len(corrs) == len(set(corrs))
+        finally:
+            for w in (wa, wb, wb2):
+                if w is not None:
+                    w.kill()
             hub.close()

@@ -449,7 +449,13 @@ METRIC_LABEL_KEYS = frozenset({
     "scheduler",
     # fleet prefix-cache tier (models/fleet_prefix.py): hit provenance is
     # the closed {local, remote} set — tpu_fleet_prefix_hits_total{source=}
-    # splits reuse by where the KV came from, never by prefix identity
+    # splits reuse by where the KV came from, never by prefix identity.
+    # The gossip/pull planes reuse the existing "outcome" key with closed
+    # sets: tpu_fleet_prefix_pub_total{outcome=} takes {shipped, shed,
+    # ingested, withdrawn, fenced, decode_drop} (publisher shipping vs
+    # supervisor ingest verdicts), and
+    # tpu_fleet_prefix_pull_admission_total{outcome=} takes {admitted,
+    # refused, bypass} (the KV-demand ledger's pull-window verdicts)
     "source",
 })
 METRIC_LABEL_PREFIXES = (

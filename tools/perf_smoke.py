@@ -1275,6 +1275,128 @@ def check_prefix_fleet_overhead() -> dict:
     return stats
 
 
+# The gossip publisher's bet (models/fleet_prefix.py PrefixGossip): the
+# PREFIXPUB/PREFIXWDL plane is pure host-side dict/json work riding the
+# worker pump cadence — a gossip-attached engine dispatches EXACTLY the
+# bare engine's device work, and a publish storm ships under the TELEM
+# byte budget with the shallow tail priority-shed (delayed, never lost).
+def check_prefix_gossip_overhead() -> dict:
+    """Budget guard for the wire gossip plane: zero added host syncs on a
+    gossip-attached engine, every shipped frame under GOSSIP_BUDGET_BYTES,
+    and storm shedding accounted — shed events requeue and drain."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import burnin, fleet_prefix, paged
+
+    cfg = burnin.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=64
+    )
+    params = burnin.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return paged.PagedServeEngine(
+            params=params, cfg=cfg, n_slots=4, n_blocks=64, block_size=4,
+            prompt_bucket=16, attn_impl="xla", sync_interval=8,
+            prefix_cache_blocks=16,
+        )
+
+    prompts = [[(17 * i + 3 * j + 1) % 63 + 1 for j in range(10)]
+               for i in range(8)]
+    reqs = [{"prompt": p, "max_tokens": 8} for p in prompts]
+    engine().pump([dict(r) for r in reqs[:1]])  # compile off the clock
+
+    bare_eng = engine()
+    done_bare = bare_eng.pump([dict(r) for r in reqs])
+
+    frames: list = []
+    gossiped_eng = engine()
+    gossip = fleet_prefix.PrefixGossip(
+        lambda kind, body: frames.append((kind, body)))
+    gossip.bind_engine(gossiped_eng)
+    gossip.resync(1)
+    done_gossiped = gossiped_eng.pump([dict(r) for r in reqs])
+    gossip.maybe_ship(force=True)
+
+    stats = {
+        "requests_bare": len(done_bare),
+        "requests_gossiped": len(done_gossiped),
+        "host_syncs_bare": bare_eng.host_syncs,
+        "host_syncs_gossiped": gossiped_eng.host_syncs,
+        "shipped_frames": gossip.shipped_frames,
+        "max_frame_bytes": gossip.max_frame_bytes,
+        "budget_bytes": fleet_prefix.GOSSIP_BUDGET_BYTES,
+    }
+    if len(done_gossiped) != len(reqs) or len(done_bare) != len(reqs):
+        raise PerfBudgetError(
+            f"gossip overhead run drained {len(done_gossiped)}/{len(reqs)} "
+            f"gossiped vs {len(done_bare)} bare"
+        )
+    if gossiped_eng.host_syncs != bare_eng.host_syncs:
+        raise PerfBudgetError(
+            f"gossip publisher added device work: "
+            f"{gossiped_eng.host_syncs} host syncs gossiped vs "
+            f"{bare_eng.host_syncs} bare — note_store/note_evict must stay "
+            f"host-side dict work"
+        )
+    if gossip.shipped_frames == 0 or not gossip._held:
+        raise PerfBudgetError(
+            "gossip-attached engine shipped nothing — the on_prefix_store "
+            "hook came unwired, so the overhead being measured is not the "
+            "publisher's"
+        )
+    if gossip.max_frame_bytes > fleet_prefix.GOSSIP_BUDGET_BYTES:
+        raise PerfBudgetError(
+            f"gossip frame of {gossip.max_frame_bytes}B exceeds the "
+            f"{fleet_prefix.GOSSIP_BUDGET_BYTES}B budget"
+        )
+
+    # Publish storm under a tiny budget: deepest rungs ship first, the
+    # shallow tail is SHED (accounted) and drains on later ticks — the
+    # budget bounds frame size, never loses a publish.
+    storm_frames: list = []
+    storm = fleet_prefix.PrefixGossip(
+        lambda kind, body: storm_frames.append(body), budget_bytes=2048)
+    storm.resync(1)
+    geom = {"block_size": 4, "kv_dtype": "float32", "n_layers": 1,
+            "kv_heads": 2, "head_dim": 16}
+    for i in range(200):
+        storm.note_store(tuple(range(i + 1)), i + 1, 0, geom)
+    storm.maybe_ship(force=True)
+    stats["storm_shed_total"] = storm.shed_total
+    stats["storm_max_frame_bytes"] = storm.max_frame_bytes
+    if storm.shed_total == 0:
+        raise PerfBudgetError(
+            "publish storm shed nothing under a 2KiB budget — priority "
+            "shedding is unwired, so frame sizes are unbounded"
+        )
+    if storm.max_frame_bytes > 2048:
+        raise PerfBudgetError(
+            f"storm frame of {storm.max_frame_bytes}B exceeds its 2048B "
+            f"budget — shedding is not bounding the frame"
+        )
+    drain_ships = 0
+    while storm.pending():
+        if storm.maybe_ship(force=True) == 0:
+            raise PerfBudgetError(
+                "shed publishes stopped draining — 'delayed, never lost' "
+                "is broken"
+            )
+        drain_ships += 1
+        if drain_ships > 10_000:
+            raise PerfBudgetError("shed drain did not converge")
+    stats["storm_drain_frames"] = drain_ships
+    total_events = sum(
+        len(json.loads(f[fleet_prefix._GOSSIP_HEADER_BYTES:])["events"])
+        for f in storm_frames
+    )
+    if total_events != 200:
+        raise PerfBudgetError(
+            f"storm shipped {total_events}/200 publish events — shed "
+            f"events were lost, not delayed"
+        )
+    return stats
+
+
 def main() -> int:
     try:
         stats = check()
@@ -1291,6 +1413,7 @@ def main() -> int:
         stats["quantized_decode"] = check_quantized_decode()
         stats["ondevice_sampling"] = check_ondevice_sampling()
         stats["prefix_fleet_overhead"] = check_prefix_fleet_overhead()
+        stats["prefix_gossip_overhead"] = check_prefix_gossip_overhead()
     except PerfBudgetError as exc:
         print(f"perf-smoke FAILED: {exc}", file=sys.stderr)
         return 1
